@@ -1,0 +1,32 @@
+"""lir_tpu — TPU-native framework for LLM interpretation-reliability studies.
+
+A brand-new JAX/XLA/pjit framework with the capabilities of the reference
+``thechoipolloi/llm-interpretation-replication`` codebase (replication code for
+"Large Language Models Are Unreliable Legal Interpreters"):
+
+- prompt-perturbation generation + scoring sweeps (reference:
+  analysis/perturb_prompts.py) executed as batched, sharded forward passes on a
+  TPU mesh instead of the OpenAI Batch API;
+- yes/no token relative-probability measurement across open-weight model zoos
+  (reference: analysis/compare_base_vs_instruct.py,
+  analysis/compare_instruct_models.py) via jitted scan decoding;
+- the full downstream statistical pipeline — Cohen's kappa, bootstrap CIs,
+  truncated-normal MC fits, human-survey agreement — vectorized with jax.vmap
+  (reference: analysis/analyze_*.py, survey_analysis/*).
+
+Layout (see SURVEY.md section 7):
+  config.py   — dataclass config, backend switch "api" | "tpu"
+  data/       — canonical prompt/question assets + row schemas (the file API)
+  models/     — pure-JAX transformer families + HF safetensors loaders
+  ops/        — core numeric ops (attention, norms, rotary, sampling readouts)
+  parallel/   — Mesh construction, NamedSharding rules, collectives helpers
+  engine/     — scoring/generation/grid/runner: the sweep executor
+  stats/      — vmapped statistics kernels (bootstrap, kappa, fits, agreement)
+  analysis/   — drivers regenerating every reference analysis artifact
+  survey/     — human-survey loading/exclusions/matching/consolidated analysis
+  report/     — figures + LaTeX emitters
+  backends/   — inference backends: local TPU (default) and optional remote API
+  utils/      — manifest/resume, logging, io
+"""
+
+__version__ = "0.1.0"

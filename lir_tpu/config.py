@@ -1,0 +1,137 @@
+"""Central configuration for lir_tpu.
+
+The reference scatters configuration across module-level CAPITALIZED constants,
+``.env`` secrets, and hard-coded personal paths (reference:
+analysis/perturb_prompts.py:19-65, analysis/config.py:1-16,
+analysis/compare_base_vs_instruct.py:129-132). Here all of it is one dataclass
+tree with a single ``backend`` switch ("tpu" | "api") as mandated by the north
+star (BASELINE.json). No secrets live in code: the optional API backend reads
+keys from the environment at call time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+from typing import Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh shape for pjit sharding.
+
+    Axis names follow the scaling-book convention: ``data`` for batch/grid
+    parallelism, ``model`` for tensor parallelism (attention heads / MLP
+    columns), ``seq`` for sequence (ring/context) parallelism. Any axis can be
+    1. The product must equal the number of devices used.
+    """
+
+    data: int = 1
+    model: int = 1
+    seq: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("data", "model", "seq")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.data, self.model, self.seq)
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.model * self.seq
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    """Numerics + execution knobs for the inference engine."""
+
+    dtype: str = "bfloat16"           # parameter/activation dtype on TPU
+    logits_dtype: str = "float32"     # final logits always accumulated in fp32
+    max_new_tokens: int = 50          # reference: compare_base_vs_instruct.py:253
+    scan_positions: int = 10          # MAX_LOOK_AHEAD, compare_base_vs_instruct.py:187
+    topk_match: int = 2               # top-2 yes/no match rule, :270-273
+    batch_size: int = 32              # padded scoring batch per device step
+    max_seq_len: int = 1024           # legal prompt + format ≲ 700 tokens (SURVEY §5)
+    remat: bool = False               # jax.checkpoint the blocks for big models
+
+
+@dataclasses.dataclass(frozen=True)
+class PerturbationConfig:
+    """Perturbation-sweep scale parameters (reference: perturb_prompts.py)."""
+
+    sessions_per_prompt: int = 100      # :787-788
+    rephrasings_per_session: int = 20   # numbered 1..20
+    rephrase_temperature: float = 0.9   # :802
+    reasoning_model_runs: int = 10      # REASONING_MODEL_RUNS, :47
+    max_batch_size: int = 50_000        # MAX_BATCH_SIZE, :29
+    subset_size: Optional[int] = None   # PROCESS_RANDOM_SUBSET/SUBSET_SIZE, :31-33
+    seed: int = 42                      # RANDOM_SEED, :34
+
+
+@dataclasses.dataclass(frozen=True)
+class StatsConfig:
+    """Bootstrap / MC budgets (BASELINE.md table)."""
+
+    bootstrap_large: int = 10_000   # simulated-individual CIs, diff CIs, family MC
+    bootstrap_standard: int = 1_000 # Pearson CIs, corr matrices, kappa CIs, QQ bands
+    bootstrap_small: int = 100      # cross-prompt, respondent-resample
+    truncnorm_samples: int = 100_000  # analyze_perturbation_results.py:113
+    truncnorm_max_iter: int = 30
+    truncnorm_damping: float = 0.5
+    truncnorm_tol: float = 1e-4
+    seed: int = 42
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryConfig:
+    """Exponential-backoff policy (reference: perturb_prompts.py:72-106)."""
+
+    max_retries: int = 10
+    initial_delay: float = 60.0
+    max_delay: float = 300.0
+    backoff_factor: float = 1.5
+    jitter: Tuple[float, float] = (0.8, 1.2)
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Top-level framework configuration."""
+
+    backend: str = "tpu"  # "tpu" (local JAX inference) | "api" (remote, optional)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    runtime: RuntimeConfig = dataclasses.field(default_factory=RuntimeConfig)
+    perturbation: PerturbationConfig = dataclasses.field(default_factory=PerturbationConfig)
+    stats: StatsConfig = dataclasses.field(default_factory=StatsConfig)
+    retry: RetryConfig = dataclasses.field(default_factory=RetryConfig)
+
+    # Paths: everything under one results root; no personal gdrive paths.
+    results_dir: Path = Path("results")
+    data_dir: Path = Path("data")
+    checkpoint_dir: Path = Path("checkpoints")
+
+    # Models under test (HF repo ids or registry names).
+    models: Sequence[str] = ()
+
+    def __post_init__(self) -> None:
+        if self.backend not in ("tpu", "api"):
+            raise ValueError(f"backend must be 'tpu' or 'api', got {self.backend!r}")
+
+    @staticmethod
+    def api_key(name: str) -> str:
+        """Read a secret from the environment (reference: analysis/config.py:6-16).
+
+        Raised lazily, only when the optional API backend is actually used.
+        """
+        val = os.environ.get(name, "")
+        if not val:
+            raise RuntimeError(
+                f"{name} not set. The 'api' backend needs it; the default 'tpu' "
+                "backend performs zero external API calls."
+            )
+        return val
+
+
+DEFAULT_CONFIG = Config()

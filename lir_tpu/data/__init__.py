@@ -1,0 +1,23 @@
+from lir_tpu.data.prompts import (
+    LEGAL_PROMPTS,
+    WORD_MEANING_QUESTIONS,
+    QUESTION_TO_QUALTRICS,
+    QUALTRICS_TO_QUESTION,
+    FEW_SHOT_PREFIX,
+    LegalPrompt,
+    format_base_prompt,
+    format_instruct_prompt,
+    rephrase_request,
+)
+
+__all__ = [
+    "LEGAL_PROMPTS",
+    "WORD_MEANING_QUESTIONS",
+    "QUESTION_TO_QUALTRICS",
+    "QUALTRICS_TO_QUESTION",
+    "FEW_SHOT_PREFIX",
+    "LegalPrompt",
+    "format_base_prompt",
+    "format_instruct_prompt",
+    "rephrase_request",
+]

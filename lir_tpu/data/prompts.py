@@ -1,0 +1,271 @@
+"""Canonical experiment prompt assets — the single source of truth.
+
+The reference hard-codes these in four+ places with drift (legal prompts:
+analysis/perturb_prompts.py:728-734; word-meaning questions:
+analysis/compare_base_vs_instruct.py:308-359 and
+analysis/compare_instruct_models.py:? (same 50); the question -> Qualtrics-ID
+mapping copy-pasted across four survey scripts, e.g.
+survey_analysis/analyze_llm_human_agreement.py:31-82). SURVEY.md §1 flags the
+duplication; here each asset exists exactly once.
+
+These are *data*, not code: the experimental stimuli whose parity defines
+capability parity with the reference study.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class LegalPrompt:
+    """One legal-interpretation stimulus (perturb_prompts.py prompt tuple).
+
+    ``target_tokens`` are the two answer-distinguishing first tokens whose
+    probabilities are read out of the logits (e.g. "Covered" vs "Not").
+    """
+
+    main: str                      # the legal question to be rephrased
+    response_format: str           # binary-answer instruction appended verbatim
+    target_tokens: Tuple[str, str]  # (token_1, token_2) for the logprob readout
+    confidence_format: str         # 0-100 confidence instruction
+
+    @property
+    def binary_prompt(self) -> str:
+        return f"{self.main} {self.response_format}"
+
+    @property
+    def confidence_prompt(self) -> str:
+        return f"{self.main} {self.confidence_format}"
+
+    def rephrased_binary(self, rephrased_main: str) -> str:
+        return f"{rephrased_main} {self.response_format}"
+
+    def rephrased_confidence(self, rephrased_main: str) -> str:
+        return f"{rephrased_main} {self.confidence_format}"
+
+
+_CONF_COVER = (
+    "How confident are you that the insurance policy should cover the loss, on a "
+    "scale from 0 (not confident) to 100 (most confident)? Answer only with a "
+    "number, without any other explanation."
+)
+
+LEGAL_PROMPTS: Tuple[LegalPrompt, ...] = (
+    LegalPrompt(
+        main=(
+            "An insurance policy contains the following exclusion: 'We will not pay "
+            "for loss or damage caused directly or indirectly by flood, surface "
+            "water, waves, tides, tidal waves, overflow of any body of water, or "
+            "their spray, all whether driven by wind or not.' If an insured files a "
+            "claim for damage caused by a levee failure rather than natural causes, "
+            "does the exclusion apply? Please analyze whether the insurance policy "
+            "would cover the damage or not."
+        ),
+        response_format=(
+            "Answer only 'Covered' if insurance covers the loss or 'Not Covered' if "
+            "it does not, without any other explanation."
+        ),
+        target_tokens=("Covered", "Not"),
+        confidence_format=_CONF_COVER,
+    ),
+    LegalPrompt(
+        main=(
+            "In a prenuptial agreement, if one party files a petition for divorce "
+            "after seven years, withdraws it without serving it, and then files "
+            "again after ten years, which filing date determines the number of "
+            "years of marriage for calculating the payment amount? The agreement "
+            "states that payment will be based on 'the number of full years they "
+            "have been married at the time a Petition for Dissolution of Marriage "
+            "is filed.' Please analyze whether 'a Petition' refers to the first or "
+            "ultimate petition filed."
+        ),
+        response_format=(
+            "Answer only 'First Petition' if the first filing date should be used "
+            "or 'Ultimate Petition' if the ultimate filing date should be used, "
+            "without any other explanation."
+        ),
+        target_tokens=("Ultimate", "First"),
+        confidence_format=(
+            "How confident are you that the first filing date should be used, on a "
+            "scale from 0 (not confident) to 100 (most confident)? Answer only "
+            "with a number, without any other explanation."
+        ),
+    ),
+    LegalPrompt(
+        main=(
+            "Does the following contract term from 1961 naturally include only "
+            "existing affiliates at the time of contract, or does it potentially "
+            "encompass affiliates that might be created over time? The term binds "
+            "[Company] and its 'other affiliate[s]' to a 50/50 royalty split after "
+            "deducting fees charged by third parties that intermediate in foreign "
+            "markets. Please analyze whether the term 'other affiliate[s]' "
+            "includes only existing affiliates or includes future affiliates as "
+            "well."
+        ),
+        response_format=(
+            "Answer only 'Existing Affiliates' or 'Future Affiliates', without any "
+            "other explanation."
+        ),
+        target_tokens=("Existing", "Future"),
+        confidence_format=(
+            "How confident are you that the royalty split only includes existing "
+            "affiliates, on a scale from 0 (not confident) to 100 (most "
+            "confident)? Answer only with a number, without any other explanation."
+        ),
+    ),
+    LegalPrompt(
+        main=(
+            "A contractor and business corresponded about construction of a new "
+            "foundry. The contractor offered to do the job either by offering an "
+            "itemized list or charging cost + 10%. After a phone call where they "
+            "allegedly agreed payment would be made 'in the usual manner', the "
+            "foundry accepted in writing. If one party claims it is customary to "
+            "pay 85% of payments due at the end of every month, but the other "
+            "argues payments are only due upon substantial completion, how should "
+            "the term 'usual manner' be interpreted? Does this term refer to the "
+            "monthly installment payments or to payment upon completion?"
+        ),
+        response_format=(
+            "Answer only 'Monthly Installment Payments' or 'Payment Upon "
+            "Completion', without any other explanation."
+        ),
+        target_tokens=("Monthly", "Payment"),
+        confidence_format=(
+            "How confident are you that the payments should be made every month, "
+            "on a scale from 0 (not confident) to 100 (most confident)? Answer "
+            "only with a number, without any other explanation."
+        ),
+    ),
+    LegalPrompt(
+        main=(
+            "You are analyzing an insurance policy dispute. The policy states: "
+            "'[Insurer will pay for] the felonious abstraction of insured property "
+            "(1) from within the premises by a person making felonious entry "
+            "therein by actual force and violence, of which force and violence "
+            "there are visible marks made by tools, explosives, electricity or "
+            "chemicals.' A business has experienced a theft where there is clear "
+            "evidence that a third party committed the burglary. No inside job is "
+            "suspected. Based on these terms, would this policy provide "
+            "compensation for losses resulting from this substantiated third-party "
+            "burglary? Please analyze whether coverage would be provided."
+        ),
+        response_format=(
+            "Answer only 'Covered' if insurance covers the loss or 'Not Covered' "
+            "if it does not, without any other explanation."
+        ),
+        target_tokens=("Covered", "Not"),
+        confidence_format=_CONF_COVER,
+    ),
+)
+
+
+# The 50 "Is an X a Y?" word-meaning questions, in survey order
+# (compare_base_vs_instruct.py:308-359). Keys of QUESTION_TO_QUALTRICS map each
+# to its Qualtrics slider column; Q*_8 is the attention check and has no
+# question.
+WORD_MEANING_QUESTIONS: Tuple[str, ...] = (
+    'Is a "screenshot" a "photograph"?',
+    'Is "advising" someone "instructing" them?',
+    'Is an "algorithm" a "procedure"?',
+    'Is a "drone" an "aircraft"?',
+    'Is "reading aloud" a form of "performance"?',
+    'Is "training" an AI model "authoring" content?',
+    'Is a "wedding" a "party"?',
+    'Is "streaming" a video "broadcasting" that video?',
+    'Is "braiding" hair a form of "weaving"?',
+    'Is "digging" a form of "construction"?',
+    'Is a "smartphone" a "computer"?',
+    'Is a "cactus" a "tree"?',
+    'Is a "bonus" a form of "wages"?',
+    'Is "forwarding" an email "sending" that email?',
+    'Is a "chatbot" a "service"?',
+    'Is "plagiarism" a form of "theft"?',
+    'Is "remote viewing" of an event "attending" it?',
+    'Is "whistling" a form of "music"?',
+    'Is "caching" data in computer memory "storing" that data?',
+    'Is a "waterway" a form of "roadway"?',
+    'Is a "deepfake" a "portrait"?',
+    'Is "humming" a form of "singing"?',
+    'Is "liking" a social media post "endorsing" it?',
+    'Is "herding" animals a form of "transporting" them?',
+    'Is an "NFT" a "security"?',
+    'Is "sleeping" an "activity"?',
+    'Is a "driverless car" a "motor vehicle operator"?',
+    'Is a "subscription fee" a form of "purchase"?',
+    'Is "mentoring" someone a form of "supervising" them?',
+    'Is a "biometric scan" a form of "signature"?',
+    'Is a "digital wallet" a "bank account"?',
+    'Is "dictation" a form of "writing"?',
+    'Is a "virtual tour" a form of "inspection"?',
+    'Is "bartering" a form of "payment"?',
+    'Is "listening" to an audiobook "reading" it?',
+    'Is a "nest" a form of "dwelling"?',
+    'Is a "QR code" a "document"?',
+    'Is a "tent" a "building"?',
+    'Is a "whisper" a form of "speech"?',
+    'Is "hiking" a form of "travel"?',
+    'Is a "recipe" a form of "instruction"?',
+    'Is "daydreaming" a form of "thinking"?',
+    'Is "gossip" a form of "news"?',
+    'Is a "mountain" a form of "hill"?',
+    'Is "walking" a form of "exercise"?',
+    'Is a "candle" a "lamp"?',
+    'Is a "trail" a "road"?',
+    'Is "repainting" a house "repairing" it?',
+    'Is "kneeling" a form of "sitting"?',
+    'Is a "mask" a form of "clothing"?',
+)
+
+
+def _qualtrics_ids():
+    # 5 groups x 11 sliders; column 8 is the attention check, skipped.
+    ids = []
+    for group in range(1, 6):
+        for col in list(range(1, 8)) + list(range(9, 12)):
+            ids.append(f"Q{group}_{col}")
+    return tuple(ids)
+
+
+QUESTION_TO_QUALTRICS: Dict[str, str] = dict(
+    zip(WORD_MEANING_QUESTIONS, _qualtrics_ids())
+)
+QUALTRICS_TO_QUESTION: Dict[str, str] = {
+    v: k for k, v in QUESTION_TO_QUALTRICS.items()
+}
+
+ATTENTION_CHECK_COLUMNS: Tuple[str, ...] = tuple(f"Q{g}_8" for g in range(1, 6))
+
+# Few-shot scaffold used for base (non-instruct) models
+# (compare_base_vs_instruct.py:458-463).
+FEW_SHOT_PREFIX = (
+    "Question: Is \"soup\" a \"beverage\"? Answer either 'Yes' or 'No', without "
+    "any other text.\nAnswer: No.\n\n"
+    "Question: Is a \"tweet\" a \"publication\"? Answer either 'Yes' or 'No', "
+    "without any other text.\nAnswer: Yes.\n\n"
+)
+
+_ANSWER_SUFFIX = " Answer either 'Yes' or 'No', without any other text."
+
+
+def format_base_prompt(question: str) -> str:
+    """Few-shot 'Question:/Answer:' scaffold for base models."""
+    return f"{FEW_SHOT_PREFIX}Question: {question}{_ANSWER_SUFFIX}\nAnswer:"
+
+
+def format_instruct_prompt(question: str) -> str:
+    """Direct question for instruction-tuned models."""
+    return f"{FEW_SHOT_PREFIX}{question}{_ANSWER_SUFFIX}"
+
+
+def rephrase_request(main_prompt: str, n: int = 20) -> str:
+    """Rephrasing instruction given to the perturbation-generator model
+    (perturb_prompts.py:791-797); served locally by the tpu backend."""
+    return (
+        f'Here is a question:\n###"{main_prompt}"###\n'
+        f"Please rephrase this question in {n} variations that differ from the "
+        "original question but preserve the substance of the question. Each "
+        "rephrasing should be a complete question, not just a fragment of a "
+        f"question. Number each rephrasing from 1 to {n}."
+    )

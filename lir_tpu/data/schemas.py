@@ -1,0 +1,295 @@
+"""Typed row schemas for every data artifact, with readers/writers.
+
+The reference's inter-layer API is files with fixed column schemas (SURVEY.md
+§1): D1 ``model_comparison_results.csv`` (writer
+analysis/compare_base_vs_instruct.py:90-111,508-513), D2
+``instruct_model_comparison_results.csv`` (compare_instruct_models.py:103-121),
+D6 the 15-column perturbation Excel (perturb_prompts.py:964-1016), D5
+``perturbations.json`` (perturb_prompts.py:847-869). Preserving these schemas
+bit-for-bit is the parity contract; everything between producer and consumer is
+re-designed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import pandas as pd
+
+# ---------------------------------------------------------------------------
+# D1: model_comparison_results.csv (base vs instruct sweep)
+# ---------------------------------------------------------------------------
+
+MODEL_COMPARISON_COLUMNS = (
+    "prompt",
+    "model",
+    "model_family",
+    "base_or_instruct",
+    "model_output",
+    "yes_prob",
+    "no_prob",
+    "odds_ratio",
+)
+
+# D2: instruct_model_comparison_results.csv
+INSTRUCT_COMPARISON_COLUMNS = (
+    "prompt",
+    "model",
+    "model_family",
+    "model_output",
+    "yes_prob",
+    "no_prob",
+    "relative_prob",
+)
+
+# D6: perturbation results workbook, 15 columns (perturb_prompts.py:965-969)
+PERTURBATION_COLUMNS = (
+    "Model",
+    "Original Main Part",
+    "Response Format",
+    "Confidence Format",
+    "Rephrased Main Part",
+    "Full Rephrased Prompt",
+    "Full Confidence Prompt",
+    "Model Response",
+    "Model Confidence Response",
+    "Log Probabilities",
+    "Token_1_Prob",
+    "Token_2_Prob",
+    "Odds_Ratio",
+    "Confidence Value",
+    "Weighted Confidence",
+)
+
+
+def model_family(model_name: str) -> str:
+    """Family tag parsed from an HF repo id (compare_base_vs_instruct.py:96)."""
+    base = model_name.split("/")[-1]
+    return base.split("-")[0].lower()
+
+
+@dataclasses.dataclass
+class ScoreRow:
+    """One scored (model, prompt) measurement — the unified D1/D2 record.
+
+    The reference drifts between ``odds_ratio`` (= yes/no,
+    compare_base_vs_instruct.py:293) and ``relative_prob`` (= yes/(yes+no),
+    compare_instruct_models.py:281); this record carries both readouts from one
+    scoring primitive (SURVEY.md §1 seam note).
+    """
+
+    prompt: str
+    model: str
+    base_or_instruct: str          # "base" | "instruct"
+    model_output: str
+    yes_prob: float
+    no_prob: float
+    position_found: int = 0
+    yes_no_found: bool = True
+
+    @property
+    def odds_ratio(self) -> float:
+        # Reference semantics (compare_base_vs_instruct.py:293): inf whenever
+        # no_prob is zero, even if yes_prob is also zero.
+        return self.yes_prob / self.no_prob if self.no_prob > 0 else math.inf
+
+    @property
+    def relative_prob(self) -> float:
+        denom = self.yes_prob + self.no_prob
+        return self.yes_prob / denom if denom > 0 else float("nan")
+
+    @property
+    def model_family(self) -> str:
+        return model_family(self.model)
+
+
+def write_model_comparison_csv(rows: Sequence[ScoreRow], path: Path) -> pd.DataFrame:
+    """D1 writer — schema parity with compare_base_vs_instruct.py:101-110."""
+    df = pd.DataFrame(
+        [
+            {
+                "prompt": r.prompt,
+                "model": r.model,
+                "model_family": r.model_family,
+                "base_or_instruct": r.base_or_instruct,
+                "model_output": r.model_output,
+                "yes_prob": r.yes_prob,
+                "no_prob": r.no_prob,
+                "odds_ratio": r.odds_ratio,
+            }
+            for r in rows
+        ],
+        columns=list(MODEL_COMPARISON_COLUMNS),
+    )
+    path.parent.mkdir(parents=True, exist_ok=True)
+    df.to_csv(path, index=False)
+    return df
+
+
+def write_instruct_comparison_csv(rows: Sequence[ScoreRow], path: Path) -> pd.DataFrame:
+    """D2 writer — schema parity with compare_instruct_models.py:112-120."""
+    df = pd.DataFrame(
+        [
+            {
+                "prompt": r.prompt,
+                "model": r.model,
+                "model_family": r.model_family,
+                "model_output": r.model_output,
+                "yes_prob": r.yes_prob,
+                "no_prob": r.no_prob,
+                "relative_prob": r.relative_prob,
+            }
+            for r in rows
+        ],
+        columns=list(INSTRUCT_COMPARISON_COLUMNS),
+    )
+    path.parent.mkdir(parents=True, exist_ok=True)
+    df.to_csv(path, index=False)
+    return df
+
+
+@dataclasses.dataclass
+class PerturbationRow:
+    """One perturbation-grid measurement — the D6 record."""
+
+    model: str
+    original_main: str
+    response_format: str
+    confidence_format: str
+    rephrased_main: str
+    full_rephrased_prompt: str
+    full_confidence_prompt: str
+    model_response: str
+    model_confidence_response: str
+    log_probabilities: str          # stringified token->logprob mapping
+    token_1_prob: float
+    token_2_prob: float
+    confidence_value: Optional[float]
+    weighted_confidence: Optional[float]
+
+    @property
+    def odds_ratio(self) -> float:
+        if self.token_2_prob > 0:
+            return self.token_1_prob / self.token_2_prob
+        return math.inf
+
+    def to_record(self) -> Dict[str, object]:
+        return {
+            "Model": self.model,
+            "Original Main Part": self.original_main,
+            "Response Format": self.response_format,
+            "Confidence Format": self.confidence_format,
+            "Rephrased Main Part": self.rephrased_main,
+            "Full Rephrased Prompt": self.full_rephrased_prompt,
+            "Full Confidence Prompt": self.full_confidence_prompt,
+            "Model Response": self.model_response,
+            "Model Confidence Response": self.model_confidence_response,
+            "Log Probabilities": self.log_probabilities,
+            "Token_1_Prob": self.token_1_prob,
+            "Token_2_Prob": self.token_2_prob,
+            "Odds_Ratio": self.odds_ratio,
+            "Confidence Value": self.confidence_value,
+            "Weighted Confidence": self.weighted_confidence,
+        }
+
+
+def perturbation_dataframe(rows: Sequence[PerturbationRow]) -> pd.DataFrame:
+    return pd.DataFrame(
+        [r.to_record() for r in rows], columns=list(PERTURBATION_COLUMNS)
+    )
+
+
+def write_perturbation_results(
+    rows: Sequence[PerturbationRow], path: Path, append: bool = True
+) -> pd.DataFrame:
+    """D6 writer with the reference's append-with-schema-check semantics
+    (perturb_prompts.py:987-1016): if an existing file's columns mismatch, the
+    old file is backed up and a fresh one written, never silently merged."""
+    df = perturbation_dataframe(rows)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    if append and path.exists():
+        read = pd.read_excel if path.suffix == ".xlsx" else pd.read_csv
+        try:
+            existing = read(path)
+        except Exception:
+            # Corrupt/truncated prior file (e.g. a kill mid-write): keep it in
+            # place and save the fresh rows alongside, as the reference does
+            # (perturb_prompts.py:1007-1011) — never lose computed results.
+            new_path = path.with_name(path.stem + "_new" + path.suffix)
+            _write_frame(df, new_path)
+            return df
+        if list(existing.columns) == list(df.columns):
+            df = pd.concat([existing, df], ignore_index=True)
+        else:
+            backup = path.with_name(path.stem + "_backup" + path.suffix)
+            path.rename(backup)
+    _write_frame(df, path)
+    return df
+
+
+def _write_frame(df: pd.DataFrame, path: Path) -> None:
+    if path.suffix == ".xlsx":
+        df.to_excel(path, index=False)
+    else:
+        df.to_csv(path, index=False)
+
+
+# ---------------------------------------------------------------------------
+# D5: perturbations.json cache
+# ---------------------------------------------------------------------------
+
+
+def save_perturbations(
+    path: Path,
+    entries: Sequence[Tuple[Tuple[str, str, Tuple[str, str], str], List[str]]],
+) -> None:
+    """Cache format parity with perturb_prompts.py:851-866."""
+    payload = [
+        {
+            "original_main": parts[0],
+            "response_format": parts[1],
+            "target_tokens": list(parts[2]),
+            "confidence_format": parts[3],
+            "rephrasings": rephrasings,
+        }
+        for parts, rephrasings in entries
+    ]
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, ensure_ascii=False, indent=2))
+
+
+def load_perturbations(
+    path: Path,
+) -> List[Tuple[Tuple[str, str, Tuple[str, str], str], List[str]]]:
+    data = json.loads(path.read_text())
+    return [
+        (
+            (
+                item["original_main"],
+                item["response_format"],
+                tuple(item["target_tokens"]),
+                item["confidence_format"],
+            ),
+            list(item["rephrasings"]),
+        )
+        for item in data
+    ]
+
+
+def validate_perturbation_cache(
+    entries: Sequence[Tuple[Tuple[str, str, Tuple[str, str], str], List[str]]],
+    prompts,
+) -> bool:
+    """Cache-consistency rule (perturb_prompts.py:757-772): entry count and
+    every prompt tuple must match the in-code prompt list, else regenerate."""
+    if len(entries) != len(prompts):
+        return False
+    for (loaded_parts, _), p in zip(entries, prompts):
+        expected = (p.main, p.response_format, tuple(p.target_tokens), p.confidence_format)
+        if tuple(loaded_parts) != expected:
+            return False
+    return True

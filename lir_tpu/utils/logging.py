@@ -1,0 +1,52 @@
+"""Session logging: structured logger + captured-session-transcript parity.
+
+The reference tees stdout into a list and dumps it to a txt file at the end
+(``log_print``/``save_captured_output``, compare_base_vs_instruct.py:9-31,
+548-550). Here the same capability is standard logging with an attachable
+capture handler, so sweep transcripts are still written as artifacts without
+monkey-patching print.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import List, Optional
+
+_LOGGER_NAME = "lir_tpu"
+
+
+class CaptureHandler(logging.Handler):
+    def __init__(self) -> None:
+        super().__init__()
+        self.lines: List[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        self.lines.append(self.format(record))
+
+
+def get_logger(name: Optional[str] = None) -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME if name is None else f"{_LOGGER_NAME}.{name}")
+    if not logging.getLogger(_LOGGER_NAME).handlers:
+        root = logging.getLogger(_LOGGER_NAME)
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s"))
+        root.addHandler(handler)
+        root.setLevel(logging.INFO)
+    return logger
+
+
+def start_capture() -> CaptureHandler:
+    handler = CaptureHandler()
+    handler.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
+    logging.getLogger(_LOGGER_NAME).addHandler(handler)
+    return handler
+
+
+def save_captured_output(handler: CaptureHandler, path: Path) -> None:
+    """Write the captured session transcript
+    (parity: compare_base_vs_instruct.py:27-31)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("\n".join(handler.lines) + "\n")
+    logging.getLogger(_LOGGER_NAME).removeHandler(handler)

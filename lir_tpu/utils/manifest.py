@@ -1,0 +1,110 @@
+"""Resume-idempotent sweep manifest.
+
+The reference achieves preemption safety by (a) a done-set of
+(Model, Original Main Part, Rephrased Main Part) keys read from the output
+Excel (perturb_prompts.py:161-188), (b) checkpoint files every 100 rows
+(:975-984), and (c) a validated perturbation cache (:739-777). This module
+keeps those exact semantics but as an append-only JSONL manifest with atomic
+line writes, so a killed TPU sweep resumes without duplicate rows (SURVEY.md
+§7 hard part 7).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, Optional, Set, Tuple
+
+import pandas as pd
+
+Key = Tuple[str, ...]
+
+
+class SweepManifest:
+    """Append-only record of completed grid cells, keyed by string tuples."""
+
+    def __init__(self, path: Path, key_fields: Tuple[str, ...]):
+        self.path = Path(path)
+        self.key_fields = key_fields
+        self._done: Set[Key] = set()
+        if self.path.exists():
+            for line in self.path.read_text().splitlines():
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                self._done.add(tuple(str(rec[f]) for f in key_fields))
+
+    def __len__(self) -> int:
+        return len(self._done)
+
+    def key_of(self, record: Dict[str, object]) -> Key:
+        return tuple(str(record[f]) for f in self.key_fields)
+
+    def is_done(self, record: Dict[str, object]) -> bool:
+        return self.key_of(record) in self._done
+
+    def mark_done(self, record: Dict[str, object]) -> None:
+        self.mark_done_many([record])
+
+    def mark_done_many(self, records: Iterable[Dict[str, object]]) -> None:
+        """Append all not-yet-done keys in one open + single fsync."""
+        lines = []
+        for record in records:
+            key = self.key_of(record)
+            if key in self._done:
+                continue
+            self._done.add(key)
+            lines.append(json.dumps(dict(zip(self.key_fields, key))))
+        if not lines:
+            return
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with self.path.open("a") as f:
+            f.write("\n".join(lines) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def pending(self, records: Iterable[Dict[str, object]]) -> Iterator[Dict[str, object]]:
+        for rec in records:
+            if not self.is_done(rec):
+                yield rec
+
+    @classmethod
+    def from_existing_results(
+        cls,
+        manifest_path: Path,
+        results_path: Optional[Path],
+        key_fields: Tuple[str, ...],
+    ) -> "SweepManifest":
+        """Seed the done-set from a prior results file, mirroring
+        load_existing_results (perturb_prompts.py:161-188)."""
+        m = cls(manifest_path, key_fields)
+        if results_path is not None and Path(results_path).exists():
+            read = pd.read_excel if str(results_path).endswith(".xlsx") else pd.read_csv
+            df = read(results_path)
+            if all(f in df.columns for f in key_fields):
+                m.mark_done_many(
+                    {f: row[f] for f in key_fields} for _, row in df.iterrows()
+                )
+        return m
+
+
+def atomic_write_text(path: Path, text: str) -> None:
+    """Crash-safe file replacement (orbax-style atomicity for result shards)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=path.name + ".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def atomic_write_json(path: Path, obj) -> None:
+    atomic_write_text(path, json.dumps(obj, ensure_ascii=False, indent=2))

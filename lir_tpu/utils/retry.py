@@ -1,0 +1,42 @@
+"""Exponential-backoff retry (reference: perturb_prompts.py:72-106).
+
+Generic over exception types so the same policy covers the optional remote-API
+backend and any transient local failure (e.g. filesystem hiccups on a
+preemptible host). Policy parity: 10 retries, 60 s initial delay capped at
+300 s, x1.5 backoff, uniform 0.8-1.2 jitter.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+from lir_tpu.config import RetryConfig
+
+T = TypeVar("T")
+
+
+def retry_with_exponential_backoff(
+    fn: Callable[[], T],
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    config: RetryConfig = RetryConfig(),
+    sleep: Callable[[float], None] = time.sleep,
+    log: Callable[[str], None] = print,
+) -> T:
+    delay = config.initial_delay
+    for attempt in range(config.max_retries + 1):
+        try:
+            return fn()
+        except retry_on as exc:
+            if attempt == config.max_retries:
+                raise
+            jitter = random.uniform(*config.jitter)
+            wait = min(delay * jitter, config.max_delay)
+            log(
+                f"Attempt {attempt + 1}/{config.max_retries} failed "
+                f"({type(exc).__name__}: {exc}); retrying in {wait:.1f}s"
+            )
+            sleep(wait)
+            delay = min(delay * config.backoff_factor, config.max_delay)
+    raise AssertionError("unreachable")

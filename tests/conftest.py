@@ -1,0 +1,33 @@
+"""Test configuration: force JAX onto 8 virtual CPU devices.
+
+This exercises the same Mesh/pjit code paths as a v5e-8 slice without TPU
+hardware (SURVEY.md §4). Must run before the first `import jax` anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_DATA = "/root/reference/data"
+
+
+@pytest.fixture(scope="session")
+def reference_data_dir():
+    """Golden reference CSVs; skip golden-parity tests when not mounted."""
+    if not os.path.isdir(REFERENCE_DATA):
+        pytest.skip("reference data not available")
+    return REFERENCE_DATA
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

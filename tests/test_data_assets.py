@@ -1,0 +1,62 @@
+"""Data-asset integrity: the experiment stimuli match the reference study."""
+
+import pandas as pd
+import pytest
+
+from lir_tpu.data import (
+    LEGAL_PROMPTS,
+    QUALTRICS_TO_QUESTION,
+    QUESTION_TO_QUALTRICS,
+    WORD_MEANING_QUESTIONS,
+    format_base_prompt,
+    format_instruct_prompt,
+)
+from lir_tpu.data.prompts import ATTENTION_CHECK_COLUMNS
+
+
+def test_counts():
+    assert len(LEGAL_PROMPTS) == 5
+    assert len(WORD_MEANING_QUESTIONS) == 50
+    assert len(QUESTION_TO_QUALTRICS) == 50
+    assert len(QUALTRICS_TO_QUESTION) == 50
+    assert len(ATTENTION_CHECK_COLUMNS) == 5
+
+
+def test_qualtrics_mapping_shape():
+    # 5 groups x 10 substantive sliders, attention column (x_8) never mapped.
+    ids = set(QUESTION_TO_QUALTRICS.values())
+    assert len(ids) == 50
+    for q_id in ids:
+        group, col = q_id[1:].split("_")
+        assert 1 <= int(group) <= 5
+        assert int(col) != 8
+        assert 1 <= int(col) <= 11
+    assert QUESTION_TO_QUALTRICS['Is a "screenshot" a "photograph"?'] == "Q1_1"
+    assert QUESTION_TO_QUALTRICS['Is "streaming" a video "broadcasting" that video?'] == "Q1_9"
+    assert QUESTION_TO_QUALTRICS['Is a "mask" a form of "clothing"?'] == "Q5_11"
+
+
+def test_target_tokens():
+    firsts = [p.target_tokens for p in LEGAL_PROMPTS]
+    assert firsts[0] == ("Covered", "Not")
+    assert firsts[1] == ("Ultimate", "First")
+    assert firsts[2] == ("Existing", "Future")
+    assert firsts[3] == ("Monthly", "Payment")
+    assert firsts[4] == ("Covered", "Not")
+
+
+def test_prompt_formatting():
+    q = WORD_MEANING_QUESTIONS[0]
+    base = format_base_prompt(q)
+    instr = format_instruct_prompt(q)
+    assert base.endswith("\nAnswer:")
+    assert q in base and q in instr
+    assert base.count("Question:") == 3  # 2 few-shot + 1 target
+    assert "soup" in base and "tweet" in base
+
+
+def test_questions_match_reference_csv(reference_data_dir):
+    """Questions must cover the committed golden CSV's prompt set."""
+    df = pd.read_csv(f"{reference_data_dir}/instruct_model_comparison_results.csv")
+    assert set(df["prompt"].unique()) <= set(WORD_MEANING_QUESTIONS)
+    assert len(set(df["prompt"].unique())) == 50

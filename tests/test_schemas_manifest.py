@@ -1,0 +1,171 @@
+"""Schema writers + resume manifest + retry policy."""
+
+import math
+
+import pandas as pd
+import pytest
+
+from lir_tpu.data import LEGAL_PROMPTS
+from lir_tpu.data.schemas import (
+    INSTRUCT_COMPARISON_COLUMNS,
+    MODEL_COMPARISON_COLUMNS,
+    PERTURBATION_COLUMNS,
+    PerturbationRow,
+    ScoreRow,
+    load_perturbations,
+    save_perturbations,
+    validate_perturbation_cache,
+    write_instruct_comparison_csv,
+    write_model_comparison_csv,
+    write_perturbation_results,
+)
+from lir_tpu.utils.manifest import SweepManifest, atomic_write_json
+from lir_tpu.utils.retry import retry_with_exponential_backoff
+from lir_tpu.config import RetryConfig
+
+
+def _row(prompt="Is a \"tent\" a \"building\"?", model="org/model-7b-instruct"):
+    return ScoreRow(
+        prompt=prompt,
+        model=model,
+        base_or_instruct="instruct",
+        model_output="Yes.",
+        yes_prob=0.6,
+        no_prob=0.2,
+    )
+
+
+def test_score_row_readouts():
+    r = _row()
+    assert r.odds_ratio == pytest.approx(3.0)
+    assert r.relative_prob == pytest.approx(0.75)
+    assert r.model_family == "model"
+    zero = ScoreRow("p", "m", "base", "", 0.0, 0.0)
+    assert math.isnan(zero.relative_prob)
+    # reference semantics: odds_ratio is inf whenever no_prob == 0
+    assert math.isinf(zero.odds_ratio)
+
+
+def test_csv_schemas(tmp_path):
+    d1 = write_model_comparison_csv([_row()], tmp_path / "d1.csv")
+    assert tuple(d1.columns) == MODEL_COMPARISON_COLUMNS
+    d2 = write_instruct_comparison_csv([_row()], tmp_path / "d2.csv")
+    assert tuple(d2.columns) == INSTRUCT_COMPARISON_COLUMNS
+    back = pd.read_csv(tmp_path / "d2.csv")
+    assert back.loc[0, "relative_prob"] == pytest.approx(0.75)
+
+
+def test_reference_csv_schema_parity(reference_data_dir):
+    d1 = pd.read_csv(f"{reference_data_dir}/model_comparison_results.csv")
+    assert tuple(d1.columns) == MODEL_COMPARISON_COLUMNS
+    d2 = pd.read_csv(f"{reference_data_dir}/instruct_model_comparison_results.csv")
+    assert tuple(d2.columns) == INSTRUCT_COMPARISON_COLUMNS
+
+
+def _pert_row(i=0):
+    p = LEGAL_PROMPTS[0]
+    return PerturbationRow(
+        model="local/test",
+        original_main=p.main,
+        response_format=p.response_format,
+        confidence_format=p.confidence_format,
+        rephrased_main=f"rephrasing {i}",
+        full_rephrased_prompt=f"rephrasing {i} " + p.response_format,
+        full_confidence_prompt=f"rephrasing {i} " + p.confidence_format,
+        model_response="Covered",
+        model_confidence_response="80",
+        log_probabilities="{}",
+        token_1_prob=0.7,
+        token_2_prob=0.1,
+        confidence_value=80,
+        weighted_confidence=78.5,
+    )
+
+
+def test_perturbation_schema_and_append(tmp_path):
+    path = tmp_path / "results.csv"
+    df1 = write_perturbation_results([_pert_row(0)], path)
+    assert tuple(df1.columns) == PERTURBATION_COLUMNS
+    df2 = write_perturbation_results([_pert_row(1)], path)
+    assert len(df2) == 2
+    assert df2.loc[0, "Odds_Ratio"] == pytest.approx(7.0)
+
+
+def test_perturbation_cache_roundtrip(tmp_path):
+    path = tmp_path / "perturbations.json"
+    entries = [
+        (
+            (p.main, p.response_format, tuple(p.target_tokens), p.confidence_format),
+            [f"r{i}" for i in range(3)],
+        )
+        for p in LEGAL_PROMPTS
+    ]
+    save_perturbations(path, entries)
+    loaded = load_perturbations(path)
+    assert loaded == entries
+    assert validate_perturbation_cache(loaded, LEGAL_PROMPTS)
+    assert not validate_perturbation_cache(loaded[:-1], LEGAL_PROMPTS)
+
+
+def test_manifest_resume(tmp_path):
+    path = tmp_path / "manifest.jsonl"
+    m = SweepManifest(path, ("model", "orig", "reph"))
+    recs = [{"model": "m", "orig": "o", "reph": f"r{i}"} for i in range(5)]
+    for r in recs[:3]:
+        m.mark_done(r)
+    # duplicate mark is a no-op
+    m.mark_done(recs[0])
+    assert len(m) == 3
+    # a fresh instance reloads the done-set from disk
+    m2 = SweepManifest(path, ("model", "orig", "reph"))
+    assert len(m2) == 3
+    assert [r["reph"] for r in m2.pending(recs)] == ["r3", "r4"]
+
+
+def test_manifest_seed_from_results(tmp_path):
+    csv = tmp_path / "prior.csv"
+    pd.DataFrame(
+        {"Model": ["m1"], "Original Main Part": ["o"], "Rephrased Main Part": ["r"]}
+    ).to_csv(csv, index=False)
+    m = SweepManifest.from_existing_results(
+        tmp_path / "man.jsonl", csv,
+        ("Model", "Original Main Part", "Rephrased Main Part"),
+    )
+    assert m.is_done({"Model": "m1", "Original Main Part": "o", "Rephrased Main Part": "r"})
+
+
+def test_retry_policy():
+    calls = []
+    waits = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("transient")
+        return "ok"
+
+    cfg = RetryConfig(max_retries=5, initial_delay=1.0, max_delay=4.0)
+    out = retry_with_exponential_backoff(
+        flaky, (ValueError,), cfg, sleep=waits.append, log=lambda s: None
+    )
+    assert out == "ok"
+    assert len(calls) == 3
+    assert len(waits) == 2
+    assert waits[1] > waits[0] * 0.5  # backoff grows modulo jitter
+
+    def always_fails():
+        raise ValueError("nope")
+
+    with pytest.raises(ValueError):
+        retry_with_exponential_backoff(
+            always_fails, (ValueError,), cfg, sleep=lambda s: None, log=lambda s: None
+        )
+
+
+def test_atomic_write(tmp_path):
+    path = tmp_path / "x.json"
+    atomic_write_json(path, {"a": 1})
+    atomic_write_json(path, {"a": 2})
+    import json
+
+    assert json.loads(path.read_text()) == {"a": 2}
