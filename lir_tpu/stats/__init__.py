@@ -1,0 +1,88 @@
+"""Vectorized statistics kernels — the L4 layer of the framework.
+
+Every statistic the reference computes with Python loops over scipy/sklearn
+is re-expressed here as a jittable/vmappable JAX kernel (bootstrap CIs,
+kappa variants, pairwise agreement, correlation matrices, truncated-normal MC
+fits), with scipy retained only for one-shot host-side hypothesis tests.
+"""
+
+from .bootstrap import (
+    BootstrapResult,
+    bootstrap_correlation,
+    bootstrap_mean_ci,
+    bootstrap_metric_matrix,
+    mae,
+    mape,
+    normal_approx_mc_difference,
+    permutation_test_difference,
+    rmse,
+    simulate_individuals,
+)
+from .core import (
+    average_ranks,
+    nan_filter,
+    pearson,
+    percentile_ci,
+    resample_indices,
+    spearman,
+)
+from .agreement import pairwise_agreement_stats, per_item_agreement
+from .correlations import (
+    bootstrap_correlation_matrix,
+    cross_rater_mean_correlation,
+    masked_pearson_matrix,
+    masked_spearman_matrix,
+)
+from .fits import truncated_normal_mc_fit
+from .kappa import (
+    aggregate_kappa,
+    cohen_kappa,
+    combined_kappa,
+    interpret_kappa,
+    pairwise_kappa_matrix,
+    per_prompt_mean_pairwise_kappa,
+    self_kappa_bootstrap,
+    within_group_kappa,
+)
+from .normality import (
+    anderson_darling_pvalue,
+    compare_distributions,
+    normality_tests,
+)
+
+__all__ = [
+    "BootstrapResult",
+    "aggregate_kappa",
+    "anderson_darling_pvalue",
+    "average_ranks",
+    "bootstrap_correlation",
+    "bootstrap_correlation_matrix",
+    "bootstrap_mean_ci",
+    "bootstrap_metric_matrix",
+    "cohen_kappa",
+    "combined_kappa",
+    "compare_distributions",
+    "cross_rater_mean_correlation",
+    "interpret_kappa",
+    "mae",
+    "mape",
+    "masked_pearson_matrix",
+    "masked_spearman_matrix",
+    "nan_filter",
+    "normal_approx_mc_difference",
+    "normality_tests",
+    "pairwise_agreement_stats",
+    "pairwise_kappa_matrix",
+    "pearson",
+    "per_item_agreement",
+    "per_prompt_mean_pairwise_kappa",
+    "percentile_ci",
+    "permutation_test_difference",
+    "resample_indices",
+    "rmse",
+    "self_kappa_bootstrap",
+    "simulate_individuals",
+    "spearman",
+    "truncated_normal_mc_fit",
+    "within_group_kappa",
+]

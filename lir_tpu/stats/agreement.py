@@ -1,0 +1,82 @@
+"""Per-item pairwise agreement kernels.
+
+Parity targets: calculate_per_item_agreement_humans /
+calculate_per_item_agreement_llms (survey_analysis_consolidated.py:234-350).
+The reference loops over all O(n^2) respondent pairs per question in Python
+(~507^2 pairs x 55 questions); here the pairwise |difference| matrix is one
+broadcast subtraction and the pair statistics are reductions over its upper
+triangle.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bootstrap import bootstrap_mean_ci
+
+
+def pairwise_agreement_stats(values: np.ndarray, scale: float) -> Dict[str, float]:
+    """Mean/std of pairwise agreement 1 - |a-b|/scale over all unordered
+    pairs of `values` (scale=100 for human sliders, 1 for LLM probabilities).
+    """
+    v = jnp.asarray(np.asarray(values, dtype=np.float64))
+    n = int(v.shape[0])
+    diffs = jnp.abs(v[:, None] - v[None, :]) / scale
+    agreement = 1.0 - diffs
+    iu = jnp.triu_indices(n, k=1)
+    pair_vals = agreement[iu]
+    return {
+        "mean_agreement": float(pair_vals.mean()),
+        "std_agreement": float(pair_vals.std()),
+        "n_pairs": n * (n - 1) // 2,
+        "response_variance": float(jnp.var(v)),
+    }
+
+
+def per_item_agreement(
+    responses_by_item: Dict[str, np.ndarray],
+    scale: float,
+    key: jax.Array,
+    n_boot: int = 1000,
+    count_key: str = "n_responses",
+) -> Dict[str, object]:
+    """Per-item pairwise agreement + bootstrap CI on the across-item mean.
+
+    `responses_by_item` maps item id -> 1-D array of responses (already
+    NaN-filtered). Items with < 2 responses are skipped, as in the reference.
+    """
+    per_item: Dict[str, Dict[str, float]] = {}
+    means = []
+    for item, vals in responses_by_item.items():
+        vals = np.asarray(vals, dtype=float)
+        vals = vals[np.isfinite(vals)]
+        if vals.size < 2:
+            continue
+        stats = pairwise_agreement_stats(vals, scale)
+        stats[count_key] = int(vals.size)
+        per_item[item] = stats
+        means.append(stats["mean_agreement"])
+
+    if not means:
+        return {
+            "per_item": per_item,
+            "overall_mean": 0.0,
+            "overall_std": 0.0,
+            "n_items": 0,
+            "overall_mean_ci_lower": 0.0,
+            "overall_mean_ci_upper": 0.0,
+        }
+
+    ci = bootstrap_mean_ci(np.asarray(means), key, n_boot=n_boot)
+    return {
+        "per_item": per_item,
+        "overall_mean": float(np.mean(means)),
+        "overall_std": float(np.std(means)),
+        "n_items": len(means),
+        "overall_mean_ci_lower": ci.ci_lower,
+        "overall_mean_ci_upper": ci.ci_upper,
+    }

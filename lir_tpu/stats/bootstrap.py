@@ -1,0 +1,262 @@
+"""Bootstrap confidence intervals as single vmapped XLA programs.
+
+The reference runs every bootstrap as a Python for-loop over scipy calls —
+1,000 to 10,000 iterations each (survey_analysis_consolidated.py:162-200,
+bootstrap_confidence_intervals.py:101-239, analyze_llm_agreement_simple_
+bootstrap.py:90-149). Here one `jax.vmap` over a (n_boot, n) index matrix
+computes all resamples in a single fused kernel; the resample axis can further
+be sharded over the `data` mesh axis by the caller.
+
+Determinism: every function takes an explicit `jax.random` key (threaded
+PRNG replaces the reference's global numpy seed-42; SURVEY.md §7 hard part 6).
+Results are reproducible bit-for-bit for a fixed key and backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy import stats as scipy_stats
+
+from .core import pearson, percentile_ci, resample_indices, spearman
+
+
+@dataclasses.dataclass
+class BootstrapResult:
+    """Point estimate + percentile CI, mirroring the dict returned by
+    survey_analysis_consolidated.py:192-200 (minus the raw distribution,
+    available via `samples`)."""
+
+    estimate: float
+    p_value: float
+    ci_lower: float
+    ci_upper: float
+    standard_error: float
+    samples: np.ndarray
+
+    def as_dict(self) -> Dict[str, float]:
+        return {
+            "correlation": self.estimate,
+            "p_value": self.p_value,
+            "ci_lower": self.ci_lower,
+            "ci_upper": self.ci_upper,
+            "standard_error": self.standard_error,
+        }
+
+
+def _bootstrap_stat(
+    stat: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    key: jax.Array,
+    n_boot: int,
+) -> jnp.ndarray:
+    n = x.shape[0]
+    idx = resample_indices(key, n_boot, n)
+    return jax.vmap(lambda i: stat(x[i], y[i]))(idx)
+
+
+_bootstrap_pearson_jit = jax.jit(
+    lambda x, y, key, n_boot: _bootstrap_stat(pearson, x, y, key, n_boot),
+    static_argnames=("n_boot",),
+)
+_bootstrap_spearman_jit = jax.jit(
+    lambda x, y, key, n_boot: _bootstrap_stat(spearman, x, y, key, n_boot),
+    static_argnames=("n_boot",),
+)
+_resampled_means_jit = jax.jit(jax.vmap(lambda v, i: v[i].mean(), in_axes=(None, 0)))
+
+
+@functools.cache
+def _jitted_metric_bootstrap(metric_fn, n_boot: int):
+    """One compiled program per (metric function, resample count) — jit's
+    cache is keyed on the function object, so building a fresh lambda per
+    call would recompile every time."""
+    return jax.jit(
+        lambda a, b, k: jax.vmap(lambda i: metric_fn(a[i], b[i]))(
+            resample_indices(k, n_boot, a.shape[0])
+        )
+    )
+
+
+_permutation_diffs_jit = jax.jit(
+    jax.vmap(
+        lambda k, pooled, n_a: (
+            lambda perm: perm[:n_a].mean() - perm[n_a:].mean()
+        )(jax.random.permutation(k, pooled)),
+        in_axes=(0, None, None),
+    ),
+    static_argnames=("n_a",),
+)
+
+
+def bootstrap_correlation(
+    x,
+    y,
+    key: jax.Array,
+    n_boot: int = 1000,
+    confidence: float = 0.95,
+    method: str = "pearson",
+) -> BootstrapResult:
+    """Correlation + percentile bootstrap CI + SE.
+
+    Parity target: calculate_pearson_with_bootstrap
+    (survey_analysis_consolidated.py:162-200). The point estimate and p-value
+    use scipy (exact match); the resampling distribution is computed on device.
+    """
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    if method == "pearson":
+        est, p = scipy_stats.pearsonr(x, y)
+        samples = _bootstrap_pearson_jit(
+            jnp.asarray(x), jnp.asarray(y), key, n_boot
+        )
+    elif method == "spearman":
+        est, p = scipy_stats.spearmanr(x, y)
+        samples = _bootstrap_spearman_jit(
+            jnp.asarray(x), jnp.asarray(y), key, n_boot
+        )
+    else:
+        raise ValueError(f"unknown method {method!r}")
+    samples = np.asarray(samples)
+    lo, hi = percentile_ci(jnp.asarray(samples), confidence)
+    return BootstrapResult(
+        estimate=float(est),
+        p_value=float(p),
+        ci_lower=float(lo),
+        ci_upper=float(hi),
+        standard_error=float(np.nanstd(samples)),
+        samples=samples,
+    )
+
+
+def bootstrap_mean_ci(
+    values,
+    key: jax.Array,
+    n_boot: int = 1000,
+    confidence: float = 0.95,
+) -> BootstrapResult:
+    """Bootstrap CI for a mean (used for per-item agreement means,
+    survey_analysis_consolidated.py:268-286, and metric CIs in
+    analyze_llm_agreement_simple_bootstrap.py)."""
+    v = jnp.asarray(np.asarray(values, dtype=np.float64))
+    idx = resample_indices(key, n_boot, v.shape[0])
+    samples = np.asarray(_resampled_means_jit(v, idx))
+    lo, hi = percentile_ci(jnp.asarray(samples), confidence)
+    return BootstrapResult(
+        estimate=float(np.mean(np.asarray(values, dtype=np.float64))),
+        p_value=float("nan"),
+        ci_lower=float(lo),
+        ci_upper=float(hi),
+        standard_error=float(np.nanstd(samples)),
+        samples=samples,
+    )
+
+
+def bootstrap_metric_matrix(
+    metric_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    x,
+    y,
+    key: jax.Array,
+    n_boot: int = 1000,
+) -> np.ndarray:
+    """Generic paired-resample bootstrap of an arbitrary jittable metric
+    (MAE/RMSE/Pearson...). Returns the raw sample distribution so callers can
+    build whatever summary the reference emits."""
+    xj, yj = jnp.asarray(np.asarray(x, float)), jnp.asarray(np.asarray(y, float))
+    return np.asarray(_jitted_metric_bootstrap(metric_fn, n_boot)(xj, yj, key))
+
+
+# Jittable agreement metrics (analyze_llm_human_agreement.py:94-148) for use
+# with bootstrap_metric_matrix.
+def mae(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return jnp.abs(x - y).mean(axis=-1)
+
+
+def rmse(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sqrt(((x - y) ** 2).mean(axis=-1))
+
+
+def mape(x: jnp.ndarray, y: jnp.ndarray, eps: float = 1e-10) -> jnp.ndarray:
+    """Mean absolute percentage error vs x (human) as the denominator."""
+    return (jnp.abs((x - y) / jnp.where(jnp.abs(x) < eps, eps, x))).mean(axis=-1) * 100.0
+
+
+def permutation_test_difference(
+    a,
+    b,
+    key: jax.Array,
+    n_perm: int = 10_000,
+) -> Dict[str, float]:
+    """Two-sided permutation test for mean(a) - mean(b) by random relabeling.
+
+    Parity target: the base-vs-instruct permutation p-value at
+    analyze_llm_agreement_simple_bootstrap.py:312-347. Vectorized: one
+    (n_perm, n_a+n_b) permutation tensor, one fused reduction.
+    """
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    pooled = jnp.asarray(np.concatenate([a, b]))
+    n_a = a.shape[0]
+    observed = float(a.mean() - b.mean())
+    keys = jax.random.split(key, n_perm)
+    diffs = np.asarray(_permutation_diffs_jit(keys, pooled, n_a))
+    p = float(np.mean(np.abs(diffs) >= abs(observed)))
+    return {
+        "observed_difference": observed,
+        "p_value": p,
+        "n_permutations": n_perm,
+    }
+
+
+def normal_approx_mc_difference(
+    mean_a: float,
+    std_a: float,
+    mean_b: float,
+    std_b: float,
+    key: jax.Array,
+    n_draws: int = 10_000,
+) -> Dict[str, float]:
+    """Monte-Carlo difference distribution from two normal approximations.
+
+    Parity target: analyze_model_family_differences.py:169-230 — draw both
+    metrics from N(mean, std), form the difference, report percentile CI and a
+    two-tailed p-value for difference != 0.
+    """
+    k1, k2 = jax.random.split(key)
+    draws_a = mean_a + std_a * jax.random.normal(k1, (n_draws,))
+    draws_b = mean_b + std_b * jax.random.normal(k2, (n_draws,))
+    diff = np.asarray(draws_a - draws_b)
+    p_pos = float(np.mean(diff > 0))
+    # Two-tailed p from the MC sign proportion, as the reference computes it.
+    p_two = float(2 * min(p_pos, 1 - p_pos))
+    return {
+        "difference_mean": float(np.mean(diff)),
+        "ci_lower": float(np.percentile(diff, 2.5)),
+        "ci_upper": float(np.percentile(diff, 97.5)),
+        "p_value": p_two,
+    }
+
+
+def simulate_individuals(
+    means,
+    stds,
+    key: jax.Array,
+    n_individuals: int,
+) -> jnp.ndarray:
+    """Simulate individual humans from per-question (mean, std):
+    clip(N(mu, sigma), 0, 1) — bootstrap_confidence_intervals.py:86-89.
+
+    Returns (n_individuals, n_questions).
+    """
+    means = jnp.asarray(np.asarray(means, float))
+    stds = jnp.asarray(np.asarray(stds, float))
+    draws = means[None, :] + stds[None, :] * jax.random.normal(
+        key, (n_individuals, means.shape[0])
+    )
+    return jnp.clip(draws, 0.0, 1.0)

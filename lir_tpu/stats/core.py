@@ -1,0 +1,68 @@
+"""Vectorized statistic primitives shared by every stats kernel.
+
+These are pure-JAX building blocks: Pearson/Spearman correlation, average
+ranks with tie handling, and resample-index generation. The reference computes
+each of these with scipy inside Python loops (e.g.
+survey_analysis/survey_analysis_consolidated.py:162-200); here they are
+shape-static jittable functions designed to be `vmap`ed over bootstrap
+resamples so the whole CI computation is one XLA program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pearson(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Pearson r along the last axis. Broadcasts over leading axes."""
+    xm = x - x.mean(axis=-1, keepdims=True)
+    ym = y - y.mean(axis=-1, keepdims=True)
+    cov = (xm * ym).sum(axis=-1)
+    denom = jnp.sqrt((xm * xm).sum(axis=-1) * (ym * ym).sum(axis=-1))
+    return jnp.where(denom > 0, cov / denom, jnp.nan)
+
+
+def average_ranks(x: jnp.ndarray) -> jnp.ndarray:
+    """Ranks (1-based) with ties assigned their average rank, along the last
+    axis — matches ``scipy.stats.rankdata(method='average')``.
+
+    Uses an O(n^2) pairwise comparison, which XLA turns into one fused
+    broadcast kernel; for the corpus sizes here (50 questions, ~500
+    respondents) this is faster than sort-based tie bookkeeping and has no
+    data-dependent shapes.
+    """
+    lt = (x[..., :, None] > x[..., None, :]).sum(axis=-1)
+    eq = (x[..., :, None] == x[..., None, :]).sum(axis=-1)
+    return lt + (eq + 1) / 2.0
+
+
+def spearman(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Spearman rho along the last axis (Pearson of average ranks)."""
+    return pearson(average_ranks(x), average_ranks(y))
+
+
+def resample_indices(key: jax.Array, n_boot: int, n: int) -> jnp.ndarray:
+    """(n_boot, n) matrix of with-replacement resample indices."""
+    return jax.random.randint(key, (n_boot, n), 0, n)
+
+
+def percentile_ci(samples: jnp.ndarray, confidence: float = 0.95):
+    """Percentile CI along the last axis; returns (lower, upper)."""
+    alpha = (1.0 - confidence) / 2.0
+    lower = jnp.nanpercentile(samples, 100.0 * alpha, axis=-1)
+    upper = jnp.nanpercentile(samples, 100.0 * (1.0 - alpha), axis=-1)
+    return lower, upper
+
+
+def nan_filter(x, *others):
+    """Host-side helper: keep positions finite in every array (the reference
+    filters NaN/inf before every statistic, SURVEY.md §4)."""
+    import numpy as np
+
+    arrs = [np.asarray(a, dtype=float) for a in (x, *others)]
+    mask = np.ones(arrs[0].shape[0], dtype=bool)
+    for a in arrs:
+        mask &= np.isfinite(a)
+    out = tuple(a[mask] for a in arrs)
+    return out if others else out[0]

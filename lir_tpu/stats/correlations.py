@@ -1,0 +1,193 @@
+"""Correlation-matrix kernels with prompt-resampled bootstrap.
+
+Parity target: calculate_model_correlations (model_comparison_graph.py:
+207-340) — 1000 bootstrap recomputations of the models x models correlation
+matrix, each a pandas `.corr()` in a Python loop. Here the masked pairwise
+Pearson matrix is a handful of matmuls (so NaN cells are handled like
+pandas' pairwise-complete observations), and the bootstrap axis is one vmap.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import resample_indices
+
+
+def masked_pearson_matrix(x: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise-complete Pearson correlation between the columns of `x`
+    (rows = items, cols = raters), NaN-aware — matches
+    ``pandas.DataFrame.corr(method='pearson')``.
+
+    All pair statistics come from cross-products of the masked matrix, so the
+    whole (n_cols x n_cols) matrix is ~6 matmuls on the MXU instead of an
+    O(n_cols^2) host loop.
+    """
+    m = jnp.isfinite(x)
+    # Pearson is invariant to per-column affine rescaling; standardizing by
+    # the column-wise finite mean/std first keeps the cross-product formula
+    # well-conditioned. Matmuls run at "highest" precision: correlations are
+    # statistics, not activations — bf16/tf32 passes are not acceptable here,
+    # and these matrices are tiny.
+    mf = m.astype(x.dtype)
+    cnt = jnp.maximum(mf.sum(axis=0), 1.0)
+    xz0 = jnp.where(m, x, 0.0)
+    mean = xz0.sum(axis=0) / cnt
+    var = (jnp.where(m, (x - mean) ** 2, 0.0)).sum(axis=0) / cnt
+    std = jnp.sqrt(jnp.maximum(var, 1e-30))
+    x = (x - mean) / std
+    xz = jnp.where(m, x, 0.0)
+    with jax.default_matmul_precision("highest"):
+        n = mf.T @ mf                  # joint-observation counts
+        sx = xz.T @ mf                 # sum of x_i over joint mask
+        sxy = xz.T @ xz
+        sxx = (xz * xz).T @ mf
+    sy = sx.T
+    syy = sxx.T
+    cov = n * sxy - sx * sy
+    var_x = n * sxx - sx * sx
+    var_y = n * syy - sy * sy
+    denom = jnp.sqrt(var_x * var_y)
+    return jnp.where((denom > 0) & (n > 1), cov / denom, jnp.nan)
+
+
+def _masked_ranks(v: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """Average ranks of `v` restricted to mask `m` (invalid positions get an
+    arbitrary value; callers must mask them out again)."""
+    vm = jnp.where(m, v, jnp.inf)
+    lt = ((vm[:, None] > vm[None, :]) & m[None, :]).sum(axis=1)
+    eq = ((vm[:, None] == vm[None, :]) & m[None, :]).sum(axis=1)
+    return (lt + (eq + 1) / 2.0).astype(v.dtype)
+
+
+def _masked_pearson_pair(xi, xj, m):
+    mf = m.astype(xi.dtype)
+    n = mf.sum()
+    xi = jnp.where(m, xi, 0.0)
+    xj = jnp.where(m, xj, 0.0)
+    mx = xi.sum() / n
+    my = xj.sum() / n
+    dx = jnp.where(m, xi - mx, 0.0)
+    dy = jnp.where(m, xj - my, 0.0)
+    denom = jnp.sqrt((dx * dx).sum() * (dy * dy).sum())
+    return jnp.where((denom > 0) & (n > 1), (dx * dy).sum() / denom, jnp.nan)
+
+
+def _spearman_pair(xi, xj):
+    m = jnp.isfinite(xi) & jnp.isfinite(xj)
+    ri = _masked_ranks(xi, m)
+    rj = _masked_ranks(xj, m)
+    return _masked_pearson_pair(ri, rj, m)
+
+
+@jax.jit
+def masked_spearman_matrix(x: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise-complete Spearman, pandas-compatible: for every column pair,
+    restrict to jointly finite rows, re-rank *within that subset*, then
+    Pearson. (Ranking whole columns first diverges whenever columns have
+    different NaN patterns — e.g. the D1 base/instruct pivot, an incomplete
+    49x18 grid.) vmapped over all pairs; O(pairs * n^2) comparisons fuse into
+    one kernel."""
+    ncol = x.shape[1]
+    ii, jj = jnp.triu_indices(ncol, k=1)
+    vals = jax.vmap(lambda i, j: _spearman_pair(x[:, i], x[:, j]))(ii, jj)
+    out = jnp.full((ncol, ncol), jnp.nan, dtype=x.dtype)
+    out = out.at[ii, jj].set(vals)
+    out = out.at[jj, ii].set(vals)
+    diag_ok = jnp.isfinite(x).sum(axis=0) > 1
+    return out.at[jnp.arange(ncol), jnp.arange(ncol)].set(
+        jnp.where(diag_ok, 1.0, jnp.nan)
+    )
+
+
+_RESAMPLED_CORR_JIT = {
+    "pearson": jax.jit(
+        jax.vmap(lambda x, i: masked_pearson_matrix(x[i]), in_axes=(None, 0))
+    ),
+    "spearman": jax.jit(
+        jax.vmap(lambda x, i: masked_spearman_matrix(x[i]), in_axes=(None, 0))
+    ),
+}
+
+
+def _pair_values(matrix: np.ndarray) -> np.ndarray:
+    iu = np.triu_indices(matrix.shape[0], k=1)
+    vals = matrix[iu]
+    return vals[np.isfinite(vals)]
+
+
+def bootstrap_correlation_matrix(
+    pivot: np.ndarray,
+    key: jax.Array,
+    method: str = "pearson",
+    n_bootstrap: int = 1000,
+    confidence: float = 0.95,
+) -> Dict[str, object]:
+    """Full parity with calculate_model_correlations: original pairwise
+    correlations + bootstrap (prompts resampled with replacement) CIs for the
+    mean/median/std of the pairwise-correlation distribution.
+
+    `pivot` is (n_prompts, n_models), NaN allowed.
+    """
+    x = jnp.asarray(np.asarray(pivot, dtype=np.float64))
+    corr_fn = masked_pearson_matrix if method == "pearson" else masked_spearman_matrix
+
+    original = np.asarray(corr_fn(x))
+    original_vals = _pair_values(original)
+
+    idx = resample_indices(key, n_bootstrap, x.shape[0])
+    boot_mats = np.asarray(_RESAMPLED_CORR_JIT[method](x, idx))
+
+    iu = np.triu_indices(x.shape[1], k=1)
+    boot_vals = boot_mats[:, iu[0], iu[1]]          # (n_boot, n_pairs)
+    with np.errstate(invalid="ignore"):
+        means = np.nanmean(boot_vals, axis=1)
+        medians = np.nanmedian(boot_vals, axis=1)
+        stds = np.nanstd(boot_vals, axis=1)
+
+    alpha = 1 - confidence
+    lo_p, hi_p = 100 * alpha / 2, 100 * (1 - alpha / 2)
+
+    def ci(samples):
+        s = samples[np.isfinite(samples)]
+        return (float(np.percentile(s, lo_p)), float(np.percentile(s, hi_p)))
+
+    return {
+        "mean_correlation": float(np.mean(original_vals)),
+        "mean_ci": ci(means),
+        "mean_se": float(np.nanstd(means)),
+        "median_correlation": float(np.median(original_vals)),
+        "median_ci": ci(medians),
+        "median_se": float(np.nanstd(medians)),
+        "std_correlation": float(np.std(original_vals)),
+        "std_ci": ci(stds),
+        "std_se": float(np.nanstd(stds)),
+        "min_correlation": float(np.min(original_vals)),
+        "max_correlation": float(np.max(original_vals)),
+        "correlation_matrix": original,
+        "correlation_values": original_vals,
+        "n_bootstrap": n_bootstrap,
+        "confidence_level": confidence,
+    }
+
+
+def cross_rater_mean_correlation(
+    matrix: np.ndarray,
+    min_items: int = 5,
+) -> float:
+    """Mean off-diagonal pairwise-complete correlation between raters
+    (columns), requiring >= min_items joint observations per pair — the inner
+    statistic of the cross-prompt rank-consistency analysis
+    (survey_analysis_consolidated.py:352-594)."""
+    x = np.asarray(matrix, dtype=np.float64)
+    m = np.isfinite(x).astype(np.float64)
+    counts = m.T @ m
+    corr = np.asarray(masked_pearson_matrix(jnp.asarray(x)))
+    iu = np.triu_indices(x.shape[1], k=1)
+    vals = corr[iu]
+    ok = np.isfinite(vals) & (counts[iu] >= min_items)
+    return float(np.mean(vals[ok])) if ok.any() else float("nan")

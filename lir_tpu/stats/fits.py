@@ -1,0 +1,198 @@
+"""Zero/one-inflated truncated-normal Monte-Carlo fitter.
+
+Parity target: conduct_truncated_normal_test
+(analyze_perturbation_results.py:113-337) — the reference's hottest loop:
+<=30 Python iterations each drawing 100,000 numpy normals, clipping to [0,1],
+and moment-matching with damping 0.5 / tolerance 1e-4. Here the whole fit is
+one `lax.while_loop` whose body draws its samples on device, so the full
+(models x prompts x 2 columns) sweep can additionally be vmapped.
+
+The goodness-of-fit readout (two-sample KS + Anderson k-sample) stays on
+scipy: those are one-shot host-side tests on the final sample, not hot.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from scipy import stats as scipy_stats
+
+EPSILON = 1e-6  # zero/one tolerance, analyze_perturbation_results.py:151
+
+
+def _simulate(key: jax.Array, mu, sigma, n: int) -> jnp.ndarray:
+    return jnp.clip(mu + sigma * jax.random.normal(key, (n,)), 0.0, 1.0)
+
+
+def _fit_loop(
+    key: jax.Array,
+    target_mean: jnp.ndarray,
+    target_std: jnp.ndarray,
+    n_simulations: int,
+    max_iterations: int,
+    tol: float,
+    damping: float,
+):
+    """Iterative moment matching as a while_loop; returns (mu, sigma, iters)."""
+
+    def cond(state):
+        _, _, i, converged = state
+        return (~converged) & (i < max_iterations)
+
+    def body(state):
+        mu, sigma, i, _ = state
+        sim = _simulate(jax.random.fold_in(key, i), mu, sigma, n_simulations)
+        sim_mean, sim_std = sim.mean(), sim.std()
+        converged = (jnp.abs(sim_mean - target_mean) < tol) & (
+            jnp.abs(sim_std - target_std) < tol
+        )
+        # Multiplicative adjustment with damping, plus a direct additive mean
+        # shift when the mean is off by > 1e-3 (reference :216-243).
+        mean_adj = 1 + damping * (
+            jnp.where(sim_mean > 0, target_mean / sim_mean, 1.0) - 1
+        )
+        std_adj = 1 + damping * (
+            jnp.where(sim_std > 0, target_std / sim_std, 1.0) - 1
+        )
+        new_mu = mu * mean_adj
+        new_sigma = sigma * std_adj
+        new_mu = new_mu + jnp.where(
+            jnp.abs(sim_mean - target_mean) > 0.001,
+            damping * (target_mean - sim_mean),
+            0.0,
+        )
+        new_mu = jnp.where(converged, mu, new_mu)
+        new_sigma = jnp.where(converged, sigma, new_sigma)
+        return (new_mu, new_sigma, i + 1, converged)
+
+    mu, sigma, iters, _ = jax.lax.while_loop(
+        cond, body, (target_mean, target_std, jnp.int32(0), jnp.bool_(False))
+    )
+    return mu, sigma, iters
+
+
+_fit_loop_jit = jax.jit(
+    _fit_loop, static_argnames=("n_simulations", "max_iterations")
+)
+
+
+def truncated_normal_mc_fit(
+    values: np.ndarray,
+    key: jax.Array,
+    n_simulations: int = 100_000,
+    max_iterations: int = 30,
+    tol: float = 1e-4,
+    damping: float = 0.5,
+    prompt_idx: int = 0,
+    column_name: str = "",
+) -> Tuple[Dict[str, object], np.ndarray]:
+    """Fit clip(N(mu, sigma), 0, 1) to `values` by MC moment matching and test
+    the fit. Returns (results dict in the reference's schema, final sample).
+    """
+    values = np.asarray(values, dtype=np.float64)
+    values = values[np.isfinite(values)]
+
+    base = {
+        "Prompt": prompt_idx + 1,
+        "Column": column_name,
+        "Model Type": "Truncated Normal with Zero/One Inflation",
+    }
+    if values.size == 0:
+        return {
+            **base,
+            "Model Fit": "Failed - No finite values",
+            "Zero Proportion": float("nan"),
+            "One Proportion": float("nan"),
+            "KS Statistic": float("nan"),
+            "KS p-value": float("nan"),
+            "AD Statistic": float("nan"),
+            "AD p-value": float("nan"),
+            "Model Adequate (Combined)": False,
+        }, np.array([])
+
+    zero_prop = float(np.mean(values < EPSILON))
+    one_prop = float(np.mean(values > 1 - EPSILON))
+    interior = values[(values >= EPSILON) & (values <= 1 - EPSILON)]
+    if interior.size == 0:
+        return {
+            **base,
+            "Model Fit": "Failed - All values are 0 or 1",
+            "Zero Proportion": zero_prop,
+            "One Proportion": one_prop,
+            "KS Statistic": float("nan"),
+            "KS p-value": float("nan"),
+            "AD Statistic": float("nan"),
+            "AD p-value": float("nan"),
+            "Model Adequate (Combined)": False,
+        }, np.array([])
+
+    target_mean = float(values.mean())
+    target_std = float(values.std())
+
+    fit_key, sim_key = jax.random.split(key)
+    mu, sigma, iters = _fit_loop_jit(
+        fit_key,
+        jnp.asarray(target_mean, jnp.float32),
+        jnp.asarray(target_std, jnp.float32),
+        n_simulations,
+        max_iterations,
+        tol,
+        damping,
+    )
+    mu, sigma = float(mu), float(sigma)
+    sample = np.asarray(_simulate(sim_key, mu, sigma, n_simulations), dtype=np.float64)
+    sim_mean, sim_std = float(sample.mean()), float(sample.std())
+
+    mean_err = abs(sim_mean - target_mean) / target_mean if target_mean else abs(sim_mean)
+    std_err = abs(sim_std - target_std) / target_std if target_std else abs(sim_std)
+
+    # Fallback: direct scipy truncnorm sampling when MC accuracy is poor
+    # (reference :259-290) — kept verbatim in spirit, scipy is fine here.
+    if mean_err > 0.01 or std_err > 0.01:
+        a, b = (0 - mu) / sigma, (1 - mu) / sigma
+        alt = scipy_stats.truncnorm.rvs(
+            a, b, loc=mu, scale=sigma, size=n_simulations,
+            random_state=np.random.default_rng(42),
+        )
+        alt_mean_err = abs(alt.mean() - target_mean) / target_mean if target_mean else abs(alt.mean())
+        alt_std_err = abs(alt.std() - target_std) / target_std if target_std else abs(alt.std())
+        if alt_mean_err < mean_err and alt_std_err < std_err:
+            sample = alt
+            sim_mean, sim_std = float(alt.mean()), float(alt.std())
+            mean_err, std_err = alt_mean_err, alt_std_err
+
+    ks_stat, ks_p = scipy_stats.ks_2samp(values, sample)
+    try:
+        ad = scipy_stats.anderson_ksamp([values, sample])
+        ad_stat, ad_p = float(ad.statistic), float(ad.pvalue)
+        ad_ok = ad_p > 0.05
+    except Exception:
+        ad_stat, ad_p, ad_ok = float("nan"), float("nan"), False
+
+    results = {
+        **base,
+        "Underlying Normal Mean": mu,
+        "Underlying Normal Std Dev": sigma,
+        "Observed Mean": target_mean,
+        "Observed Std Dev": target_std,
+        "Simulated Mean": sim_mean,
+        "Simulated Std Dev": sim_std,
+        "Mean Relative Error": float(mean_err),
+        "Std Relative Error": float(std_err),
+        "Zero Proportion": zero_prop,
+        "One Proportion": one_prop,
+        "Interior Mean": float(interior.mean()),
+        "Interior Std Dev": float(interior.std()),
+        "Iterations": int(iters),
+        "KS Statistic": float(ks_stat),
+        "KS p-value": float(ks_p),
+        "AD Statistic": ad_stat,
+        "AD p-value": ad_p,
+        "Model Adequate (KS p>0.05)": bool(ks_p > 0.05),
+        "Model Adequate (AD p>0.05)": bool(ad_ok),
+        "Model Adequate (Combined)": bool(ks_p > 0.05) and bool(ad_ok),
+    }
+    return results, sample

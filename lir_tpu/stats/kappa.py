@@ -1,0 +1,272 @@
+"""Cohen's kappa kernels — every kappa variant the reference computes.
+
+The reference has four distinct kappa procedures, all loop-based:
+
+1. within-prompt perturbation kappa via an O(n^2) Python pair loop
+   (analyze_perturbation_results.py:1094-1188) — ~2000^2 pairs per prompt;
+2. per-prompt mean pairwise kappa across models + bootstrap "self-kappa"
+   (calculate_cohens_kappa.py:76-218);
+3. pooled aggregate kappa across all models with a 1000-fold bootstrap CI
+   (model_comparison_graph.py:549-672);
+4. pairwise model-model kappa matrices (model_comparison_graph.py:495-547).
+
+Here the pair loops collapse to closed forms — for a group of n binary
+decisions with s ones, agreeing pairs = C(s,2) + C(n-s,2) and total pairs =
+C(n,2) — so the 2000^2-pair loop becomes a couple of reductions, and the
+bootstrap variants are vmapped over resample indices.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import resample_indices
+
+KAPPA_BANDS = (
+    (0.0, "Poor agreement (worse than chance)"),
+    (0.2, "Slight agreement"),
+    (0.4, "Fair agreement"),
+    (0.6, "Moderate agreement"),
+    (0.8, "Substantial agreement"),
+)
+
+
+def interpret_kappa(kappa: float) -> str:
+    """Interpretation bands (analyze_perturbation_results.py:1173-1184,
+    calculate_cohens_kappa.py:379-394)."""
+    for upper, label in KAPPA_BANDS:
+        if kappa < upper:
+            return label
+    return "Almost perfect agreement"
+
+
+def cohen_kappa(a: jnp.ndarray, b: jnp.ndarray, n_classes: int = 2) -> jnp.ndarray:
+    """Cohen's kappa between two label vectors, sklearn-compatible.
+
+    po = observed agreement; pe = sum_k p_a(k) * p_b(k). Returns NaN when
+    pe == 1 (both raters constant and identical), matching
+    ``sklearn.metrics.cohen_kappa_score``'s 0/0 behavior.
+    """
+    a = a.astype(jnp.int32)
+    b = b.astype(jnp.int32)
+    po = (a == b).mean()
+    ks = jnp.arange(n_classes)
+    pa = (a[None, :] == ks[:, None]).mean(axis=1)
+    pb = (b[None, :] == ks[:, None]).mean(axis=1)
+    pe = (pa * pb).sum()
+    return jnp.where(pe < 1.0, (po - pe) / (1.0 - pe), jnp.nan)
+
+
+def within_group_kappa(
+    decisions: np.ndarray, group_ids: np.ndarray
+) -> Dict[str, float]:
+    """Within-prompt kappa, closed form.
+
+    Parity: analyze_perturbation_results.py:1094-1188. Observed agreement is
+    the fraction of agreeing same-group pairs (groups of size <= 1 excluded);
+    expected agreement is p1^2 + p0^2 over *all* decisions; kappa is the usual
+    ratio. `decisions` is 0/1; `group_ids` is any integer labeling.
+    """
+    decisions = np.asarray(decisions)
+    group_ids = np.asarray(group_ids)
+    if decisions.size == 0:
+        return {
+            "kappa": float("nan"),
+            "observed_agreement": float("nan"),
+            "expected_agreement": float("nan"),
+        }
+
+    uniq = np.unique(group_ids)
+    agree_pairs = 0.0
+    total_pairs = 0.0
+    for g in uniq:
+        d = decisions[group_ids == g]
+        n = d.size
+        if n <= 1:
+            continue
+        s = float(d.sum())
+        agree_pairs += s * (s - 1) / 2 + (n - s) * (n - s - 1) / 2
+        total_pairs += n * (n - 1) / 2
+
+    observed = agree_pairs / total_pairs if total_pairs > 0 else 0.0
+    p1 = float(decisions.mean())
+    expected = p1 * p1 + (1 - p1) * (1 - p1)
+    kappa = (observed - expected) / (1 - expected) if expected < 1 else 1.0
+    return {
+        "kappa": float(kappa),
+        "observed_agreement": float(observed),
+        "expected_agreement": float(expected),
+    }
+
+
+def pairwise_kappa_matrix(binary: np.ndarray) -> np.ndarray:
+    """All-pairs kappa between columns of a (n_items, n_raters) binary matrix
+    with possible NaN entries (only rows finite for both raters count).
+
+    Parity: the model-pair kappa loop at model_comparison_graph.py:495-547.
+    Returns a symmetric (n_raters, n_raters) matrix with NaN diagonal-free 1s.
+    """
+    binary = np.asarray(binary, dtype=float)
+    n = binary.shape[1]
+    out = np.full((n, n), np.nan)
+    for i in range(n):
+        out[i, i] = 1.0
+        for j in range(i + 1, n):
+            mask = np.isfinite(binary[:, i]) & np.isfinite(binary[:, j])
+            if mask.sum() < 2:
+                continue
+            k = float(
+                cohen_kappa(
+                    jnp.asarray(binary[mask, i]), jnp.asarray(binary[mask, j])
+                )
+            )
+            out[i, j] = out[j, i] = k
+    return out
+
+
+def _aggregate_kappa_boot(rates, flat, ri, fi):
+    obs = rates[ri].mean()
+    q1 = flat[fi].mean()
+    ch = q1 * q1 + (1 - q1) * (1 - q1)
+    return jnp.where(ch < 1, (obs - ch) / (1 - ch), jnp.nan)
+
+
+_aggregate_kappa_boot_jit = jax.jit(
+    jax.vmap(_aggregate_kappa_boot, in_axes=(None, None, 0, 0))
+)
+
+_self_kappa_boot_jit = jax.jit(
+    jax.vmap(lambda d, i, j: cohen_kappa(d[i], d[j]), in_axes=(None, 0, 0))
+)
+
+
+def _agreement_rates(binary: jnp.ndarray) -> jnp.ndarray:
+    """Per-row fraction of agreeing rater pairs, closed form.
+    binary: (n_items, n_raters) in {0,1}."""
+    n = binary.shape[1]
+    s = binary.sum(axis=1)
+    agree = s * (s - 1) / 2 + (n - s) * (n - s - 1) / 2
+    total = n * (n - 1) / 2
+    return agree / total
+
+
+def aggregate_kappa(
+    binary: np.ndarray,
+    key: jax.Array,
+    n_boot: int = 1000,
+) -> Dict[str, float]:
+    """Pooled kappa across all raters with a bootstrap CI.
+
+    Parity: calculate_aggregate_cohens_kappa (model_comparison_graph.py:
+    549-672): observed = mean per-prompt pair-agreement rate; chance =
+    p1^2 + p0^2 over the flattened matrix; bootstrap resamples the
+    per-prompt agreement rates and the flattened values independently.
+    """
+    b = jnp.asarray(np.asarray(binary, dtype=np.float32))
+    rates = _agreement_rates(b)
+    flat = b.reshape(-1)
+
+    observed = float(rates.mean())
+    p1 = float(flat.mean())
+    chance = p1 * p1 + (1 - p1) * (1 - p1)
+    kappa = (observed - chance) / (1 - chance) if chance < 1 else 0.0
+
+    k1, k2 = jax.random.split(key)
+    rate_idx = resample_indices(k1, n_boot, rates.shape[0])
+    flat_idx = resample_indices(k2, n_boot, flat.shape[0])
+    samples = np.asarray(_aggregate_kappa_boot_jit(rates, flat, rate_idx, flat_idx))
+    samples = samples[np.isfinite(samples)]
+    return {
+        "aggregate_kappa": float(kappa),
+        "observed_agreement": observed,
+        "chance_agreement": float(chance),
+        "kappa_ci_lower": float(np.percentile(samples, 2.5)) if samples.size else float("nan"),
+        "kappa_ci_upper": float(np.percentile(samples, 97.5)) if samples.size else float("nan"),
+        "n_prompts": int(binary.shape[0]),
+        "n_models": int(binary.shape[1]),
+        "p_class1": p1,
+        "p_class0": 1 - p1,
+    }
+
+
+def self_kappa_bootstrap(
+    decisions: np.ndarray,
+    key: jax.Array,
+    n_boot: int = 1000,
+) -> Dict[str, float]:
+    """Perturbation 'self-kappa': kappa between two independent bootstrap
+    resamples of one decision vector, averaged over n_boot draws.
+
+    Parity: calculate_cohens_kappa.py:185-216. NaN draws (constant identical
+    resamples) are dropped, mirroring the reference's try/except skip.
+    """
+    d = jnp.asarray(np.asarray(decisions, dtype=np.int32))
+    n = d.shape[0]
+    k1, k2 = jax.random.split(key)
+    idx1 = resample_indices(k1, n_boot, n)
+    idx2 = resample_indices(k2, n_boot, n)
+    samples = np.asarray(_self_kappa_boot_jit(d, idx1, idx2))
+    samples = samples[np.isfinite(samples)]
+    if samples.size == 0:
+        return {"self_kappa": float("nan"), "self_kappa_std": float("nan"),
+                "min_kappa": float("nan"), "max_kappa": float("nan")}
+    return {
+        "self_kappa": float(samples.mean()),
+        "self_kappa_std": float(samples.std()),
+        "min_kappa": float(samples.min()),
+        "max_kappa": float(samples.max()),
+    }
+
+
+def combined_kappa(
+    model_kappa: float,
+    perturbation_kappa: float,
+    key: jax.Array,
+    model_kappa_std: float = 0.1,
+    pert_kappa_std: float = 0.1,
+    n_boot: int = 1000,
+) -> Dict[str, float]:
+    """Combine the two kappa sources as min(model_draw, perturbation_draw)
+    over normal draws (calculate_cohens_kappa.py:328-371)."""
+    k1, k2 = jax.random.split(key)
+    m = model_kappa + model_kappa_std * jax.random.normal(k1, (n_boot,))
+    p = perturbation_kappa + pert_kappa_std * jax.random.normal(k2, (n_boot,))
+    combined = np.asarray(jnp.minimum(m, p))
+    return {
+        "mean_kappa": float(combined.mean()),
+        "median_kappa": float(np.median(combined)),
+        "lower_ci": float(np.percentile(combined, 2.5)),
+        "upper_ci": float(np.percentile(combined, 97.5)),
+    }
+
+
+def per_prompt_mean_pairwise_kappa(
+    decisions_by_model: np.ndarray,
+) -> Dict[str, float]:
+    """Mean pairwise kappa for one prompt's decision vector across models.
+
+    Parity note: the reference calls ``cohen_kappa_score([x], [y])`` on
+    single-element lists (calculate_cohens_kappa.py:124-127), which is
+    degenerate — it yields NaN for every disagreeing pair and NaN/1 for
+    agreeing ones. SURVEY.md §7 lists this as a defect to fix, not replicate:
+    we report the fraction of agreeing pairs (the quantity the reference's
+    degenerate code effectively measures) alongside the agreement percentage.
+    """
+    d = np.asarray(decisions_by_model, dtype=float)
+    d = d[np.isfinite(d)]
+    n = d.size
+    if n < 2:
+        return {"avg_pairwise_agreement": float("nan"), "n_models": int(n),
+                "agree_percent": float("nan")}
+    s = float(d.sum())
+    agree = (s * (s - 1) / 2 + (n - s) * (n - s - 1) / 2) / (n * (n - 1) / 2)
+    mean_dec = float(d.mean())
+    return {
+        "avg_pairwise_agreement": float(agree),
+        "n_models": int(n),
+        "agree_percent": mean_dec if mean_dec > 0.5 else 1 - mean_dec,
+    }

@@ -34,7 +34,7 @@ def retry_with_exponential_backoff(
             jitter = random.uniform(*config.jitter)
             wait = min(delay * jitter, config.max_delay)
             log(
-                f"Attempt {attempt + 1}/{config.max_retries} failed "
+                f"Attempt {attempt + 1}/{config.max_retries + 1} failed "
                 f"({type(exc).__name__}: {exc}); retrying in {wait:.1f}s"
             )
             sleep(wait)

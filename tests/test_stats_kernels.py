@@ -1,0 +1,242 @@
+"""Unit tests for the stats kernels vs scipy/sklearn ground truth."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats as scipy_stats
+from sklearn.metrics import cohen_kappa_score
+
+from lir_tpu.stats import (
+    aggregate_kappa,
+    average_ranks,
+    bootstrap_correlation,
+    bootstrap_correlation_matrix,
+    bootstrap_mean_ci,
+    cohen_kappa,
+    interpret_kappa,
+    masked_pearson_matrix,
+    masked_spearman_matrix,
+    normal_approx_mc_difference,
+    normality_tests,
+    pairwise_agreement_stats,
+    pearson,
+    permutation_test_difference,
+    self_kappa_bootstrap,
+    spearman,
+    truncated_normal_mc_fit,
+    within_group_kappa,
+)
+
+
+KEY = jax.random.PRNGKey(42)
+
+
+class TestCore:
+    def test_pearson_matches_scipy(self, rng):
+        x = rng.normal(size=200)
+        y = 0.6 * x + rng.normal(size=200)
+        expected = scipy_stats.pearsonr(x, y)[0]
+        got = float(pearson(jnp.asarray(x), jnp.asarray(y)))
+        assert abs(got - expected) < 1e-6
+
+    def test_spearman_matches_scipy_with_ties(self, rng):
+        x = rng.integers(0, 10, size=100).astype(float)  # heavy ties
+        y = rng.integers(0, 10, size=100).astype(float)
+        expected = scipy_stats.spearmanr(x, y)[0]
+        got = float(spearman(jnp.asarray(x), jnp.asarray(y)))
+        assert abs(got - expected) < 1e-6
+
+    def test_average_ranks_matches_scipy(self, rng):
+        x = rng.integers(0, 5, size=50).astype(float)
+        expected = scipy_stats.rankdata(x, method="average")
+        got = np.asarray(average_ranks(jnp.asarray(x)))
+        np.testing.assert_allclose(got, expected)
+
+
+class TestBootstrap:
+    def test_bootstrap_correlation_brackets_estimate(self, rng):
+        x = rng.normal(size=100)
+        y = 0.7 * x + 0.3 * rng.normal(size=100)
+        res = bootstrap_correlation(x, y, KEY, n_boot=1000)
+        assert res.ci_lower < res.estimate < res.ci_upper
+        assert 0 < res.standard_error < 0.2
+        expected = scipy_stats.pearsonr(x, y)
+        assert abs(res.estimate - expected[0]) < 1e-12
+        assert abs(res.p_value - expected[1]) < 1e-12
+
+    def test_bootstrap_deterministic_for_fixed_key(self, rng):
+        x, y = rng.normal(size=50), rng.normal(size=50)
+        a = bootstrap_correlation(x, y, KEY, n_boot=200)
+        b = bootstrap_correlation(x, y, KEY, n_boot=200)
+        np.testing.assert_array_equal(a.samples, b.samples)
+
+    def test_bootstrap_mean_ci(self, rng):
+        v = rng.normal(loc=5.0, size=400)
+        res = bootstrap_mean_ci(v, KEY, n_boot=1000)
+        # CI should bracket the true mean and be close to analytic width
+        assert res.ci_lower < 5.0 < res.ci_upper
+        assert abs(res.estimate - v.mean()) < 1e-12
+
+    def test_permutation_test_null(self, rng):
+        a = rng.normal(size=60)
+        b = rng.normal(size=60)
+        res = permutation_test_difference(a, b, KEY, n_perm=2000)
+        assert res["p_value"] > 0.01  # same distribution: should not reject
+
+    def test_permutation_test_signal(self, rng):
+        a = rng.normal(loc=1.0, size=60)
+        b = rng.normal(loc=0.0, size=60)
+        res = permutation_test_difference(a, b, KEY, n_perm=2000)
+        assert res["p_value"] < 0.01
+
+    def test_normal_approx_mc_difference(self):
+        res = normal_approx_mc_difference(0.8, 0.05, 0.5, 0.05, KEY, n_draws=10_000)
+        assert res["p_value"] < 0.01
+        assert res["ci_lower"] > 0
+
+
+class TestKappa:
+    def test_cohen_kappa_matches_sklearn(self, rng):
+        for _ in range(5):
+            a = rng.integers(0, 2, size=80)
+            b = rng.integers(0, 2, size=80)
+            expected = cohen_kappa_score(a, b)
+            got = float(cohen_kappa(jnp.asarray(a), jnp.asarray(b)))
+            assert abs(got - expected) < 1e-6
+
+    def test_cohen_kappa_constant_identical_is_nan(self):
+        a = jnp.ones(10, dtype=jnp.int32)
+        assert np.isnan(float(cohen_kappa(a, a)))
+
+    def test_within_group_kappa_matches_pair_loop(self, rng):
+        decisions = rng.integers(0, 2, size=300)
+        groups = rng.integers(0, 5, size=300)
+        got = within_group_kappa(decisions, groups)
+        # Brute-force O(n^2) loop, as the reference computes it
+        agree = total = 0
+        for g in np.unique(groups):
+            d = decisions[groups == g]
+            for i in range(len(d)):
+                for j in range(i + 1, len(d)):
+                    total += 1
+                    agree += int(d[i] == d[j])
+        observed = agree / total
+        p1 = decisions.mean()
+        expected_agreement = p1 * p1 + (1 - p1) * (1 - p1)
+        kappa = (observed - expected_agreement) / (1 - expected_agreement)
+        assert abs(got["observed_agreement"] - observed) < 1e-12
+        assert abs(got["kappa"] - kappa) < 1e-12
+
+    def test_aggregate_kappa_matches_loop(self, rng):
+        binary = rng.integers(0, 2, size=(40, 6))
+        got = aggregate_kappa(binary, KEY, n_boot=200)
+        # reference formulation
+        import itertools
+
+        rates = []
+        for row in binary:
+            agree = sum(
+                1
+                for i, j in itertools.combinations(range(len(row)), 2)
+                if row[i] == row[j]
+            )
+            rates.append(agree / (len(row) * (len(row) - 1) / 2))
+        observed = np.mean(rates)
+        p1 = binary.mean()
+        chance = p1 * p1 + (1 - p1) * (1 - p1)
+        kappa = (observed - chance) / (1 - chance)
+        assert abs(got["aggregate_kappa"] - kappa) < 1e-6
+        assert got["kappa_ci_lower"] <= got["aggregate_kappa"] <= got["kappa_ci_upper"]
+
+    def test_self_kappa_near_zero_for_random(self, rng):
+        d = rng.integers(0, 2, size=500)
+        got = self_kappa_bootstrap(d, KEY, n_boot=300)
+        assert abs(got["self_kappa"]) < 0.15  # independent resamples ~ chance
+
+    def test_interpret_bands(self):
+        assert "Poor" in interpret_kappa(-0.1)
+        assert "Slight" in interpret_kappa(0.1)
+        assert "Fair" in interpret_kappa(0.3)
+        assert "Moderate" in interpret_kappa(0.5)
+        assert "Substantial" in interpret_kappa(0.7)
+        assert "perfect" in interpret_kappa(0.9)
+
+
+class TestAgreement:
+    def test_pairwise_agreement_matches_loop(self, rng):
+        vals = rng.uniform(0, 100, size=50)
+        got = pairwise_agreement_stats(vals, scale=100.0)
+        pair_vals = [
+            (100 - abs(vals[i] - vals[j])) / 100
+            for i in range(len(vals))
+            for j in range(i + 1, len(vals))
+        ]
+        assert abs(got["mean_agreement"] - np.mean(pair_vals)) < 1e-6
+        assert abs(got["std_agreement"] - np.std(pair_vals)) < 1e-6
+        assert got["n_pairs"] == len(pair_vals)
+
+
+class TestCorrelationMatrix:
+    def test_masked_pearson_matches_pandas(self, rng):
+        import pandas as pd
+
+        x = rng.normal(size=(30, 5))
+        x[rng.uniform(size=x.shape) < 0.1] = np.nan  # pairwise-complete case
+        expected = pd.DataFrame(x).corr(method="pearson").values
+        got = np.asarray(masked_pearson_matrix(jnp.asarray(x)))
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-8)
+
+    def test_masked_spearman_matches_pandas_dense(self, rng):
+        import pandas as pd
+
+        x = rng.normal(size=(30, 4))
+        expected = pd.DataFrame(x).corr(method="spearman").values
+        got = np.asarray(masked_spearman_matrix(jnp.asarray(x)))
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-8)
+
+    def test_masked_spearman_matches_pandas_with_nan(self, rng):
+        """Pairwise-complete spearman must re-rank within each joint subset
+        (pandas semantics), not rank whole columns first."""
+        import pandas as pd
+
+        x = rng.normal(size=(40, 5))
+        x[rng.uniform(size=x.shape) < 0.3] = np.nan
+        expected = pd.DataFrame(x).corr(method="spearman").values
+        got = np.asarray(masked_spearman_matrix(jnp.asarray(x)))
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+    def test_bootstrap_correlation_matrix_sane(self, rng):
+        x = rng.normal(size=(50, 6))
+        res = bootstrap_correlation_matrix(x, KEY, n_bootstrap=200)
+        assert res["mean_ci"][0] <= res["mean_correlation"] <= res["mean_ci"][1]
+        assert res["correlation_matrix"].shape == (6, 6)
+
+
+class TestFitsAndNormality:
+    def test_truncnorm_fit_recovers_moments(self):
+        rng = np.random.default_rng(0)
+        true = np.clip(rng.normal(0.6, 0.25, size=5000), 0, 1)
+        res, sample = truncated_normal_mc_fit(true, KEY, n_simulations=50_000)
+        assert res["Mean Relative Error"] < 0.01
+        assert res["Std Relative Error"] < 0.02
+        assert sample.size == 50_000
+        # a truncated normal fit to truncated-normal data should be adequate
+        assert res["KS p-value"] > 0.01
+
+    def test_truncnorm_fit_all_extreme(self):
+        res = truncated_normal_mc_fit(np.array([0.0, 1.0, 1.0]), KEY)
+        assert "Failed" in res[0]["Model Fit"] if isinstance(res, tuple) else True
+
+    def test_normality_gaussian_passes(self):
+        rng = np.random.default_rng(1)
+        res = normality_tests(rng.normal(size=800))
+        assert res["KS p-value"] > 0.05
+        assert res["AD Normal (stat<crit)"]
+
+    def test_normality_bimodal_fails(self):
+        rng = np.random.default_rng(2)
+        data = np.concatenate([rng.normal(-3, 0.3, 400), rng.normal(3, 0.3, 400)])
+        res = normality_tests(data)
+        assert res["KS p-value"] < 0.05
+        assert not res["AD Normal (stat<crit)"]
