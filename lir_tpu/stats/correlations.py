@@ -9,7 +9,8 @@ pandas' pairwise-complete observations), and the bootstrap axis is one vmap.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import warnings
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -144,7 +145,10 @@ def bootstrap_correlation_matrix(
 
     iu = np.triu_indices(x.shape[1], k=1)
     boot_vals = boot_mats[:, iu[0], iu[1]]          # (n_boot, n_pairs)
-    with np.errstate(invalid="ignore"):
+    with np.errstate(invalid="ignore"), warnings.catch_warnings():
+        # Resamples where no pair has joint coverage reduce to all-NaN rows;
+        # they contribute NaN (dropped by ci()/agg()) rather than a warning.
+        warnings.simplefilter("ignore", RuntimeWarning)
         means = np.nanmean(boot_vals, axis=1)
         medians = np.nanmedian(boot_vals, axis=1)
         stds = np.nanstd(boot_vals, axis=1)
@@ -154,20 +158,26 @@ def bootstrap_correlation_matrix(
 
     def ci(samples):
         s = samples[np.isfinite(samples)]
+        if s.size == 0:
+            return (float("nan"), float("nan"))
         return (float(np.percentile(s, lo_p)), float(np.percentile(s, hi_p)))
 
+    def agg(fn, vals):
+        finite = vals[np.isfinite(vals)]
+        return float(fn(finite)) if finite.size else float("nan")
+
     return {
-        "mean_correlation": float(np.mean(original_vals)),
+        "mean_correlation": agg(np.mean, original_vals),
         "mean_ci": ci(means),
-        "mean_se": float(np.nanstd(means)),
-        "median_correlation": float(np.median(original_vals)),
+        "mean_se": agg(np.nanstd, means),
+        "median_correlation": agg(np.median, original_vals),
         "median_ci": ci(medians),
-        "median_se": float(np.nanstd(medians)),
-        "std_correlation": float(np.std(original_vals)),
+        "median_se": agg(np.nanstd, medians),
+        "std_correlation": agg(np.std, original_vals),
         "std_ci": ci(stds),
-        "std_se": float(np.nanstd(stds)),
-        "min_correlation": float(np.min(original_vals)),
-        "max_correlation": float(np.max(original_vals)),
+        "std_se": agg(np.nanstd, stds),
+        "min_correlation": agg(np.min, original_vals),
+        "max_correlation": agg(np.max, original_vals),
         "correlation_matrix": original,
         "correlation_values": original_vals,
         "n_bootstrap": n_bootstrap,

@@ -13,7 +13,7 @@ scipy: those are one-shot host-side tests on the final sample, not hot.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -100,17 +100,24 @@ def truncated_normal_mc_fit(
         "Column": column_name,
         "Model Type": "Truncated Normal with Zero/One Inflation",
     }
+    failure_nans = {
+        "KS Statistic": float("nan"),
+        "KS p-value": float("nan"),
+        "AD Statistic": float("nan"),
+        "AD p-value": float("nan"),
+        "Interior Mean": float("nan"),
+        "Interior Std Dev": float("nan"),
+        "Model Adequate (KS p>0.05)": False,
+        "Model Adequate (AD p>0.05)": False,
+        "Model Adequate (Combined)": False,
+    }
     if values.size == 0:
         return {
             **base,
             "Model Fit": "Failed - No finite values",
             "Zero Proportion": float("nan"),
             "One Proportion": float("nan"),
-            "KS Statistic": float("nan"),
-            "KS p-value": float("nan"),
-            "AD Statistic": float("nan"),
-            "AD p-value": float("nan"),
-            "Model Adequate (Combined)": False,
+            **failure_nans,
         }, np.array([])
 
     zero_prop = float(np.mean(values < EPSILON))
@@ -122,11 +129,7 @@ def truncated_normal_mc_fit(
             "Model Fit": "Failed - All values are 0 or 1",
             "Zero Proportion": zero_prop,
             "One Proportion": one_prop,
-            "KS Statistic": float("nan"),
-            "KS p-value": float("nan"),
-            "AD Statistic": float("nan"),
-            "AD p-value": float("nan"),
-            "Model Adequate (Combined)": False,
+            **failure_nans,
         }, np.array([])
 
     target_mean = float(values.mean())

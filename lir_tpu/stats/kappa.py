@@ -18,7 +18,7 @@ bootstrap variants are vmapped over resample indices.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,8 @@ KAPPA_BANDS = (
 def interpret_kappa(kappa: float) -> str:
     """Interpretation bands (analyze_perturbation_results.py:1173-1184,
     calculate_cohens_kappa.py:379-394)."""
+    if np.isnan(kappa):
+        return "Undefined (kappa is NaN)"
     for upper, label in KAPPA_BANDS:
         if kappa < upper:
             return label

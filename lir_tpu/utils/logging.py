@@ -28,12 +28,10 @@ class CaptureHandler(logging.Handler):
 def get_logger(name: Optional[str] = None) -> logging.Logger:
     logger = logging.getLogger(_LOGGER_NAME if name is None else f"{_LOGGER_NAME}.{name}")
     root = logging.getLogger(_LOGGER_NAME)
-    # Install the console handler exactly once, independent of any capture
-    # handlers that may have been attached first.
-    if not any(
-        isinstance(h, logging.StreamHandler) and not isinstance(h, CaptureHandler)
-        for h in root.handlers
-    ):
+    # Install the console handler exactly once. CaptureHandler derives from
+    # logging.Handler (not StreamHandler), so capture handlers attached first
+    # never satisfy this check.
+    if not any(isinstance(h, logging.StreamHandler) for h in root.handlers):
         handler = logging.StreamHandler()
         handler.setFormatter(logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s"))
         root.addHandler(handler)

@@ -1,7 +1,11 @@
 """Test configuration: force JAX onto 8 virtual CPU devices.
 
 This exercises the same Mesh/pjit code paths as a v5e-8 slice without TPU
-hardware (SURVEY.md §4). Must run before the first `import jax` anywhere.
+hardware (SURVEY.md §4). The environment may pre-import jax with a TPU
+plugin selected (JAX_PLATFORMS=axon via sitecustomize), so env vars alone
+are too late — we must override via jax.config before any backend
+initializes, or the first `jax.devices()` call tries to reach real TPU
+hardware and stalls the whole test session.
 """
 
 import os
@@ -13,6 +17,10 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
