@@ -1,0 +1,374 @@
+"""Unified functional decoder-only transformer.
+
+One forward covers the whole reference model zoo (SURVEY.md §2.6): GPT-2, the
+GPT-NeoX family (pythia / dolly-v2 / stablelm-alpha / RedPajama / h2ogpt),
+Llama-2 / Mistral / Qwen / Baichuan2, Falcon (MQA + shared-LN parallel block),
+Bloom (ALiBi + embedding LayerNorm) and OPT — selected purely by
+``registry.ModelConfig`` knobs. The reference reaches these architectures via
+``transformers`` torch classes (analysis/compare_base_vs_instruct.py:423-455);
+here they are a single JAX program so XLA can fuse and shard them.
+
+Design (TPU-first):
+- Layers are STACKED along a leading axis and iterated with ``lax.scan`` —
+  one compiled block body regardless of depth, fast compiles, remat-friendly.
+- Params/activations run in the param dtype (bf16 on TPU); softmax and the
+  final logits are computed in fp32 (SURVEY.md §7 hard part 3).
+- KV-cache prefill/decode split so scoring can capture per-step logits
+  (the C13 measurement primitive, compare_base_vs_instruct.py:185-305).
+- No data-dependent Python control flow below ``jit``; masks make padding a
+  no-op so the whole scoring grid runs at fixed shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import ModelConfig
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Param init (random weights for tests; real weights come from models/loader.py)
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32) -> Params:
+    """Random-normal init with the exact tree layout the loader fills."""
+    k = iter(jax.random.split(key, 64))
+    D, H, K, hd, F, L = (cfg.hidden_size, cfg.n_heads, cfg.n_kv_heads,
+                         cfg.head_dim, cfg.intermediate_size, cfg.n_layers)
+
+    def w(*shape, scale=0.02):
+        return (scale * jax.random.normal(next(k), shape)).astype(dtype)
+
+    def norm_p(*lead) -> Params:
+        p = {"scale": jnp.ones((*lead, D), dtype)}
+        if cfg.norm == "layernorm":
+            p["bias"] = jnp.zeros((*lead, D), dtype)
+        return p
+
+    layers: Params = {
+        "ln1": norm_p(L),
+        "wq": w(L, D, H * hd), "wk": w(L, D, K * hd), "wv": w(L, D, K * hd),
+        "wo": w(L, H * hd, D),
+        "w_up": w(L, D, F), "w_down": w(L, F, D),
+    }
+    if not cfg.shared_block_ln:
+        layers["ln2"] = norm_p(L)
+    if cfg.gated_mlp:
+        layers["w_gate"] = w(L, D, F)
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, H * hd), dtype)
+        layers["bk"] = jnp.zeros((L, K * hd), dtype)
+        layers["bv"] = jnp.zeros((L, K * hd), dtype)
+    if cfg.attn_out_bias:
+        layers["bo"] = jnp.zeros((L, D), dtype)
+    if cfg.mlp_bias:
+        layers["b_up"] = jnp.zeros((L, F), dtype)
+        layers["b_down"] = jnp.zeros((L, D), dtype)
+
+    params: Params = {"tok_embed": w(cfg.vocab_size, D, scale=0.02), "layers": layers}
+    if cfg.pos_embedding == "learned":
+        params["pos_embed"] = w(cfg.max_seq_len + cfg.learned_pos_offset, D)
+    if cfg.embedding_norm:
+        params["embed_ln"] = {"scale": jnp.ones((D,), dtype),
+                              "bias": jnp.zeros((D,), dtype)}
+    if cfg.final_norm:
+        params["final_ln"] = norm_p()
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(D, cfg.vocab_size)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def _norm(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    if cfg.norm == "rmsnorm":
+        xf = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + cfg.norm_eps)
+        return (xf * p["scale"].astype(jnp.float32)).astype(x.dtype)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    xf = (xf - mu) * jax.lax.rsqrt(var + cfg.norm_eps)
+    out = xf * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _act(x: jax.Array, kind: str) -> jax.Array:
+    if kind == "silu":
+        return jax.nn.silu(x)
+    if kind == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if kind == "gelu_new":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.relu(x)
+
+
+def _rope_sincos(positions: jax.Array, rotary_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    """sin/cos tables for rotate-half RoPE. positions: (..., S) int."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # (..., S, rd/2)
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def _apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array, rotary_dim: int) -> jax.Array:
+    """x: (B, S, nH, hd); rotate-half convention (HF llama/neox/falcon)."""
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    x1, x2 = rot[..., : rotary_dim // 2], rot[..., rotary_dim // 2:]
+    sin = sin[:, :, None, :].astype(x.dtype)   # (B, S, 1, rd/2)
+    cos = cos[:, :, None, :].astype(x.dtype)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return jnp.concatenate([out, rest], axis=-1) if rest.shape[-1] else out
+
+
+def alibi_slopes(n_heads: int) -> jax.Array:
+    """ALiBi per-head slopes (bloom). Matches HF build_alibi_tensor."""
+    closest = 2 ** math.floor(math.log2(n_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base ** (i + 1) for i in range(closest)]
+    if closest != n_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        slopes += [extra_base ** (2 * i + 1) for i in range(n_heads - closest)]
+    return jnp.asarray(slopes, dtype=jnp.float32)
+
+
+def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
+               cfg: ModelConfig) -> jax.Array:
+    """q: (B,S,H,hd); k,v: (B,T,K,hd); bias: (B,H|1,S,T) additive fp32."""
+    B, S, H, hd = q.shape
+    K = k.shape[2]
+    if K != H:  # GQA/MQA: repeat kv heads
+        k = jnp.repeat(k, H // K, axis=2)
+        v = jnp.repeat(v, H // K, axis=2)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
+    scores = scores / math.sqrt(hd) + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v)
+    return out.reshape(B, S, H * hd)
+
+
+def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
+           bias: jax.Array, cache_kv: Optional[Tuple[jax.Array, jax.Array]],
+           cache_index: Optional[jax.Array]):
+    """One transformer block. Returns (new_x, (k_full, v_full))."""
+    B, S, _ = x.shape
+    H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h_attn_in = _norm(x, lp["ln1"], cfg)
+    q = jnp.einsum("bsd,de->bse", h_attn_in, lp["wq"])
+    k = jnp.einsum("bsd,de->bse", h_attn_in, lp["wk"])
+    v = jnp.einsum("bsd,de->bse", h_attn_in, lp["wv"])
+    if cfg.qkv_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, S, H, hd)
+    k = k.reshape(B, S, K, hd)
+    v = v.reshape(B, S, K, hd)
+    if cfg.pos_embedding == "rotary":
+        rd = cfg.rotary_dim
+        q = _apply_rope(q, sin, cos, rd)
+        k = _apply_rope(k, sin, cos, rd)
+
+    if cache_kv is not None:
+        # Decode: insert this step's k/v at cache_index, attend over full cache.
+        ck, cv = cache_kv
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+        k_all, v_all = ck, cv
+    else:
+        ck = cv = None
+        k_all, v_all = k, v
+
+    attn = _attention(q, k_all, v_all, bias, cfg)
+    attn = jnp.einsum("bse,ed->bsd", attn, lp["wo"])
+    if cfg.attn_out_bias:
+        attn = attn + lp["bo"]
+
+    if cfg.parallel_block:
+        mlp_in = h_attn_in if cfg.shared_block_ln else _norm(x, lp["ln2"], cfg)
+    else:
+        x = x + attn
+        mlp_in = _norm(x, lp["ln2"], cfg)
+
+    up = jnp.einsum("bsd,df->bsf", mlp_in, lp["w_up"])
+    if cfg.mlp_bias:
+        up = up + lp["b_up"]
+    if cfg.gated_mlp:
+        gate = jnp.einsum("bsd,df->bsf", mlp_in, lp["w_gate"])
+        hidden = _act(gate, cfg.activation) * up
+    else:
+        hidden = _act(up, cfg.activation)
+    mlp = jnp.einsum("bsf,fd->bsd", hidden, lp["w_down"])
+    if cfg.mlp_bias:
+        mlp = mlp + lp["b_down"]
+
+    out = x + attn + mlp if cfg.parallel_block else x + mlp
+    return out, (ck, cv)
+
+
+def _embed(params: Params, cfg: ModelConfig, tokens: jax.Array,
+           positions: jax.Array) -> jax.Array:
+    x = jnp.take(params["tok_embed"], tokens, axis=0)
+    if cfg.pos_embedding == "learned":
+        x = x + jnp.take(params["pos_embed"], positions + cfg.learned_pos_offset, axis=0)
+    if cfg.embedding_norm:
+        ln = {"scale": params["embed_ln"]["scale"], "bias": params["embed_ln"]["bias"]}
+        x = _norm(x, ln, dataclasses.replace(cfg, norm="layernorm"))
+    return x
+
+
+def _unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
+    if cfg.final_norm:
+        x = _norm(x, params["final_ln"], cfg)
+    head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32), head.astype(jnp.float32))
+    if cfg.logit_softcap:
+        logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
+    return logits
+
+
+def _causal_bias(attn_mask: jax.Array, positions: jax.Array, cfg: ModelConfig,
+                 key_positions: Optional[jax.Array] = None,
+                 key_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Additive fp32 attention bias (B, H|1, S, T).
+
+    ``positions`` are mask-aware indices (pads get 0). Causality compares
+    positions, so left-padded batches behave exactly like unpadded prompts.
+    """
+    if key_positions is None:
+        key_positions, key_mask = positions, attn_mask
+    neg = jnp.float32(-1e9)
+    qp = positions[:, :, None]           # (B, S, 1)
+    kp = key_positions[:, None, :]       # (B, 1, T)
+    allowed = (kp <= qp) & (key_mask[:, None, :] > 0)
+    bias = jnp.where(allowed, 0.0, neg)[:, None, :, :]  # (B, 1, S, T)
+    if cfg.pos_embedding == "alibi":
+        slopes = alibi_slopes(cfg.n_heads)  # (H,)
+        alibi = slopes[None, :, None, None] * kp.astype(jnp.float32)[:, None, :, :]
+        bias = bias + alibi
+    return bias
+
+
+def mask_positions(attn_mask: jax.Array) -> jax.Array:
+    """Mask-aware position ids: pads -> 0, tokens -> 0..n-1 (left-pad safe)."""
+    return jnp.maximum(jnp.cumsum(attn_mask, axis=-1) - 1, 0)
+
+
+# ---------------------------------------------------------------------------
+# Public forwards
+# ---------------------------------------------------------------------------
+
+def _scan_blocks(params: Params, cfg: ModelConfig, x, sin, cos, bias,
+                 cache=None, cache_index=None):
+    """lax.scan over the stacked layer params."""
+    def body(carry, xs):
+        h = carry
+        if cache is None:
+            lp = xs
+            h, _ = _block(h, lp, cfg, sin, cos, bias, None, None)
+            return h, None
+        lp, (ck, cv) = xs
+        h, (nk, nv) = _block(h, lp, cfg, sin, cos, bias, (ck, cv), cache_index)
+        return h, (nk, nv)
+
+    xs = params["layers"] if cache is None else (params["layers"], cache)
+    x, new_cache = lax.scan(body, x, xs)
+    return x, new_cache
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+            attn_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Full-sequence causal forward. tokens: (B, S) int32 -> fp32 logits (B,S,V)."""
+    if attn_mask is None:
+        attn_mask = jnp.ones_like(tokens)
+    positions = mask_positions(attn_mask)
+    x = _embed(params, cfg, tokens, positions)
+    sin = cos = None
+    if cfg.pos_embedding == "rotary":
+        sin, cos = _rope_sincos(positions, cfg.rotary_dim, cfg.rope_theta)
+    bias = _causal_bias(attn_mask, positions, cfg)
+    x, _ = _scan_blocks(params, cfg, x, sin, cos, bias)
+    return _unembed(params, cfg, x)
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.float32):
+    """Per-layer KV cache stacked on the layer axis: (L, B, T, K, hd) pair."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
+            attn_mask: jax.Array, max_len: int):
+    """Run the prompt, fill the KV cache, return last-position logits.
+
+    tokens/attn_mask: (B, S) with LEFT padding (so position S-1 is the prompt
+    end for every row — mirrors the reference's unpadded single-prompt calls).
+    Returns (logits_last (B, V) fp32, cache, next_positions (B,)).
+    """
+    B, S = tokens.shape
+    positions = mask_positions(attn_mask)
+    x = _embed(params, cfg, tokens, positions)
+    sin = cos = None
+    if cfg.pos_embedding == "rotary":
+        sin, cos = _rope_sincos(positions, cfg.rotary_dim, cfg.rope_theta)
+    bias = _causal_bias(attn_mask, positions, cfg)
+
+    # Scan layers, capturing k/v (B, S, K, hd) per layer into a (L, ...) stack.
+    def body(h, lp):
+        h_in = h
+        h_out, _ = _block(h_in, lp, cfg, sin, cos, bias, None, None)
+        # Recompute k/v cheaply for capture: done inside _block normally; to
+        # avoid double compute we inline the projection here.
+        a_in = _norm(h_in, lp["ln1"], cfg)
+        k = jnp.einsum("bsd,de->bse", a_in, lp["wk"])
+        v = jnp.einsum("bsd,de->bse", a_in, lp["wv"])
+        if cfg.qkv_bias:
+            k, v = k + lp["bk"], v + lp["bv"]
+        k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        if cfg.pos_embedding == "rotary":
+            k = _apply_rope(k, sin, cos, cfg.rotary_dim)
+        return h_out, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    logits = _unembed(params, cfg, x[:, -1:, :])[:, 0, :]
+
+    pad = max_len - S
+    ck = jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+    cv = jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+    next_positions = positions[:, -1] + 1
+    return logits, (ck, cv), next_positions
+
+
+def decode_step(params: Params, cfg: ModelConfig, cache, token: jax.Array,
+                position: jax.Array, step_index: jax.Array,
+                prompt_mask: jax.Array):
+    """One greedy-decode step.
+
+    token: (B,) int32 current input; position: (B,) its mask-aware position;
+    step_index: scalar slot in the cache where this token's k/v land (= S + t);
+    prompt_mask: (B, T) validity mask over the FULL cache length T (prompt pads
+    0, prompt tokens and generated slots 1 once written).
+    Returns (logits (B, V) fp32, new_cache).
+    """
+    B = token.shape[0]
+    x = _embed(params, cfg, token[:, None], position[:, None])
+    sin = cos = None
+    if cfg.pos_embedding == "rotary":
+        sin, cos = _rope_sincos(position[:, None], cfg.rotary_dim, cfg.rope_theta)
+
+    T = cache[0].shape[2]
+    key_positions = mask_positions(prompt_mask)
+    bias = _causal_bias(jnp.ones((B, 1), jnp.int32), position[:, None], cfg,
+                        key_positions=key_positions, key_mask=prompt_mask)
+    x, new_cache = _scan_blocks(params, cfg, x, sin, cos, bias,
+                                cache=cache, cache_index=step_index)
+    logits = _unembed(params, cfg, x)[:, 0, :]
+    return logits, new_cache

@@ -1,0 +1,199 @@
+"""Functional T5-family encoder-decoder (t5-v1_1, flan-t5, T0, tk-instruct).
+
+The reference routes "t5|t0|tk-instruct" repos through
+``AutoModelForSeq2SeqLM`` (compare_instruct_models.py:471-475) and reads
+yes/no probabilities from the decoder's first generated position
+(compare_base_vs_instruct.py:203-241). This is the JAX equivalent: relative
+position buckets, RMSNorm, gated-GeLU MLP (v1.1), no biases anywhere,
+fp32 softmax/logits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import T5Config
+
+Params = Dict[str, Any]
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    xf = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _relative_bucket(rel: jax.Array, bidirectional: bool, num_buckets: int,
+                     max_distance: int) -> jax.Array:
+    """HF T5 relative_position_bucket (modeling_t5 semantics re-derived)."""
+    ret = jnp.zeros_like(rel)
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (rel > 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(rel)
+    else:
+        n = jnp.maximum(-rel, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def _rel_bias(rel_embed: jax.Array, q_pos: jax.Array, k_pos: jax.Array,
+              cfg: T5Config, bidirectional: bool) -> jax.Array:
+    """(B,S),(B,T) mask-aware positions -> additive bias (B, H, S, T) fp32."""
+    rel = k_pos[:, None, :] - q_pos[:, :, None]          # (B, S, T)
+    buckets = _relative_bucket(rel, bidirectional,
+                               cfg.relative_attention_num_buckets,
+                               cfg.relative_attention_max_distance)
+    bias = jnp.take(rel_embed, buckets, axis=0)          # (B, S, T, H)
+    return jnp.transpose(bias, (0, 3, 1, 2)).astype(jnp.float32)
+
+
+def _attn(q, k, v, bias):
+    """q:(B,S,H,hd) k,v:(B,T,H,hd) bias fp32 (B,H,S,T). T5: NO 1/sqrt(d)."""
+    B, S, H, hd = q.shape
+    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, H * hd)
+
+
+def _proj(x, w):
+    return jnp.einsum("bsd,de->bse", x, w)
+
+
+def _mlp(x, lp, cfg: T5Config):
+    if cfg.gated_mlp:
+        h = jax.nn.gelu(_proj(x, lp["wi_0"]), approximate=True) * _proj(x, lp["wi_1"])
+    else:
+        h = jax.nn.relu(_proj(x, lp["wi"]))
+    return _proj(h, lp["wo_mlp"])
+
+
+def init_params(cfg: T5Config, key: jax.Array, dtype=jnp.float32) -> Params:
+    k = iter(jax.random.split(key, 16))
+    D, H, hd, F, L = (cfg.hidden_size, cfg.n_heads, cfg.head_dim,
+                      cfg.intermediate_size, cfg.n_layers)
+
+    def w(*shape, scale=0.02):
+        return (scale * jax.random.normal(next(k), shape)).astype(dtype)
+
+    def stack(cross: bool) -> Params:
+        p = {
+            "ln_attn": jnp.ones((L, D), dtype),
+            "wq": w(L, D, H * hd), "wk": w(L, D, H * hd), "wv": w(L, D, H * hd),
+            "wo": w(L, H * hd, D),
+            "ln_mlp": jnp.ones((L, D), dtype),
+        }
+        if cfg.gated_mlp:
+            p.update({"wi_0": w(L, D, F), "wi_1": w(L, D, F)})
+        else:
+            p["wi"] = w(L, D, F)
+        p["wo_mlp"] = w(L, F, D)
+        if cross:
+            p.update({
+                "ln_cross": jnp.ones((L, D), dtype),
+                "cq": w(L, D, H * hd), "ck": w(L, D, H * hd),
+                "cv": w(L, D, H * hd), "co": w(L, H * hd, D),
+            })
+        return p
+
+    params: Params = {
+        "shared_embed": w(cfg.vocab_size, D),
+        "enc_rel_embed": w(cfg.relative_attention_num_buckets, H),
+        "dec_rel_embed": w(cfg.relative_attention_num_buckets, H),
+        "encoder": stack(cross=False),
+        "enc_final_ln": jnp.ones((D,), dtype),
+        "decoder": stack(cross=True),
+        "dec_final_ln": jnp.ones((D,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(D, cfg.vocab_size)
+    return params
+
+
+def encode(params: Params, cfg: T5Config, tokens: jax.Array,
+           attn_mask: jax.Array) -> jax.Array:
+    """Encoder stack: (B, S) -> (B, S, D)."""
+    positions = jnp.maximum(jnp.cumsum(attn_mask, axis=-1) - 1, 0)
+    x = jnp.take(params["shared_embed"], tokens, axis=0)
+    pad_bias = jnp.where(attn_mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
+    rel = _rel_bias(params["enc_rel_embed"], positions, positions, cfg, True)
+    bias = rel + pad_bias
+
+    def body(h, lp):
+        a_in = _rmsnorm(h, lp["ln_attn"], cfg.norm_eps)
+        B, S, _ = a_in.shape
+        q = _proj(a_in, lp["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        kk = _proj(a_in, lp["wk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        vv = _proj(a_in, lp["wv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        h = h + jnp.einsum("bse,ed->bsd", _attn(q, kk, vv, bias), lp["wo"])
+        m_in = _rmsnorm(h, lp["ln_mlp"], cfg.norm_eps)
+        h = h + _mlp(m_in, lp, cfg)
+        return h, None
+
+    x, _ = lax.scan(body, x, params["encoder"])
+    return _rmsnorm(x, params["enc_final_ln"], cfg.norm_eps)
+
+
+def decode(params: Params, cfg: T5Config, enc_out: jax.Array,
+           enc_mask: jax.Array, dec_tokens: jax.Array,
+           dec_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Full (teacher-forced) decoder pass -> fp32 logits (B, S_dec, V).
+
+    For the yes/no readout only the first decoded position is needed:
+    feed ``dec_tokens = [[decoder_start_token_id]]``.
+    """
+    B, S = dec_tokens.shape
+    if dec_mask is None:
+        dec_mask = jnp.ones_like(dec_tokens)
+    positions = jnp.maximum(jnp.cumsum(dec_mask, axis=-1) - 1, 0)
+    x = jnp.take(params["shared_embed"], dec_tokens, axis=0)
+
+    causal = (positions[:, None, :] <= positions[:, :, None]) & (dec_mask[:, None, :] > 0)
+    self_bias = _rel_bias(params["dec_rel_embed"], positions, positions, cfg, False)
+    self_bias = self_bias + jnp.where(causal[:, None, :, :], 0.0, -1e9)
+    cross_bias = jnp.where(enc_mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
+
+    def body(h, lp):
+        a_in = _rmsnorm(h, lp["ln_attn"], cfg.norm_eps)
+        q = _proj(a_in, lp["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        kk = _proj(a_in, lp["wk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        vv = _proj(a_in, lp["wv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        h = h + jnp.einsum("bse,ed->bsd", _attn(q, kk, vv, self_bias), lp["wo"])
+
+        c_in = _rmsnorm(h, lp["ln_cross"], cfg.norm_eps)
+        Te = enc_out.shape[1]
+        cq = _proj(c_in, lp["cq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        ck = _proj(enc_out, lp["ck"]).reshape(B, Te, cfg.n_heads, cfg.head_dim)
+        cv = _proj(enc_out, lp["cv"]).reshape(B, Te, cfg.n_heads, cfg.head_dim)
+        h = h + jnp.einsum("bse,ed->bsd", _attn(cq, ck, cv, cross_bias), lp["co"])
+
+        m_in = _rmsnorm(h, lp["ln_mlp"], cfg.norm_eps)
+        h = h + _mlp(m_in, lp, cfg)
+        return h, None
+
+    x, _ = lax.scan(body, x, params["decoder"])
+    x = _rmsnorm(x, params["dec_final_ln"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        # T5 v1.0 ties + rescales by d_model^-0.5.
+        head = params["shared_embed"].T
+        x = x * (cfg.hidden_size ** -0.5)
+    else:
+        head = params["lm_head"]
+    return jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32), head.astype(jnp.float32))
+
+
+def forward(params: Params, cfg: T5Config, enc_tokens: jax.Array,
+            enc_mask: jax.Array, dec_tokens: jax.Array,
+            dec_mask: Optional[jax.Array] = None) -> jax.Array:
+    enc_out = encode(params, cfg, enc_tokens, enc_mask)
+    return decode(params, cfg, enc_out, enc_mask, dec_tokens, dec_mask)

@@ -1,0 +1,423 @@
+"""HF checkpoint -> JAX pytree conversion.
+
+The reference loads every model with ``AutoModelForCausalLM.from_pretrained``
++ bitsandbytes int8 (compare_base_vs_instruct.py:423-455). Here weights are
+converted ONCE from the HF torch state_dict into the stacked-layer pytree that
+``models/decoder.py`` / ``models/encdec.py`` consume (bf16 on TPU), then cached;
+no torch on the hot path.
+
+Conventions:
+- All our projection matrices are (in_features, out_features); torch
+  ``nn.Linear`` stores (out, in) and is transposed; GPT-2 ``Conv1D`` is
+  already (in, out).
+- Fused QKV layouts are de-interleaved per family (gpt-neox/bloom use
+  head-major [q k v] interleave; falcon MQA appends single k/v rows).
+- Layer params are stacked on a leading L axis for ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from .registry import ModelConfig, T5Config
+
+Params = Dict[str, Any]
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().to("cpu")
+        if t.dtype.__str__() == "torch.bfloat16":
+            t = t.float()
+        return t.numpy()
+    return np.asarray(t)
+
+
+class _SD:
+    """State-dict view with transparent prefix stripping + numpy conversion."""
+
+    def __init__(self, sd: Mapping[str, Any]):
+        self.sd = dict(sd)
+
+    def __call__(self, key: str) -> np.ndarray:
+        if key in self.sd:
+            return _np(self.sd[key])
+        for pref in ("transformer.", "model.", "gpt_neox."):
+            if pref + key in self.sd:
+                return _np(self.sd[pref + key])
+        raise KeyError(key)
+
+    def has(self, key: str) -> bool:
+        try:
+            self(key)
+            return True
+        except KeyError:
+            return False
+
+
+def _lin(w: np.ndarray) -> np.ndarray:
+    """torch Linear (out, in) -> ours (in, out)."""
+    return np.ascontiguousarray(w.T)
+
+
+def _stack(rows, dtype) -> jnp.ndarray:
+    return jnp.asarray(np.stack(rows), dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Per-family layer extractors: (sd, cfg, i) -> dict of per-layer numpy arrays
+# ---------------------------------------------------------------------------
+
+def _split_qkv_headmajor(w: np.ndarray, b, H: int, hd: int):
+    """gpt-neox / bloom fusion: rows are [h0:(q k v), h1:(q k v), ...].
+
+    w: (3*H*hd, D) torch layout -> three (D, H*hd)."""
+    D = w.shape[1]
+    w3 = w.reshape(H, 3, hd, D)
+    outs = []
+    for j in range(3):
+        outs.append(np.ascontiguousarray(w3[:, j].reshape(H * hd, D).T))
+    if b is None:
+        return outs, (None, None, None)
+    b3 = b.reshape(H, 3, hd)
+    bs = [np.ascontiguousarray(b3[:, j].reshape(H * hd)) for j in range(3)]
+    return outs, bs
+
+
+def _layer_gpt2(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
+    p = f"h.{i}."
+    D = cfg.hidden_size
+    ca_w = sd(p + "attn.c_attn.weight")          # Conv1D: (D, 3D) = (in, out)
+    ca_b = sd(p + "attn.c_attn.bias")
+    return {
+        "ln1.scale": sd(p + "ln_1.weight"), "ln1.bias": sd(p + "ln_1.bias"),
+        "wq": ca_w[:, :D], "wk": ca_w[:, D:2 * D], "wv": ca_w[:, 2 * D:],
+        "bq": ca_b[:D], "bk": ca_b[D:2 * D], "bv": ca_b[2 * D:],
+        "wo": sd(p + "attn.c_proj.weight"), "bo": sd(p + "attn.c_proj.bias"),
+        "ln2.scale": sd(p + "ln_2.weight"), "ln2.bias": sd(p + "ln_2.bias"),
+        "w_up": sd(p + "mlp.c_fc.weight"), "b_up": sd(p + "mlp.c_fc.bias"),
+        "w_down": sd(p + "mlp.c_proj.weight"), "b_down": sd(p + "mlp.c_proj.bias"),
+    }
+
+
+def _layer_gptneox(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
+    p = f"layers.{i}."
+    (wq, wk, wv), (bq, bk, bv) = _split_qkv_headmajor(
+        sd(p + "attention.query_key_value.weight"),
+        sd(p + "attention.query_key_value.bias"), cfg.n_heads, cfg.head_dim)
+    return {
+        "ln1.scale": sd(p + "input_layernorm.weight"),
+        "ln1.bias": sd(p + "input_layernorm.bias"),
+        "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+        "wo": _lin(sd(p + "attention.dense.weight")),
+        "bo": sd(p + "attention.dense.bias"),
+        "ln2.scale": sd(p + "post_attention_layernorm.weight"),
+        "ln2.bias": sd(p + "post_attention_layernorm.bias"),
+        "w_up": _lin(sd(p + "mlp.dense_h_to_4h.weight")),
+        "b_up": sd(p + "mlp.dense_h_to_4h.bias"),
+        "w_down": _lin(sd(p + "mlp.dense_4h_to_h.weight")),
+        "b_down": sd(p + "mlp.dense_4h_to_h.bias"),
+    }
+
+
+def _layer_llama(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
+    p = f"layers.{i}."
+    out = {
+        "ln1.scale": sd(p + "input_layernorm.weight"),
+        "wq": _lin(sd(p + "self_attn.q_proj.weight")),
+        "wk": _lin(sd(p + "self_attn.k_proj.weight")),
+        "wv": _lin(sd(p + "self_attn.v_proj.weight")),
+        "wo": _lin(sd(p + "self_attn.o_proj.weight")),
+        "ln2.scale": sd(p + "post_attention_layernorm.weight"),
+        "w_gate": _lin(sd(p + "mlp.gate_proj.weight")),
+        "w_up": _lin(sd(p + "mlp.up_proj.weight")),
+        "w_down": _lin(sd(p + "mlp.down_proj.weight")),
+    }
+    if cfg.qkv_bias:  # qwen-style
+        out.update({"bq": sd(p + "self_attn.q_proj.bias"),
+                    "bk": sd(p + "self_attn.k_proj.bias"),
+                    "bv": sd(p + "self_attn.v_proj.bias")})
+    return out
+
+
+def _layer_baichuan(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
+    """Baichuan2 packs qkv as W_pack (3D, D), q|k|v blocks (not interleaved)."""
+    p = f"layers.{i}."
+    D = cfg.hidden_size
+    wp = sd(p + "self_attn.W_pack.weight")  # (3D, D)
+    return {
+        "ln1.scale": sd(p + "input_layernorm.weight"),
+        "wq": _lin(wp[:D]), "wk": _lin(wp[D:2 * D]), "wv": _lin(wp[2 * D:]),
+        "wo": _lin(sd(p + "self_attn.o_proj.weight")),
+        "ln2.scale": sd(p + "post_attention_layernorm.weight"),
+        "w_gate": _lin(sd(p + "mlp.gate_proj.weight")),
+        "w_up": _lin(sd(p + "mlp.up_proj.weight")),
+        "w_down": _lin(sd(p + "mlp.down_proj.weight")),
+    }
+
+
+def _layer_falcon(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
+    """falcon-7b MQA fusion: rows = [H query heads | 1 key head | 1 value head]."""
+    p = f"h.{i}."
+    H, hd = cfg.n_heads, cfg.head_dim
+    w = sd(p + "self_attention.query_key_value.weight")  # ((H+2)*hd, D)
+    wv3 = w.reshape(H + 2, hd, -1)
+    wq = np.ascontiguousarray(wv3[:H].reshape(H * hd, -1).T)
+    wk = np.ascontiguousarray(wv3[H].T)
+    wv = np.ascontiguousarray(wv3[H + 1].T)
+    return {
+        "ln1.scale": sd(p + "input_layernorm.weight"),
+        "ln1.bias": sd(p + "input_layernorm.bias"),
+        "wq": wq, "wk": wk, "wv": wv,
+        "wo": _lin(sd(p + "self_attention.dense.weight")),
+        "w_up": _lin(sd(p + "mlp.dense_h_to_4h.weight")),
+        "w_down": _lin(sd(p + "mlp.dense_4h_to_h.weight")),
+    }
+
+
+def _layer_bloom(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
+    p = f"h.{i}."
+    (wq, wk, wv), (bq, bk, bv) = _split_qkv_headmajor(
+        sd(p + "self_attention.query_key_value.weight"),
+        sd(p + "self_attention.query_key_value.bias"), cfg.n_heads, cfg.head_dim)
+    return {
+        "ln1.scale": sd(p + "input_layernorm.weight"),
+        "ln1.bias": sd(p + "input_layernorm.bias"),
+        "wq": wq, "wk": wk, "wv": wv, "bq": bq, "bk": bk, "bv": bv,
+        "wo": _lin(sd(p + "self_attention.dense.weight")),
+        "bo": sd(p + "self_attention.dense.bias"),
+        "ln2.scale": sd(p + "post_attention_layernorm.weight"),
+        "ln2.bias": sd(p + "post_attention_layernorm.bias"),
+        "w_up": _lin(sd(p + "mlp.dense_h_to_4h.weight")),
+        "b_up": sd(p + "mlp.dense_h_to_4h.bias"),
+        "w_down": _lin(sd(p + "mlp.dense_4h_to_h.weight")),
+        "b_down": sd(p + "mlp.dense_4h_to_h.bias"),
+    }
+
+
+def _layer_opt(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
+    p = f"decoder.layers.{i}."
+    return {
+        "ln1.scale": sd(p + "self_attn_layer_norm.weight"),
+        "ln1.bias": sd(p + "self_attn_layer_norm.bias"),
+        "wq": _lin(sd(p + "self_attn.q_proj.weight")),
+        "bq": sd(p + "self_attn.q_proj.bias"),
+        "wk": _lin(sd(p + "self_attn.k_proj.weight")),
+        "bk": sd(p + "self_attn.k_proj.bias"),
+        "wv": _lin(sd(p + "self_attn.v_proj.weight")),
+        "bv": sd(p + "self_attn.v_proj.bias"),
+        "wo": _lin(sd(p + "self_attn.out_proj.weight")),
+        "bo": sd(p + "self_attn.out_proj.bias"),
+        "ln2.scale": sd(p + "final_layer_norm.weight"),
+        "ln2.bias": sd(p + "final_layer_norm.bias"),
+        "w_up": _lin(sd(p + "fc1.weight")), "b_up": sd(p + "fc1.bias"),
+        "w_down": _lin(sd(p + "fc2.weight")), "b_down": sd(p + "fc2.bias"),
+    }
+
+
+_LAYER_FNS: Dict[str, Callable[[_SD, ModelConfig, int], Dict[str, np.ndarray]]] = {
+    "gpt2": _layer_gpt2, "gpt_neox": _layer_gptneox, "llama": _layer_llama,
+    "mistral": _layer_llama, "qwen2": _layer_llama, "qwen": _layer_llama,
+    "baichuan": _layer_baichuan, "falcon": _layer_falcon,
+    "RefinedWebModel": _layer_falcon, "bloom": _layer_bloom, "opt": _layer_opt,
+}
+
+_EMBED_KEYS = {
+    "gpt2": "wte.weight", "gpt_neox": "embed_in.weight",
+    "llama": "embed_tokens.weight", "mistral": "embed_tokens.weight",
+    "qwen2": "embed_tokens.weight", "qwen": "embed_tokens.weight",
+    "baichuan": "embed_tokens.weight",
+    "falcon": "word_embeddings.weight", "RefinedWebModel": "word_embeddings.weight",
+    "bloom": "word_embeddings.weight", "opt": "decoder.embed_tokens.weight",
+}
+
+_FINAL_LN = {
+    "gpt2": ("ln_f.weight", "ln_f.bias"),
+    "gpt_neox": ("final_layer_norm.weight", "final_layer_norm.bias"),
+    "llama": ("norm.weight", None), "mistral": ("norm.weight", None),
+    "qwen2": ("norm.weight", None), "qwen": ("norm.weight", None),
+    "baichuan": ("norm.weight", None),
+    "falcon": ("ln_f.weight", "ln_f.bias"),
+    "RefinedWebModel": ("ln_f.weight", "ln_f.bias"),
+    "bloom": ("ln_f.weight", "ln_f.bias"),
+    "opt": ("decoder.final_layer_norm.weight", "decoder.final_layer_norm.bias"),
+}
+
+
+def convert_decoder(state_dict: Mapping[str, Any], cfg: ModelConfig,
+                    family: str, dtype=jnp.float32) -> Params:
+    """Build the stacked-layer pytree `models/decoder.py` expects."""
+    sd = _SD(state_dict)
+    layer_fn = _LAYER_FNS[family]
+    rows = [layer_fn(sd, cfg, i) for i in range(cfg.n_layers)]
+
+    layers: Params = {}
+    for key in rows[0]:
+        stacked = _stack([r[key] for r in rows], dtype)
+        if "." in key:  # "ln1.scale" -> layers["ln1"]["scale"]
+            a, b = key.split(".")
+            layers.setdefault(a, {})[b] = stacked
+        else:
+            layers[key] = stacked
+
+    params: Params = {"tok_embed": jnp.asarray(sd(_EMBED_KEYS[family]), dtype),
+                      "layers": layers}
+
+    if cfg.pos_embedding == "learned":
+        pk = {"gpt2": "wpe.weight", "opt": "decoder.embed_positions.weight"}[family]
+        params["pos_embed"] = jnp.asarray(sd(pk), dtype)
+    if cfg.embedding_norm:
+        params["embed_ln"] = {
+            "scale": jnp.asarray(sd("word_embeddings_layernorm.weight"), dtype),
+            "bias": jnp.asarray(sd("word_embeddings_layernorm.bias"), dtype)}
+    if cfg.final_norm:
+        wkey, bkey = _FINAL_LN[family]
+        fl = {"scale": jnp.asarray(sd(wkey), dtype)}
+        if bkey is not None:
+            fl["bias"] = jnp.asarray(sd(bkey), dtype)
+        params["final_ln"] = fl
+    if not cfg.tie_embeddings:
+        for head_key in ("embed_out.weight", "lm_head.weight"):
+            if sd.has(head_key):
+                params["lm_head"] = jnp.asarray(_lin(sd(head_key)), dtype)
+                break
+        else:
+            raise KeyError("untied lm head not found in state dict")
+    return params
+
+
+def convert_t5(state_dict: Mapping[str, Any], cfg: T5Config,
+               dtype=jnp.float32) -> Params:
+    sd = _SD(state_dict)
+
+    def stack_block(side: str, cross: bool) -> Params:
+        rows = []
+        for i in range(cfg.n_layers):
+            p = f"{side}.block.{i}."
+            row = {
+                "ln_attn": sd(p + "layer.0.layer_norm.weight"),
+                "wq": _lin(sd(p + "layer.0.SelfAttention.q.weight")),
+                "wk": _lin(sd(p + "layer.0.SelfAttention.k.weight")),
+                "wv": _lin(sd(p + "layer.0.SelfAttention.v.weight")),
+                "wo": _lin(sd(p + "layer.0.SelfAttention.o.weight")),
+            }
+            mlp_idx = 2 if cross else 1
+            if cross:
+                row.update({
+                    "ln_cross": sd(p + "layer.1.layer_norm.weight"),
+                    "cq": _lin(sd(p + "layer.1.EncDecAttention.q.weight")),
+                    "ck": _lin(sd(p + "layer.1.EncDecAttention.k.weight")),
+                    "cv": _lin(sd(p + "layer.1.EncDecAttention.v.weight")),
+                    "co": _lin(sd(p + "layer.1.EncDecAttention.o.weight")),
+                })
+            m = f"{p}layer.{mlp_idx}."
+            row["ln_mlp"] = sd(m + "layer_norm.weight")
+            if cfg.gated_mlp:
+                row["wi_0"] = _lin(sd(m + "DenseReluDense.wi_0.weight"))
+                row["wi_1"] = _lin(sd(m + "DenseReluDense.wi_1.weight"))
+            else:
+                row["wi"] = _lin(sd(m + "DenseReluDense.wi.weight"))
+            row["wo_mlp"] = _lin(sd(m + "DenseReluDense.wo.weight"))
+            rows.append(row)
+        return {k: _stack([r[k] for r in rows], dtype) for k in rows[0]}
+
+    params: Params = {
+        "shared_embed": jnp.asarray(sd("shared.weight"), dtype),
+        "enc_rel_embed": jnp.asarray(
+            sd("encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"),
+            dtype),
+        "dec_rel_embed": jnp.asarray(
+            sd("decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"),
+            dtype),
+        "encoder": stack_block("encoder", cross=False),
+        "enc_final_ln": jnp.asarray(sd("encoder.final_layer_norm.weight"), dtype),
+        "decoder": stack_block("decoder", cross=True),
+        "dec_final_ln": jnp.asarray(sd("decoder.final_layer_norm.weight"), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(_lin(sd("lm_head.weight")), dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# HF-config adapters
+# ---------------------------------------------------------------------------
+
+def config_from_hf(hf_cfg) -> Tuple[ModelConfig, str]:
+    """Map a transformers PretrainedConfig to (ModelConfig, family)."""
+    mt = hf_cfg.model_type
+    g = lambda *names, d=None: next(
+        (getattr(hf_cfg, n) for n in names if getattr(hf_cfg, n, None) is not None), d)
+    common = dict(
+        name=getattr(hf_cfg, "name_or_path", mt) or mt,
+        vocab_size=hf_cfg.vocab_size,
+        hidden_size=g("hidden_size", "n_embd", "d_model"),
+        n_layers=g("num_hidden_layers", "n_layer", "num_layers"),
+        n_heads=g("num_attention_heads", "n_head"),
+        max_seq_len=g("max_position_embeddings", "n_positions", "seq_length", d=2048),
+    )
+    if mt == "gpt2":
+        return ModelConfig(**common, intermediate_size=4 * common["hidden_size"],
+                           pos_embedding="learned", norm="layernorm",
+                           norm_eps=hf_cfg.layer_norm_epsilon, activation="gelu_new",
+                           gated_mlp=False, qkv_bias=True, attn_out_bias=True,
+                           mlp_bias=True, tie_embeddings=True), "gpt2"
+    if mt == "gpt_neox":
+        return ModelConfig(**common, intermediate_size=hf_cfg.intermediate_size,
+                           pos_embedding="rotary", rotary_pct=hf_cfg.rotary_pct,
+                           rope_theta=getattr(hf_cfg, "rotary_emb_base", 10000.0),
+                           norm="layernorm", norm_eps=hf_cfg.layer_norm_eps,
+                           activation="gelu", gated_mlp=False,
+                           parallel_block=hf_cfg.use_parallel_residual,
+                           qkv_bias=True, attn_out_bias=True, mlp_bias=True), "gpt_neox"
+    if mt in ("llama", "mistral", "qwen2", "baichuan"):
+        return ModelConfig(**common, intermediate_size=hf_cfg.intermediate_size,
+                           n_kv_heads=g("num_key_value_heads"),
+                           rope_theta=g("rope_theta", d=10000.0),
+                           norm_eps=hf_cfg.rms_norm_eps,
+                           qkv_bias=(mt == "qwen2" and getattr(
+                               hf_cfg, "attention_bias", False)) or bool(
+                               getattr(hf_cfg, "use_bias", False)),
+                           tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings",
+                                                       False))), mt
+    if mt in ("falcon", "RefinedWebModel"):
+        return ModelConfig(**common, intermediate_size=4 * common["hidden_size"],
+                           n_kv_heads=1 if g("multi_query", d=True) else common["n_heads"],
+                           pos_embedding="rotary", norm="layernorm",
+                           norm_eps=hf_cfg.layer_norm_epsilon,
+                           activation="gelu", gated_mlp=False, parallel_block=True,
+                           shared_block_ln=True, tie_embeddings=True), "falcon"
+    if mt == "bloom":
+        return ModelConfig(**common, intermediate_size=4 * common["hidden_size"],
+                           pos_embedding="alibi", norm="layernorm",
+                           norm_eps=hf_cfg.layer_norm_epsilon, activation="gelu_new",
+                           gated_mlp=False, embedding_norm=True, qkv_bias=True,
+                           attn_out_bias=True, mlp_bias=True,
+                           tie_embeddings=True), "bloom"
+    if mt == "opt":
+        return ModelConfig(**common, intermediate_size=hf_cfg.ffn_dim,
+                           pos_embedding="learned", learned_pos_offset=2,
+                           norm="layernorm", activation="relu", gated_mlp=False,
+                           qkv_bias=True, attn_out_bias=True, mlp_bias=True,
+                           tie_embeddings=True), "opt"
+    raise ValueError(f"unsupported model_type {mt!r}")
+
+
+def t5_config_from_hf(hf_cfg) -> T5Config:
+    return T5Config(
+        name=getattr(hf_cfg, "name_or_path", "t5") or "t5",
+        vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.d_model,
+        n_layers=hf_cfg.num_layers, n_heads=hf_cfg.num_heads,
+        head_dim=hf_cfg.d_kv, intermediate_size=hf_cfg.d_ff,
+        norm_eps=hf_cfg.layer_norm_epsilon,
+        relative_attention_num_buckets=hf_cfg.relative_attention_num_buckets,
+        relative_attention_max_distance=getattr(
+            hf_cfg, "relative_attention_max_distance", 128),
+        gated_mlp="gated" in hf_cfg.feed_forward_proj,
+        activation="gelu_new" if "gelu" in hf_cfg.feed_forward_proj else "relu",
+        tie_embeddings=bool(hf_cfg.tie_word_embeddings),
+        decoder_start_token_id=hf_cfg.decoder_start_token_id,
+    )
