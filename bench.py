@@ -1,0 +1,73 @@
+"""Benchmark: prompts/sec/chip on the perturbation-sweep scoring path.
+
+BASELINE.json's metric. The reference's "throughput" was the OpenAI Batch API
+(server-side, 24 h completion window — no local number exists, so
+``vs_baseline`` is measured against the committed nominal in BENCH_NOMINAL
+below; >1.0 means faster than the first recorded run of this same bench).
+
+Runs the real engine end to end on whatever accelerator is present (TPU chip
+under axon; CPU otherwise): flagship-class decoder, random bf16 weights,
+batched greedy decode (10 new tokens — the C13 scan window) + yes/no readout.
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# First recorded value of this benchmark on the target chip (v5e-1, 2026-07-29:
+# 6554 prompts/s, flagship cfg, seq 256, 10 generated tokens). Update
+# deliberately when the bench definition changes, never silently.
+BENCH_NOMINAL = 6554.0  # prompts/sec/chip
+
+BATCH = 32
+SEQ = 256
+NEW_TOKENS = 10  # MAX_LOOK_AHEAD: the positions the C13 readout consumes
+
+
+def main() -> None:
+    from __graft_entry__ import _flagship_cfg
+    from lir_tpu.engine import generate, score
+    from lir_tpu.models import decoder
+
+    cfg = _flagship_cfg()
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(3, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)
+    mask = jnp.ones_like(toks)
+
+    def step(params, toks, mask):
+        gen, logits = generate.greedy_decode(params, cfg, toks, mask,
+                                             max_new_tokens=NEW_TOKENS)
+        return score.readout_from_step_logits(logits, gen, jnp.int32(1),
+                                              jnp.int32(2))
+
+    # Warmup/compile.
+    jax.block_until_ready(step(params, toks, mask))
+
+    n_iters = 10
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        jax.block_until_ready(step(params, toks, mask))
+    dt = time.perf_counter() - t0
+
+    prompts_per_sec = BATCH * n_iters / dt
+    print(json.dumps({
+        "metric": "prompts_per_sec_per_chip",
+        "value": round(prompts_per_sec, 3),
+        "unit": f"prompts/s ({cfg.name}, seq={SEQ}, {NEW_TOKENS} gen, {dev.platform})",
+        "vs_baseline": round(prompts_per_sec / BENCH_NOMINAL, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Fake inference backend for hermetic tests (SURVEY.md §4).
+
+The reference has no test suite; its committed CSVs double as golden outputs.
+Our upgrade: a deterministic tokenizer + tiny-model stand-in so the engine
+(L2) and stats (L4) layers are testable with zero network, zero weights, and
+zero TPU time. The FakeTokenizer implements exactly the slice of the HF
+tokenizer protocol the engine touches (``__call__ -> .input_ids``,
+``decode``, ``pad_token_id``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import List, Sequence
+
+
+@dataclasses.dataclass
+class _Encoding:
+    input_ids: List[int]
+
+
+class FakeTokenizer:
+    """Whitespace word tokenizer with a stable hashed vocab.
+
+    Ids are stable across runs/processes (md5, not Python hash). ' Yes' and
+    ' No' map to dedicated reserved ids so yes/no readout tests are exact.
+    """
+
+    VOCAB = 1000
+    PAD, YES, NO = 0, 1, 2
+    _RESERVED = 3
+
+    pad_token_id = PAD
+    eos_token_id = PAD
+
+    def _word_id(self, w: str) -> int:
+        if w == "Yes":
+            return self.YES
+        if w == "No":
+            return self.NO
+        h = int(hashlib.md5(w.encode()).hexdigest(), 16)
+        return self._RESERVED + h % (self.VOCAB - self._RESERVED)
+
+    def __call__(self, text: str, add_special_tokens: bool = True) -> _Encoding:
+        return _Encoding([self._word_id(w) for w in text.split()])
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        out = []
+        for i in ids:
+            i = int(i)
+            if i == self.YES:
+                out.append("Yes")
+            elif i == self.NO:
+                out.append("No")
+            elif i != self.PAD or not skip_special_tokens:
+                out.append(f"<{i}>")
+        return " ".join(out)
+
+    def __len__(self) -> int:
+        return self.VOCAB

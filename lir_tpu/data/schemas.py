@@ -211,6 +211,8 @@ def write_perturbation_results(
     old file is backed up and a fresh one written, never silently merged."""
     df = perturbation_dataframe(rows)
     path.parent.mkdir(parents=True, exist_ok=True)
+    if path.suffix == ".xlsx" and not _xlsx_available():
+        path = path.with_suffix(".csv")
     if append and path.exists():
         read = pd.read_excel if path.suffix == ".xlsx" else pd.read_csv
         try:
@@ -219,7 +221,17 @@ def write_perturbation_results(
             # Corrupt/truncated prior file (e.g. a kill mid-write): keep it in
             # place and save the fresh rows alongside, as the reference does
             # (perturb_prompts.py:1007-1011) — never lose computed results.
+            # Later flushes in the same situation must APPEND to the side
+            # file, not overwrite it (rows are already marked done upstream).
             new_path = path.with_name(path.stem + "_new" + path.suffix)
+            if new_path.exists():
+                try:
+                    prev = (pd.read_excel if new_path.suffix == ".xlsx"
+                            else pd.read_csv)(new_path)
+                    if list(prev.columns) == list(df.columns):
+                        df = pd.concat([prev, df], ignore_index=True)
+                except Exception:
+                    pass
             _write_frame(df, new_path)
             return df
         if list(existing.columns) == list(df.columns):
@@ -231,11 +243,49 @@ def write_perturbation_results(
     return df
 
 
+def _xlsx_available() -> bool:
+    try:
+        import openpyxl  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
 def _write_frame(df: pd.DataFrame, path: Path) -> None:
-    if path.suffix == ".xlsx":
+    if path.suffix == ".xlsx" and _xlsx_available():
         df.to_excel(path, index=False)
     else:
-        df.to_csv(path, index=False)
+        # Environment has no Excel engine: keep the 15-column schema but in
+        # CSV next to the requested name (columns, not container, are the
+        # D6 contract — SURVEY.md §2.4).
+        df.to_csv(path.with_suffix(".csv") if path.suffix == ".xlsx" else path,
+                  index=False)
+
+
+def resolve_results_path(path: Path) -> Path:
+    """The path _write_frame will actually use (xlsx -> csv fallback when no
+    Excel engine exists). Resolve ONCE at sweep start so manifests, readers,
+    and writers agree on the artifact name."""
+    path = Path(path)
+    if path.suffix == ".xlsx" and not _xlsx_available():
+        return path.with_suffix(".csv")
+    return path
+
+
+def read_results_frame(path: Path) -> pd.DataFrame:
+    """Read a results artifact written by _write_frame (xlsx or CSV fallback)."""
+    path = Path(path)
+    if path.suffix == ".xlsx":
+        if path.exists() and _xlsx_available():
+            return pd.read_excel(path)
+        csv = path.with_suffix(".csv")
+        if csv.exists():
+            return pd.read_csv(csv)
+        if path.exists():
+            raise RuntimeError(
+                f"{path} exists but no Excel engine (openpyxl) is available "
+                f"and no CSV fallback was found at {csv}")
+    return pd.read_csv(path)
 
 
 # ---------------------------------------------------------------------------

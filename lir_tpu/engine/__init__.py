@@ -1,0 +1,5 @@
+"""Inference engine: batched scoring, greedy decode, grid + sweep drivers."""
+
+from .runner import PromptScore, ScoringEngine  # noqa: F401
+from .score import YesNoScores, readout_from_step_logits, weighted_confidence  # noqa: F401
+from .sweep import run_perturbation_sweep, run_word_meaning_sweep  # noqa: F401

@@ -1,0 +1,84 @@
+"""Greedy decoding with per-step logit capture.
+
+The reference's measurement path is ``model.generate(max_new_tokens=50,
+output_scores=True, return_dict_in_generate=True)`` followed by a scan of the
+first 10 score tensors (compare_base_vs_instruct.py:251-278). Here that is one
+jitted program: prefill the KV cache, then ``lax.scan`` 50 greedy steps,
+stacking each step's fp32 logits. Fixed shapes throughout — the grid engine
+batches ragged prompts by left-padding (decoder.mask_positions makes padding
+a no-op).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import decoder
+from ..models.registry import ModelConfig, T5Config
+from ..models import encdec
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def greedy_decode(params, cfg: ModelConfig, tokens: jax.Array,
+                  attn_mask: jax.Array, max_new_tokens: int = 50
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """tokens/attn_mask: (B, S) LEFT-padded int32.
+
+    Returns (generated (B, max_new_tokens) int32,
+             step_logits (B, max_new_tokens, V) fp32)."""
+    B, S = tokens.shape
+    T = S + max_new_tokens
+    logits0, cache, pos0 = decoder.prefill(params, cfg, tokens, attn_mask, T)
+
+    cache_mask0 = jnp.pad(attn_mask, ((0, 0), (0, max_new_tokens)))
+
+    def step(carry, t):
+        logits, cache, cache_mask = carry
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        cache_mask = cache_mask.at[:, S + t].set(1)
+        new_logits, cache = decoder.decode_step(
+            params, cfg, cache, nxt, pos0 + t, S + t, cache_mask)
+        return (new_logits, cache, cache_mask), (nxt, logits)
+
+    (_, _, _), (gen, step_logits) = lax.scan(
+        step, (logits0, cache, cache_mask0), jnp.arange(max_new_tokens))
+    # scan stacks on axis 0 -> (T_new, B, ...); put batch first.
+    return jnp.swapaxes(gen, 0, 1), jnp.swapaxes(step_logits, 0, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def t5_greedy_decode(params, cfg: T5Config, enc_tokens: jax.Array,
+                     enc_mask: jax.Array, max_new_tokens: int = 50
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Encoder-decoder greedy decode (reference Seq2Seq branch,
+    compare_base_vs_instruct.py:203-241).
+
+    Re-runs the (tiny) decoder stack over a fixed (B, max_new) buffer each
+    step — sequences here are ≤50 tokens so a KV cache buys nothing.
+    Returns (generated (B, max_new), step_logits (B, max_new, V) fp32)."""
+    B = enc_tokens.shape[0]
+    enc_out = encdec.encode(params, cfg, enc_tokens, enc_mask)
+
+    dec_buf0 = jnp.full((B, max_new_tokens + 1), cfg.decoder_start_token_id,
+                        dtype=jnp.int32)
+    mask0 = jnp.zeros((B, max_new_tokens + 1), jnp.int32).at[:, 0].set(1)
+
+    def step(carry, t):
+        dec_buf, mask = carry
+        logits = encdec.decode(params, cfg, enc_out, enc_mask, dec_buf, mask)
+        # Logits at the last valid position (= t).
+        step_logits = jnp.take_along_axis(
+            logits, t[None, None, None].repeat(B, 0), axis=1)[:, 0, :]
+        nxt = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+        dec_buf = dec_buf.at[:, t + 1].set(nxt)
+        mask = mask.at[:, t + 1].set(1)
+        return (dec_buf, mask), (nxt, step_logits)
+
+    (_, _), (gen, step_logits) = lax.scan(
+        step, (dec_buf0, mask0), jnp.arange(max_new_tokens))
+    return jnp.swapaxes(gen, 0, 1), jnp.swapaxes(step_logits, 0, 1)

@@ -1,0 +1,135 @@
+"""Batched scoring engine: the TPU replacement for both reference backends.
+
+Where the reference loops prompts one at a time through
+``model.generate(output_scores=True)`` (compare_base_vs_instruct.py:458-492)
+or ships them to the OpenAI Batch API (perturb_prompts.py:551-726), this
+engine packs ragged prompts into fixed-shape left-padded batches, runs ONE
+jitted greedy-decode-with-capture per batch (sharded over the device mesh),
+and applies the C13 readout vectorized over the batch.
+
+Static-shape discipline: prompts are bucketed by token length and the batch
+axis padded to ``batch_size``, so XLA compiles once per (bucket, batch_size)
+pair and every subsequent batch reuses the cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import RuntimeConfig
+from . import generate, score, tokens as tok
+
+
+@dataclasses.dataclass
+class PromptScore:
+    """One prompt's raw measurement. Sweep drivers wrap this into
+    data/schemas.py records (which add model identity and D1/D2 semantics)."""
+
+    prompt: str
+    completion: str
+    yes_prob: float
+    no_prob: float
+    yes_logprob: float
+    no_logprob: float
+    odds_ratio: float
+    relative_prob: float
+    position_found: int
+    yes_no_found: bool
+
+
+class ScoringEngine:
+    """Holds (params, cfg, tokenizer) and the jitted decode path.
+
+    ``encoder_decoder=True`` routes through the T5 branch (reference routing
+    rule compare_instruct_models.py:471-475).
+    """
+
+    def __init__(self, params: Any, cfg: Any, tokenizer: Any,
+                 runtime: Optional[RuntimeConfig] = None,
+                 encoder_decoder: bool = False,
+                 yes_text: str = "Yes", no_text: str = "No"):
+        self.params = params
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.rt = runtime or RuntimeConfig()
+        self.encoder_decoder = encoder_decoder
+        self.yes_id, self.no_id = tok.yes_no_ids(
+            tokenizer, encoder_decoder=encoder_decoder,
+            yes_text=yes_text, no_text=no_text)
+        self.eos_id = getattr(tokenizer, "eos_token_id", None)
+        # Length buckets: powers of two up to max_seq_len (≲700-token prompts).
+        self.buckets = [b for b in (64, 128, 256, 512, 1024)
+                        if b <= self.rt.max_seq_len] or [self.rt.max_seq_len]
+
+    # -- building blocks ----------------------------------------------------
+
+    def decode_prompts(self, prompts: Sequence[str]
+                       ) -> Tuple[jax.Array, jax.Array]:
+        """Tokenize once, left-pad into the smallest fitting bucket, run one
+        jitted greedy decode. Returns (generated (B, T_new) int32,
+        step_logits (B, T_new, V) fp32)."""
+        ids_list = [self.tokenizer(p).input_ids for p in prompts]
+        bucket = tok.pick_bucket([len(i) for i in ids_list], self.buckets)
+        toks_arr, mask = tok.left_pad_ids(ids_list, bucket,
+                                          tok.pad_token_id(self.tokenizer))
+        if self.encoder_decoder:
+            return generate.t5_greedy_decode(
+                self.params, self.cfg, jnp.asarray(toks_arr), jnp.asarray(mask),
+                max_new_tokens=self.rt.max_new_tokens)
+        return generate.greedy_decode(
+            self.params, self.cfg, jnp.asarray(toks_arr), jnp.asarray(mask),
+            max_new_tokens=self.rt.max_new_tokens)
+
+    def decode_completion(self, generated_ids: np.ndarray) -> str:
+        """Token ids -> text, stopping at the first EOS (HF generate parity —
+        the fixed-length jitted decode keeps emitting after EOS; those tokens
+        must not leak into response text or the confidence-integer parse)."""
+        trimmed = tok.trim_at_eos(np.asarray(generated_ids).tolist(), self.eos_id)
+        return self.tokenizer.decode(trimmed, skip_special_tokens=True).strip()
+
+    # -- public API ---------------------------------------------------------
+
+    def score_prompts(self, prompts: Sequence[str]) -> List[PromptScore]:
+        """Score every prompt; one jitted call per full batch."""
+        order = np.argsort([len(p) for p in prompts], kind="stable")
+        rows: List[Optional[PromptScore]] = [None] * len(prompts)
+        B = self.rt.batch_size
+        for start in range(0, len(order), B):
+            idx = order[start:start + B]
+            batch_prompts = [prompts[i] for i in idx]
+            rows_out = self._score_batch(batch_prompts)
+            for i, r in zip(idx, rows_out):
+                rows[i] = r
+        return rows  # type: ignore[return-value]
+
+    def _score_batch(self, batch_prompts: List[str]) -> List[PromptScore]:
+        n = len(batch_prompts)
+        B = self.rt.batch_size
+        padded_prompts = batch_prompts + [batch_prompts[-1]] * (B - n)
+
+        gen, step_logits = self.decode_prompts(padded_prompts)
+        res = score.readout_from_step_logits(
+            step_logits, gen, jnp.int32(self.yes_id), jnp.int32(self.no_id),
+            scan_positions=self.rt.scan_positions)
+
+        res = jax.device_get(res)
+        out = []
+        for j in range(n):
+            out.append(PromptScore(
+                prompt=batch_prompts[j],
+                completion=self.decode_completion(res.generated[j]),
+                yes_prob=float(res.yes_prob[j]),
+                no_prob=float(res.no_prob[j]),
+                yes_logprob=float(res.yes_logprob[j]),
+                no_logprob=float(res.no_logprob[j]),
+                odds_ratio=float(res.odds_ratio[j]),
+                relative_prob=float(res.relative_prob[j]),
+                position_found=int(res.position_found[j]),
+                yes_no_found=bool(res.yes_no_found[j]),
+            ))
+        return out

@@ -1,0 +1,109 @@
+"""The core measurement primitive: yes/no token-probability readout.
+
+C13 parity (SURVEY.md §2.1): the reference generates up to 50 tokens with
+scores, scans the first MAX_LOOK_AHEAD=10 generated positions, and at the
+FIRST position where the Yes or No token id appears in the top-2 reads
+P(yes)/P(no) from that position's softmax, falling back to position 0
+(compare_base_vs_instruct.py:185-305). The two reference scripts drifted on
+the readout (odds_ratio = yes/no vs relative_prob = yes/(yes+no), SURVEY.md
+§1); here ONE primitive returns both.
+
+Everything is vectorized over the batch: (B,) results from one jitted call,
+replacing the reference's one-prompt-at-a-time loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+MAX_LOOK_AHEAD = 10   # compare_base_vs_instruct.py:187
+TOPK_MATCH = 2        # top-2 rule, :270-273
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class YesNoScores:
+    """Batched scorer output (all arrays shaped (B,) unless noted)."""
+
+    yes_prob: jax.Array
+    no_prob: jax.Array
+    yes_logprob: jax.Array
+    no_logprob: jax.Array
+    odds_ratio: jax.Array        # yes/no  (compare_base_vs_instruct.py:293)
+    relative_prob: jax.Array     # yes/(yes+no) (compare_instruct_models.py:281)
+    position_found: jax.Array    # int32; first top-2 match, else 0
+    yes_no_found: jax.Array      # bool
+    generated: jax.Array         # (B, max_new) int32 token ids (completion text)
+
+
+def readout_from_step_logits(step_logits: jax.Array, generated: jax.Array,
+                             yes_id: jax.Array, no_id: jax.Array,
+                             scan_positions: int = MAX_LOOK_AHEAD) -> YesNoScores:
+    """Apply the scan-position rule to captured per-step logits.
+
+    step_logits: (B, T_new, V) fp32; generated: (B, T_new) int32;
+    yes_id/no_id: scalar int32 target token ids (first sub-token of " Yes" /
+    " No" or "Yes"/"No" per tokenizer adapter — SURVEY.md §7 hard part 1).
+    """
+    B, T, V = step_logits.shape
+    yes_id = jnp.broadcast_to(jnp.asarray(yes_id, jnp.int32), (B,))  # per-row ok
+    no_id = jnp.broadcast_to(jnp.asarray(no_id, jnp.int32), (B,))
+    window = step_logits[:, :scan_positions, :]          # (B, P, V)
+    probs = jax.nn.softmax(window, axis=-1)
+
+    _, top_idx = jax.lax.top_k(window, TOPK_MATCH)        # (B, P, k)
+    is_target = ((top_idx == yes_id[:, None, None])
+                 | (top_idx == no_id[:, None, None]))
+    found_at = jnp.any(is_target, axis=-1)                # (B, P)
+
+    any_found = jnp.any(found_at, axis=-1)                # (B,)
+    first_pos = jnp.argmax(found_at, axis=-1)             # first True, else 0
+    position = jnp.where(any_found, first_pos, 0).astype(jnp.int32)
+
+    sel = jnp.take_along_axis(probs, position[:, None, None], axis=1)[:, 0, :]
+    yes_prob = jnp.take_along_axis(sel, yes_id[:, None], axis=1)[:, 0]
+    no_prob = jnp.take_along_axis(sel, no_id[:, None], axis=1)[:, 0]
+    eps = 1e-10
+    denom = yes_prob + no_prob
+    return YesNoScores(
+        yes_prob=yes_prob,
+        no_prob=no_prob,
+        yes_logprob=jnp.log(yes_prob + eps),
+        no_logprob=jnp.log(no_prob + eps),
+        odds_ratio=yes_prob / (no_prob + eps),
+        relative_prob=jnp.where(denom > 0, yes_prob / (denom + eps), jnp.nan),
+        position_found=position,
+        yes_no_found=any_found,
+        generated=generated,
+    )
+
+
+def topk_logprobs(step_logits: jax.Array, k: int = 20, position: int = 0):
+    """Top-k (logprob, token_id) at one generated position — fills the D6
+    'Log Probabilities' column the API backend got from OpenAI's
+    ``top_logprobs=20`` (perturb_prompts.py:249-252,474-488).
+
+    Returns (logprobs (B, k), ids (B, k))."""
+    logp = jax.nn.log_softmax(step_logits[:, position, :], axis=-1)
+    vals, ids = jax.lax.top_k(logp, k)
+    return vals, ids
+
+
+def weighted_confidence(step_logits: jax.Array, digit_token_ids: jax.Array,
+                        digit_values: jax.Array, position: int = 0) -> jax.Array:
+    """E[v] over integer-token probabilities 0..100 — the API-backend
+    "Weighted Confidence" readout (perturb_prompts.py:504-526) recomputed
+    from local logits.
+
+    digit_token_ids: (K,) token ids whose decoded text is an integer in
+    [0, 100]; digit_values: (K,) the integers. Probabilities are renormalized
+    over the digit set, matching the reference's sum-over-top-logprobs.
+    Returns (B,) expected confidence.
+    """
+    probs = jax.nn.softmax(step_logits[:, position, :], axis=-1)  # (B, V)
+    p = probs[:, digit_token_ids]                                 # (B, K)
+    mass = jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.sum(p * digit_values[None, :], axis=-1) / jnp.maximum(mass[:, 0], 1e-10)

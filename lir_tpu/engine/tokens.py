@@ -1,0 +1,130 @@
+"""Tokenizer adapters: target-token-id resolution + ragged batch packing.
+
+SURVEY.md §7 ranks tokenizer semantics parity as hard part #1: the decoder
+branch of the reference uses the first sub-token of the LEADING-SPACE
+variants '" Yes"/" No"' (compare_base_vs_instruct.py:244-247, fallback to
+bare "Yes"/"No" at compare_instruct_models.py:232-233), while the
+encoder-decoder branch uses bare ``tokenizer("Yes").input_ids[0]``
+(compare_base_vs_instruct.py:208-209). Mis-resolving these ids silently
+corrupts every downstream statistic, so this module is the one place that
+rule lives, and tests pin it per family.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def first_token_id(tokenizer, text: str) -> int:
+    ids = tokenizer(text, add_special_tokens=False).input_ids
+    if len(ids) == 0:
+        raise ValueError(f"tokenizer produced no ids for {text!r}")
+    return int(ids[0])
+
+
+def yes_no_ids(tokenizer, *, encoder_decoder: bool = False,
+               yes_text: str = "Yes", no_text: str = "No") -> Tuple[int, int]:
+    """Resolve the two target token ids under the reference's rules."""
+    if encoder_decoder:
+        return first_token_id(tokenizer, yes_text), first_token_id(tokenizer, no_text)
+    try:
+        return (first_token_id(tokenizer, " " + yes_text),
+                first_token_id(tokenizer, " " + no_text))
+    except ValueError:
+        return first_token_id(tokenizer, yes_text), first_token_id(tokenizer, no_text)
+
+
+def target_token_ids(tokenizer, targets: Sequence[str],
+                     *, encoder_decoder: bool = False) -> List[int]:
+    """First-token ids for arbitrary target strings (legal prompts use e.g.
+    'Covered'/'Not' — perturb_prompts.py target_tokens)."""
+    out = []
+    for t in targets:
+        if encoder_decoder:
+            out.append(first_token_id(tokenizer, t))
+        else:
+            try:
+                out.append(first_token_id(tokenizer, " " + t))
+            except ValueError:
+                out.append(first_token_id(tokenizer, t))
+    return out
+
+
+def integer_token_table(tokenizer, lo: int = 0, hi: int = 100
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """(token_ids, values) for every single-token rendering of an integer in
+    [lo, hi] — with and without leading space. Feeds
+    engine.score.weighted_confidence (reference E[v] readout,
+    perturb_prompts.py:504-526, which scans top_logprobs for integer-parsable
+    token strings)."""
+    ids, vals = [], []
+    seen = set()
+    for v in range(lo, hi + 1):
+        for text in (str(v), " " + str(v)):
+            toks = tokenizer(text, add_special_tokens=False).input_ids
+            if len(toks) == 1 and toks[0] not in seen:
+                seen.add(toks[0])
+                ids.append(int(toks[0]))
+                vals.append(float(v))
+    return np.asarray(ids, np.int32), np.asarray(vals, np.float32)
+
+
+def pad_token_id(tokenizer) -> int:
+    pid = getattr(tokenizer, "pad_token_id", None)
+    if pid is None:
+        pid = getattr(tokenizer, "eos_token_id", 0) or 0
+    return int(pid)
+
+
+def left_pad_ids(ids_list: Sequence[Sequence[int]], max_len: int,
+                 pad_id: int) -> Tuple[np.ndarray, np.ndarray]:
+    """LEFT-pad pre-tokenized prompts to (B, max_len) int32 (tokens, mask).
+
+    Left padding keeps the prompt end at position max_len-1 for every row, so
+    one jitted prefill serves ragged prompts (decoder.mask_positions gives
+    pads position 0 and the bias masks them out). Truncates from the left if
+    a prompt exceeds max_len (reference prompts are ≲700 tokens, SURVEY §5).
+    """
+    B = len(ids_list)
+    tokens = np.full((B, max_len), pad_id, np.int32)
+    mask = np.zeros((B, max_len), np.int32)
+    for i, ids in enumerate(ids_list):
+        ids = list(ids)[-max_len:]
+        tokens[i, max_len - len(ids):] = ids
+        mask[i, max_len - len(ids):] = 1
+    return tokens, mask
+
+
+def left_pad_batch(tokenizer, prompts: Sequence[str], max_len: int,
+                   *, add_special_tokens: bool = True
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Tokenize + LEFT-pad to (B, max_len) int32 (tokens, mask)."""
+    ids_list = [tokenizer(p, add_special_tokens=add_special_tokens).input_ids
+                for p in prompts]
+    return left_pad_ids(ids_list, max_len, pad_token_id(tokenizer))
+
+
+def trim_at_eos(ids: Sequence[int], eos_id: Optional[int]) -> List[int]:
+    """Drop the first EOS and everything after it — parity with HF
+    ``generate`` stopping at EOS (the jitted decode runs a fixed number of
+    steps, so post-EOS garbage must not leak into decoded completions or the
+    confidence-integer parse)."""
+    ids = [int(i) for i in ids]
+    if eos_id is None:
+        return ids
+    try:
+        return ids[: ids.index(int(eos_id))]
+    except ValueError:
+        return ids
+
+
+def pick_bucket(lengths: Sequence[int], buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits the longest prompt (static-shape discipline:
+    one compile per bucket instead of one per length)."""
+    m = max(lengths)
+    for b in sorted(buckets):
+        if b >= m:
+            return b
+    return max(buckets)

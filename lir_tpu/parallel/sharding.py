@@ -1,0 +1,126 @@
+"""Device mesh + parameter sharding rules.
+
+The reference's only intra-model parallelism is accelerate's
+``device_map="auto"`` layer offloading (compare_base_vs_instruct.py:424-435);
+its only "communication backend" is the OpenAI Batch REST API (SURVEY.md §5).
+The TPU-native replacement is declarative: build a ``jax.sharding.Mesh`` over
+the slice, annotate params/activations with ``NamedSharding``, and let XLA
+emit the all-gather/reduce-scatter/psum collectives over ICI.
+
+Axes (scaling-book convention):
+- ``data``  — the perturbation/question grid (batch) axis.
+- ``model`` — tensor parallelism: attention heads / MLP columns / vocab.
+- ``seq``   — sequence (context) parallelism for the long-context path
+  (parallel/ring_attention.py).
+
+Megatron-style rules: qkv projections are column-parallel (heads), the
+attention output and MLP down projection row-parallel, embeddings sharded on
+the hidden axis, the LM head on vocab. Families whose head counts don't
+divide the mesh (falcon-7b MQA: 71 q heads, 1 kv head) degrade gracefully to
+replicated attention + sharded MLP rather than failing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import MeshConfig
+from ..models.registry import ModelConfig
+
+Params = Dict[str, Any]
+
+
+def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
+    """Create a (data, model, seq) mesh. Works on real TPU slices and on
+    virtual CPU devices (XLA_FLAGS=--xla_force_host_platform_device_count=N)."""
+    if devices is None:
+        devices = jax.devices()
+    n = cfg.n_devices
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(cfg.shape)
+    return Mesh(arr, cfg.axis_names)
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1),
+                ("data", "model", "seq"))
+
+
+def decoder_param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
+    """PartitionSpec tree matching models/decoder.py's param layout.
+
+    Head-sharded attention requires n_heads % model_size == 0 AND
+    n_kv_heads % model_size == 0 (MQA/odd-head families replicate attention
+    instead); MLP sharding requires intermediate_size % model_size == 0.
+    """
+    m = mesh.shape["model"]
+    shard_attn = (cfg.n_heads % m == 0) and (cfg.n_kv_heads % m == 0)
+    shard_mlp = cfg.intermediate_size % m == 0
+    shard_vocab = cfg.vocab_size % m == 0
+    shard_hidden = cfg.hidden_size % m == 0
+
+    A = "model" if shard_attn else None    # qkv output / wo input axis
+    F = "model" if shard_mlp else None     # MLP hidden axis
+    V = "model" if shard_vocab else None   # vocab axis
+
+    layers: Params = {
+        "ln1": {"scale": P(None, None)},
+        "wq": P(None, None, A), "wk": P(None, None, A), "wv": P(None, None, A),
+        "wo": P(None, A, None),
+        "w_up": P(None, None, F), "w_down": P(None, F, None),
+    }
+    if cfg.norm == "layernorm":
+        layers["ln1"]["bias"] = P(None, None)
+    if not cfg.shared_block_ln:
+        layers["ln2"] = dict(layers["ln1"])
+    if cfg.gated_mlp:
+        layers["w_gate"] = P(None, None, F)
+    if cfg.qkv_bias:
+        layers.update({"bq": P(None, A), "bk": P(None, A), "bv": P(None, A)})
+    if cfg.attn_out_bias:
+        layers["bo"] = P(None, None)
+    if cfg.mlp_bias:
+        layers.update({"b_up": P(None, F), "b_down": P(None, None)})
+
+    specs: Params = {
+        # Embedding sharded on hidden: the take() stays local, layer 0's
+        # first matmul all-gathers activations (cheap at these batch sizes).
+        "tok_embed": P(None, "model" if shard_hidden else None),
+        "layers": layers,
+    }
+    if cfg.pos_embedding == "learned":
+        specs["pos_embed"] = P(None, "model" if shard_hidden else None)
+    if cfg.embedding_norm:
+        specs["embed_ln"] = {"scale": P(None), "bias": P(None)}
+    if cfg.final_norm:
+        specs["final_ln"] = {"scale": P(None)}
+        if cfg.norm == "layernorm":
+            specs["final_ln"]["bias"] = P(None)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, V)
+    return specs
+
+
+def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    """device_put every param with its NamedSharding (single host)."""
+    specs = decoder_param_specs(cfg, mesh)
+
+    def place(leaf, spec):
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, params, specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Inputs: grid/batch axis over 'data', sequence axis replicated."""
+    return NamedSharding(mesh, P("data", None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

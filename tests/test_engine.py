@@ -1,0 +1,171 @@
+"""Engine tests: readout rule, greedy decode, batched scorer, sharded forward.
+
+The readout rule under test is C13 (compare_base_vs_instruct.py:185-305):
+scan first 10 generated positions, first top-2 yes/no hit wins, fallback to
+position 0. Sharding tests exercise the same Mesh/pjit paths as a v5e-8 via
+8 virtual CPU devices (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import MeshConfig, RuntimeConfig
+from lir_tpu.engine import generate, score, tokens as tok
+from lir_tpu.engine.runner import ScoringEngine
+from lir_tpu.models import decoder
+from lir_tpu.models.loader import config_from_hf, convert_decoder
+from lir_tpu.models.registry import tiny
+from lir_tpu.parallel import sharding
+
+
+def _tiny_llama_params(vocab=1000, seed=0):
+    import transformers as tf
+    torch.manual_seed(seed)
+    hf = tf.LlamaForCausalLM(tf.LlamaConfig(
+        vocab_size=vocab, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, intermediate_size=128,
+        max_position_embeddings=256, tie_word_embeddings=False)).eval()
+    cfg, fam = config_from_hf(hf.config)
+    return convert_decoder(hf.state_dict(), cfg, fam), cfg, hf
+
+
+# ---------------------------------------------------------------------------
+# Readout rule (pure function, synthetic logits)
+# ---------------------------------------------------------------------------
+
+def test_readout_first_top2_match_wins():
+    B, T, V = 2, 12, 50
+    yes_id, no_id = 7, 9
+    logits = np.full((B, T, V), -10.0, np.float32)
+    logits[:, :, 3] = 5.0          # dominant distractor everywhere
+    logits[:, :, 4] = 4.0          # second-place distractor
+    # Row 0: yes enters top-2 at position 3 (beats the 4.0 distractor).
+    logits[0, 3, yes_id] = 4.5
+    logits[0, 3, no_id] = 1.0
+    # Row 1: no match anywhere -> fallback position 0.
+    res = score.readout_from_step_logits(
+        jnp.asarray(logits), jnp.zeros((B, T), jnp.int32),
+        jnp.int32(yes_id), jnp.int32(no_id))
+    assert int(res.position_found[0]) == 3 and bool(res.yes_no_found[0])
+    assert int(res.position_found[1]) == 0 and not bool(res.yes_no_found[1])
+    # Probabilities read at the matched position.
+    probs = jax.nn.softmax(jnp.asarray(logits[0, 3]))
+    np.testing.assert_allclose(float(res.yes_prob[0]), float(probs[yes_id]),
+                               rtol=1e-6)
+    # Both readouts present and consistent (SURVEY §1 drift fixed).
+    rp = float(res.relative_prob[0])
+    orr = float(res.odds_ratio[0])
+    assert 0.0 <= rp <= 1.0
+    np.testing.assert_allclose(orr / (1 + orr), rp, rtol=1e-4)
+
+
+def test_weighted_confidence():
+    B, V = 1, 40
+    ids = jnp.asarray([5, 6], jnp.int32)
+    vals = jnp.asarray([0.0, 100.0], jnp.float32)
+    logits = np.full((B, 1, V), -10.0, np.float32)
+    logits[0, 0, 5] = 2.0   # p(0)
+    logits[0, 0, 6] = 2.0   # p(100) equal -> E[v] = 50
+    out = score.weighted_confidence(jnp.asarray(logits), ids, vals)
+    np.testing.assert_allclose(float(out[0]), 50.0, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Greedy decode vs repeated full forward
+# ---------------------------------------------------------------------------
+
+def test_greedy_decode_matches_full_forward():
+    params, cfg, hf = _tiny_llama_params()
+    rng = np.random.default_rng(0)
+    S, NEW = 7, 5
+    toks = rng.integers(3, 1000, size=(2, S)).astype(np.int32)
+    gen, step_logits = generate.greedy_decode(
+        params, cfg, jnp.asarray(toks), jnp.ones((2, S), jnp.int32),
+        max_new_tokens=NEW)
+    gen = np.asarray(gen)
+
+    with torch.no_grad():
+        out = hf.generate(torch.tensor(toks.astype(np.int64)),
+                          max_new_tokens=NEW, do_sample=False,
+                          output_scores=True, return_dict_in_generate=True,
+                          pad_token_id=0)
+    ref_gen = out.sequences[:, S:].numpy()
+    np.testing.assert_array_equal(gen, ref_gen)
+    for t in range(NEW):
+        np.testing.assert_allclose(np.asarray(step_logits[:, t, :]),
+                                   out.scores[t].numpy(), atol=2e-3, rtol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end batched scorer with the fake tokenizer
+# ---------------------------------------------------------------------------
+
+def test_scoring_engine_end_to_end():
+    tokenizer = FakeTokenizer()
+    params, cfg, _ = _tiny_llama_params(vocab=FakeTokenizer.VOCAB)
+    eng = ScoringEngine(params, cfg, tokenizer,
+                        RuntimeConfig(batch_size=4, max_new_tokens=12,
+                                      max_seq_len=64))
+    prompts = [f"Is a tomato number {i} a fruit ? Answer Yes or No" for i in range(6)]
+    rows = eng.score_prompts(prompts)
+    assert len(rows) == 6
+    for r in rows:
+        assert 0.0 <= r.yes_prob <= 1.0 and 0.0 <= r.no_prob <= 1.0
+        assert np.isnan(r.relative_prob) or 0.0 <= r.relative_prob <= 1.0
+        assert 0 <= r.position_found < 10
+        assert isinstance(r.completion, str)
+    # Deterministic: same prompts -> identical numbers.
+    rows2 = eng.score_prompts(prompts)
+    np.testing.assert_allclose([r.yes_prob for r in rows],
+                               [r.yes_prob for r in rows2], rtol=0, atol=0)
+
+
+def test_fake_tokenizer_yes_no_ids():
+    t = FakeTokenizer()
+    # Decoder rule: leading-space variant first; fake tokenizer strips spaces
+    # so both resolve to the reserved ids.
+    assert tok.yes_no_ids(t) == (FakeTokenizer.YES, FakeTokenizer.NO)
+
+
+# ---------------------------------------------------------------------------
+# Sharded forward on the 8-virtual-device mesh
+# ---------------------------------------------------------------------------
+
+def test_sharded_forward_matches_single_device():
+    params, cfg, _ = _tiny_llama_params()
+    mesh = sharding.build_mesh(MeshConfig(data=2, model=4))
+    sharded = sharding.shard_params(params, cfg, mesh)
+
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(rng.integers(3, 1000, size=(4, 10)).astype(np.int32))
+    toks_sharded = jax.device_put(toks, sharding.batch_sharding(mesh))
+
+    ref = decoder.forward(params, cfg, toks)
+    out = jax.jit(lambda p, t: decoder.forward(p, cfg, t))(sharded, toks_sharded)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_sharded_greedy_decode():
+    params, cfg, _ = _tiny_llama_params()
+    mesh = sharding.build_mesh(MeshConfig(data=2, model=4))
+    sharded = sharding.shard_params(params, cfg, mesh)
+    rng = np.random.default_rng(2)
+    toks = rng.integers(3, 1000, size=(4, 6)).astype(np.int32)
+    mask = np.ones_like(toks)
+
+    ref_gen, ref_logits = generate.greedy_decode(
+        params, cfg, jnp.asarray(toks), jnp.asarray(mask), max_new_tokens=4)
+    bs = sharding.batch_sharding(mesh)
+    gen, logits = generate.greedy_decode(
+        sharded, cfg, jax.device_put(jnp.asarray(toks), bs),
+        jax.device_put(jnp.asarray(mask), bs), max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(gen), np.asarray(ref_gen))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               atol=1e-3, rtol=1e-3)

@@ -1,0 +1,110 @@
+"""Sweep-driver tests: D1/D2/D6 row production, manifest resume, checkpoints.
+
+Capability parity under test (SURVEY.md §2.1 C4/C5/C9/C11): grid expansion,
+done-set dedup, checkpoint-every-N, append-with-schema-check — all with the
+fake backend so no weights or network are needed.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import torch
+
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import RuntimeConfig
+from lir_tpu.data.prompts import LegalPrompt, WORD_MEANING_QUESTIONS, format_instruct_prompt
+from lir_tpu.engine import grid as grid_mod
+from lir_tpu.engine.runner import ScoringEngine
+from lir_tpu.engine.sweep import run_perturbation_sweep, run_word_meaning_sweep
+from lir_tpu.models.loader import config_from_hf, convert_decoder
+from lir_tpu.utils.manifest import SweepManifest
+
+
+def _engine(batch_size=4, max_new=8):
+    import transformers as tf
+    torch.manual_seed(0)
+    hf = tf.LlamaForCausalLM(tf.LlamaConfig(
+        vocab_size=FakeTokenizer.VOCAB, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, intermediate_size=128,
+        max_position_embeddings=512, tie_word_embeddings=False)).eval()
+    cfg, fam = config_from_hf(hf.config)
+    params = convert_decoder(hf.state_dict(), cfg, fam)
+    return ScoringEngine(params, cfg, FakeTokenizer(),
+                         RuntimeConfig(batch_size=batch_size,
+                                       max_new_tokens=max_new,
+                                       max_seq_len=256))
+
+
+PROMPTS = (
+    LegalPrompt(
+        main="Does a vehicle include a bicycle ?",
+        response_format="Answer Covered or Not .",
+        target_tokens=("Covered", "Not"),
+        confidence_format="Give a number from 0 to 100 .",
+    ),
+    LegalPrompt(
+        main="Is a drone an aircraft ?",
+        response_format="Answer Yes or No .",
+        target_tokens=("Yes", "No"),
+        confidence_format="Give a number from 0 to 100 .",
+    ),
+)
+PERTURBATIONS = (
+    ["Would a bicycle count as a vehicle ?", "Can a bicycle be a vehicle ?"],
+    ["Would a drone count as an aircraft ?"],
+)
+
+
+def test_grid_expansion_and_subset():
+    cells = grid_mod.build_grid("m", PROMPTS, PERTURBATIONS)
+    # original + rephrasings per prompt: (1+2) + (1+1) = 5
+    assert len(cells) == 5
+    assert cells[0].rephrase_idx == 0
+    assert cells[0].rephrased_main == PROMPTS[0].main
+    sub = grid_mod.random_subset(cells, 3, seed=42)
+    assert len(sub) == 3
+    assert grid_mod.random_subset(cells, 3, seed=42) == sub  # deterministic
+
+
+def test_perturbation_sweep_writes_d6_and_resumes(tmp_path):
+    eng = _engine()
+    out = tmp_path / "results.xlsx"
+    rows = run_perturbation_sweep(eng, "tiny-llama", PROMPTS, PERTURBATIONS,
+                                  out, checkpoint_every=2)
+    assert len(rows) == 5
+    from lir_tpu.data.schemas import read_results_frame
+    df = read_results_frame(out)
+    assert len(df) == 5
+    from lir_tpu.data.schemas import PERTURBATION_COLUMNS
+    assert list(df.columns) == list(PERTURBATION_COLUMNS)
+    assert df["Token_1_Prob"].between(0, 1).all()
+    assert df["Weighted Confidence"].between(0, 100).all()
+    # Log Probabilities column holds a parseable top-20 map.
+    import json
+    lp = json.loads(df["Log Probabilities"].iloc[0])
+    assert len(lp) == 20
+
+    # Resume: everything already done -> no new rows, file unchanged.
+    rows2 = run_perturbation_sweep(eng, "tiny-llama", PROMPTS, PERTURBATIONS,
+                                   out, checkpoint_every=2)
+    assert rows2 == []
+    assert len(read_results_frame(out)) == 5
+
+    # A new model re-runs the full grid (key includes model).
+    rows3 = run_perturbation_sweep(eng, "tiny-llama-2", PROMPTS, PERTURBATIONS,
+                                   out, checkpoint_every=2)
+    assert len(rows3) == 5
+    assert len(read_results_frame(out)) == 10
+
+
+def test_word_meaning_sweep_rows():
+    eng = _engine(batch_size=8)
+    questions = list(WORD_MEANING_QUESTIONS[:6])
+    rows = run_word_meaning_sweep(eng, "tiny-llama", "instruct", questions,
+                                  format_instruct_prompt)
+    assert len(rows) == 6
+    for q, r in zip(questions, rows):
+        assert r.prompt == q
+        assert r.model == "tiny-llama"
+        assert 0 <= r.yes_prob <= 1 and 0 <= r.no_prob <= 1
