@@ -1,0 +1,486 @@
+"""Human-LLM agreement metrics: point estimates and question-resampled
+bootstrap (C39/C41), plus the D9 ``llm_human_agreement_bootstrap.json`` writer.
+
+Parity targets:
+  - survey_analysis/analyze_llm_human_agreement.py:94-316 (point metrics:
+    MAE/RMSE/MAPE/Pearson/Spearman per model, worst-disagreement questions,
+    per-question across-model variance, ``llm_human_agreement_analysis.json``)
+  - survey_analysis/analyze_llm_agreement_simple_bootstrap.py:90-480
+    (question-resampled bootstrap, n=1000; overall base-vs-instruct
+    comparison with 10,000-fold bootstrap CI and permutation p-value;
+    matched-pairs normal-approximation test; D9 JSON)
+
+The reference's broken respondent-resampling variant
+(analyze_llm_human_agreement_bootstrap.py — references an undefined
+``survey_df``, SURVEY.md §2.2 C40) is a known defect; its working semantics
+are fully covered by this module.
+
+TPU-native redesign: each bootstrap iteration in the reference re-walks the
+model DataFrame row-by-row. Here each model is reduced once to aligned
+(human, model, valid) vectors over the 50 canonical questions, and all 1000
+resamples evaluate as one vmapped kernel. A reference quirk preserved
+deliberately: membership of a question in a bootstrap sample is tested with
+``in sampled_questions`` (analyze_llm_agreement_simple_bootstrap.py:101), so
+duplicate draws do NOT up-weight a question — the resample acts as a random
+subset. The kernel reproduces exactly that via a boolean membership mask.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+from scipy import stats as scipy_stats
+
+from ..stats.bootstrap import bootstrap_mean_ci, permutation_test_difference
+from ..stats.core import resample_indices
+
+
+# ---------------------------------------------------------------------------
+# Data alignment
+# ---------------------------------------------------------------------------
+
+
+def relative_prob_series(df: pd.DataFrame) -> pd.Series:
+    """The unified readout: ``relative_prob`` when present (D2), else
+    yes/(yes+no) with 0.5 fallback on zero mass (D1) — the column-handling
+    branch at analyze_llm_human_agreement.py:102-106."""
+    if "relative_prob" in df.columns:
+        return df["relative_prob"].astype(float)
+    total = df["yes_prob"].astype(float) + df["no_prob"].astype(float)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        rel = df["yes_prob"].astype(float) / total
+    return rel.where(total > 0, 0.5)
+
+
+def human_averages_from_detailed(
+    detailed: Dict[str, object], question_mapping: Dict[str, str]
+) -> Dict[str, float]:
+    """prompt -> human mean on the 0-1 scale (mean_response / 100)."""
+    by_q = detailed["results"]["by_question"]
+    return {
+        prompt: by_q[qid]["mean_response"] / 100.0
+        for prompt, qid in question_mapping.items()
+        if qid in by_q
+    }
+
+
+def aligned_vectors(
+    model_df: pd.DataFrame, human_averages: Dict[str, float]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[str]]:
+    """(human, model, valid) aligned over the canonical question order.
+
+    `valid` marks questions the model answered with a finite probability.
+    """
+    questions = list(human_averages.keys())
+    rel = relative_prob_series(model_df)
+    by_prompt = dict(zip(model_df["prompt"], rel))
+    h = np.asarray([human_averages[q] for q in questions], dtype=float)
+    m = np.asarray(
+        [by_prompt.get(q, np.nan) for q in questions], dtype=float
+    )
+    valid = np.isfinite(m)
+    return h, m, valid, questions
+
+
+# ---------------------------------------------------------------------------
+# Point metrics (C39)
+# ---------------------------------------------------------------------------
+
+
+def agreement_metrics(
+    model_df: pd.DataFrame,
+    model_name: str,
+    human_averages: Dict[str, float],
+    min_questions: int = 10,
+) -> Optional[Dict[str, object]]:
+    """MAE/RMSE/MAPE/Pearson/Spearman between one model's relative
+    probabilities and human averages (calculate_agreement_metrics,
+    analyze_llm_human_agreement.py:94-148)."""
+    h, m, valid, questions = aligned_vectors(model_df, human_averages)
+    h, m = h[valid], m[valid]
+    qs = [q for q, v in zip(questions, valid) if v]
+    if h.size < min_questions:
+        return None
+
+    diff = np.abs(h - m)
+    mae = float(diff.mean())
+    rmse = float(np.sqrt(((h - m) ** 2).mean()))
+    mape = float(np.mean(np.abs((h - m) / h)) * 100)
+    pearson_r, pearson_p = scipy_stats.pearsonr(h, m)
+    spearman_r, spearman_p = scipy_stats.spearmanr(h, m)
+
+    order = np.argsort(-diff)
+    worst = [
+        {
+            "prompt": qs[i],
+            "human_avg": float(h[i]),
+            "model_prob": float(m[i]),
+            "difference": float(diff[i]),
+        }
+        for i in order[:5]
+    ]
+    return {
+        "model": model_name,
+        "n_questions": int(h.size),
+        "mae": mae,
+        "rmse": rmse,
+        "mape": mape,
+        "pearson_r": float(pearson_r),
+        "pearson_p": float(pearson_p),
+        "spearman_r": float(spearman_r),
+        "spearman_p": float(spearman_p),
+        "worst_questions": worst,
+        "matched": {"human_avg": h, "model_prob": m, "prompts": qs},
+    }
+
+
+def analyze_all_models(
+    human_averages: Dict[str, float],
+    instruct_df: pd.DataFrame,
+    base_df: Optional[pd.DataFrame] = None,
+) -> List[Dict[str, object]]:
+    """Per-model point metrics across both CSVs, sorted by MAE ascending."""
+    results = []
+    for model in instruct_df["model"].unique():
+        r = agreement_metrics(
+            instruct_df[instruct_df["model"] == model], model, human_averages
+        )
+        if r:
+            r["model_type"] = "instruct"
+            results.append(r)
+    if base_df is not None:
+        for model in base_df["model"].unique():
+            r = agreement_metrics(
+                base_df[base_df["model"] == model], model, human_averages
+            )
+            if r:
+                r["model_type"] = "base"
+                results.append(r)
+    results.sort(key=lambda x: x["mae"])
+    return results
+
+
+def question_variance(
+    all_results: List[Dict[str, object]], human_averages: Dict[str, float]
+) -> Dict[str, Dict[str, float]]:
+    """Across-model response variance per question
+    (analyze_llm_human_agreement.py:265-288)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for prompt, h_avg in human_averages.items():
+        probs = []
+        for r in all_results:
+            matched = r["matched"]
+            if prompt in matched["prompts"]:
+                probs.append(matched["model_prob"][matched["prompts"].index(prompt)])
+        if probs:
+            out[prompt] = {
+                "human_avg": float(h_avg),
+                "model_mean": float(np.mean(probs)),
+                "model_std": float(np.std(probs)),
+                "n_models": len(probs),
+            }
+    return out
+
+
+def write_agreement_analysis(
+    all_results: List[Dict[str, object]],
+    human_averages: Dict[str, float],
+    path: Path,
+) -> Dict[str, object]:
+    """``llm_human_agreement_analysis.json`` (analyze_llm_human_agreement.py:
+    291-310)."""
+    payload = {
+        "analysis_type": "llm_human_agreement",
+        "description": "Comparison of LLM outputs to human average ratings per question",
+        "model_results": [
+            {
+                "model": r["model"],
+                "model_type": r["model_type"],
+                "mae": r["mae"],
+                "rmse": r["rmse"],
+                "mape": r["mape"],
+                "pearson_r": r["pearson_r"],
+                "n_questions": r["n_questions"],
+            }
+            for r in all_results
+        ],
+        "question_variance": question_variance(all_results, human_averages),
+    }
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2))
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# Question-resampled bootstrap (C41)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_questions",))
+def _boot_metric_kernel(h, m, valid, idx, n_questions: int):
+    """All bootstrap iterations at once. For each index row: select the
+    UNIQUE sampled questions (membership semantics, see module docstring)
+    intersected with `valid`, then compute (mae, mse, mape, pearson, n)."""
+
+    def one(ix):
+        sel = jnp.zeros((n_questions,), dtype=bool).at[ix].set(True) & valid
+        n = sel.sum()
+        w = sel / jnp.maximum(n, 1)
+        d = jnp.where(sel, h - m, 0.0)
+        mae = jnp.abs(d).sum() / jnp.maximum(n, 1)
+        mse = (d * d).sum() / jnp.maximum(n, 1)
+
+        ape = jnp.abs((h - m) / jnp.where(h == 0, jnp.nan, h))
+        ape_ok = sel & jnp.isfinite(ape)
+        n_ape = ape_ok.sum()
+        mape = jnp.where(
+            n_ape > 0,
+            jnp.where(ape_ok, ape, 0.0).sum() / jnp.maximum(n_ape, 1) * 100.0,
+            jnp.nan,
+        )
+
+        hm = (jnp.where(sel, h, 0.0)).sum() / jnp.maximum(n, 1)
+        mm = (jnp.where(sel, m, 0.0)).sum() / jnp.maximum(n, 1)
+        dh = jnp.where(sel, h - hm, 0.0)
+        dm = jnp.where(sel, m - mm, 0.0)
+        denom = jnp.sqrt((dh * dh).sum() * (dm * dm).sum())
+        pearson = jnp.where(denom > 0, (dh * dm).sum() / denom, jnp.nan)
+        return mae, mse, mape, pearson, n
+
+    return jax.vmap(one)(idx)
+
+
+def bootstrap_agreement_metrics(
+    model_df: pd.DataFrame,
+    human_averages: Dict[str, float],
+    key: jax.Array,
+    n_bootstrap: int = 1000,
+    confidence: float = 0.95,
+    min_questions: int = 10,
+    min_successful: int = 100,
+) -> Optional[Dict[str, float]]:
+    """Bootstrap-over-questions CIs for one model's agreement metrics
+    (analyze_llm_agreement_simple_bootstrap.py:151-212)."""
+    h, m, valid, _ = aligned_vectors(model_df, human_averages)
+    n_q = h.shape[0]
+    idx = resample_indices(key, n_bootstrap, n_q)
+    mae_s, mse_s, mape_s, r_s, n_s = (
+        np.asarray(a)
+        for a in _boot_metric_kernel(
+            jnp.asarray(h), jnp.asarray(np.where(valid, m, 0.0)),
+            jnp.asarray(valid), idx, n_q,
+        )
+    )
+    ok = n_s >= min_questions
+    if ok.sum() < min_successful:
+        return None
+
+    alpha = 1 - confidence
+    metrics: Dict[str, float] = {"n_bootstrap": int(ok.sum())}
+    for name, samples in (
+        ("mae", mae_s), ("mse", mse_s), ("mape", mape_s), ("pearson_r", r_s)
+    ):
+        vals = samples[ok]
+        vals = vals[np.isfinite(vals)]
+        if vals.size:
+            metrics[f"{name}_mean"] = float(np.mean(vals))
+            metrics[f"{name}_ci_lower"] = float(np.percentile(vals, alpha / 2 * 100))
+            metrics[f"{name}_ci_upper"] = float(
+                np.percentile(vals, (1 - alpha / 2) * 100)
+            )
+            metrics[f"{name}_std"] = float(np.std(vals))
+        else:
+            for suffix in ("mean", "ci_lower", "ci_upper", "std"):
+                metrics[f"{name}_{suffix}"] = float("nan")
+    return metrics
+
+
+def bootstrap_all_models(
+    human_averages: Dict[str, float],
+    instruct_df: pd.DataFrame,
+    base_df: Optional[pd.DataFrame],
+    key: jax.Array,
+    n_bootstrap: int = 1000,
+) -> List[Dict[str, object]]:
+    """All models' bootstrap metrics, base models first (reference order:
+    analyze_llm_agreement_simple_bootstrap.py:163-166), sorted by MAE."""
+    jobs = []
+    if base_df is not None:
+        jobs += [(m, "base", base_df) for m in base_df["model"].unique()]
+    jobs += [(m, "instruct", instruct_df) for m in instruct_df["model"].unique()]
+
+    results = []
+    # The reference demands >= 100 successful iterations (:187); scale the
+    # gate down proportionally when running with reduced budgets.
+    min_successful = min(100, max(1, n_bootstrap // 10))
+    for model, model_type, src in jobs:
+        key, sub = jax.random.split(key)
+        metrics = bootstrap_agreement_metrics(
+            src[src["model"] == model], human_averages, sub, n_bootstrap,
+            min_successful=min_successful,
+        )
+        if metrics is None:
+            continue
+        results.append({"model": model, "model_type": model_type, **metrics})
+    results.sort(key=lambda x: x["mae_mean"])
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Group difference statistics (C41 overall comparison)
+# ---------------------------------------------------------------------------
+
+
+def difference_stats(
+    group1: Sequence[float],
+    group2: Sequence[float],
+    key: jax.Array,
+    n_bootstrap: int = 10_000,
+) -> Tuple[float, float, float, float]:
+    """(observed diff, ci_lower, ci_upper, permutation p) for
+    mean(group1) - mean(group2) — calculate_difference_stats
+    (analyze_llm_agreement_simple_bootstrap.py:312-347). Composed from the
+    shared bootstrap kernels in lir_tpu.stats."""
+    a = np.asarray(group1, dtype=float)
+    b = np.asarray(group2, dtype=float)
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    means_a = bootstrap_mean_ci(a, k1, n_boot=n_bootstrap).samples
+    means_b = bootstrap_mean_ci(b, k2, n_boot=n_bootstrap).samples
+    diffs = means_a - means_b
+    ci_lower = float(np.percentile(diffs, 2.5))
+    ci_upper = float(np.percentile(diffs, 97.5))
+
+    perm = permutation_test_difference(a, b, k3, n_perm=n_bootstrap)
+    return perm["observed_difference"], ci_lower, ci_upper, perm["p_value"]
+
+
+def matched_pairs_analysis(
+    all_results: List[Dict[str, object]],
+    families: Optional[Dict[str, Sequence[str]]] = None,
+) -> Dict[str, Dict[str, float]]:
+    """Paired instruct-base differences per family with a normal-approx
+    paired test (analyze_llm_agreement_simple_bootstrap.py:392-444)."""
+    pairs = []
+    families = families or DEFAULT_FAMILIES
+    for family, models in families.items():
+        base = instruct = None
+        for r in all_results:
+            if r["model"] in models:
+                if "instruct" in r["model"].lower() or "tuned" in r["model"].lower():
+                    instruct = r
+                else:
+                    base = r
+        if base and instruct:
+            pairs.append({"family": family, "base": base, "instruct": instruct})
+
+    out: Dict[str, Dict[str, float]] = {}
+    for metric in ("mae", "mse", "mape"):
+        diffs = [
+            p["instruct"][f"{metric}_mean"] - p["base"][f"{metric}_mean"]
+            for p in pairs
+        ]
+        if not diffs:
+            continue
+        mean_diff = float(np.mean(diffs))
+        se = float(np.std(diffs) / np.sqrt(len(diffs)))
+        t = mean_diff / se if se > 0 else 0.0
+        p = float(2 * (1 - scipy_stats.norm.cdf(abs(t))))
+        out[metric] = {
+            "per_family": {
+                pr["family"]: float(d) for pr, d in zip(pairs, diffs)
+            },
+            "mean_difference": mean_diff,
+            "ci_lower": mean_diff - 1.96 * se,
+            "ci_upper": mean_diff + 1.96 * se,
+            "p_value": p,
+        }
+    return out
+
+
+DEFAULT_FAMILIES: Dict[str, Tuple[str, str]] = {
+    "Falcon": ("tiiuae/falcon-7b", "tiiuae/falcon-7b-instruct"),
+    "StableLM": (
+        "stabilityai/stablelm-base-alpha-7b",
+        "stabilityai/stablelm-tuned-alpha-7b",
+    ),
+    "RedPajama": (
+        "togethercomputer/RedPajama-INCITE-7B-Base",
+        "togethercomputer/RedPajama-INCITE-7B-Instruct",
+    ),
+}
+
+
+def bootstrap_results_payload(
+    all_results: List[Dict[str, object]],
+    key: jax.Array,
+    n_bootstrap: int = 1000,
+    n_diff_bootstrap: int = 10_000,
+) -> Dict[str, object]:
+    """The D9 ``llm_human_agreement_bootstrap.json`` schema
+    (analyze_llm_agreement_simple_bootstrap.py:447-477)."""
+    base = [r for r in all_results if r["model_type"] == "base"]
+    instruct = [r for r in all_results if r["model_type"] == "instruct"]
+    payload: Dict[str, object] = {
+        "analysis_type": "llm_human_agreement_bootstrap_questions",
+        "description": (
+            "Comparison of LLM outputs to human average ratings with "
+            "bootstrap confidence intervals (sampling questions)"
+        ),
+        "bootstrap_parameters": {
+            "n_iterations": n_bootstrap,
+            "confidence_level": 0.95,
+            "bootstrap_method": "questions_with_replacement",
+        },
+        "model_results": [
+            {k: v for k, v in r.items()} for r in all_results
+        ],
+        "overall_comparison": {
+            "base_models_count": len(base),
+            "instruct_models_count": len(instruct),
+            "metrics": {},
+        },
+    }
+    for metric in ("mae", "mse", "mape"):
+        b_vals = [
+            r[f"{metric}_mean"] for r in base if np.isfinite(r[f"{metric}_mean"])
+        ]
+        i_vals = [
+            r[f"{metric}_mean"]
+            for r in instruct
+            if np.isfinite(r[f"{metric}_mean"])
+        ]
+        if not b_vals or not i_vals:
+            continue
+        key, sub = jax.random.split(key)
+        diff, lo, hi, p = difference_stats(b_vals, i_vals, sub, n_diff_bootstrap)
+        payload["overall_comparison"]["metrics"][metric] = {
+            "base_mean": float(np.mean(b_vals)),
+            "base_ci": [
+                float(np.percentile(b_vals, 2.5)),
+                float(np.percentile(b_vals, 97.5)),
+            ],
+            "instruct_mean": float(np.mean(i_vals)),
+            "instruct_ci": [
+                float(np.percentile(i_vals, 2.5)),
+                float(np.percentile(i_vals, 97.5)),
+            ],
+            "difference": diff,
+            "difference_ci": [lo, hi],
+            "p_value": p,
+        }
+    return payload
+
+
+def write_bootstrap_results(payload: Dict[str, object], path: Path) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2))

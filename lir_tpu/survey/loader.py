@@ -1,0 +1,187 @@
+"""Survey loading, cleaning, exclusion, and question matching (L2/L3 glue).
+
+Parity targets in the reference:
+  - load_and_clean_survey_data   survey_analysis/survey_analysis_consolidated.py:9-29
+  - apply_exclusion_criteria     survey_analysis/survey_analysis_consolidated.py:36-85
+  - extract_question_text        survey_analysis/survey_analysis_consolidated.py:87-103
+  - match_survey_to_llm_questions survey_analysis/survey_analysis_consolidated.py:105-126
+
+The reference applies the identical-slider and attention-check filters with
+row-wise Python loops; here all three exclusion criteria are vectorized
+column operations with byte-identical selection semantics (same ordering:
+duration -> identical -> attention, each on the survivors of the previous).
+
+This module also owns the D7 artifact ``survey_analysis_detailed.json``:
+four survey scripts consume it (analyze_llm_human_agreement.py:15-16,
+bootstrap_confidence_intervals.py:13-14, ...) but its producer is missing
+from the reference tree (SURVEY.md §2.4 D7), so ``survey_detailed`` is the
+in-tree replacement producer.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..data.prompts import QUALTRICS_TO_QUESTION
+
+# 5 groups x 11 sliders; column 8 is the attention check ("set slider to 100").
+GROUPS = tuple(range(1, 6))
+
+
+def group_question_ids(group: int) -> List[str]:
+    """Substantive question columns of one survey group (attention Q*_8
+    excluded) — the group structure shared by every survey script
+    (e.g. bootstrap_confidence_intervals.py:46-52)."""
+    return [f"Q{group}_{i}" for i in range(1, 12) if i != 8]
+
+
+def all_question_cols(df: pd.DataFrame) -> List[str]:
+    """Every Q{g}_{i} column present, attention checks included — the
+    ``question_cols`` list of the reference loader."""
+    cols = []
+    for group in GROUPS:
+        for question in range(1, 12):
+            col = f"Q{group}_{question}"
+            if col in df.columns:
+                cols.append(col)
+    return cols
+
+
+def load_survey(path: Path) -> Tuple[pd.DataFrame, List[str]]:
+    """Load the Qualtrics export, drop its two descriptive header rows, and
+    numeric-coerce Duration plus every slider column."""
+    df = pd.read_csv(path)
+    df = df[2:].reset_index(drop=True)
+    df["Duration (in seconds)"] = pd.to_numeric(
+        df["Duration (in seconds)"], errors="coerce"
+    )
+    question_cols = all_question_cols(df)
+    for col in question_cols:
+        df[col] = pd.to_numeric(df[col], errors="coerce")
+    return df, question_cols
+
+
+def apply_exclusions(
+    df: pd.DataFrame, question_cols: List[str]
+) -> Tuple[pd.DataFrame, Dict[str, float]]:
+    """Three exclusion criteria, applied in the reference's order.
+
+    1. Duration < 20% of the (pre-filter) median completion time.
+    2. All substantive sliders identical (attention Q*_8 not counted),
+       among respondents who answered more than one substantive question.
+    3. Any answered attention check != 100.
+    """
+    initial_count = len(df)
+    stats: Dict[str, float] = {}
+
+    duration = df["Duration (in seconds)"]
+    median_duration = duration.median()
+    min_duration = 0.2 * median_duration
+    stats["duration_excluded"] = int((duration < min_duration).sum())
+    stats["median_duration"] = float(median_duration)
+    stats["min_duration_threshold"] = float(min_duration)
+    df = df[duration >= min_duration]
+
+    substantive = [c for c in question_cols if not c.endswith("_8")]
+    vals = df[substantive]
+    answered = vals.notna().sum(axis=1)
+    # "All identical": nunique over answered sliders == 1, with > 1 answered.
+    identical = (vals.nunique(axis=1, dropna=True) == 1) & (answered > 1)
+    stats["identical_excluded"] = int(identical.sum())
+    df = df[~identical]
+
+    attention_cols = [f"Q{g}_8" for g in GROUPS if f"Q{g}_8" in df.columns]
+    att = df[attention_cols]
+    failed = (att.notna() & (att != 100)).any(axis=1)
+    stats["attention_failed"] = int(failed.sum())
+    df = df[~failed]
+
+    stats["final_count"] = len(df)
+    stats["total_excluded"] = initial_count - len(df)
+    return df.reset_index(drop=True), stats
+
+
+def extract_question_text(raw_path: Path) -> Dict[str, str]:
+    """Column id -> question text, parsed from the Qualtrics header row
+    (the text after the last " - " separator)."""
+    df_raw = pd.read_csv(raw_path)
+    headers = df_raw.iloc[0]
+    mapping: Dict[str, str] = {}
+    for col in df_raw.columns:
+        if col.startswith("Q") and "_" in col:
+            text = headers[col]
+            if pd.notna(text) and isinstance(text, str) and " - " in text:
+                mapping[col] = text.split(" - ")[-1].strip()
+    return mapping
+
+
+def match_survey_to_llm_questions(
+    llm_df: pd.DataFrame, question_mapping: Dict[str, str]
+) -> Dict[str, str]:
+    """LLM prompt text -> Qualtrics question id, for prompts whose text
+    matches a survey question exactly (attention checks excluded)."""
+    prompt_to_question = {
+        text: qid
+        for qid, text in question_mapping.items()
+        if not qid.endswith("_8")
+    }
+    return {
+        prompt: prompt_to_question[prompt]
+        for prompt in llm_df["prompt"].unique()
+        if prompt in prompt_to_question
+    }
+
+
+def canonical_question_mapping() -> Dict[str, str]:
+    """The static 50-question -> Qualtrics-id mapping (the dict copy-pasted
+    across four reference survey scripts, e.g.
+    analyze_llm_human_agreement.py:31-82) from the single prompt asset."""
+    return {q: qid for qid, q in QUALTRICS_TO_QUESTION.items()}
+
+
+def survey_detailed(
+    clean_df: pd.DataFrame, question_cols: List[str]
+) -> Dict[str, object]:
+    """Produce the D7 ``survey_analysis_detailed.json`` payload.
+
+    Schema (as consumed at analyze_llm_human_agreement.py:86-89 and
+    bootstrap_confidence_intervals.py:82-89):
+    ``results.by_question[Qx_y] = {mean_response, std_response,
+    proportion_yes, n_responses}`` with mean/std on the 0-100 slider scale.
+    ``proportion_yes`` is the fraction of respondents above the slider
+    midpoint (> 50); the upstream producer is absent from the reference
+    tree, so this definition is ours and is documented here.
+    """
+    by_question: Dict[str, Dict[str, float]] = {}
+    for col in question_cols:
+        if col.endswith("_8"):
+            continue
+        responses = clean_df[col].dropna().to_numpy(dtype=float)
+        if responses.size == 0:
+            continue
+        by_question[col] = {
+            "mean_response": float(np.mean(responses)),
+            "std_response": float(np.std(responses)),
+            "proportion_yes": float(np.mean(responses > 50.0)),
+            "n_responses": int(responses.size),
+        }
+    return {"results": {"by_question": by_question}}
+
+
+def write_survey_detailed(
+    clean_df: pd.DataFrame, question_cols: List[str], path: Path
+) -> Dict[str, object]:
+    payload = survey_detailed(clean_df, question_cols)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(payload, indent=2))
+    return payload
+
+
+def load_survey_detailed(path: Path) -> Dict[str, object]:
+    return json.loads(Path(path).read_text())

@@ -1,0 +1,238 @@
+"""Correlation p-value suite: all-pairs LLM and human-rater correlations
+with significance tests and distribution comparisons (C43).
+
+Parity target: survey_analysis/calculate_correlation_pvalues.py:38-320 —
+model-model Pearson+p over >10 common questions, rater-rater Pearson+p
+within survey groups (>=3 common questions), and LLM-vs-human correlation
+distribution comparison via Mann-Whitney U / KS / t-test / Cohen's d.
+
+TPU-native redesign: the reference calls scipy.pearsonr inside an
+O(raters^2) Python loop (~25k calls for ~100 raters x 5 groups). Here each
+group's correlation matrix is one masked-Pearson kernel; p-values are then
+computed in closed form from (r, n) exactly as pearsonr does
+(t = r*sqrt((n-2)/(1-r^2)), two-sided t survival), vectorized over the
+whole matrix.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+from scipy import stats as scipy_stats
+
+from ..stats.correlations import masked_pearson_matrix
+from .loader import GROUPS, group_question_ids
+from .human_llm import relative_prob_series
+
+
+def pearson_pvalues(r: np.ndarray, n: np.ndarray) -> np.ndarray:
+    """Two-sided p-values for Pearson r with n joint observations (the
+    beta/t distribution used by scipy.stats.pearsonr)."""
+    r = np.asarray(r, dtype=float)
+    n = np.asarray(n, dtype=float)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        t = r * np.sqrt((n - 2) / np.maximum(1e-300, 1 - r * r))
+        p = 2 * scipy_stats.t.sf(np.abs(t), np.maximum(n - 2, 1))
+    p = np.where(np.abs(r) >= 1.0, 0.0, p)
+    return np.where(n > 2, p, np.nan)
+
+
+def _joint_counts(x: np.ndarray) -> np.ndarray:
+    m = np.isfinite(x).astype(float)
+    return m.T @ m
+
+
+def llm_correlations_with_pvalues(
+    instruct_df: pd.DataFrame,
+    base_df: pd.DataFrame,
+    min_questions: int = 10,
+) -> List[Dict[str, object]]:
+    """All-pairs model-model correlations over common questions (:38-94).
+    The reference requires strictly more than `min_questions` valid pairs.
+
+    Defect fixed, not replicated: the reference concatenates the D1 and D2
+    frames FIRST and then reads ``row['relative_prob']`` (:42,57-58), which
+    is NaN for every D1 row after the concat — silently dropping all base
+    models from an analysis that explicitly loads them. Here the readout is
+    computed per-frame before concatenation, so base models participate via
+    yes/(yes+no) as intended.
+    """
+    combined = pd.concat(
+        [
+            base_df.assign(_rel=relative_prob_series(base_df)),
+            instruct_df.assign(_rel=relative_prob_series(instruct_df)),
+        ],
+        ignore_index=True,
+    )
+    # Models present in BOTH CSVs (e.g. Qwen-7B-Chat) have duplicate
+    # (model, prompt) rows; the reference's dict build keeps the last one
+    # (:55-65), so mirror that rather than pivot_table's mean-aggregation.
+    combined = combined.drop_duplicates(subset=["model", "prompt"], keep="last")
+    pivot = combined.pivot_table(index="prompt", columns="model", values="_rel")
+    models = list(pivot.columns)
+    x = pivot.to_numpy(dtype=float)
+
+    corr = np.asarray(masked_pearson_matrix(jnp.asarray(x)))
+    counts = _joint_counts(x)
+    pvals = pearson_pvalues(corr, counts)
+
+    out = []
+    for i in range(len(models)):
+        for j in range(i + 1, len(models)):
+            n = int(counts[i, j])
+            if n > min_questions and np.isfinite(corr[i, j]):
+                out.append(
+                    {
+                        "model1": models[i],
+                        "model2": models[j],
+                        "correlation": float(corr[i, j]),
+                        "p_value": float(pvals[i, j]),
+                        "n_questions": n,
+                        "significant": bool(pvals[i, j] < 0.05),
+                    }
+                )
+    return out
+
+
+def apply_pvalue_exclusions(df: pd.DataFrame) -> pd.DataFrame:
+    """The C43 script's own (lighter) exclusion pass (:217-227): duration
+    < 20% of median, and answered attention checks != 100. No
+    identical-slider filter."""
+    duration = df["Duration (in seconds)"]
+    df = df[duration >= 0.2 * duration.median()]
+    for group in GROUPS:
+        col = f"Q{group}_8"
+        if col in df.columns:
+            df = df[(df[col].isna()) | (df[col] == 100)]
+    return df
+
+
+def human_correlations_with_pvalues(
+    clean_df: pd.DataFrame,
+    min_questions: int = 3,
+) -> List[Dict[str, object]]:
+    """All-pairs rater-rater correlations within each group (:96-136)."""
+    out = []
+    for group in GROUPS:
+        gq = group_question_ids(group)
+        gdata = clean_df[clean_df[f"Q{group}_1"].notna()]
+        if len(gdata) < 2:
+            continue
+        x = gdata[gq].to_numpy(dtype=float).T  # (questions, raters)
+        corr = np.asarray(masked_pearson_matrix(jnp.asarray(x)))
+        counts = _joint_counts(x)
+        pvals = pearson_pvalues(corr, counts)
+        n_r = x.shape[1]
+        for i in range(n_r):
+            for j in range(i + 1, n_r):
+                n = int(counts[i, j])
+                if n >= min_questions and np.isfinite(corr[i, j]):
+                    out.append(
+                        {
+                            "group": group,
+                            "rater1_idx": i,
+                            "rater2_idx": j,
+                            "correlation": float(corr[i, j]),
+                            "p_value": float(pvals[i, j]),
+                            "n_questions": n,
+                            "significant": bool(pvals[i, j] < 0.05),
+                        }
+                    )
+    return out
+
+
+def compare_correlation_distributions(
+    llm_correlations: List[Dict[str, object]],
+    human_correlations: List[Dict[str, object]],
+) -> Dict[str, object]:
+    """LLM-vs-human correlation distribution tests (:138-204)."""
+    llm_vals = np.asarray(
+        [c["correlation"] for c in llm_correlations], dtype=float
+    )
+    human_vals = np.asarray(
+        [c["correlation"] for c in human_correlations], dtype=float
+    )
+    llm_vals = llm_vals[np.isfinite(llm_vals)]
+    human_vals = human_vals[np.isfinite(human_vals)]
+
+    mw_stat, mw_p = scipy_stats.mannwhitneyu(
+        llm_vals, human_vals, alternative="two-sided"
+    )
+    ks_stat, ks_p = scipy_stats.ks_2samp(llm_vals, human_vals)
+    t_stat, t_p = scipy_stats.ttest_ind(llm_vals, human_vals)
+
+    pooled_std = float(np.sqrt((llm_vals.std() ** 2 + human_vals.std() ** 2) / 2))
+    cohens_d = float((llm_vals.mean() - human_vals.mean()) / pooled_std)
+
+    def _stats_block(vals, rows):
+        sig = sum(1 for c in rows if c["significant"])
+        return {
+            "mean": float(vals.mean()),
+            "std": float(vals.std()),
+            "median": float(np.median(vals)),
+            "n_pairs": int(vals.size),
+            "significant_pairs": sig,
+            "proportion_significant": sig / len(rows) if rows else 0,
+        }
+
+    return {
+        "llm_stats": _stats_block(llm_vals, llm_correlations),
+        "human_stats": _stats_block(human_vals, human_correlations),
+        "comparison_tests": {
+            "mann_whitney": {
+                "statistic": float(mw_stat),
+                "p_value": float(mw_p),
+                "significant": bool(mw_p < 0.05),
+            },
+            "kolmogorov_smirnov": {
+                "statistic": float(ks_stat),
+                "p_value": float(ks_p),
+                "significant": bool(ks_p < 0.05),
+            },
+            "t_test": {
+                "statistic": float(t_stat),
+                "p_value": float(t_p),
+                "significant": bool(t_p < 0.05),
+            },
+            "effect_size": {
+                "cohens_d": cohens_d,
+                "interpretation": (
+                    "small"
+                    if abs(cohens_d) < 0.5
+                    else "medium"
+                    if abs(cohens_d) < 0.8
+                    else "large"
+                ),
+            },
+        },
+    }
+
+
+def run_pvalue_analysis(
+    instruct_df: pd.DataFrame,
+    base_df: pd.DataFrame,
+    survey_df: pd.DataFrame,
+) -> Dict[str, object]:
+    """End-to-end C43 (main, :206-320). `survey_df` is the loaded (not yet
+    excluded) survey frame; this analysis applies its own exclusion rules."""
+    clean = apply_pvalue_exclusions(survey_df)
+    llm_corrs = llm_correlations_with_pvalues(instruct_df, base_df)
+    human_corrs = human_correlations_with_pvalues(clean)
+    comparison = compare_correlation_distributions(llm_corrs, human_corrs)
+    return {
+        "llm_correlations": llm_corrs,
+        "human_correlations": human_corrs,
+        "comparison": comparison,
+    }
+
+
+def write_pvalue_analysis(results: Dict[str, object], path: Path) -> None:
+    """``correlation_pvalues_analysis.json`` (:312-319)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(results, indent=2))

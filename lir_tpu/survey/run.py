@@ -1,0 +1,187 @@
+"""End-to-end survey analysis pipeline driver.
+
+Replaces the reference's seven standalone survey scripts (each re-loading and
+re-cleaning the same CSVs, SURVEY.md §2.3) with one orchestrated pass that
+loads/cleans once and emits every artifact:
+
+  survey_analysis_detailed.json        (D7 - producer missing upstream)
+  consolidated_analysis_results.json   (D8)
+  llm_human_agreement_analysis.json    (C39)
+  llm_human_agreement_bootstrap.json   (D9, C41)
+  bootstrap_confidence_intervals.json  (C38)
+  family_differences.json              (C42)
+  correlation_pvalues_analysis.json    (C43)
+  proportion_analysis.json             (analyze_base_vs_instruct_vs_human)
+
+Usage:
+  python -m lir_tpu.survey.run --survey data/word_meaning_survey_results.csv \\
+      --instruct data/instruct_model_comparison_results.csv \\
+      --base data/model_comparison_results.csv --out results/survey
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+from typing import Dict, Optional
+
+import jax
+import pandas as pd
+
+from ..utils.logging import get_logger
+from . import consolidated, family_differences, human_llm, loader, proportions
+from . import pvalues as pvalues_mod
+from . import simulated
+
+log = get_logger(__name__)
+
+
+def run_survey_pipeline(
+    survey_csv: Path,
+    instruct_csv: Path,
+    base_csv: Optional[Path],
+    out_dir: Path,
+    seed: int = 42,
+    n_bootstrap_standard: int = 1000,
+    n_bootstrap_small: int = 100,
+    n_bootstrap_large: int = 10_000,
+    run_simulated_individuals: bool = True,
+) -> Dict[str, object]:
+    """Run every survey analysis and write all artifacts into `out_dir`.
+
+    Returns the in-memory results keyed by artifact name.
+    """
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, 6)
+
+    log.info("Loading survey data from %s", survey_csv)
+    survey_df, question_cols = loader.load_survey(survey_csv)
+    clean_df, exclusion_stats = loader.apply_exclusions(survey_df, question_cols)
+    log.info(
+        "Exclusions: %d -> %d respondents",
+        exclusion_stats["final_count"] + exclusion_stats["total_excluded"],
+        exclusion_stats["final_count"],
+    )
+
+    instruct_df = pd.read_csv(instruct_csv)
+    base_df = pd.read_csv(base_csv) if base_csv else None
+
+    question_mapping_text = loader.extract_question_text(survey_csv)
+    matches = loader.match_survey_to_llm_questions(
+        instruct_df, question_mapping_text
+    )
+    canonical = loader.canonical_question_mapping()
+
+    # D7 — the detailed per-question stats the downstream scripts assume.
+    detailed = loader.write_survey_detailed(
+        clean_df, question_cols, out_dir / "survey_analysis_detailed.json"
+    )
+
+    # D8 — consolidated analysis.
+    log.info("Running consolidated analysis")
+    analysis = consolidated.run_consolidated_analysis(
+        clean_df, question_cols, instruct_df, matches, exclusion_stats,
+        keys[0], n_bootstrap_standard, n_bootstrap_small,
+    )
+    consolidated.save_consolidated_results(
+        analysis, out_dir / "consolidated_analysis_results.json"
+    )
+    (out_dir / "consolidated_report.txt").write_text(
+        consolidated.format_report(analysis)
+    )
+
+    # C39 — point agreement metrics.
+    log.info("Running human-LLM agreement metrics")
+    human_avgs = human_llm.human_averages_from_detailed(detailed, canonical)
+    point_results = human_llm.analyze_all_models(human_avgs, instruct_df, base_df)
+    human_llm.write_agreement_analysis(
+        point_results, human_avgs, out_dir / "llm_human_agreement_analysis.json"
+    )
+
+    # C41 / D9 — question-resampled bootstrap.
+    log.info("Running question-resampled bootstrap (n=%d)", n_bootstrap_standard)
+    boot_results = human_llm.bootstrap_all_models(
+        human_avgs, instruct_df, base_df, keys[1], n_bootstrap_standard
+    )
+    d9_payload = human_llm.bootstrap_results_payload(
+        boot_results, keys[2], n_bootstrap_standard, n_bootstrap_large
+    )
+    human_llm.write_bootstrap_results(
+        d9_payload, out_dir / "llm_human_agreement_bootstrap.json"
+    )
+
+    # C42 — family differences from D9.
+    fam = family_differences.analyze_family_differences(d9_payload, keys[3])
+    family_differences.write_family_differences(
+        fam, out_dir / "family_differences.json"
+    )
+
+    results: Dict[str, object] = {
+        "detailed": detailed,
+        "consolidated": analysis,
+        "agreement_points": point_results,
+        "agreement_bootstrap": d9_payload,
+        "family_differences": fam,
+    }
+
+    # C38 — simulated-individual bootstrap (heavy; needs the D1 CSV).
+    if run_simulated_individuals and base_df is not None:
+        log.info("Running simulated-individual bootstrap (n=%d)", n_bootstrap_large)
+        sim = simulated.run_simulated_bootstrap(
+            base_df, canonical, detailed, keys[4],
+            n_bootstrap=n_bootstrap_large,
+        )
+        simulated.write_simulated_bootstrap(
+            sim, out_dir / "bootstrap_confidence_intervals.json"
+        )
+        results["simulated_bootstrap"] = sim
+
+    # C43 — correlation p-values (own exclusion rules, raw survey frame).
+    if base_df is not None:
+        log.info("Running correlation p-value analysis")
+        pv = pvalues_mod.run_pvalue_analysis(instruct_df, base_df, survey_df)
+        pvalues_mod.write_pvalue_analysis(
+            pv, out_dir / "correlation_pvalues_analysis.json"
+        )
+        results["pvalues"] = pv
+
+    # Proportion-based comparison + validity audit.
+    prop = proportions.run_proportion_analysis(instruct_df, detailed, canonical)
+    proportions.write_proportion_analysis(
+        prop, out_dir / "proportion_analysis.json"
+    )
+    results["proportions"] = prop
+
+    log.info("Survey pipeline complete; artifacts in %s", out_dir)
+    return results
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--survey", type=Path, required=True)
+    parser.add_argument("--instruct", type=Path, required=True)
+    parser.add_argument("--base", type=Path, default=None)
+    parser.add_argument("--out", type=Path, default=Path("results/survey"))
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--quick", action="store_true",
+                        help="reduced bootstrap budgets for smoke runs")
+    args = parser.parse_args()
+
+    kwargs = {}
+    if args.quick:
+        kwargs = dict(
+            n_bootstrap_standard=50,
+            n_bootstrap_small=20,
+            n_bootstrap_large=200,
+            run_simulated_individuals=True,
+        )
+    run_survey_pipeline(
+        args.survey, args.instruct, args.base, args.out, seed=args.seed,
+        **kwargs,
+    )
+
+
+if __name__ == "__main__":
+    main()

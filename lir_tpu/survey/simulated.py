@@ -1,0 +1,319 @@
+"""Simulated-individual human-model correlation bootstrap (C38).
+
+Parity target: survey_analysis/bootstrap_confidence_intervals.py:54-311 —
+simulate individual humans from per-question (mean, std) as
+clip(N(mu, sigma), 0, 1), correlate each simulated human with each model
+over a random survey group, and bootstrap (10,000 iterations x 100 samples)
+the base-vs-instruct mean correlation difference; plus per-model 1000-fold
+CIs and six hard-coded family comparisons.
+
+TPU-native redesign: the reference nests Python loops (bootstrap x sample x
+question) around scipy.pearsonr — ~10^6 interpreter-level correlations per
+model. Here all (n_iterations x n_samples) simulated humans for one model
+are drawn as one (N, 10) tensor per sampled group, and the masked Pearson
+against the model's group vector is a single vmapped kernel; the entire C38
+analysis is a handful of XLA launches per model.
+
+Sampling-validity semantics preserved exactly (:82-97): a (model, group)
+pair contributes only when >= 8 of the group's questions are matched AND none
+of the model's matched probabilities is NaN; otherwise every draw of that
+group is rejected for that model, exactly as the reference's
+``any(np.isnan(model_vals))`` rejection does.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from ..stats.core import resample_indices
+from .loader import GROUPS, group_question_ids
+
+
+MIN_MATCHED_QUESTIONS = 8  # bootstrap_confidence_intervals.py:91
+
+
+def model_group_tensors(
+    model_df: pd.DataFrame,
+    question_mapping: Dict[str, str],
+    detailed: Dict[str, object],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Per-group aligned tensors for one model.
+
+    Returns (means, stds, model_vals, usable):
+      means/stds: (5, 10) human per-question moments on the 0-1 scale
+      model_vals: (5, 10) model relative probabilities (NaN where unmatched)
+      usable:     (5,) bool — group passes the >=8-matched / no-NaN gate
+    """
+    by_q = detailed["results"]["by_question"]
+    qid_to_prompt = {qid: p for p, qid in question_mapping.items()}
+    rel_by_prompt: Dict[str, float] = {}
+    for _, row in model_df.iterrows():
+        if "relative_prob" in row.index:
+            rel = row["relative_prob"]
+        else:
+            total = row["yes_prob"] + row["no_prob"]
+            rel = row["yes_prob"] / total if total > 0 else float("nan")
+        rel_by_prompt[row["prompt"]] = float(rel) if pd.notna(rel) else float("nan")
+
+    n_g = len(GROUPS)
+    means = np.full((n_g, 10), np.nan)
+    stds = np.full((n_g, 10), np.nan)
+    vals = np.full((n_g, 10), np.nan)
+    matched = np.zeros((n_g, 10), dtype=bool)
+    has_nan = np.zeros(n_g, dtype=bool)
+    for gi, group in enumerate(GROUPS):
+        for qi, qid in enumerate(group_question_ids(group)):
+            prompt = qid_to_prompt.get(qid)
+            if prompt is None or prompt not in rel_by_prompt or qid not in by_q:
+                continue
+            matched[gi, qi] = True
+            means[gi, qi] = by_q[qid]["mean_response"] / 100.0
+            stds[gi, qi] = by_q[qid]["std_response"] / 100.0
+            v = rel_by_prompt[prompt]
+            vals[gi, qi] = v
+            if not np.isfinite(v):
+                has_nan[gi] = True
+    usable = (matched.sum(axis=1) >= MIN_MATCHED_QUESTIONS) & ~has_nan
+    return means, stds, vals, usable
+
+
+@jax.jit
+def _simulated_correlations(key, means, stds, model_vals, usable):
+    """(n_draws,) correlations between simulated humans and the model.
+
+    Each draw: pick a uniform group, simulate clip(N(mean, std), 0, 1) per
+    matched question, masked Pearson against the model's values. Draws whose
+    group is unusable come back NaN (the caller drops them), mirroring the
+    reference's rejected samples. `key` must be a batch of keys (one per
+    draw); the draw count is the batch size.
+    """
+
+    def one(k):
+        kg, kh = jax.random.split(k)
+        g = jax.random.randint(kg, (), 0, means.shape[0])
+        mu, sigma, mv = means[g], stds[g], model_vals[g]
+        mask = jnp.isfinite(mv) & jnp.isfinite(mu)
+        h = jnp.clip(mu + sigma * jax.random.normal(kh, mu.shape), 0.0, 1.0)
+        mf = mask.astype(h.dtype)
+        n = jnp.maximum(mf.sum(), 1.0)
+        hm = (jnp.where(mask, h, 0.0)).sum() / n
+        mm = (jnp.where(mask, mv, 0.0)).sum() / n
+        dh = jnp.where(mask, h - hm, 0.0)
+        dm = jnp.where(mask, mv - mm, 0.0)
+        denom = jnp.sqrt((dh * dh).sum() * (dm * dm).sum())
+        corr = jnp.where(denom > 0, (dh * dm).sum() / denom, jnp.nan)
+        return jnp.where(usable[g], corr, jnp.nan)
+
+    return jax.vmap(one)(key)
+
+
+def individual_correlations(
+    tensors: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]],
+    key: jax.Array,
+    n_samples: int,
+) -> Dict[str, np.ndarray]:
+    """Per-model arrays of valid simulated-individual correlations
+    (calculate_individual_correlations, :54-99)."""
+    out: Dict[str, np.ndarray] = {}
+    for model, (means, stds, vals, usable) in tensors.items():
+        key, sub = jax.random.split(key)
+        draws = _simulated_correlations(
+            jax.random.split(sub, n_samples),
+            jnp.asarray(means),
+            jnp.asarray(stds),
+            jnp.asarray(vals),
+            jnp.asarray(usable),
+        )
+        arr = np.asarray(draws)
+        out[model] = arr[np.isfinite(arr)]
+    return out
+
+
+def run_simulated_bootstrap(
+    base_df: pd.DataFrame,
+    question_mapping: Dict[str, str],
+    detailed: Dict[str, object],
+    key: jax.Array,
+    n_base_samples: int = 500,
+    n_bootstrap: int = 10_000,
+    n_boot_samples: int = 100,
+    n_per_model_boot: int = 1000,
+    families: Optional[Dict[str, Dict[str, str]]] = None,
+) -> Dict[str, object]:
+    """The full C38 analysis. `base_df` is the D1 CSV (both base and
+    instruct rows, distinguished by ``base_or_instruct``)."""
+    model_types = {
+        model: base_df.loc[base_df["model"] == model, "base_or_instruct"].iloc[0]
+        for model in base_df["model"].unique()
+    }
+    tensors = {
+        model: model_group_tensors(
+            base_df[base_df["model"] == model], question_mapping, detailed
+        )
+        for model in base_df["model"].unique()
+    }
+
+    k_base, k_boot, k_model = jax.random.split(key, 3)
+
+    # Base correlations (reference: n_samples=500, seed 42; :103).
+    base_corrs = individual_correlations(tensors, k_base, n_base_samples)
+    model_stats = {
+        model: {
+            "type": model_types[model],
+            "mean_corr": float(np.mean(corrs)) if corrs.size else float("nan"),
+            "std_corr": float(np.std(corrs)) if corrs.size else float("nan"),
+            "n_correlations": int(corrs.size),
+        }
+        for model, corrs in base_corrs.items()
+        if corrs.size
+    }
+
+    # Bootstrap: n_bootstrap iterations of fresh n_boot_samples draws per
+    # model, pooled by type within each iteration (:126-148). All draws for
+    # one model happen in a single kernel of n_bootstrap*n_boot_samples.
+    sums = {"base": np.zeros(n_bootstrap), "instruct": np.zeros(n_bootstrap)}
+    counts = {"base": np.zeros(n_bootstrap), "instruct": np.zeros(n_bootstrap)}
+    for model, (means, stds, vals, usable) in tensors.items():
+        k_boot, sub = jax.random.split(k_boot)
+        draws = _simulated_correlations(
+            jax.random.split(sub, n_bootstrap * n_boot_samples),
+            jnp.asarray(means),
+            jnp.asarray(stds),
+            jnp.asarray(vals),
+            jnp.asarray(usable),
+        )
+        arr = np.asarray(draws).reshape(n_bootstrap, n_boot_samples)
+        finite = np.isfinite(arr)
+        mtype = model_types[model]
+        sums[mtype] += np.where(finite, arr, 0.0).sum(axis=1)
+        counts[mtype] += finite.sum(axis=1)
+
+    def _boot_means(mtype):
+        c = counts[mtype]
+        with np.errstate(invalid="ignore"):
+            m = np.where(c > 0, sums[mtype] / c, np.nan)
+        return m[np.isfinite(m)]
+
+    base_means_boot = _boot_means("base")
+    instruct_means_boot = _boot_means("instruct")
+
+    def _pooled_mean(mtype):
+        pooled = np.concatenate(
+            [c for m, c in base_corrs.items() if model_types[m] == mtype]
+            or [np.asarray([])]
+        )
+        return float(np.mean(pooled)) if pooled.size else float("nan")
+
+    def _ci(samples):
+        if len(samples) == 0:
+            return (float("nan"), float("nan"))
+        return (
+            float(np.percentile(samples, 2.5)),
+            float(np.percentile(samples, 97.5)),
+        )
+
+    base_mean = _pooled_mean("base")
+    instruct_mean = _pooled_mean("instruct")
+    base_ci = _ci(base_means_boot)
+    instruct_ci = _ci(instruct_means_boot)
+
+    n_common = min(len(base_means_boot), len(instruct_means_boot))
+    diff_samples = base_means_boot[:n_common] - instruct_means_boot[:n_common]
+    diff_ci = _ci(diff_samples)
+    diff_mean = base_mean - instruct_mean
+
+    # Per-model CIs: 1000 resamples of each model's base correlations (:211-230).
+    per_model: Dict[str, Dict[str, object]] = {}
+    for model, corrs in base_corrs.items():
+        if corrs.size == 0:
+            continue
+        k_model, sub = jax.random.split(k_model)
+        idx = np.asarray(resample_indices(sub, n_per_model_boot, corrs.size))
+        boot_means = corrs[idx].mean(axis=1)
+        lo, hi = _ci(boot_means)
+        per_model[model] = {
+            "type": model_types[model],
+            "mean": model_stats[model]["mean_corr"],
+            "ci_lower": lo,
+            "ci_upper": hi,
+        }
+
+    families = families or DEFAULT_SIMULATED_FAMILIES
+    family_rows = []
+    for family, pair in families.items():
+        b, i = pair.get("base"), pair.get("instruct")
+        if b in per_model and i in per_model:
+            bs, is_ = per_model[b], per_model[i]
+            overlap = not (
+                bs["ci_upper"] < is_["ci_lower"] or is_["ci_upper"] < bs["ci_lower"]
+            )
+            family_rows.append(
+                {
+                    "family": family,
+                    "base_mean": bs["mean"],
+                    "base_ci": [bs["ci_lower"], bs["ci_upper"]],
+                    "instruct_mean": is_["mean"],
+                    "instruct_ci": [is_["ci_lower"], is_["ci_upper"]],
+                    "difference": bs["mean"] - is_["mean"],
+                    "non_overlapping_ci": not overlap,
+                }
+            )
+
+    return {
+        "methodology": (
+            "Bootstrap confidence intervals for individual human-model "
+            "correlations"
+        ),
+        "n_bootstrap": n_bootstrap,
+        "overall_results": {
+            "base": {
+                "mean": base_mean,
+                "ci_lower": base_ci[0],
+                "ci_upper": base_ci[1],
+            },
+            "instruct": {
+                "mean": instruct_mean,
+                "ci_lower": instruct_ci[0],
+                "ci_upper": instruct_ci[1],
+            },
+            "difference": {
+                "mean": diff_mean,
+                "ci_lower": diff_ci[0],
+                "ci_upper": diff_ci[1],
+                "significant": bool(diff_ci[0] > 0 or diff_ci[1] < 0),
+            },
+        },
+        "per_model_results": per_model,
+        "family_comparisons": family_rows,
+        "model_stats": model_stats,
+    }
+
+
+DEFAULT_SIMULATED_FAMILIES: Dict[str, Dict[str, str]] = {
+    "t5": {"base": "google/t5-v1_1-base", "instruct": "google/flan-t5-base"},
+    "falcon": {"base": "tiiuae/falcon-7b", "instruct": "tiiuae/falcon-7b-instruct"},
+    "bloom": {"base": "bigscience/bloom-7b1", "instruct": "bigscience/bloomz-7b1"},
+    "stablelm": {
+        "base": "stabilityai/stablelm-base-alpha-7b",
+        "instruct": "stabilityai/stablelm-tuned-alpha-7b",
+    },
+    "redpajama": {
+        "base": "togethercomputer/RedPajama-INCITE-7B-Base",
+        "instruct": "togethercomputer/RedPajama-INCITE-7B-Instruct",
+    },
+    "pythia": {"base": "EleutherAI/pythia-6.9b", "instruct": "databricks/dolly-v2-7b"},
+}
+
+
+def write_simulated_bootstrap(results: Dict[str, object], path: Path) -> None:
+    """``bootstrap_confidence_intervals.json`` (:277-310)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(results, indent=2))

@@ -26,7 +26,13 @@ class CaptureHandler(logging.Handler):
 
 
 def get_logger(name: Optional[str] = None) -> logging.Logger:
-    logger = logging.getLogger(_LOGGER_NAME if name is None else f"{_LOGGER_NAME}.{name}")
+    if name is None:
+        qualified = _LOGGER_NAME
+    elif name.startswith(_LOGGER_NAME + ".") or name == _LOGGER_NAME:
+        qualified = name  # already package-qualified (callers pass __name__)
+    else:
+        qualified = f"{_LOGGER_NAME}.{name}"
+    logger = logging.getLogger(qualified)
     root = logging.getLogger(_LOGGER_NAME)
     # Install the console handler exactly once. CaptureHandler derives from
     # logging.Handler (not StreamHandler), so capture handlers attached first

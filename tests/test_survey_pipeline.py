@@ -1,0 +1,328 @@
+"""Parity tests for the survey subsystem (C31-C43) against independent
+reference-style (row-loop pandas/scipy) reimplementations, evaluated on the
+committed reference data (D2/D3) — the free regression fixtures of
+SURVEY.md §4.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+from scipy import stats as scipy_stats
+
+from lir_tpu.survey import (
+    agreement_metrics,
+    apply_exclusions,
+    bootstrap_agreement_metrics,
+    canonical_question_mapping,
+    extract_question_text,
+    human_averages_from_detailed,
+    human_correlations_with_pvalues,
+    human_cross_prompt_correlations,
+    human_llm_correlation,
+    human_responses_by_question,
+    llm_correlations_with_pvalues,
+    llm_cross_prompt_correlations,
+    llm_responses_by_question,
+    load_survey,
+    match_survey_to_llm_questions,
+    model_group_tensors,
+    pearson_pvalues,
+    relative_prob_series,
+    survey_detailed,
+)
+from lir_tpu.survey.loader import group_question_ids
+
+KEY = jax.random.PRNGKey(42)
+
+
+@pytest.fixture(scope="module")
+def survey(reference_data_dir):
+    return load_survey(f"{reference_data_dir}/word_meaning_survey_results.csv")
+
+
+@pytest.fixture(scope="module")
+def clean(survey):
+    df, cols = survey
+    return apply_exclusions(df, cols)
+
+
+@pytest.fixture(scope="module")
+def instruct_df(reference_data_dir):
+    return pd.read_csv(f"{reference_data_dir}/instruct_model_comparison_results.csv")
+
+
+@pytest.fixture(scope="module")
+def base_df(reference_data_dir):
+    return pd.read_csv(f"{reference_data_dir}/model_comparison_results.csv")
+
+
+@pytest.fixture(scope="module")
+def matches(reference_data_dir, instruct_df):
+    mapping = extract_question_text(
+        f"{reference_data_dir}/word_meaning_survey_results.csv"
+    )
+    return match_survey_to_llm_questions(instruct_df, mapping)
+
+
+class TestLoaderAndExclusions:
+    def test_load_shape(self, survey):
+        df, cols = survey
+        # D3: 507 respondent rows, 55 slider columns (5 groups x 11).
+        assert len(df) == 507
+        assert len(cols) == 55
+
+    def test_exclusions_match_reference_row_loop(self, survey):
+        """Vectorized exclusions == the reference's row-by-row loops
+        (survey_analysis_consolidated.py:36-85)."""
+        df, cols = survey
+        ours, stats = apply_exclusions(df, cols)
+
+        # Independent reimplementation with explicit Python loops.
+        ref = df.copy()
+        median = ref["Duration (in seconds)"].median()
+        ref = ref[ref["Duration (in seconds)"] >= 0.2 * median]
+        identical = []
+        for idx, row in ref.iterrows():
+            answered = [c for c in cols if pd.notna(row[c]) and not c.endswith("_8")]
+            if len(answered) > 1 and len({row[c] for c in answered}) == 1:
+                identical.append(idx)
+        ref = ref.drop(identical)
+        attention = []
+        for idx, row in ref.iterrows():
+            for g in range(1, 6):
+                col = f"Q{g}_8"
+                if col in ref.columns and pd.notna(row[col]) and row[col] != 100:
+                    attention.append(idx)
+                    break
+        ref = ref.drop(attention)
+
+        assert stats["identical_excluded"] == len(identical)
+        assert stats["attention_failed"] == len(attention)
+        assert stats["final_count"] == len(ref)
+        # Same surviving respondents (compare a stable identifier column).
+        assert list(ours["ResponseId"]) == list(ref["ResponseId"])
+
+    def test_matching_covers_all_50(self, matches):
+        assert len(matches) == 50
+        assert set(matches.values()) == {
+            q for g in range(1, 6) for q in group_question_ids(g)
+        }
+
+    def test_canonical_mapping_agrees_with_qualtrics_headers(self, matches):
+        canonical = canonical_question_mapping()
+        assert canonical == matches
+
+    def test_survey_detailed_schema(self, clean, survey):
+        _, cols = survey
+        clean_df, _ = clean
+        payload = survey_detailed(clean_df, cols)
+        by_q = payload["results"]["by_question"]
+        assert len(by_q) == 50
+        q = by_q["Q1_1"]
+        direct = clean_df["Q1_1"].dropna().to_numpy(dtype=float)
+        assert q["mean_response"] == pytest.approx(direct.mean())
+        assert q["std_response"] == pytest.approx(direct.std())
+        assert 0.0 <= q["proportion_yes"] <= 1.0
+        assert q["n_responses"] == direct.size
+
+
+class TestConsolidated:
+    def test_human_llm_correlation_point_estimate(self, clean, survey, instruct_df, matches):
+        clean_df, _ = clean
+        _, cols = survey
+        h_stats = human_responses_by_question(clean_df, cols)
+        l_stats = llm_responses_by_question(instruct_df)
+        res = human_llm_correlation(h_stats, l_stats, matches, KEY, n_bootstrap=50)
+
+        h = [h_stats[q]["mean"] / 100.0 for p, q in matches.items()]
+        m = [l_stats[p]["mean"] for p, q in matches.items()]
+        expected_r, expected_p = scipy_stats.pearsonr(h, m)
+        assert res["correlation"] == pytest.approx(expected_r)
+        assert res["p_value"] == pytest.approx(expected_p)
+        assert res["n_questions"] == 50
+
+    def test_llm_mean_uses_nan_skipping(self, instruct_df):
+        """The reference's np.mean(Series) dispatches to pandas' skipna mean."""
+        stats = llm_responses_by_question(instruct_df)
+        for prompt, s in stats.items():
+            direct = instruct_df.loc[
+                instruct_df["prompt"] == prompt, "relative_prob"
+            ]
+            assert s["mean"] == pytest.approx(direct.mean(), nan_ok=True)
+
+    def test_human_cross_prompt_base_mean(self, clean):
+        """Kernel pair means == pandas .corr() pooled means
+        (survey_analysis_consolidated.py:352-412)."""
+        clean_df, _ = clean
+        res = human_cross_prompt_correlations(clean_df, KEY, n_bootstrap=10)
+
+        all_corrs = []
+        for g in range(1, 6):
+            gq = group_question_ids(g)
+            gdf = clean_df[clean_df[f"Q{g}_1"].notna()]
+            rows, ids = [], []
+            for idx in gdf.index:
+                vals = [gdf.loc[idx, q] / 100.0 for q in gq]
+                if sum(pd.notna(v) for v in vals) >= 5:
+                    rows.append(vals)
+                    ids.append(idx)
+            mat = pd.DataFrame(rows, index=ids, columns=gq).T
+            corr = mat.corr(method="pearson")
+            for i in range(len(corr)):
+                for j in range(i + 1, len(corr)):
+                    v = corr.iloc[i, j]
+                    if not np.isnan(v):
+                        all_corrs.append(v)
+
+        assert res["n_pairs"] == len(all_corrs)
+        assert res["mean_correlation"] == pytest.approx(np.mean(all_corrs), abs=1e-6)
+
+    def test_llm_cross_prompt_base_mean(self, instruct_df, matches):
+        res = llm_cross_prompt_correlations(instruct_df, matches, KEY, n_bootstrap=10)
+
+        prompt_to_group = {
+            p: int(q.split("_")[0][1:]) for p, q in matches.items()
+        }
+        all_corrs = []
+        for g in range(1, 6):
+            prompts = [p for p, gg in prompt_to_group.items() if gg == g]
+            data = instruct_df[instruct_df["prompt"].isin(prompts)]
+            pivot = data.pivot_table(
+                index="prompt", columns="model", values="relative_prob"
+            )
+            corr = pivot.corr(method="pearson")
+            for i in range(len(corr)):
+                for j in range(i + 1, len(corr)):
+                    v = corr.iloc[i, j]
+                    if not np.isnan(v):
+                        all_corrs.append(v)
+
+        assert res["n_pairs"] == len(all_corrs)
+        assert res["mean_correlation"] == pytest.approx(np.mean(all_corrs), abs=1e-6)
+
+
+class TestHumanLLMAgreement:
+    @pytest.fixture(scope="class")
+    def human_avgs(self, clean, survey):
+        clean_df, _ = clean
+        _, cols = survey
+        detailed = survey_detailed(clean_df, cols)
+        return human_averages_from_detailed(detailed, canonical_question_mapping())
+
+    def test_point_metrics_vs_direct(self, human_avgs, instruct_df):
+        model = instruct_df["model"].unique()[0]
+        mdf = instruct_df[instruct_df["model"] == model]
+        res = agreement_metrics(mdf, model, human_avgs)
+        assert res is not None
+
+        rel = dict(zip(mdf["prompt"], mdf["relative_prob"]))
+        pairs = [
+            (human_avgs[q], rel[q])
+            for q in human_avgs
+            if q in rel and np.isfinite(rel[q])
+        ]
+        h, m = map(np.asarray, zip(*pairs))
+        assert res["n_questions"] == len(pairs)
+        assert res["mae"] == pytest.approx(np.abs(h - m).mean())
+        assert res["rmse"] == pytest.approx(np.sqrt(((h - m) ** 2).mean()))
+        r, p = scipy_stats.pearsonr(h, m)
+        assert res["pearson_r"] == pytest.approx(r)
+
+    def test_relative_prob_from_yes_no(self, base_df):
+        rel = relative_prob_series(base_df)
+        row = base_df.iloc[0]
+        total = row["yes_prob"] + row["no_prob"]
+        expected = row["yes_prob"] / total if total > 0 else 0.5
+        assert rel.iloc[0] == pytest.approx(expected)
+
+    def test_bootstrap_full_sample_equals_point(self, human_avgs, instruct_df):
+        """A bootstrap metric evaluated on every question (identity-like
+        resample covering all indices) equals the direct metric."""
+        model = instruct_df["model"].unique()[0]
+        mdf = instruct_df[instruct_df["model"] == model]
+        point = agreement_metrics(mdf, model, human_avgs)
+        boot = bootstrap_agreement_metrics(
+            mdf, human_avgs, KEY, n_bootstrap=400, min_successful=10
+        )
+        assert boot is not None
+        # Bootstrap mean approximates the point value.
+        assert boot["mae_mean"] == pytest.approx(point["mae"], abs=0.05)
+        assert boot["mae_ci_lower"] <= point["mae"] <= boot["mae_ci_upper"]
+
+
+class TestPvalues:
+    def test_pearson_pvalues_match_scipy(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=20)
+        y = 0.5 * x + rng.normal(size=20)
+        r, p = scipy_stats.pearsonr(x, y)
+        ours = pearson_pvalues(np.asarray([r]), np.asarray([20]))[0]
+        assert ours == pytest.approx(p, rel=1e-6)
+
+    def test_llm_pairs_match_scipy(self, instruct_df, base_df):
+        rows = llm_correlations_with_pvalues(instruct_df, base_df)
+        assert len(rows) > 100
+        # Spot-check three pairs against a direct scipy computation.
+        combined = pd.concat(
+            [
+                base_df.assign(_rel=relative_prob_series(base_df)),
+                instruct_df.assign(_rel=relative_prob_series(instruct_df)),
+            ],
+            ignore_index=True,
+        )
+        for row in rows[:3]:
+            a = combined[combined["model"] == row["model1"]]
+            b = combined[combined["model"] == row["model2"]]
+            da = dict(zip(a["prompt"], a["_rel"]))
+            db = dict(zip(b["prompt"], b["_rel"]))
+            common = [
+                q
+                for q in set(da) & set(db)
+                if np.isfinite(da[q]) and np.isfinite(db[q])
+            ]
+            r, p = scipy_stats.pearsonr(
+                [da[q] for q in common], [db[q] for q in common]
+            )
+            assert row["correlation"] == pytest.approx(r, abs=1e-6)
+            assert row["p_value"] == pytest.approx(p, rel=1e-5, abs=1e-12)
+            assert row["n_questions"] == len(common)
+
+    def test_human_pairs_subset(self, clean):
+        clean_df, _ = clean
+        rows = human_correlations_with_pvalues(clean_df)
+        assert len(rows) > 1000
+        sample = rows[0]
+        g = sample["group"]
+        gq = group_question_ids(g)
+        gdf = clean_df[clean_df[f"Q{g}_1"].notna()]
+        r1 = gdf.iloc[sample["rater1_idx"]]
+        r2 = gdf.iloc[sample["rater2_idx"]]
+        v1, v2 = [], []
+        for q in gq:
+            if pd.notna(r1[q]) and pd.notna(r2[q]):
+                v1.append(r1[q])
+                v2.append(r2[q])
+        r, p = scipy_stats.pearsonr(v1, v2)
+        assert sample["correlation"] == pytest.approx(r, abs=1e-6)
+        assert sample["n_questions"] == len(v1)
+
+
+class TestSimulated:
+    def test_group_tensor_gate(self, base_df, clean, survey):
+        clean_df, _ = clean
+        _, cols = survey
+        detailed = survey_detailed(clean_df, cols)
+        mapping = canonical_question_mapping()
+        model = base_df["model"].unique()[0]
+        means, stds, vals, usable = model_group_tensors(
+            base_df[base_df["model"] == model], mapping, detailed
+        )
+        assert means.shape == (5, 10)
+        # A usable group has >= 8 matched questions and no NaN model values.
+        for gi in range(5):
+            matched = np.isfinite(vals[gi]).sum()
+            if usable[gi]:
+                assert matched >= 8
