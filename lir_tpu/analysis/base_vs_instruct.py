@@ -1,0 +1,212 @@
+"""Base-vs-instruct delta analysis over the D1 CSV (C28).
+
+Parity target: analysis/analyze_results_base_versus_instruct.py:1-268 —
+pair base/instruct rows per family on prompt, drop rows where any of the four
+probabilities is zero, recompute relative probabilities, report per-family
+Pearson r and the instruct-minus-base difference distribution (mean, std,
+2.5/97.5 percentiles), and emit the bar/violin/heatmap figures plus three
+CSVs. The Mistral family is dropped as in the reference (:34); hard-coded
+G:/ paths become arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import seaborn as sns  # noqa: E402
+from scipy import stats as scipy_stats  # noqa: E402
+
+from ..utils.logging import get_logger  # noqa: E402
+
+log = get_logger(__name__)
+
+DROPPED_FAMILIES = ("mistral",)  # reference :34
+
+
+def process_model_pair(
+    df: pd.DataFrame, base_model: str, instruct_model: str
+) -> pd.DataFrame:
+    """Merge one family's base/instruct rows on prompt, keep rows where all
+    four probabilities are positive, and add rel_prob columns (:38-58)."""
+    base = df[df["model"] == base_model]
+    instruct = df[df["model"] == instruct_model]
+    paired = pd.merge(base, instruct, on="prompt", suffixes=("_base", "_instruct"))
+    valid = (
+        (paired["yes_prob_base"] > 0)
+        & (paired["no_prob_base"] > 0)
+        & (paired["yes_prob_instruct"] > 0)
+        & (paired["no_prob_instruct"] > 0)
+    )
+    paired["rel_prob_base"] = paired["yes_prob_base"] / (
+        paired["yes_prob_base"] + paired["no_prob_base"]
+    )
+    paired["rel_prob_instruct"] = paired["yes_prob_instruct"] / (
+        paired["yes_prob_instruct"] + paired["no_prob_instruct"]
+    )
+    return paired[valid]
+
+
+def family_differences(df: pd.DataFrame) -> Dict[str, object]:
+    """Per-family paired analysis: correlation + difference distribution.
+
+    Returns {"statistics": rows, "prompt_differences": long frame}.
+    """
+    df = df[~df["model_family"].isin(DROPPED_FAMILIES)]
+    stats_rows: List[Dict[str, object]] = []
+    long_rows: List[Dict[str, object]] = []
+
+    for family in df["model_family"].unique():
+        fam = df[df["model_family"] == family]
+        base_models = fam.loc[fam["base_or_instruct"] == "base", "model"]
+        instruct_models = fam.loc[fam["base_or_instruct"] == "instruct", "model"]
+        if base_models.empty or instruct_models.empty:
+            log.info("Family %s lacks a base or instruct model; skipped", family)
+            continue
+        paired = process_model_pair(
+            df, base_models.iloc[0], instruct_models.iloc[0]
+        )
+        if len(paired) == 0:
+            log.info("Family %s has no valid pairs after zero filtering", family)
+            continue
+
+        corr, p = scipy_stats.pearsonr(
+            paired["rel_prob_base"], paired["rel_prob_instruct"]
+        )
+        diff = (paired["rel_prob_instruct"] - paired["rel_prob_base"]).to_numpy()
+        lo, hi = np.percentile(diff, [2.5, 97.5])
+        stats_rows.append(
+            {
+                "Model_Family": family,
+                "Mean": float(diff.mean()),
+                "Std_Dev": float(diff.std()),
+                "Lower_CI_95": float(lo),
+                "Upper_CI_95": float(hi),
+                "CI_Width": float(hi - lo),
+                "Num_Samples": int(diff.size),
+                "Correlation": float(corr),
+                "Correlation_p": float(p),
+            }
+        )
+        for prompt, d in zip(paired["prompt"], diff):
+            long_rows.append(
+                {"Difference": float(d), "Prompt": prompt, "Model Family": family}
+            )
+
+    return {
+        "statistics": pd.DataFrame(stats_rows),
+        "prompt_differences": pd.DataFrame(long_rows),
+    }
+
+
+def _bar_plot(stats_df: pd.DataFrame, path: Path) -> None:
+    fig, ax = plt.subplots(figsize=(15, 8))
+    ax.bar(stats_df["Model_Family"], stats_df["Mean"])
+    ax.set_xticks(range(len(stats_df)))
+    ax.set_xticklabels(stats_df["Model_Family"], rotation=45, ha="right")
+    ax.set_title("Average Difference in Relative Probability\n(Instruct - Base)")
+    ax.set_ylabel("Difference in Relative Probability")
+    fig.tight_layout()
+    fig.savefig(path, dpi=150)
+    plt.close(fig)
+
+
+def _violin_plot(
+    long_df: pd.DataFrame, path: Path, rng: np.random.Generator
+) -> None:
+    families = long_df["Model Family"].unique()
+    colors = plt.cm.tab10(np.linspace(0, 1, len(families)))
+    fig, ax = plt.subplots(figsize=(15, 10))
+    for idx, family in enumerate(families):
+        vals = long_df.loc[long_df["Model Family"] == family, "Difference"].to_numpy()
+        lo, hi = np.percentile(vals, [2.5, 97.5])
+        parts = ax.violinplot([vals], [idx + 1], widths=0.3, showmeans=False,
+                              showmedians=False, showextrema=False)
+        for pc in parts["bodies"]:
+            pc.set_facecolor(colors[idx])
+            pc.set_edgecolor("none")
+            pc.set_alpha(0.3)
+        ax.scatter(rng.normal(idx + 1, 0.08, size=vals.size), vals,
+                   alpha=0.4, s=30, color=colors[idx])
+        ax.scatter(idx + 1, vals.mean(), color="black", s=80, zorder=5)
+        ax.plot([idx + 1, idx + 1], [lo, hi], color="black", linewidth=2, zorder=4)
+        for y in (lo, hi):
+            ax.plot([idx + 0.9, idx + 1.1], [y, y], color="black", linewidth=2,
+                    zorder=4)
+    ax.axhline(0, color="gray", linestyle="--", alpha=0.7)
+    ax.set_xticks(range(1, len(families) + 1))
+    ax.set_xticklabels(families, rotation=45, ha="right")
+    ax.set_ylabel("Relative Probability Difference (Instruct - Base)")
+    ax.legend(
+        handles=[
+            plt.Line2D([0], [0], marker="o", color="w",
+                       markerfacecolor=colors[i], markersize=10, label=f)
+            for i, f in enumerate(families)
+        ],
+        loc="best",
+    )
+    fig.tight_layout()
+    fig.savefig(path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+
+
+def _heatmap(pivot: pd.DataFrame, path: Path) -> None:
+    fig = plt.figure(figsize=(18, max(4.0, len(pivot) * 0.4)))
+    sns.heatmap(pivot, center=0, cmap="RdBu_r", fmt=".2f")
+    fig.tight_layout()
+    fig.savefig(path, dpi=150)
+    plt.close(fig)
+
+
+def run_base_vs_instruct_analysis(
+    results_csv: Path,
+    out_dir: Path,
+    make_figures: bool = True,
+    seed: int = 42,
+) -> Dict[str, object]:
+    """Full C28: analysis + figures + the three CSV artifacts."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    df = pd.read_csv(results_csv)
+    res = family_differences(df)
+    stats_df: pd.DataFrame = res["statistics"]
+    long_df: pd.DataFrame = res["prompt_differences"]
+
+    stats_df.to_csv(out_dir / "model_rel_prob_statistics.csv", index=False)
+    long_df.to_csv(out_dir / "prompt_rel_prob_differences.csv", index=False)
+    pivot = long_df.pivot_table(
+        index="Prompt", columns="Model Family", values="Difference",
+        aggfunc="mean",
+    )
+    pivot.to_csv(out_dir / "prompt_rel_prob_heatmap_data.csv")
+
+    if make_figures and len(stats_df):
+        rng = np.random.default_rng(seed)
+        _bar_plot(stats_df, out_dir / "rel_prob_differences.png")
+        _violin_plot(long_df, out_dir / "prompt_rel_prob_differences.png", rng)
+        _heatmap(pivot, out_dir / "prompt_rel_prob_heatmap.png")
+
+    return {**res, "heatmap": pivot}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--results", type=Path, required=True,
+                        help="D1 model_comparison_results.csv")
+    parser.add_argument("--out", type=Path, default=Path("results/base_vs_instruct"))
+    parser.add_argument("--no-figures", action="store_true")
+    args = parser.parse_args()
+    run_base_vs_instruct_analysis(
+        args.results, args.out, make_figures=not args.no_figures
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,415 @@
+"""Two-source Cohen's kappa combiner (C29).
+
+Parity target: analysis/calculate_cohens_kappa.py:20-675 — per-prompt
+inter-model agreement from the D2 CSV, per-prompt perturbation "self-kappa"
+from the D6 workbook (1000 bootstrap pairs of binarized decisions), keyword
+matching of the 5 legal prompts across the two datasets, min-of-normal-draws
+combination with bootstrap CI, interpretation bands, bar/scatter/distribution
+figures, LaTeX table, and the four CSV artifacts.
+
+Defect fixed, not replicated (SURVEY.md §7): the reference computes
+per-prompt model agreement with ``cohen_kappa_score([x], [y])`` on
+single-element lists (:124-127), a degenerate statistic (NaN for every
+disagreeing pair). We report the pairwise agreement fraction that loop
+actually measures (stats.kappa.per_prompt_mean_pairwise_kappa) and use it as
+the model-variation agreement input.
+
+All bootstrap loops run as vmapped kernels (stats.kappa.self_kappa_bootstrap,
+stats.kappa.combined_kappa).
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import seaborn as sns  # noqa: E402
+
+from ..data.schemas import read_results_frame  # noqa: E402
+from ..stats.kappa import (  # noqa: E402
+    combined_kappa,
+    interpret_kappa,
+    per_prompt_mean_pairwise_kappa,
+    self_kappa_bootstrap,
+)
+from ..utils.logging import get_logger  # noqa: E402
+
+log = get_logger(__name__)
+
+# Keyword table matching the 5 legal prompts across datasets (:230-241).
+LEGAL_PROMPT_KEYWORDS: Dict[str, List[str]] = {
+    "Insurance Policy Water Damage Exclusion": [
+        "water damage", "levee", "flood", "insurance policy",
+    ],
+    "Prenuptial Agreement Petition Filing Date": [
+        "prenuptial", "petition", "dissolution", "marriage", "filing",
+    ],
+    "Contract Term Affiliate Interpretation": [
+        "contract", "affiliate", "royalty", "1961", "company",
+    ],
+    "Construction Payment Terms Interpretation": [
+        "contractor", "usual manner", "payment", "foundry", "construction",
+    ],
+    "Insurance Policy Burglary Coverage": [
+        "insurance", "felonious", "burglary", "theft", "visible marks",
+    ],
+}
+
+
+def prepare_model_data(df: pd.DataFrame) -> pd.DataFrame:
+    """Per-prompt inter-model agreement from the D2 CSV (:76-145)."""
+    df = df.copy()
+    df["binary_decision"] = (df["relative_prob"] > 0.5).astype(int)
+    rows = []
+    for prompt, group in df.groupby("prompt"):
+        if group["model"].nunique() < 2:
+            continue
+        stats = per_prompt_mean_pairwise_kappa(
+            group["binary_decision"].to_numpy()
+        )
+        rows.append(
+            {
+                "prompt": prompt,
+                "avg_pairwise_kappa": stats["avg_pairwise_agreement"],
+                "n_models": stats["n_models"],
+                "agree_percent": stats["agree_percent"],
+            }
+        )
+    return pd.DataFrame(rows)
+
+
+def prepare_perturbation_data(
+    df: pd.DataFrame, key: jax.Array, n_bootstrap: int = 1000
+) -> pd.DataFrame:
+    """Per-prompt perturbation self-kappa from the D6 workbook (:147-218)."""
+    df = df.copy()
+    if "Total_Prob" not in df.columns:
+        df["Total_Prob"] = df["Token_1_Prob"] + df["Token_2_Prob"]
+    if "Relative_Prob" not in df.columns:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            df["Relative_Prob"] = df["Token_1_Prob"] / df["Total_Prob"]
+    df["binary_decision"] = (df["Relative_Prob"] > 0.5).astype(int)
+
+    rows = []
+    for prompt, group in df.groupby("Original Main Part"):
+        decisions = group["binary_decision"].to_numpy()
+        mean_dec = float(decisions.mean())
+        key, sub = jax.random.split(key)
+        boot = self_kappa_bootstrap(decisions, sub, n_boot=n_bootstrap)
+        rows.append(
+            {
+                "prompt": prompt,
+                "n_variations": int(decisions.size),
+                "agree_percent": mean_dec if mean_dec > 0.5 else 1 - mean_dec,
+                **boot,
+            }
+        )
+    return pd.DataFrame(rows)
+
+
+def _keyword_match(
+    df: pd.DataFrame, columns: Sequence[str]
+) -> Dict[str, pd.Series]:
+    """title -> first row whose prompt text contains any keyword (:247-311)."""
+    out: Dict[str, pd.Series] = {}
+    for title, keywords in LEGAL_PROMPT_KEYWORDS.items():
+        for col in columns:
+            if title in out or col not in df.columns:
+                continue
+            for keyword in keywords:
+                matches = df[
+                    df[col].str.contains(keyword, case=False, regex=False, na=False)
+                ]
+                if not matches.empty:
+                    out[title] = matches.iloc[0]
+                    break
+            if title in out:
+                break
+    return out
+
+
+def match_legal_prompts(
+    model_kappa_df: pd.DataFrame, pert_kappa_df: pd.DataFrame
+) -> Tuple[pd.DataFrame, pd.DataFrame]:
+    """Keyword-match the 5 legal prompts in both prepared frames (:220-326).
+
+    The model-comparison CSV holds the 50 word-meaning questions, so in the
+    canonical data it matches few/none of the legal keywords — preserved
+    behavior; the combiner then runs on whatever titles match in both.
+    """
+    model_rows = []
+    for title, row in _keyword_match(model_kappa_df, ["prompt"]).items():
+        model_rows.append(
+            {
+                "title": title,
+                "prompt": row["prompt"],
+                "avg_pairwise_kappa": row["avg_pairwise_kappa"],
+                "n_models": row["n_models"],
+                "agree_percent": row["agree_percent"],
+                "source": "model_comparison",
+            }
+        )
+    pert_rows = []
+    for title, row in _keyword_match(pert_kappa_df, ["prompt"]).items():
+        pert_rows.append(
+            {
+                "title": title,
+                "prompt": row["prompt"],
+                "self_kappa": row["self_kappa"],
+                "n_variations": row["n_variations"],
+                "agree_percent": row["agree_percent"],
+                "source": "perturbation",
+            }
+        )
+    return pd.DataFrame(model_rows), pd.DataFrame(pert_rows)
+
+
+def combine_kappas(
+    model_legal_df: pd.DataFrame,
+    pert_legal_df: pd.DataFrame,
+    key: jax.Array,
+    n_bootstrap: int = 1000,
+) -> Dict[str, Dict[str, object]]:
+    """Min-of-draws combination per matched title (:566-600)."""
+    out: Dict[str, Dict[str, object]] = {}
+    for title in model_legal_df["title"].unique():
+        mdata = model_legal_df[model_legal_df["title"] == title]
+        pdata = pert_legal_df[pert_legal_df["title"] == title]
+        if mdata.empty or pdata.empty:
+            continue
+        m_kappa = float(mdata["avg_pairwise_kappa"].mean())
+        m_std = float(mdata["avg_pairwise_kappa"].std()) if len(mdata) > 1 else 0.1
+        p_kappa = float(pdata["self_kappa"].mean())
+        p_std = float(pdata["self_kappa"].std()) if len(pdata) > 1 else 0.1
+        key, sub = jax.random.split(key)
+        combined = combined_kappa(
+            m_kappa, p_kappa, sub, m_std, p_std, n_boot=n_bootstrap
+        )
+        out[title] = {
+            "model_kappa": m_kappa,
+            "model_kappa_std": m_std,
+            "model_interpretation": interpret_kappa(m_kappa),
+            "perturbation_kappa": p_kappa,
+            "perturbation_kappa_std": p_std,
+            "perturbation_interpretation": interpret_kappa(p_kappa),
+            "combined": combined,
+            "combined_interpretation": interpret_kappa(combined["mean_kappa"]),
+        }
+    return out
+
+
+def combined_results_frame(
+    combined: Dict[str, Dict[str, object]]
+) -> pd.DataFrame:
+    rows = []
+    for title, res in combined.items():
+        rows.append(
+            {
+                "Prompt": title,
+                "Model Kappa": res["model_kappa"],
+                "Model Kappa Std": res["model_kappa_std"],
+                "Model Interpretation": res["model_interpretation"],
+                "Perturbation Kappa": res["perturbation_kappa"],
+                "Perturbation Kappa Std": res["perturbation_kappa_std"],
+                "Perturbation Interpretation": res["perturbation_interpretation"],
+                "Combined Mean Kappa": res["combined"]["mean_kappa"],
+                "Combined Median Kappa": res["combined"]["median_kappa"],
+                "Combined Lower CI": res["combined"]["lower_ci"],
+                "Combined Upper CI": res["combined"]["upper_ci"],
+                "Combined Interpretation": res["combined_interpretation"],
+            }
+        )
+    return pd.DataFrame(rows)
+
+
+def kappa_latex_table(combined_df: pd.DataFrame) -> str:
+    """LaTeX summary (:630-655)."""
+    lines = [
+        "\\begin{table}[htbp]",
+        "\\centering",
+        "\\caption{Cohen's Kappa Analysis of Model Variation vs. Prompt "
+        "Perturbation}",
+        "\\label{tab:kappa_analysis}",
+        "\\begin{tabular}{lccccc}",
+        "\\hline",
+        "Prompt & Model $\\kappa$ & Perturbation $\\kappa$ & Combined "
+        "$\\kappa$ & 95\\% CI & Interpretation \\\\ ",
+        "\\hline",
+    ]
+    for _, row in combined_df.iterrows():
+        short = " ".join(row["Prompt"].split()[-2:])
+        ci = f"[{row['Combined Lower CI']:.3f}, {row['Combined Upper CI']:.3f}]"
+        lines.append(
+            f"{short} & {row['Model Kappa']:.3f} & "
+            f"{row['Perturbation Kappa']:.3f} & "
+            f"{row['Combined Mean Kappa']:.3f} & {ci} & "
+            f"{row['Combined Interpretation']} \\\\ "
+        )
+    lines += ["\\hline", "\\end{tabular}", "\\end{table}", ""]
+    return "\n".join(lines)
+
+
+def _plots(
+    combined: Dict[str, Dict[str, object]],
+    out_dir: Path,
+    key: jax.Array,
+    n_bootstrap: int = 1000,
+) -> None:
+    """Bar + scatter + per-title distribution figures (:396-513)."""
+    titles = list(combined.keys())
+    if not titles:
+        return
+    model_k = [combined[t]["model_kappa"] for t in titles]
+    pert_k = [combined[t]["perturbation_kappa"] for t in titles]
+    comb_k = [combined[t]["combined"]["mean_kappa"] for t in titles]
+
+    x = np.arange(len(titles))
+    width = 0.25
+    fig, ax = plt.subplots(figsize=(14, 8))
+    ax.bar(x - width, model_k, width, label="Model Variation Kappa")
+    ax.bar(x, pert_k, width, label="Perturbation Kappa")
+    ax.bar(x + width, comb_k, width, label="Combined Kappa")
+    ax.set_ylabel("Cohen's Kappa Value")
+    ax.set_title("Comparison of Kappa Values by Source of Variation")
+    ax.set_xticks(x)
+    ax.set_xticklabels(
+        [" ".join(t.split()[-2:]) for t in titles], rotation=45, ha="right"
+    )
+    for level in (0, 0.2, 0.4, 0.6, 0.8):
+        ax.axhline(level, color="gray", linestyle="--", alpha=0.5)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(out_dir / "kappa_comparison_bar.png", dpi=150,
+                bbox_inches="tight")
+    plt.close(fig)
+
+    # Per-title bootstrap distribution (regenerate draws for the histogram —
+    # combined_kappa returns summary stats, the figure needs the samples).
+    for title in titles:
+        res = combined[title]
+        key, k1, k2 = jax.random.split(key, 3)
+        m = res["model_kappa"] + res["model_kappa_std"] * np.asarray(
+            jax.random.normal(k1, (n_bootstrap,))
+        )
+        p = res["perturbation_kappa"] + res["perturbation_kappa_std"] * np.asarray(
+            jax.random.normal(k2, (n_bootstrap,))
+        )
+        samples = np.minimum(m, p)
+        fig, ax = plt.subplots(figsize=(10, 6))
+        sns.histplot(samples, kde=True, ax=ax)
+        ax.axvline(res["combined"]["mean_kappa"], color="r", linestyle="--",
+                   label=f"Mean: {res['combined']['mean_kappa']:.3f}")
+        ax.axvline(res["combined"]["lower_ci"], color="g", linestyle=":",
+                   label=f"2.5th percentile: {res['combined']['lower_ci']:.3f}")
+        ax.axvline(res["combined"]["upper_ci"], color="g", linestyle=":",
+                   label=f"97.5th percentile: {res['combined']['upper_ci']:.3f}")
+        ax.set_xlabel("Cohen's Kappa Value")
+        ax.set_ylabel("Frequency")
+        ax.set_title(f"Bootstrap Distribution of Combined Kappa: {title}")
+        ax.legend()
+        fig.tight_layout()
+        short = "_".join(title.split()[-2:]).lower()
+        fig.savefig(out_dir / f"kappa_distribution_{short}.png", dpi=150,
+                    bbox_inches="tight")
+        plt.close(fig)
+
+    fig, ax = plt.subplots(figsize=(10, 8))
+    ax.scatter(model_k, pert_k, s=100, alpha=0.7)
+    lo = min(min(model_k), min(pert_k))
+    hi = max(max(model_k), max(pert_k))
+    ax.plot([lo, hi], [lo, hi], "k--", alpha=0.5)
+    for i, t in enumerate(titles):
+        ax.annotate(" ".join(t.split()[-2:]), (model_k[i], pert_k[i]),
+                    fontsize=12, xytext=(5, 5), textcoords="offset points")
+    ax.set_xlabel("Model Variation Kappa")
+    ax.set_ylabel("Perturbation Kappa")
+    ax.set_title("Model Variation vs. Prompt Perturbation Kappa")
+    ax.grid(True, alpha=0.3)
+    for val in (0.2, 0.4, 0.6, 0.8):
+        ax.axhline(val, color="gray", linestyle="--", alpha=0.2)
+        ax.axvline(val, color="gray", linestyle="--", alpha=0.2)
+    fig.tight_layout()
+    fig.savefig(out_dir / "kappa_scatter.png", dpi=150, bbox_inches="tight")
+    plt.close(fig)
+
+
+def run_kappa_analysis(
+    instruct_csv: Path,
+    perturbation_results: Path,
+    out_dir: Path,
+    seed: int = 42,
+    n_bootstrap: int = 1000,
+    make_figures: bool = True,
+) -> Dict[str, object]:
+    """Full C29 pipeline; artifact names match the reference (:560-658)."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    key = jax.random.PRNGKey(seed)
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    model_df = pd.read_csv(instruct_csv)
+    pert_df = read_results_frame(Path(perturbation_results))
+
+    model_kappa_df = prepare_model_data(model_df)
+    pert_kappa_df = prepare_perturbation_data(pert_df, k1, n_bootstrap)
+    model_kappa_df.to_csv(out_dir / "model_kappa_metrics.csv", index=False)
+    pert_kappa_df.to_csv(out_dir / "perturbation_kappa_metrics.csv", index=False)
+
+    model_legal_df, pert_legal_df = match_legal_prompts(
+        model_kappa_df, pert_kappa_df
+    )
+    model_legal_df.to_csv(out_dir / "model_legal_kappas.csv", index=False)
+    pert_legal_df.to_csv(out_dir / "perturbation_legal_kappas.csv", index=False)
+
+    combined: Dict[str, Dict[str, object]] = {}
+    if not model_legal_df.empty and not pert_legal_df.empty:
+        combined = combine_kappas(model_legal_df, pert_legal_df, k2, n_bootstrap)
+    else:
+        log.info(
+            "No matched legal prompts across datasets (%d model, %d "
+            "perturbation) — combined kappa skipped",
+            len(model_legal_df), len(pert_legal_df),
+        )
+
+    combined_df = combined_results_frame(combined)
+    combined_df.to_csv(out_dir / "combined_kappa_results.csv", index=False)
+    (out_dir / "kappa_analysis_table.tex").write_text(
+        kappa_latex_table(combined_df)
+    )
+    if make_figures and combined:
+        _plots(combined, out_dir, k3, n_bootstrap)
+
+    return {
+        "model_kappa": model_kappa_df,
+        "perturbation_kappa": pert_kappa_df,
+        "combined": combined,
+        "combined_frame": combined_df,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--instruct", type=Path, required=True,
+                        help="D2 instruct_model_comparison_results.csv")
+    parser.add_argument("--perturbation", type=Path, required=True,
+                        help="D6 perturbation results workbook")
+    parser.add_argument("--out", type=Path, default=Path("results/kappa"))
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--no-figures", action="store_true")
+    args = parser.parse_args()
+    run_kappa_analysis(
+        args.instruct, args.perturbation, args.out, seed=args.seed,
+        make_figures=not args.no_figures,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,311 @@
+"""Inter-model correlation and agreement suite over the D2 CSV (C30).
+
+Parity target: analysis/model_comparison_graph.py:33-781 — reference-model
+difference plot (Baichuan anchor with fallback), prompt-resampled bootstrap
+(1000x) of the model-model Pearson/Spearman correlation matrices with
+percentile CIs for mean/median/std, lower-triangle heatmap with abbreviated
+names, pairwise model kappas, and the pooled aggregate kappa with bootstrap
+CI. Filters opt-iml and Mistral rows as the reference does (:724-726).
+
+The 1000-iteration correlation-matrix bootstrap (a pandas .corr() per
+iteration in the reference, :207-340) runs as one vmapped masked-Pearson
+kernel (stats.correlations.bootstrap_correlation_matrix).
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import jax
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import seaborn as sns  # noqa: E402
+
+from ..stats.correlations import bootstrap_correlation_matrix  # noqa: E402
+from ..stats.kappa import aggregate_kappa, pairwise_kappa_matrix  # noqa: E402
+from ..utils.logging import get_logger  # noqa: E402
+
+log = get_logger(__name__)
+
+FILTERED_MODEL_PATTERNS = ("opt-iml-1.3b", "mistral")  # reference :724-726
+
+
+def filter_models(df: pd.DataFrame) -> pd.DataFrame:
+    out = df
+    out = out[~out["model"].str.contains("opt-iml-1.3b")]
+    out = out[~out["model"].str.contains("mistral", case=False)]
+    return out
+
+
+def abbreviated_model_name(model_name: str) -> str:
+    """Short display name (get_abbreviated_model_name, :342-387)."""
+    name = model_name.split("/")[-1]
+    return name[:18] + ".." if len(name) > 20 else name
+
+
+def prompt_model_pivot(df: pd.DataFrame) -> pd.DataFrame:
+    return df.pivot_table(index="prompt", columns="model", values="relative_prob")
+
+
+def reference_model_differences(
+    df: pd.DataFrame, rng: np.random.Generator
+) -> Dict[str, object]:
+    """Per-model differences in relative_prob vs the Baichuan anchor
+    (random fallback when absent, :59-79)."""
+    models = df["model"].unique()
+    anchors = [m for m in models if "baichuan" in m.lower()]
+    if anchors:
+        reference_model = anchors[0]
+    else:
+        prompts = df["prompt"].unique()
+        valid = [
+            m
+            for m in models
+            if df[df["model"] == m]["relative_prob"].notna().sum() >= len(prompts)
+        ]
+        if not valid:
+            counts = df.groupby("model")["relative_prob"].count()
+            valid = [counts.idxmax()]
+        reference_model = valid[int(rng.integers(len(valid)))]
+
+    pivot = prompt_model_pivot(df)
+    ref = pivot[reference_model]
+    diffs: Dict[str, np.ndarray] = {}
+    for model in models:
+        if model == reference_model:
+            continue
+        d = (pivot[model] - ref).dropna().to_numpy()
+        if d.size:
+            diffs[model] = d
+    return {"reference_model": reference_model, "differences": diffs}
+
+
+def plot_reference_differences(
+    result: Dict[str, object], output_path: Path, rng: np.random.Generator
+) -> None:
+    """Violin + jitter + CI per model vs the anchor (:83-205)."""
+    diffs: Dict[str, np.ndarray] = result["differences"]
+    if not diffs:
+        return
+    colors = plt.cm.tab10(np.linspace(0, 1, 10))
+    fig, ax = plt.subplots(figsize=(14, 10))
+    legend_elements = []
+    for idx, (model, vals) in enumerate(diffs.items()):
+        color = colors[idx % len(colors)]
+        parts = ax.violinplot([vals], [idx], widths=0.6, showmeans=False,
+                              showmedians=False, showextrema=False)
+        for pc in parts["bodies"]:
+            pc.set_facecolor(color)
+            pc.set_edgecolor("none")
+            pc.set_alpha(0.3)
+        ax.scatter(rng.normal(idx, 0.08, size=vals.size), vals, alpha=0.7,
+                   s=50, color=color)
+        if vals.size > 1:
+            lo, hi = np.percentile(vals, [2.5, 97.5])
+            ax.plot([idx, idx], [lo, hi], color="black", linewidth=2, zorder=4)
+            for y in (lo, hi):
+                ax.plot([idx - 0.1, idx + 0.1], [y, y], color="black",
+                        linewidth=2, zorder=4)
+        ax.scatter(idx, vals.mean(), color="black", s=100, zorder=5)
+        legend_elements.append(
+            plt.Line2D([0], [0], marker="s", color="w", markerfacecolor=color,
+                       markersize=10, label=model.split("/")[-1])
+        )
+    ax.scatter(len(diffs), 0, color="black", s=100, marker="*")
+    legend_elements.append(
+        plt.Line2D([0], [0], marker="*", color="black", markersize=10,
+                   label=f"Reference: {result['reference_model'].split('/')[-1]}")
+    )
+    ax.axhline(0, color="gray", linestyle="--", alpha=0.7)
+    ax.set_xticks(range(len(diffs)))
+    ax.set_xticklabels([""] * len(diffs))
+    ax.set_xlabel("Model")
+    ax.set_ylabel("Difference in Relative Probability\nfrom Reference Model")
+    ax.legend(handles=legend_elements, loc="upper center",
+              bbox_to_anchor=(0.5, -0.15), ncol=3)
+    fig.tight_layout()
+    fig.subplots_adjust(bottom=0.3)
+    output_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(output_path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+
+
+def plot_correlation_matrix(
+    corr_matrix: np.ndarray, model_names: List[str], output_path: Path
+) -> None:
+    """Lower-triangle heatmap with abbreviated names (:389-433)."""
+    mask = np.triu(np.ones_like(corr_matrix, dtype=bool))
+    labels = [abbreviated_model_name(m) for m in model_names]
+    fig = plt.figure(figsize=(12, 10))
+    sns.heatmap(
+        corr_matrix, mask=mask, cmap="RdBu_r", center=0, vmin=-1, vmax=1,
+        annot=True, fmt=".2f", annot_kws={"size": 8},
+        xticklabels=labels, yticklabels=labels,
+        cbar_kws={"label": "Correlation"},
+    )
+    plt.xticks(rotation=45, ha="right")
+    plt.tight_layout()
+    output_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(output_path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+
+
+def plot_correlation_distribution(
+    values: np.ndarray,
+    output_path: Path,
+    correlation_type: str,
+    mean_ci,
+    median_ci,
+) -> None:
+    """Histogram of pairwise correlations with CI markers (:435-493)."""
+    fig, ax = plt.subplots(figsize=(10, 6))
+    ax.hist(values, bins=20, edgecolor="black", alpha=0.7)
+    ax.axvline(values.mean(), color="red", linestyle="--",
+               label=f"Mean: {values.mean():.3f} "
+                     f"[{mean_ci[0]:.3f}, {mean_ci[1]:.3f}]")
+    ax.axvline(np.median(values), color="green", linestyle="--",
+               label=f"Median: {np.median(values):.3f} "
+                     f"[{median_ci[0]:.3f}, {median_ci[1]:.3f}]")
+    ax.set_xlabel(f"{correlation_type.capitalize()} correlation")
+    ax.set_ylabel("Frequency")
+    ax.set_title(f"Pairwise model {correlation_type} correlations")
+    ax.legend()
+    fig.tight_layout()
+    output_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(output_path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+
+
+def plot_kappa_distribution(kappas: np.ndarray, output_path: Path) -> None:
+    """Histogram of pairwise model kappas (:674-708)."""
+    kappas = kappas[np.isfinite(kappas)]
+    if kappas.size == 0:
+        return
+    fig, ax = plt.subplots(figsize=(10, 6))
+    ax.hist(kappas, bins=20, edgecolor="black", alpha=0.7)
+    ax.axvline(kappas.mean(), color="red", linestyle="--",
+               label=f"Mean: {kappas.mean():.3f}")
+    ax.set_xlabel("Cohen's Kappa")
+    ax.set_ylabel("Frequency")
+    ax.legend()
+    fig.tight_layout()
+    output_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(output_path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+
+
+def run_model_graph_analysis(
+    instruct_csv: Path,
+    out_dir: Path,
+    seed: int = 42,
+    n_bootstrap: int = 1000,
+    make_figures: bool = True,
+) -> Dict[str, object]:
+    """Full C30 pipeline (__main__, :710-781)."""
+    out_dir = Path(out_dir)
+    figures_dir = out_dir / "figures"
+    out_dir.mkdir(parents=True, exist_ok=True)
+    key = jax.random.PRNGKey(seed)
+    rng = np.random.default_rng(seed)
+
+    df = filter_models(pd.read_csv(instruct_csv))
+    log.info(
+        "Model graph analysis: %d rows, %d models after filtering",
+        len(df), df["model"].nunique(),
+    )
+    pivot = prompt_model_pivot(df)
+    model_names = list(pivot.columns)
+
+    ref_diffs = reference_model_differences(df, rng)
+    if make_figures:
+        plot_reference_differences(
+            ref_diffs, figures_dir / "model_comparison_plot.png", rng
+        )
+
+    correlations: Dict[str, Dict[str, object]] = {}
+    for corr_type in ("pearson", "spearman"):
+        key, sub = jax.random.split(key)
+        stats = bootstrap_correlation_matrix(
+            pivot.to_numpy(dtype=float), sub, method=corr_type,
+            n_bootstrap=n_bootstrap,
+        )
+        correlations[corr_type] = stats
+        pd.DataFrame(
+            stats["correlation_matrix"], index=model_names, columns=model_names
+        ).to_csv(out_dir / f"model_{corr_type}_correlation_matrix.csv")
+        if make_figures:
+            plot_correlation_matrix(
+                stats["correlation_matrix"], model_names,
+                figures_dir / f"model_{corr_type}_correlation_matrix.png",
+            )
+            plot_correlation_distribution(
+                stats["correlation_values"],
+                figures_dir / f"model_{corr_type}_correlation_distribution.png",
+                corr_type, stats["mean_ci"], stats["median_ci"],
+            )
+
+    binary = (pivot.to_numpy(dtype=float) > 0.5).astype(float)
+    binary[~np.isfinite(pivot.to_numpy(dtype=float))] = np.nan
+    kappa_matrix = pairwise_kappa_matrix(binary)
+    pd.DataFrame(kappa_matrix, index=model_names, columns=model_names).to_csv(
+        out_dir / "model_pairwise_kappa_matrix.csv"
+    )
+    iu = np.triu_indices(len(model_names), k=1)
+    if make_figures:
+        plot_kappa_distribution(
+            kappa_matrix[iu], figures_dir / "model_kappa_distribution.png"
+        )
+
+    # Aggregate kappa over prompts answered by every model; fall back to
+    # >= 2 models per prompt, as the reference does (:567-571).
+    complete = pivot.dropna()
+    if len(complete) < 2:
+        complete = pivot.dropna(thresh=2)
+    key, sub = jax.random.split(key)
+    agg = aggregate_kappa(
+        (complete.to_numpy(dtype=float) > 0.5).astype(np.float32), sub,
+        n_boot=n_bootstrap,
+    )
+    pd.DataFrame([agg]).to_csv(out_dir / "aggregate_kappa_results.csv", index=False)
+
+    summary = {
+        "reference_model": ref_diffs["reference_model"],
+        "correlations": {
+            k: {kk: vv for kk, vv in v.items()
+                if kk not in ("correlation_matrix", "correlation_values")}
+            for k, v in correlations.items()
+        },
+        "aggregate_kappa": agg,
+    }
+    return {
+        "pivot": pivot,
+        "reference_differences": ref_diffs,
+        "correlations": correlations,
+        "pairwise_kappa_matrix": kappa_matrix,
+        "aggregate_kappa": agg,
+        "summary": summary,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--instruct", type=Path, required=True,
+                        help="D2 instruct_model_comparison_results.csv")
+    parser.add_argument("--out", type=Path, default=Path("results/model_graph"))
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--no-figures", action="store_true")
+    args = parser.parse_args()
+    run_model_graph_analysis(
+        args.instruct, args.out, seed=args.seed,
+        make_figures=not args.no_figures,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,597 @@
+"""Per-model perturbation-distribution analysis (C20-C27).
+
+Parity target: analysis/analyze_perturbation_results.py — for each model in
+the D6 results workbook: relative probabilities, per-prompt summary stats
+with 2.5/97.5 percentiles, normality tests (KS + Anderson-Darling),
+truncated-normal Monte-Carlo fits, within-prompt Cohen's kappa, instruction
+and confidence compliance audits, QQ/histogram/violin figures, and LaTeX
+appendix tables. Artifact names match the reference exactly:
+
+  summary_statistics.csv, normality_test_results.csv,
+  truncated_normal_test_results.csv, cohens_kappa_results.csv,
+  output_compliance_results.csv, confidence_compliance_results.csv,
+  prompt_perturbation_tables.tex, prompt_perturbation_standalone.tex,
+  compliance_summary.tex, confidence_compliance_summary.tex, figures/*.png
+
+TPU-native redesign: the O(n^2) same-prompt kappa pair loop (:1127-1139) is
+closed-form (stats.kappa.within_group_kappa); the 30x100k-sample MC fit
+(:193-243) is a lax.while_loop kernel (stats.fits); QQ bootstrap bands are a
+vmapped sort. Fixed hard-coded personal paths (:1965,2005) become arguments.
+
+Compliance checks double as pipeline assertions (SURVEY.md §4): call
+``assert_compliance`` to gate a sweep on minimum compliance rates instead of
+only reporting them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+import pandas as pd
+
+from ..data.prompts import LEGAL_PROMPTS, LegalPrompt
+from ..data.schemas import read_results_frame
+from ..report import figures
+from ..report.latex import (
+    compliance_latex_table,
+    confidence_compliance_latex_table,
+    perturbation_latex_table,
+    standalone_latex_document,
+)
+from ..stats.fits import truncated_normal_mc_fit
+from ..stats.kappa import interpret_kappa, within_group_kappa
+from ..stats.normality import normality_tests
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+MIN_ROWS_FOR_ANALYSIS = 100  # reference :1724
+
+
+def add_relative_prob(df: pd.DataFrame) -> pd.DataFrame:
+    """Relative_Prob = Token_1/(Token_1+Token_2), NaN on zero mass
+    (:1738-1746)."""
+    df = df.copy()
+    total = df["Token_1_Prob"] + df["Token_2_Prob"]
+    with np.errstate(invalid="ignore", divide="ignore"):
+        df["Relative_Prob"] = np.where(
+            total > 0, df["Token_1_Prob"] / total, np.nan
+        )
+    return df
+
+
+# ---------------------------------------------------------------------------
+# Per-prompt summary statistics (:1789-1845)
+# ---------------------------------------------------------------------------
+
+
+def prompt_summary_stats(
+    prompt_data: pd.DataFrame, prompt_idx: int, token_options: Sequence[str]
+) -> Dict[str, object]:
+    first_token, second_token = token_options[0], token_options[1]
+    finite = prompt_data[np.isfinite(prompt_data["Relative_Prob"])]
+    if len(finite) > 0:
+        rp = finite["Relative_Prob"]
+        lo, hi = np.percentile(rp, [2.5, 97.5])
+        stats = {
+            "Prompt Number": prompt_idx + 1,
+            "First Token": first_token,
+            "Second Token": second_token,
+            f'Mean Relative Probability of "{first_token}"': rp.mean(),
+            "Std Dev": rp.std(),
+            "Min": rp.min(),
+            "Max": rp.max(),
+            "2.5th Percentile": lo,
+            "97.5th Percentile": hi,
+            "95% Interval Width": hi - lo,
+        }
+    else:
+        stats = {
+            "Prompt Number": prompt_idx + 1,
+            "First Token": first_token,
+            "Second Token": second_token,
+            f'Mean Relative Probability of "{first_token}"': np.nan,
+            "Std Dev": np.nan,
+            "Min": np.nan,
+            "Max": np.nan,
+            "2.5th Percentile": np.nan,
+            "97.5th Percentile": np.nan,
+            "95% Interval Width": np.nan,
+        }
+
+    has_conf = (
+        "Weighted Confidence" in prompt_data.columns
+        and not prompt_data["Weighted Confidence"].isna().all()
+    )
+    if has_conf:
+        conf = prompt_data.dropna(subset=["Weighted Confidence"])[
+            "Weighted Confidence"
+        ]
+        if len(conf) > 0:
+            clo, chi = np.percentile(conf, [2.5, 97.5])
+            stats.update(
+                {
+                    f'Mean Weighted Confidence for "{first_token}"': conf.mean(),
+                    "Confidence Std Dev": conf.std(),
+                    "Confidence Min": conf.min(),
+                    "Confidence Max": conf.max(),
+                    "Confidence 2.5th Percentile": clo,
+                    "Confidence 97.5th Percentile": chi,
+                    "Confidence 95% Interval Width": chi - clo,
+                }
+            )
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# Within-prompt Cohen's kappa (C24, :1094-1188)
+# ---------------------------------------------------------------------------
+
+
+def perturbation_kappa(df: pd.DataFrame) -> Tuple[float, float, float]:
+    """Binarize Relative_Prob > 0.5 and compute the same-prompt-pairs kappa
+    via the closed-form kernel."""
+    finite = df[np.isfinite(df["Relative_Prob"])]
+    if len(finite) == 0:
+        return float("nan"), float("nan"), float("nan")
+    decisions = (finite["Relative_Prob"] > 0.5).to_numpy(dtype=int)
+    groups = pd.factorize(finite["Original Main Part"])[0]
+    res = within_group_kappa(decisions, groups)
+    return res["kappa"], res["observed_agreement"], res["expected_agreement"]
+
+
+# ---------------------------------------------------------------------------
+# Compliance audits (C25/C26, :1191-1675)
+# ---------------------------------------------------------------------------
+
+# Expected-token tables per canonical prompt (:1207-1248). Derived from the
+# prompt assets: first tokens are the target tokens; accepted full responses
+# cover the casing variants the reference allows. The reference additionally
+# accepts two truncated variants for prompt 4 (:1236-1237) that cannot be
+# derived from the instruction text.
+EXTRA_FULL_RESPONSES: Dict[int, Dict[str, List[str]]] = {
+    3: {
+        "Monthly": ["Monthly Installment Payment"],
+        "Payment": ["Payment Upon"],
+    },
+}
+
+
+def expected_compliance_tokens(
+    prompt: LegalPrompt, prompt_idx: Optional[int] = None
+) -> Dict[str, object]:
+    t1, t2 = prompt.target_tokens
+    full: Dict[str, List[str]] = {}
+    for token in (t1, t2):
+        # Reconstruct the allowed answer phrases from the response format:
+        # every quoted alternative in the instruction that starts with the
+        # token, plus lower-cased tail variants.
+        phrases = []
+        fmt = prompt.response_format
+        for part in fmt.split("'")[1::2]:  # quoted alternatives
+            if part.startswith(token):
+                phrases.append(part)
+                if " " in part:
+                    head, tail = part.split(" ", 1)
+                    phrases.append(f"{head} {tail.lower()}")
+        if prompt_idx is not None:
+            phrases.extend(EXTRA_FULL_RESPONSES.get(prompt_idx, {}).get(token, []))
+        full[token] = phrases or [token]
+    return {"first_tokens": [t1, t2], "full_responses": full}
+
+
+def parse_logprob_content(raw) -> Optional[Tuple[str, str]]:
+    """(first token, full response) from a stored Log Probabilities value
+    (json -> ast fallback, :1301-1322)."""
+    obj = raw
+    if isinstance(obj, str):
+        try:
+            obj = json.loads(obj)
+        except (json.JSONDecodeError, ValueError):
+            try:
+                obj = ast.literal_eval(obj)
+            except (ValueError, SyntaxError):
+                return None
+    if not isinstance(obj, dict) or "content" not in obj or not obj["content"]:
+        return None
+    tokens = [t.get("token", "") for t in obj["content"]]
+    return tokens[0], "".join(tokens).strip()
+
+
+def check_output_compliance(
+    df: pd.DataFrame,
+    prompts: Sequence[LegalPrompt],
+) -> pd.DataFrame:
+    """First-token and conditional full-response compliance per prompt
+    (:1191-1451)."""
+    results = []
+    for idx, original_prompt in enumerate(df["Original Main Part"].unique()):
+        if idx >= len(prompts):
+            break
+        expected = expected_compliance_tokens(prompts[idx], idx)
+        pdata = df[df["Original Main Part"] == original_prompt]
+        valid = pdata[np.isfinite(pdata["Relative_Prob"])]
+        total = len(valid)
+        if total == 0:
+            continue
+
+        first_ok = first_bad = sub_ok = sub_bad = 0
+        for raw in valid["Log Probabilities"]:
+            parsed = parse_logprob_content(raw)
+            if parsed is None:
+                continue
+            first_token, full_response = parsed
+
+            matched = None
+            for exp in expected["first_tokens"]:
+                if first_token == exp or first_token.startswith(exp):
+                    matched = exp
+                    break
+            if matched is None:
+                first_bad += 1
+                continue
+            first_ok += 1
+
+            norm_resp = full_response.replace(" ", "")
+            is_full = False
+            for exp_full in expected["full_responses"].get(matched, []):
+                norm_exp = exp_full.replace(" ", "")
+                if (
+                    full_response == exp_full
+                    or norm_resp == norm_exp
+                    or norm_resp.startswith(norm_exp)
+                ):
+                    is_full = True
+                    break
+            if is_full:
+                sub_ok += 1
+            else:
+                sub_bad += 1
+
+        row: Dict[str, object] = {
+            "Prompt": idx + 1,
+            "Expected_First_Tokens": ", ".join(expected["first_tokens"]),
+            "Total_Samples": total,
+            "First_Token_Compliant": first_ok,
+            "First_Token_Non_Compliant": first_bad,
+            "First_Token_Compliance_Rate": first_ok / total * 100,
+            "First_Token_Non_Compliance_Rate": first_bad / total * 100,
+        }
+        if first_ok > 0:
+            row.update(
+                {
+                    "Conditional_Subsequent_Compliant": sub_ok,
+                    "Conditional_Subsequent_Non_Compliant": sub_bad,
+                    "Conditional_Subsequent_Compliance_Rate": sub_ok / first_ok * 100,
+                    "Conditional_Subsequent_Non_Compliance_Rate": sub_bad
+                    / first_ok
+                    * 100,
+                }
+            )
+        results.append(row)
+    return pd.DataFrame(results)
+
+
+def check_confidence_compliance(
+    df: pd.DataFrame, prompts: Sequence[LegalPrompt]
+) -> pd.DataFrame:
+    """Integer-in-[0,100] confidence compliance per prompt (:1501-1675)."""
+    if "Model Confidence Response" not in df.columns:
+        return pd.DataFrame()
+    results = []
+    for idx, original_prompt in enumerate(df["Original Main Part"].unique()):
+        if idx >= len(prompts):
+            break
+        pdata = df[df["Original Main Part"] == original_prompt]
+        valid = pdata[pdata["Model Confidence Response"].notna()]
+        total = len(valid)
+        if total == 0:
+            continue
+
+        compliant = 0
+        kinds = {"float": 0, "text": 0, "out_of_range": 0, "other": 0}
+        for raw in valid["Model Confidence Response"]:
+            s = str(raw).strip()
+            try:
+                v = int(s)
+                if 0 <= v <= 100:
+                    compliant += 1
+                else:
+                    kinds["out_of_range"] += 1
+            except ValueError:
+                try:
+                    float(s)
+                    kinds["float"] += 1
+                except ValueError:
+                    if any(c.isalpha() for c in s):
+                        kinds["text"] += 1
+                    else:
+                        kinds["other"] += 1
+        non_compliant = total - compliant
+        results.append(
+            {
+                "Prompt": idx + 1,
+                "Total_Confidence_Samples": total,
+                "Confidence_Compliant": compliant,
+                "Confidence_Non_Compliant": non_compliant,
+                "Confidence_Compliance_Rate": compliant / total * 100,
+                "Confidence_Non_Compliance_Rate": non_compliant / total * 100,
+                "Float_Errors": kinds["float"],
+                "Text_Errors": kinds["text"],
+                "Out_Of_Range_Errors": kinds["out_of_range"],
+                "Other_Errors": kinds["other"],
+            }
+        )
+    return pd.DataFrame(results)
+
+
+def assert_compliance(
+    compliance_df: pd.DataFrame,
+    min_first_token_rate: float = 50.0,
+) -> None:
+    """Turn the compliance report into a pipeline assertion (SURVEY.md §4:
+    'compliance checks become assertions, not just reports')."""
+    if compliance_df.empty:
+        return
+    overall = (
+        compliance_df["First_Token_Compliant"].sum()
+        / compliance_df["Total_Samples"].sum()
+        * 100
+    )
+    if overall < min_first_token_rate:
+        raise AssertionError(
+            f"First-token compliance {overall:.1f}% below the "
+            f"{min_first_token_rate:.1f}% gate — measurement likely invalid "
+            "(wrong target tokens or prompt formatting)."
+        )
+
+
+# ---------------------------------------------------------------------------
+# Per-model orchestration (:1719-1960)
+# ---------------------------------------------------------------------------
+
+
+def analyze_model(
+    df: pd.DataFrame,
+    model_name: str,
+    output_dir: Path,
+    prompts: Sequence[LegalPrompt] = LEGAL_PROMPTS,
+    key: Optional[jax.Array] = None,
+    n_simulations: int = 100_000,
+    make_figures: bool = True,
+) -> Dict[str, object]:
+    """Full single-model analysis; writes every reference artifact into
+    `output_dir` and returns the result frames."""
+    output_dir = Path(output_dir)
+    output_dir.mkdir(parents=True, exist_ok=True)
+    figures_dir = output_dir / "figures"
+    key = key if key is not None else jax.random.PRNGKey(42)
+
+    if len(df) < MIN_ROWS_FOR_ANALYSIS:
+        log.warning(
+            "Only %d rows for %s; skipping detailed analysis", len(df), model_name
+        )
+        summary = pd.DataFrame(
+            [
+                {
+                    "Model": model_name,
+                    "Total Rows": len(df),
+                    "Status": "Insufficient data for analysis",
+                }
+            ]
+        )
+        summary.to_csv(output_dir / "summary_statistics.csv", index=False)
+        return {"summary": summary, "status": "insufficient_data"}
+
+    df = add_relative_prob(df)
+    non_finite = int((~np.isfinite(df["Relative_Prob"])).sum())
+    if non_finite:
+        log.warning(
+            "%d non-finite relative probabilities for %s", non_finite, model_name
+        )
+
+    unique_prompts = df["Original Main Part"].unique()
+    summary_rows, normality_rows, truncated_rows, tables = [], [], [], []
+    rng = np.random.default_rng(42)
+
+    for idx, original_prompt in enumerate(unique_prompts):
+        pdata = df[df["Original Main Part"] == original_prompt]
+        token_options = (
+            prompts[idx].target_tokens if idx < len(prompts) else ("A", "B")
+        )
+
+        if make_figures:
+            figures.probability_histogram(pdata, idx, token_options, figures_dir)
+            figures.confidence_histogram(pdata, idx, token_options, figures_dir)
+
+        tables.append(
+            perturbation_latex_table(
+                pdata, idx,
+                prompts[idx].main if idx < len(prompts) else original_prompt,
+                token_options, rng,
+            )
+        )
+        summary_rows.append(prompt_summary_stats(pdata, idx, token_options))
+
+        rp = pdata["Relative_Prob"].to_numpy(dtype=float)
+        nres = normality_tests(rp, prompt_idx=idx)
+        nres["Column"] = "Relative_Prob"
+        normality_rows.append(nres)
+
+        if make_figures:
+            key, sub = jax.random.split(key)
+            figures.qq_plot(pdata, "Relative_Prob", idx, token_options,
+                            figures_dir, sub)
+
+        key, sub = jax.random.split(key)
+        tres, sample = truncated_normal_mc_fit(
+            rp, sub, n_simulations=n_simulations, prompt_idx=idx,
+            column_name="Relative_Prob",
+        )
+        truncated_rows.append(tres)
+        if make_figures and sample.size:
+            figures.truncated_model_plot(
+                pdata, "Relative_Prob", idx, token_options, sample,
+                figures_dir, tres["KS Statistic"],
+            )
+
+        has_conf = (
+            "Weighted Confidence" in pdata.columns
+            and not pdata["Weighted Confidence"].isna().all()
+        )
+        if has_conf:
+            conf_data = pdata.dropna(subset=["Weighted Confidence"])
+            conf = conf_data["Weighted Confidence"].to_numpy(dtype=float)
+            cres = normality_tests(conf, prompt_idx=idx)
+            cres["Column"] = "Weighted_Confidence"
+            normality_rows.append(cres)
+            if make_figures:
+                key, sub = jax.random.split(key)
+                figures.qq_plot(conf_data, "Weighted Confidence", idx,
+                                token_options, figures_dir, sub)
+
+            # Rescale 0-100 confidence to [0,1] for the truncated fit, then
+            # report on the original scale (:1880-1900).
+            scale = 100.0 if conf.max() > 1 else 1.0
+            key, sub = jax.random.split(key)
+            ctres, csample = truncated_normal_mc_fit(
+                conf / scale, sub, n_simulations=n_simulations,
+                prompt_idx=idx, column_name="Weighted Confidence",
+            )
+            if csample.size:
+                csample = csample * scale
+                for field in (
+                    "Underlying Normal Mean", "Underlying Normal Std Dev",
+                    "Observed Mean", "Observed Std Dev", "Simulated Mean",
+                    "Simulated Std Dev", "Interior Mean", "Interior Std Dev",
+                ):
+                    if field in ctres and np.isfinite(ctres[field]):
+                        ctres[field] *= scale
+            truncated_rows.append(ctres)
+            if make_figures and csample.size:
+                figures.truncated_model_plot(
+                    conf_data, "Weighted Confidence", idx, token_options,
+                    csample, figures_dir, ctres["KS Statistic"],
+                )
+
+    # LaTeX artifacts.
+    (output_dir / "prompt_perturbation_tables.tex").write_text(
+        "\n".join(tables), encoding="utf-8"
+    )
+    (output_dir / "prompt_perturbation_standalone.tex").write_text(
+        standalone_latex_document(tables), encoding="utf-8"
+    )
+
+    summary_df = pd.DataFrame(summary_rows)
+    summary_df.to_csv(output_dir / "summary_statistics.csv", index=False)
+
+    if make_figures:
+        figures.combined_visualization(df, prompts, output_dir, rng)
+        figures.combined_confidence_visualization(df, prompts, output_dir, rng)
+
+    normality_df = pd.DataFrame(normality_rows)
+    normality_df.to_csv(output_dir / "normality_test_results.csv", index=False)
+    truncated_df = pd.DataFrame(truncated_rows)
+    truncated_df.to_csv(
+        output_dir / "truncated_normal_test_results.csv", index=False
+    )
+
+    kappa, observed, expected = perturbation_kappa(df)
+    kappa_df = pd.DataFrame(
+        [
+            {
+                "Model": model_name,
+                "Cohen's Kappa": kappa,
+                "Observed Agreement": observed,
+                "Expected Agreement": expected,
+            }
+        ]
+    )
+    kappa_df.to_csv(output_dir / "cohens_kappa_results.csv", index=False)
+    log.info(
+        "%s: kappa=%.4f (%s)", model_name, kappa, interpret_kappa(kappa)
+    )
+
+    compliance_df = check_output_compliance(df, prompts)
+    if len(compliance_df):
+        compliance_df.to_csv(
+            output_dir / "output_compliance_results.csv", index=False
+        )
+        (output_dir / "compliance_summary.tex").write_text(
+            compliance_latex_table(compliance_df), encoding="utf-8"
+        )
+    confidence_df = check_confidence_compliance(df, prompts)
+    if len(confidence_df):
+        confidence_df.to_csv(
+            output_dir / "confidence_compliance_results.csv", index=False
+        )
+        (output_dir / "confidence_compliance_summary.tex").write_text(
+            confidence_compliance_latex_table(confidence_df), encoding="utf-8"
+        )
+
+    return {
+        "summary": summary_df,
+        "normality": normality_df,
+        "truncated": truncated_df,
+        "kappa": kappa_df,
+        "compliance": compliance_df,
+        "confidence_compliance": confidence_df,
+        "status": "ok",
+    }
+
+
+def analyze_all_models(
+    results_path: Path,
+    output_root: Path,
+    prompts: Sequence[LegalPrompt] = LEGAL_PROMPTS,
+    seed: int = 42,
+    n_simulations: int = 100_000,
+    make_figures: bool = True,
+) -> Dict[str, Dict[str, object]]:
+    """The reference's __main__ loop (:1963-2026): one output directory per
+    model (dots/dashes replaced), no hard-coded personal paths."""
+    df = read_results_frame(Path(results_path))
+    key = jax.random.PRNGKey(seed)
+    out: Dict[str, Dict[str, object]] = {}
+    if "Model" in df.columns:
+        for model_name in df["Model"].unique():
+            key, sub = jax.random.split(key)
+            safe = model_name.replace(".", "_").replace("-", "_")
+            out[model_name] = analyze_model(
+                df[df["Model"] == model_name].copy(), model_name,
+                Path(output_root) / safe, prompts, sub,
+                n_simulations=n_simulations, make_figures=make_figures,
+            )
+    else:
+        out["Single Model"] = analyze_model(
+            df, "Single Model", Path(output_root), prompts, key,
+            n_simulations=n_simulations, make_figures=make_figures,
+        )
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="Per-model perturbation analysis (C20-C27 parity)."
+    )
+    parser.add_argument("--results", type=Path, required=True,
+                        help="D6 results workbook (xlsx or csv)")
+    parser.add_argument("--out", type=Path, default=Path("results/perturbation"))
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--n-simulations", type=int, default=100_000)
+    parser.add_argument("--no-figures", action="store_true")
+    args = parser.parse_args()
+    analyze_all_models(
+        args.results, args.out, seed=args.seed,
+        n_simulations=args.n_simulations, make_figures=not args.no_figures,
+    )
+
+
+if __name__ == "__main__":
+    main()

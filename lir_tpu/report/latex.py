@@ -1,0 +1,270 @@
+"""LaTeX artifact generators for the perturbation analysis (C27, C25/C26).
+
+Parity targets in the reference:
+  - create_latex_table                analysis/analyze_perturbation_results.py:722-864
+  - create_standalone_latex_document  :866-909
+  - create_compliance_latex_table     :1453-1499
+  - create_confidence_compliance_latex_table :1677-1716
+
+The representative-rephrasing tables use percentile-stratified sampling (20
+chunks, one random row each); randomness is an explicit numpy Generator so
+tables are reproducible (reference uses pandas' global-state .sample()).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+PROMPT_DESCRIPTIONS = (
+    "Insurance Policy Water Damage Exclusion",
+    "Prenuptial Agreement Petition Filing Date",
+    "Contract Term Affiliate Interpretation",
+    "Construction Payment Terms Interpretation",
+    "Insurance Policy Burglary Coverage",
+)
+
+
+def _escape(text: str) -> str:
+    return (
+        str(text).replace("_", "\\_").replace("%", "\\%").replace("&", "\\&")
+    )
+
+
+def _stratified_rows(
+    sorted_df: pd.DataFrame, rng: np.random.Generator, num_chunks: int = 20
+) -> pd.DataFrame:
+    """One random row from each of `num_chunks` percentile chunks
+    (:777-795)."""
+    n = len(sorted_df)
+    chunk_size = n // num_chunks
+    if chunk_size == 0:
+        return sorted_df
+    picks = []
+    for i in range(num_chunks):
+        start = i * chunk_size
+        end = (i + 1) * chunk_size if i < num_chunks - 1 else n
+        if start < end:
+            picks.append(sorted_df.iloc[int(rng.integers(start, end))])
+    return pd.DataFrame(picks)
+
+
+def perturbation_latex_table(
+    data: pd.DataFrame,
+    prompt_idx: int,
+    prompt_main: str,
+    token_options: Sequence[str],
+    rng: Optional[np.random.Generator] = None,
+) -> str:
+    """Longtable of 20 representative rephrasings with relative probability
+    and percentile; confidence table appended when data exists (:722-864)."""
+    rng = rng or np.random.default_rng(42)
+    first_token, second_token = token_options[0], token_options[1]
+    description = (
+        PROMPT_DESCRIPTIONS[prompt_idx]
+        if prompt_idx < len(PROMPT_DESCRIPTIONS)
+        else f"Prompt {prompt_idx + 1}"
+    )
+    has_confidence = (
+        "Weighted Confidence" in data.columns
+        and not data["Weighted Confidence"].isna().all()
+    )
+
+    out: List[str] = [
+        f"\\subsection*{{Prompt {prompt_idx + 1}: {description}}}",
+        "",
+        f"\\textbf{{Original Prompt:}} {prompt_main}",
+        "",
+        "\\subsubsection*{Next-Token Distribution Table}",
+        "",
+        "\\begin{longtable}{p{0.65\\textwidth}cc}",
+        f"\\caption{{Representative Relative Probabilities for {description}: "
+        f'"{first_token}" vs "{second_token}" (Prompt {prompt_idx + 1})}} \\\\',
+        "\\hline",
+        "Prompt Variation & \\makecell{Relative\\\\Probability} & Percentile \\\\",
+        "\\hline",
+        "\\endhead",
+        "\\hline",
+        "\\endfoot",
+    ]
+
+    finite = data[np.isfinite(data["Relative_Prob"])]
+    if len(finite) == 0:
+        out += [
+            "No valid data available for this prompt. & - & - \\\\",
+            "\\end{longtable}",
+            "",
+        ]
+        return "\n".join(out)
+
+    sorted_df = finite.sort_values("Relative_Prob")
+    for _, row in _stratified_rows(sorted_df, rng).iterrows():
+        prob = float(row["Relative_Prob"])
+        percentile = 100 * float((sorted_df["Relative_Prob"] <= prob).mean())
+        out.append(
+            f"{_escape(row['Full Rephrased Prompt'])} & {prob:.3f} & "
+            f"{percentile:.1f}\\% \\\\"
+        )
+    out += ["\\end{longtable}", ""]
+
+    if has_confidence:
+        out += [
+            "\\subsubsection*{Confidence Estimates Table}",
+            "",
+            "\\begin{longtable}{p{0.65\\textwidth}cc}",
+            f"\\caption{{Representative Weighted Confidence for {description}: "
+            f'"{first_token}" (Prompt {prompt_idx + 1})}} \\\\',
+            "\\hline",
+            "Prompt Variation & \\makecell{Weighted\\\\Confidence} & Percentile \\\\",
+            "\\hline",
+            "\\endhead",
+            "\\hline",
+            "\\endfoot",
+        ]
+        filtered = data.dropna(subset=["Weighted Confidence"])
+        if len(filtered) > 0:
+            sorted_conf = filtered.sort_values("Weighted Confidence")
+            for _, row in _stratified_rows(sorted_conf, rng).iterrows():
+                conf = float(row["Weighted Confidence"])
+                percentile = 100 * float(
+                    (sorted_conf["Weighted Confidence"] <= conf).mean()
+                )
+                out.append(
+                    f"{_escape(row['Full Confidence Prompt'])} & {conf:.1f} & "
+                    f"{percentile:.1f}\\% \\\\"
+                )
+        else:
+            out.append("No confidence data available for this prompt. & - & - \\\\")
+        out += ["\\end{longtable}", ""]
+    return "\n".join(out)
+
+
+STANDALONE_PREAMBLE = r"""\documentclass[12pt]{article}
+\usepackage{amsfonts}
+\usepackage[utf8]{inputenc}
+\usepackage{hyperref}
+\usepackage[margin=1.25in]{geometry}
+\usepackage{natbib}
+\usepackage{longtable}
+\usepackage{subcaption}
+\usepackage{graphicx}
+\usepackage{makecell}
+\usepackage{float}
+\usepackage{amsmath}
+\usepackage{setspace}
+\usepackage{comment}
+\usepackage[font=normal,labelfont=bf,skip=6pt]{caption}
+
+\setlength{\parskip}{0.5em}
+
+\title{Prompt Perturbation Analysis Appendix}
+\author{}
+\date{\today}
+
+\begin{document}
+\maketitle
+
+\section*{Prompt Perturbation Analysis}
+
+This appendix presents the detailed results of the prompt perturbation
+analysis. For each legal interpretation prompt, the original prompt is shown
+in plain text followed by a table of 20 representative prompt variations
+selected from different percentile ranges of the distribution, with each
+rephrasing's relative probability and its percentile rank.
+
+"""
+
+
+def standalone_latex_document(tables: Sequence[str]) -> str:
+    """Complete compilable document wrapping the per-prompt tables
+    (:866-909)."""
+    return STANDALONE_PREAMBLE + "\n".join(tables) + "\n\\end{document}"
+
+
+def compliance_latex_table(compliance_df: pd.DataFrame) -> str:
+    """Output-instruction compliance summary table (:1453-1499)."""
+    lines = [
+        "\\begin{table}[h]",
+        "\\centering",
+        "\\caption{Output Instruction Compliance Analysis}",
+        "\\begin{tabular}{lccc}",
+        "\\hline",
+        "Prompt & \\makecell{First Token\\\\Non-Compliance (\\%)} & "
+        "\\makecell{Conditional Subsequent\\\\Non-Compliance (\\%)} & "
+        "\\makecell{Total\\\\Samples} \\\\",
+        "\\hline",
+    ]
+    for _, row in compliance_df.iterrows():
+        sub = row.get("Conditional_Subsequent_Non_Compliance_Rate")
+        sub_str = f"{sub:.3f}" if pd.notna(sub) else "N/A"
+        lines.append(
+            f"{row['Prompt']} & {row['First_Token_Non_Compliance_Rate']:.3f} & "
+            f"{sub_str} & {row['Total_Samples']} \\\\"
+        )
+    lines.append("\\hline")
+
+    overall_first = (
+        compliance_df["First_Token_Non_Compliant"].sum()
+        / compliance_df["Total_Samples"].sum()
+        * 100
+    )
+    total_all = compliance_df["Total_Samples"].sum()
+    sub_col = "Conditional_Subsequent_Non_Compliance_Rate"
+    overall_sub_str = "N/A"
+    if sub_col in compliance_df.columns:
+        valid = compliance_df[compliance_df[sub_col].notna()]
+        if len(valid) > 0 and valid["First_Token_Compliant"].sum() > 0:
+            w = valid["First_Token_Compliant"]
+            overall_sub = (w * valid[sub_col]).sum() / w.sum()
+            overall_sub_str = f"\\textbf{{{overall_sub:.3f}}}"
+    lines += [
+        f"\\textbf{{Overall}} & \\textbf{{{overall_first:.3f}}} & "
+        f"{overall_sub_str} & \\textbf{{{total_all}}} \\\\",
+        "\\hline",
+        "\\end{tabular}",
+        "\\end{table}",
+    ]
+    return "\n".join(lines)
+
+
+def confidence_compliance_latex_table(confidence_df: pd.DataFrame) -> str:
+    """Integer-confidence compliance summary table (:1677-1716)."""
+    lines = [
+        "\\begin{table}[h]",
+        "\\centering",
+        "\\caption{Confidence Output Compliance Analysis (Integer Requirement)}",
+        "\\begin{tabular}{lcccccc}",
+        "\\hline",
+        "Prompt & \\makecell{Non-Compliance\\\\Rate (\\%)} & "
+        "\\makecell{Total\\\\Samples} & \\makecell{Float\\\\Errors} & "
+        "\\makecell{Text\\\\Errors} & \\makecell{Out of\\\\Range} & "
+        "\\makecell{Other\\\\Errors} \\\\",
+        "\\hline",
+    ]
+    for _, row in confidence_df.iterrows():
+        lines.append(
+            f"{row['Prompt']} & {row['Confidence_Non_Compliance_Rate']:.3f} & "
+            f"{row['Total_Confidence_Samples']} & {row['Float_Errors']} & "
+            f"{row['Text_Errors']} & {row['Out_Of_Range_Errors']} & "
+            f"{row['Other_Errors']} \\\\"
+        )
+    lines.append("\\hline")
+    overall = (
+        confidence_df["Confidence_Non_Compliant"].sum()
+        / confidence_df["Total_Confidence_Samples"].sum()
+        * 100
+    )
+    lines += [
+        f"\\textbf{{Overall}} & \\textbf{{{overall:.3f}}} & "
+        f"\\textbf{{{confidence_df['Total_Confidence_Samples'].sum()}}} & "
+        f"\\textbf{{{confidence_df['Float_Errors'].sum()}}} & "
+        f"\\textbf{{{confidence_df['Text_Errors'].sum()}}} & "
+        f"\\textbf{{{confidence_df['Out_Of_Range_Errors'].sum()}}} & "
+        f"\\textbf{{{confidence_df['Other_Errors'].sum()}}} \\\\",
+        "\\hline",
+        "\\end{tabular}",
+        "\\end{table}",
+    ]
+    return "\n".join(lines)

@@ -1,0 +1,341 @@
+"""Tests for the analysis drivers (C20-C30): synthetic-D6 perturbation
+analysis, base-vs-instruct deltas vs direct pandas recomputation, kappa
+combiner, and the model-graph suite on the committed D2 CSV."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from lir_tpu.analysis import (
+    add_relative_prob,
+    analyze_model,
+    assert_compliance,
+    check_confidence_compliance,
+    check_output_compliance,
+    expected_compliance_tokens,
+    family_differences,
+    parse_logprob_content,
+    perturbation_kappa,
+    prepare_model_data,
+    prepare_perturbation_data,
+    run_kappa_analysis,
+    run_model_graph_analysis,
+)
+from lir_tpu.data.prompts import LEGAL_PROMPTS
+
+import jax
+
+KEY = jax.random.PRNGKey(0)
+
+
+def synthetic_perturbation_frame(n_per_prompt=120, seed=7) -> pd.DataFrame:
+    """A D6-schema frame with known properties: mostly-compliant logprob
+    strings, a few non-compliant rows, mixed confidence formats."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for prompt in LEGAL_PROMPTS:
+        t1, t2 = prompt.target_tokens
+        for i in range(n_per_prompt):
+            p1 = float(np.clip(rng.beta(4, 2), 0.001, 0.999))
+            p2 = 1 - p1
+            if i % 10 == 0:  # non-compliant first token
+                content = [{"token": "I"}, {"token": " think"}]
+            elif p1 > 0.5:
+                # Compliant: the full expected phrase tokens.
+                phrase = prompt.response_format.split("'")[1]
+                content = [{"token": phrase.split(" ")[0]}]
+                for w in phrase.split(" ")[1:]:
+                    content.append({"token": f" {w}"})
+            else:
+                phrase = prompt.response_format.split("'")[3]
+                content = [{"token": phrase.split(" ")[0]}]
+                for w in phrase.split(" ")[1:]:
+                    content.append({"token": f" {w}"})
+            conf_choices = ["85", "42", "100", "3.5", "high", "150"]
+            conf = conf_choices[i % len(conf_choices)]
+            rows.append(
+                {
+                    "Model": "synthetic-model",
+                    "Original Main Part": prompt.main,
+                    "Response Format": prompt.response_format,
+                    "Confidence Format": prompt.confidence_format,
+                    "Rephrased Main Part": f"{prompt.main[:30]}... v{i}",
+                    "Full Rephrased Prompt": f"variant {i}: {prompt.binary_prompt[:60]}",
+                    "Full Confidence Prompt": f"variant {i}: {prompt.confidence_prompt[:60]}",
+                    "Model Response": content[0]["token"],
+                    "Model Confidence Response": conf,
+                    "Log Probabilities": json.dumps({"content": content}),
+                    "Token_1_Prob": p1,
+                    "Token_2_Prob": p2,
+                    "Odds_Ratio": p1 / p2,
+                    "Confidence Value": None,
+                    "Weighted Confidence": float(rng.uniform(0, 100)),
+                }
+            )
+    return pd.DataFrame(rows)
+
+
+@pytest.fixture(scope="module")
+def synthetic_df():
+    return synthetic_perturbation_frame()
+
+
+@pytest.fixture(scope="module")
+def instruct_df(reference_data_dir):
+    return pd.read_csv(f"{reference_data_dir}/instruct_model_comparison_results.csv")
+
+
+@pytest.fixture(scope="module")
+def base_df(reference_data_dir):
+    return pd.read_csv(f"{reference_data_dir}/model_comparison_results.csv")
+
+
+class TestPerturbationAnalysis:
+    def test_relative_prob(self, synthetic_df):
+        df = add_relative_prob(synthetic_df)
+        expected = synthetic_df["Token_1_Prob"] / (
+            synthetic_df["Token_1_Prob"] + synthetic_df["Token_2_Prob"]
+        )
+        np.testing.assert_allclose(df["Relative_Prob"], expected)
+
+    def test_relative_prob_zero_mass_is_nan(self):
+        df = pd.DataFrame({"Token_1_Prob": [0.0], "Token_2_Prob": [0.0]})
+        assert np.isnan(add_relative_prob(df)["Relative_Prob"].iloc[0])
+
+    def test_kappa_matches_direct_pair_loop(self, synthetic_df):
+        df = add_relative_prob(synthetic_df)
+        kappa, observed, expected = perturbation_kappa(df)
+
+        # Direct O(n^2) reimplementation of the reference's loops.
+        finite = df[np.isfinite(df["Relative_Prob"])]
+        dec = (finite["Relative_Prob"] > 0.5).astype(int)
+        agree = pairs = 0
+        for _, group in finite.assign(d=dec).groupby("Original Main Part"):
+            vals = group["d"].to_numpy()
+            for i in range(len(vals)):
+                for j in range(i + 1, len(vals)):
+                    pairs += 1
+                    agree += int(vals[i] == vals[j])
+        obs_direct = agree / pairs
+        p1 = dec.mean()
+        exp_direct = p1 * p1 + (1 - p1) * (1 - p1)
+        assert observed == pytest.approx(obs_direct)
+        assert expected == pytest.approx(exp_direct)
+        assert kappa == pytest.approx(
+            (obs_direct - exp_direct) / (1 - exp_direct)
+        )
+
+    def test_logprob_parsing(self):
+        raw = json.dumps(
+            {"content": [{"token": "Not"}, {"token": " Covered"}]}
+        )
+        first, full = parse_logprob_content(raw)
+        assert first == "Not"
+        assert full == "Not Covered"
+        # ast fallback for single-quoted dicts.
+        first2, full2 = parse_logprob_content(
+            "{'content': [{'token': 'Covered'}]}"
+        )
+        assert first2 == "Covered"
+        assert parse_logprob_content("not a dict at all") is None
+
+    def test_expected_tokens_cover_reference_table(self):
+        # Prompt 1/5: Covered / Not Covered variants.
+        exp = expected_compliance_tokens(LEGAL_PROMPTS[0], 0)
+        assert exp["first_tokens"] == ["Covered", "Not"]
+        assert "Not Covered" in exp["full_responses"]["Not"]
+        assert "Not covered" in exp["full_responses"]["Not"]
+        # Prompt 4 extras (reference :1236-1237).
+        exp4 = expected_compliance_tokens(LEGAL_PROMPTS[3], 3)
+        assert "Monthly Installment Payment" in exp4["full_responses"]["Monthly"]
+        assert "Payment Upon" in exp4["full_responses"]["Payment"]
+
+    def test_output_compliance_counts(self, synthetic_df):
+        df = add_relative_prob(synthetic_df)
+        comp = check_output_compliance(df, LEGAL_PROMPTS)
+        assert len(comp) == 5
+        # 1 in 10 rows is intentionally non-compliant.
+        for _, row in comp.iterrows():
+            assert row["First_Token_Non_Compliant"] == row["Total_Samples"] // 10
+            # All compliant first tokens carry the full phrase.
+            assert row["Conditional_Subsequent_Compliance_Rate"] == pytest.approx(100.0)
+        assert_compliance(comp)  # well above the 50% gate
+
+    def test_confidence_compliance_categories(self, synthetic_df):
+        conf = check_confidence_compliance(synthetic_df, LEGAL_PROMPTS)
+        assert len(conf) == 5
+        row = conf.iloc[0]
+        n = row["Total_Confidence_Samples"]
+        # Choices cycle through 3 valid ints, one float, one text, one
+        # out-of-range value.
+        assert row["Confidence_Compliant"] == n // 2
+        assert row["Float_Errors"] == n // 6
+        assert row["Text_Errors"] == n // 6
+        assert row["Out_Of_Range_Errors"] == n // 6
+
+    def test_analyze_model_artifacts(self, synthetic_df, tmp_path):
+        res = analyze_model(
+            synthetic_df, "synthetic-model", tmp_path,
+            n_simulations=2000, make_figures=True,
+        )
+        assert res["status"] == "ok"
+        for name in (
+            "summary_statistics.csv",
+            "normality_test_results.csv",
+            "truncated_normal_test_results.csv",
+            "cohens_kappa_results.csv",
+            "output_compliance_results.csv",
+            "confidence_compliance_results.csv",
+            "prompt_perturbation_tables.tex",
+            "prompt_perturbation_standalone.tex",
+            "compliance_summary.tex",
+            "confidence_compliance_summary.tex",
+            "combined_prompts_visualization.png",
+            "combined_confidence_visualization.png",
+        ):
+            assert (tmp_path / name).exists(), name
+        # Figures per prompt.
+        for i in range(1, 6):
+            assert (tmp_path / "figures" / f"prompt_{i}_distribution.png").exists()
+            assert (tmp_path / "figures" / f"prompt_{i}_qq_plot.png").exists()
+        summary = pd.read_csv(tmp_path / "summary_statistics.csv")
+        assert len(summary) == 5
+        assert (summary["95% Interval Width"] > 0).all()
+        tex = (tmp_path / "prompt_perturbation_standalone.tex").read_text()
+        assert tex.startswith("\\documentclass")
+        assert tex.rstrip().endswith("\\end{document}")
+
+    def test_analyze_model_insufficient_data(self, synthetic_df, tmp_path):
+        res = analyze_model(
+            synthetic_df.head(10), "tiny", tmp_path / "tiny",
+            make_figures=False,
+        )
+        assert res["status"] == "insufficient_data"
+        assert (tmp_path / "tiny" / "summary_statistics.csv").exists()
+
+
+class TestBaseVsInstruct:
+    def test_family_stats_match_direct(self, base_df):
+        res = family_differences(base_df)
+        stats = res["statistics"].set_index("Model_Family")
+        assert "mistral" not in stats.index
+
+        # Direct recomputation for one family.
+        family = stats.index[0]
+        fam = base_df[base_df["model_family"] == family]
+        base_model = fam.loc[fam["base_or_instruct"] == "base", "model"].iloc[0]
+        instr_model = fam.loc[fam["base_or_instruct"] == "instruct", "model"].iloc[0]
+        b = base_df[base_df["model"] == base_model].set_index("prompt")
+        i = base_df[base_df["model"] == instr_model].set_index("prompt")
+        common = b.index.intersection(i.index)
+        diffs = []
+        for prompt in common:
+            yb, nb = b.loc[prompt, "yes_prob"], b.loc[prompt, "no_prob"]
+            yi, ni = i.loc[prompt, "yes_prob"], i.loc[prompt, "no_prob"]
+            if yb > 0 and nb > 0 and yi > 0 and ni > 0:
+                diffs.append(yi / (yi + ni) - yb / (yb + nb))
+        assert stats.loc[family, "Num_Samples"] == len(diffs)
+        assert stats.loc[family, "Mean"] == pytest.approx(np.mean(diffs))
+
+    def test_artifacts(self, base_df, tmp_path, reference_data_dir):
+        from lir_tpu.analysis import run_base_vs_instruct_analysis
+
+        res = run_base_vs_instruct_analysis(
+            f"{reference_data_dir}/model_comparison_results.csv",
+            tmp_path, make_figures=True,
+        )
+        for name in (
+            "model_rel_prob_statistics.csv",
+            "prompt_rel_prob_differences.csv",
+            "prompt_rel_prob_heatmap_data.csv",
+            "rel_prob_differences.png",
+            "prompt_rel_prob_differences.png",
+            "prompt_rel_prob_heatmap.png",
+        ):
+            assert (tmp_path / name).exists(), name
+        assert len(res["statistics"]) > 0
+
+
+class TestKappaCombined:
+    def test_prepare_model_data(self, instruct_df):
+        prepared = prepare_model_data(instruct_df)
+        assert len(prepared) == 50
+        assert ((prepared["agree_percent"] >= 0.5)
+                & (prepared["agree_percent"] <= 1.0)).all()
+        assert ((prepared["avg_pairwise_kappa"] >= 0)
+                & (prepared["avg_pairwise_kappa"] <= 1)).all()
+
+    def test_prepare_perturbation_data(self, synthetic_df):
+        prepared = prepare_perturbation_data(synthetic_df, KEY, n_bootstrap=100)
+        assert len(prepared) == 5
+        assert (prepared["self_kappa"].abs() <= 1.0).all()
+        assert (prepared["n_variations"] == 120).all()
+
+    def test_end_to_end(self, instruct_df, synthetic_df, tmp_path, reference_data_dir):
+        pert_path = tmp_path / "combined_results.csv"
+        synthetic_df.to_csv(pert_path, index=False)
+        res = run_kappa_analysis(
+            f"{reference_data_dir}/instruct_model_comparison_results.csv",
+            pert_path, tmp_path / "out", n_bootstrap=100, make_figures=True,
+        )
+        out = tmp_path / "out"
+        for name in (
+            "model_kappa_metrics.csv",
+            "perturbation_kappa_metrics.csv",
+            "model_legal_kappas.csv",
+            "perturbation_legal_kappas.csv",
+            "combined_kappa_results.csv",
+            "kappa_analysis_table.tex",
+        ):
+            assert (out / name).exists(), name
+        # The synthetic perturbation prompts ARE the 5 legal prompts, and
+        # the word-meaning D2 CSV matches some legal keywords ("company" in
+        # prompts etc.) — combined results exist whenever both sides match.
+        assert isinstance(res["combined"], dict)
+
+
+class TestModelGraph:
+    def test_correlation_matrix_matches_pandas(self, instruct_df, tmp_path):
+        res = run_model_graph_analysis(
+            _write_csv(instruct_df, tmp_path / "d2.csv"),
+            tmp_path / "out", n_bootstrap=50, make_figures=False,
+        )
+        pivot = res["pivot"]
+        ours = res["correlations"]["pearson"]["correlation_matrix"]
+        theirs = pivot.corr(method="pearson").to_numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-5)
+        # Filtering applied.
+        assert not any("mistral" in m.lower() for m in pivot.columns)
+        assert not any("opt-iml" in m for m in pivot.columns)
+
+    def test_aggregate_kappa_fields(self, instruct_df, tmp_path):
+        res = run_model_graph_analysis(
+            _write_csv(instruct_df, tmp_path / "d2.csv"),
+            tmp_path / "out", n_bootstrap=50, make_figures=False,
+        )
+        agg = res["aggregate_kappa"]
+        assert -1 <= agg["aggregate_kappa"] <= 1
+        assert agg["kappa_ci_lower"] <= agg["kappa_ci_upper"]
+        assert agg["n_models"] == len(res["pivot"].columns)
+
+    def test_figures_written(self, instruct_df, tmp_path):
+        run_model_graph_analysis(
+            _write_csv(instruct_df, tmp_path / "d2.csv"),
+            tmp_path / "out", n_bootstrap=20, make_figures=True,
+        )
+        figs = tmp_path / "out" / "figures"
+        for name in (
+            "model_comparison_plot.png",
+            "model_pearson_correlation_matrix.png",
+            "model_spearman_correlation_matrix.png",
+            "model_pearson_correlation_distribution.png",
+            "model_kappa_distribution.png",
+        ):
+            assert (figs / name).exists(), name
+
+
+def _write_csv(df, path):
+    df.to_csv(path, index=False)
+    return path
