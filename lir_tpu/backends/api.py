@@ -1,0 +1,297 @@
+"""Optional remote-API backend (C7-C9), preserved behind the config switch.
+
+Parity target: the OpenAI Batch API client of analysis/perturb_prompts.py —
+request building with custom_id metadata (:190-269), JSONL save/upload
+(:271-292), batch create/poll/download (:294-345), >50,000-request chunking
+(:578-600), and the result decoder that recovers Token_1/2_Prob from
+first-token top_logprobs, the odds ratio, and the probability-weighted
+confidence E[v] over integer tokens (:398-549).
+
+The default 'tpu' backend performs zero external API calls; this module
+exists for capability parity (BASELINE.json's ``backend: "api" | "tpu"``
+switch). Network access is abstracted behind the BatchTransport protocol:
+production wires the OpenAI client (lazily, keys from the environment via
+Config.api_key), tests inject a fake transport. Nothing here imports an SDK
+at module import time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import re
+import time
+from typing import Dict, Iterable, List, Optional, Protocol, Sequence, Tuple
+
+from ..config import Config
+from ..engine.grid import GridCell
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+MAX_BATCH_SIZE = 50_000     # perturb_prompts.py:29
+POLL_INTERVAL_S = 60.0      # :313-330
+TERMINAL_FAILURES = ("failed", "cancelled", "expired")
+
+
+class BatchTransport(Protocol):
+    """The five remote operations the batch pipeline needs."""
+
+    def upload_jsonl(self, lines: Sequence[str]) -> str:
+        """Upload request lines; return a file id."""
+
+    def create_batch(self, file_id: str) -> str:
+        """Create a batch over the uploaded file; return a batch id."""
+
+    def batch_status(self, batch_id: str) -> str:
+        """Return current status string (completed/failed/...)."""
+
+    def batch_output_file(self, batch_id: str) -> Optional[str]:
+        """Return the output file id once completed."""
+
+    def download_jsonl(self, file_id: str) -> List[str]:
+        """Download result lines."""
+
+
+def openai_transport(config: Optional[Config] = None) -> BatchTransport:
+    """Production transport over the OpenAI SDK (lazy import; the key is
+    read from the environment only when this is constructed)."""
+    config = config or Config(backend="api")
+    api_key = config.api_key("OPENAI_API_KEY")
+    import openai  # imported here so the tpu backend never needs the SDK
+
+    client = openai.OpenAI(api_key=api_key)
+
+    class _Transport:
+        def upload_jsonl(self, lines: Sequence[str]) -> str:
+            data = ("\n".join(lines) + "\n").encode("utf-8")
+            f = client.files.create(file=("batch.jsonl", data), purpose="batch")
+            return f.id
+
+        def create_batch(self, file_id: str) -> str:
+            b = client.batches.create(
+                input_file_id=file_id,
+                endpoint="/v1/chat/completions",
+                completion_window="24h",
+            )
+            return b.id
+
+        def batch_status(self, batch_id: str) -> str:
+            return client.batches.retrieve(batch_id).status
+
+        def batch_output_file(self, batch_id: str) -> Optional[str]:
+            return client.batches.retrieve(batch_id).output_file_id
+
+        def download_jsonl(self, file_id: str) -> List[str]:
+            content = client.files.content(file_id)
+            return content.text.splitlines()
+
+    return _Transport()
+
+
+# ---------------------------------------------------------------------------
+# Request building (C4 parity for the remote path)
+# ---------------------------------------------------------------------------
+
+
+def build_batch_requests(
+    cells: Sequence[GridCell],
+    model: str,
+    reasoning_model: bool = False,
+) -> Tuple[List[Dict[str, object]], Dict[str, GridCell]]:
+    """Expand grid cells into chat-completion batch requests with a
+    custom_id -> cell map (perturb_prompts.py:190-269). Binary requests get
+    temperature 0, logprobs top-20; confidence requests are plain."""
+    requests: List[Dict[str, object]] = []
+    id_map: Dict[str, GridCell] = {}
+    for cell in cells:
+        for fmt, prompt in (
+            ("binary", cell.binary_prompt),
+            ("confidence", cell.confidence_prompt),
+        ):
+            custom_id = f"p{cell.prompt_idx}_r{cell.rephrase_idx}_{fmt}"
+            body: Dict[str, object] = {
+                "model": model,
+                "messages": [{"role": "user", "content": prompt}],
+            }
+            if reasoning_model:
+                body["max_completion_tokens"] = 2000
+            else:
+                body["temperature"] = 0
+                body["max_tokens"] = 500
+                if fmt == "binary":
+                    body["logprobs"] = True
+                    body["top_logprobs"] = 20
+            requests.append(
+                {
+                    "custom_id": custom_id,
+                    "method": "POST",
+                    "url": "/v1/chat/completions",
+                    "body": body,
+                }
+            )
+            id_map[custom_id] = cell
+    return requests, id_map
+
+
+def chunk_requests(
+    requests: Sequence[Dict[str, object]],
+    max_batch_size: int = MAX_BATCH_SIZE,
+) -> List[List[Dict[str, object]]]:
+    """Split oversized request lists (perturb_prompts.py:578-600)."""
+    return [
+        list(requests[i : i + max_batch_size])
+        for i in range(0, len(requests), max_batch_size)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Batch lifecycle (C7)
+# ---------------------------------------------------------------------------
+
+
+def run_batch(
+    transport: BatchTransport,
+    requests: Sequence[Dict[str, object]],
+    poll_interval: float = POLL_INTERVAL_S,
+    max_wait: float = 24 * 3600,
+    sleep=time.sleep,
+) -> Optional[List[Dict[str, object]]]:
+    """Upload -> create -> poll -> download one batch. Returns decoded
+    result objects, or None on a terminal failure (the caller skips the
+    model, perturb_prompts.py:324-328)."""
+    lines = [json.dumps(r) for r in requests]
+    file_id = transport.upload_jsonl(lines)
+    batch_id = transport.create_batch(file_id)
+    log.info("batch %s created (%d requests)", batch_id, len(requests))
+
+    waited = 0.0
+    while waited < max_wait:
+        status = transport.batch_status(batch_id)
+        if status == "completed":
+            break
+        if status in TERMINAL_FAILURES:
+            log.error("batch %s terminal status: %s", batch_id, status)
+            return None
+        sleep(poll_interval)
+        waited += poll_interval
+    else:
+        log.error("batch %s timed out after %.0fs", batch_id, max_wait)
+        return None
+
+    out_file = transport.batch_output_file(batch_id)
+    if out_file is None:
+        return None
+    return [json.loads(line) for line in transport.download_jsonl(out_file)]
+
+
+# ---------------------------------------------------------------------------
+# Result decoding (C8)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ApiScore:
+    """Decoded per-cell measurement from batch results."""
+
+    custom_id: str
+    response_text: str = ""
+    confidence_text: str = ""
+    token_1_prob: float = 0.0
+    token_2_prob: float = 0.0
+    log_probabilities: str = ""
+    confidence_value: Optional[int] = None
+    weighted_confidence: Optional[float] = None
+
+    @property
+    def odds_ratio(self) -> float:
+        if self.token_2_prob > 0:
+            return self.token_1_prob / self.token_2_prob
+        return math.inf
+
+
+def _first_token_probs(
+    logprob_content: List[Dict[str, object]],
+    target_tokens: Tuple[str, str],
+) -> Tuple[float, float]:
+    """Scan the first position's top_logprobs for the two target tokens
+    (perturb_prompts.py:474-490); a missing target scores 0."""
+    if not logprob_content:
+        return 0.0, 0.0
+    top = logprob_content[0].get("top_logprobs", [])
+    p1 = p2 = 0.0
+    for entry in top:
+        token = str(entry.get("token", "")).strip()
+        lp = float(entry.get("logprob", -math.inf))
+        if token == target_tokens[0]:
+            p1 = math.exp(lp)
+        elif token == target_tokens[1]:
+            p2 = math.exp(lp)
+    return p1, p2
+
+
+def _weighted_confidence(
+    logprob_content: List[Dict[str, object]]
+) -> Optional[float]:
+    """E[v] over integer tokens 0-100 in the first confidence position's
+    top_logprobs (perturb_prompts.py:504-526)."""
+    if not logprob_content:
+        return None
+    top = logprob_content[0].get("top_logprobs", [])
+    num, den = 0.0, 0.0
+    for entry in top:
+        token = str(entry.get("token", "")).strip()
+        if not token.isdigit():
+            continue
+        v = int(token)
+        if not 0 <= v <= 100:
+            continue
+        p = math.exp(float(entry.get("logprob", -math.inf)))
+        num += v * p
+        den += p
+    return num / den if den > 0 else None
+
+
+def decode_batch_results(
+    results: Iterable[Dict[str, object]],
+    id_map: Dict[str, GridCell],
+) -> Dict[str, ApiScore]:
+    """Re-key raw batch result objects by custom_id and extract the
+    measurement fields (perturb_prompts.py:352-549)."""
+    scores: Dict[str, ApiScore] = {}
+    for obj in results:
+        custom_id = str(obj.get("custom_id", ""))
+        base_id, _, fmt = custom_id.rpartition("_")
+        cell = id_map.get(custom_id)
+        if cell is None:
+            continue
+        body = (
+            obj.get("response", {}).get("body", {})
+            if isinstance(obj.get("response"), dict)
+            else {}
+        )
+        choices = body.get("choices") or [{}]
+        message = choices[0].get("message", {}) or {}
+        text = str(message.get("content", "") or "")
+        logprobs = choices[0].get("logprobs") or {}
+        content = logprobs.get("content") or []
+
+        score = scores.setdefault(base_id, ApiScore(custom_id=base_id))
+        if fmt == "binary":
+            score.response_text = text
+            score.token_1_prob, score.token_2_prob = _first_token_probs(
+                content, cell.target_tokens
+            )
+            score.log_probabilities = json.dumps(
+                {
+                    str(e.get("token", "")): float(e.get("logprob", 0.0))
+                    for e in (content[0].get("top_logprobs", []) if content else [])
+                }
+            )
+        else:
+            score.confidence_text = text
+            m = re.search(r"\b(\d+)\b", text)
+            score.confidence_value = int(m.group(1)) if m else None
+            score.weighted_confidence = _weighted_confidence(content)
+    return scores

@@ -3,3 +3,13 @@
 from .runner import PromptScore, ScoringEngine  # noqa: F401
 from .score import YesNoScores, readout_from_step_logits, weighted_confidence  # noqa: F401
 from .sweep import run_perturbation_sweep, run_word_meaning_sweep  # noqa: F401
+from .multi import (  # noqa: F401
+    ModelSpec,
+    base_instruct_pairs,
+    run_model_comparison_sweep,
+)
+from .rephrase import (  # noqa: F401
+    load_or_generate_perturbations,
+    parse_numbered_rephrasings,
+    rephraser_from_engine,
+)

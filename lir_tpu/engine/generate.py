@@ -52,6 +52,42 @@ def greedy_decode(params, cfg: ModelConfig, tokens: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+def sample_decode(params, cfg: ModelConfig, tokens: jax.Array,
+                  attn_mask: jax.Array, key: jax.Array,
+                  temperature: float = 0.9, max_new_tokens: int = 50
+                  ) -> jax.Array:
+    """Temperature sampling with the same prefill + lax.scan structure as
+    greedy_decode, for the on-pod perturbation generator (the reference
+    rephrases with temperature 0.9 via the Anthropic API,
+    perturb_prompts.py:799-809; here the sampler runs on the local model).
+
+    Returns generated (B, max_new_tokens) int32. Per-step logits are not
+    captured — rephrasings need text only, and dropping the (B, T, V) stack
+    keeps HBM free for long sample runs."""
+    B, S = tokens.shape
+    T = S + max_new_tokens
+    logits0, cache, pos0 = decoder.prefill(params, cfg, tokens, attn_mask, T)
+    cache_mask0 = jnp.pad(attn_mask, ((0, 0), (0, max_new_tokens)))
+
+    def step(carry, xs):
+        logits, cache, cache_mask = carry
+        t, step_key = xs
+        nxt = jax.random.categorical(
+            step_key, logits / jnp.maximum(temperature, 1e-6), axis=-1
+        ).astype(jnp.int32)
+        cache_mask = cache_mask.at[:, S + t].set(1)
+        new_logits, cache = decoder.decode_step(
+            params, cfg, cache, nxt, pos0 + t, S + t, cache_mask)
+        return (new_logits, cache, cache_mask), nxt
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _, _), gen = lax.scan(
+        step, (logits0, cache, cache_mask0),
+        (jnp.arange(max_new_tokens), keys))
+    return jnp.swapaxes(gen, 0, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
 def t5_greedy_decode(params, cfg: T5Config, enc_tokens: jax.Array,
                      enc_mask: jax.Array, max_new_tokens: int = 50
                      ) -> Tuple[jax.Array, jax.Array]:

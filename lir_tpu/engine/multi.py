@@ -1,0 +1,161 @@
+"""Multi-model sweep orchestration (C10-C12 drivers, C15/C16 aux).
+
+Parity targets:
+  - the (base, instruct) pair loop of compare_base_vs_instruct.py:386-550
+    and the instruct-only loop of compare_instruct_models.py:376-566,
+    including the per-model try/except that emits NaN rows instead of
+    killing a 12-hour sweep (:482-492 / :512-522);
+  - the ThreadPoolExecutor model fan-out of perturb_prompts.py:917-962 —
+    on TPU the models share the chips, so the sweep is sequential per model
+    (SURVEY.md §2.5) with the same results-merging semantics;
+  - C15 memory management: params are dropped and the device allowed to
+    reclaim HBM between models (replacing gc/empty_cache/HF-cache-delete,
+    compare_base_vs_instruct.py:68-88);
+  - C16 session capture: the whole sweep log is written next to the CSVs.
+
+Cost accounting becomes throughput accounting: every scored prompt feeds a
+ThroughputMeter and the sweep summary reports prompts/sec/chip
+(BASELINE.json metric) instead of dollars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..data import schemas
+from ..data.prompts import (
+    WORD_MEANING_QUESTIONS,
+    format_base_prompt,
+    format_instruct_prompt,
+)
+from ..utils.logging import get_logger, save_captured_output, start_capture
+from ..utils.profiling import ThroughputMeter, device_memory_stats, trace
+from .runner import ScoringEngine
+from .sweep import run_word_meaning_sweep
+
+log = get_logger(__name__)
+
+# An engine factory returns a ready ScoringEngine for a model name; the
+# sweep drops every reference to it afterwards so HBM is reclaimed.
+EngineFactory = Callable[[str], ScoringEngine]
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """One model in a sweep."""
+
+    name: str
+    base_or_instruct: str  # "base" | "instruct"
+
+    @property
+    def is_base(self) -> bool:
+        return self.base_or_instruct == "base"
+
+
+def nan_rows_for_model(
+    spec: ModelSpec, questions: Sequence[str]
+) -> List[schemas.ScoreRow]:
+    """NaN fallback rows — one bad model must not abort the sweep
+    (compare_base_vs_instruct.py:482-492)."""
+    return [
+        schemas.ScoreRow(
+            prompt=q, model=spec.name, base_or_instruct=spec.base_or_instruct,
+            model_output="ERROR", yes_prob=float("nan"),
+            no_prob=float("nan"), yes_no_found=False,
+        )
+        for q in questions
+    ]
+
+
+def format_for(spec: ModelSpec) -> Callable[[str], str]:
+    """C14 prompt-formatter routing: few-shot 'Question:/Answer:' scaffold
+    for base models (plus bloom-7b1, compare_base_vs_instruct.py:463), the
+    direct form otherwise."""
+    if spec.is_base or spec.name.lower() == "bigscience/bloom-7b1":
+        return format_base_prompt
+    return format_instruct_prompt
+
+
+def run_model_comparison_sweep(
+    specs: Sequence[ModelSpec],
+    engine_factory: EngineFactory,
+    out_dir: Path,
+    questions: Sequence[str] = WORD_MEANING_QUESTIONS,
+    write_base_csv: bool = True,
+    write_instruct_csv: bool = True,
+) -> Dict[str, object]:
+    """Sweep every model over the 50 word-meaning questions, producing the
+    D1 and/or D2 CSVs plus throughput metrics and a session log."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    capture = start_capture()
+    meter = ThroughputMeter()
+    all_rows: List[schemas.ScoreRow] = []
+    per_model: Dict[str, Dict[str, object]] = {}
+
+    for spec in specs:
+        log.info("=== %s (%s) ===", spec.name, spec.base_or_instruct)
+        engine: Optional[ScoringEngine] = None
+        try:
+            engine = engine_factory(spec.name)
+            with meter.measure(), trace(f"sweep/{spec.name.split('/')[-1]}"):
+                rows = run_word_meaning_sweep(
+                    engine, spec.name, spec.base_or_instruct,
+                    questions, format_for(spec),
+                )
+            meter.add(len(rows))
+            n_found = sum(r.yes_no_found for r in rows)
+            per_model[spec.name] = {
+                "rows": len(rows),
+                "yes_no_found": n_found,
+                "status": "ok",
+            }
+            log.info(
+                "%s: %d rows, yes/no found in %d", spec.name, len(rows), n_found
+            )
+        except Exception as exc:
+            log.error("Model %s failed: %s — emitting NaN rows", spec.name, exc)
+            rows = nan_rows_for_model(spec, questions)
+            per_model[spec.name] = {"rows": len(rows), "status": f"error: {exc}"}
+        finally:
+            # C15: drop the params reference so the backend reclaims HBM
+            # before the next model loads.
+            engine = None
+        all_rows.extend(rows)
+        mem = device_memory_stats()
+        if mem:
+            log.info("device memory: %s", mem)
+
+    artifacts: Dict[str, object] = {"per_model": per_model,
+                                    "throughput": meter.summary()}
+    if write_base_csv:
+        # D1 holds every swept model, base and instruct alike.
+        df = schemas.write_model_comparison_csv(
+            all_rows, out_dir / "model_comparison_results.csv"
+        )
+        artifacts["model_comparison_csv"] = df
+    if write_instruct_csv:
+        instruct_rows = [r for r in all_rows if r.base_or_instruct == "instruct"]
+        if instruct_rows:
+            df = schemas.write_instruct_comparison_csv(
+                instruct_rows, out_dir / "instruct_model_comparison_results.csv"
+            )
+            artifacts["instruct_comparison_csv"] = df
+
+    log.info("Sweep throughput: %s", meter.summary())
+    save_captured_output(capture, out_dir / "sweep_session_log.txt")
+    return artifacts
+
+
+def base_instruct_pairs(
+    pairs: Sequence[Tuple[str, str]]
+) -> List[ModelSpec]:
+    """Expand (base, instruct) repo-id pairs into a sweep order matching the
+    reference's pair loop (compare_base_vs_instruct.py:136-180)."""
+    specs: List[ModelSpec] = []
+    for base, instruct in pairs:
+        specs.append(ModelSpec(base, "base"))
+        specs.append(ModelSpec(instruct, "instruct"))
+    return specs

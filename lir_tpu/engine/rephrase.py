@@ -1,0 +1,176 @@
+"""On-pod perturbation generation (C3) — zero external API calls.
+
+Parity target: analysis/perturb_prompts.py:727-870. The reference asks
+Claude (temperature 0.9) for 100 sessions x 20 numbered rephrasings per
+legal prompt, parses the numbered list (including continuation lines),
+caches everything to perturbations.json, and validates the cache against
+the in-code prompt list on reload. Here the generator is any local
+instruct model run through the sampling decoder; the parser, cache format,
+and validation rule are byte-compatible, and a cached reference
+perturbations.json can be dropped in directly (BASELINE north star:
+"reuse cached perturbations.json or run an instruct model on-pod as the
+rephraser").
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..data import schemas
+from ..data.prompts import LegalPrompt, rephrase_request
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+PromptParts = Tuple[str, str, Tuple[str, str], str]
+
+
+def parse_numbered_rephrasings(text: str) -> List[str]:
+    """Parse a numbered-list response into rephrasings.
+
+    Rule parity (perturb_prompts.py:812-835): skip blanks and "here are"
+    preambles; "N. text" splits at the first dot; "N text" strips leading
+    digits and ' .-\\t'; unnumbered lines continue the previous rephrasing.
+    """
+    out: List[str] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.lower().startswith("here are"):
+            continue
+        if line[0].isdigit():
+            parts = line.split(".", 1)
+            if len(parts) > 1:
+                out.append(parts[1].strip())
+            else:
+                out.append(line.lstrip("0123456789").strip(" .-\t"))
+        elif out:
+            out[-1] += " " + line
+        else:
+            out.append(line)
+    return out
+
+
+def prompt_parts(prompt: LegalPrompt) -> PromptParts:
+    return (
+        prompt.main,
+        prompt.response_format,
+        tuple(prompt.target_tokens),
+        prompt.confidence_format,
+    )
+
+
+def generate_rephrasings(
+    generate_text: Callable[[Sequence[str], jax.Array], List[str]],
+    prompts: Sequence[LegalPrompt],
+    key: jax.Array,
+    sessions_per_prompt: int = 100,
+    rephrasings_per_session: int = 20,
+    sessions_per_batch: int = 8,
+) -> List[Tuple[PromptParts, List[str]]]:
+    """Generate the full perturbation set with a local model.
+
+    `generate_text` maps (prompt texts, PRNG key) -> decoded texts; the
+    sweep drivers pass a sampling-decode closure over the loaded rephraser
+    model. Sessions are batched — the reference's 100 sequential API calls
+    per prompt become ceil(100/B) batched TPU sampling calls.
+    """
+    results: List[Tuple[PromptParts, List[str]]] = []
+    for prompt in prompts:
+        request = rephrase_request(prompt.main, n=rephrasings_per_session)
+        all_rephrasings: List[str] = []
+        remaining = sessions_per_prompt
+        while remaining > 0:
+            n = min(sessions_per_batch, remaining)
+            remaining -= n
+            key, sub = jax.random.split(key)
+            try:
+                texts = generate_text([request] * n, sub)
+            except Exception as exc:  # session-skip parity (:841-843)
+                log.warning("rephrase batch failed (%s); skipping", exc)
+                continue
+            for text in texts:
+                all_rephrasings.extend(parse_numbered_rephrasings(text))
+        log.info(
+            "Generated %d rephrasings for prompt %r",
+            len(all_rephrasings), prompt.main[:50],
+        )
+        results.append((prompt_parts(prompt), all_rephrasings))
+    return results
+
+
+def load_or_generate_perturbations(
+    cache_path: Path,
+    prompts: Sequence[LegalPrompt],
+    generate_text: Optional[Callable[[Sequence[str], jax.Array], List[str]]],
+    key: Optional[jax.Array] = None,
+    sessions_per_prompt: int = 100,
+    rephrasings_per_session: int = 20,
+) -> List[Tuple[PromptParts, List[str]]]:
+    """Cache-or-generate flow with the reference's validation rule
+    (perturb_prompts.py:739-777): a reloaded cache must match the in-code
+    prompt list element-by-element or it is regenerated.
+    """
+    cache_path = Path(cache_path)
+    if cache_path.exists():
+        try:
+            entries = schemas.load_perturbations(cache_path)
+        except Exception as exc:
+            log.warning("Perturbation cache unreadable (%s); regenerating", exc)
+            entries = []
+        if entries and schemas.validate_perturbation_cache(entries, prompts):
+            log.info(
+                "Loaded %d cached perturbation sets from %s",
+                len(entries), cache_path,
+            )
+            return entries
+        if entries:
+            log.warning(
+                "Perturbation cache at %s does not match the prompt list; "
+                "regenerating", cache_path,
+            )
+
+    if generate_text is None:
+        raise RuntimeError(
+            f"No valid perturbation cache at {cache_path} and no rephraser "
+            "model supplied. Provide generate_text (a local sampling model) "
+            "or a cached perturbations.json."
+        )
+    key = key if key is not None else jax.random.PRNGKey(42)
+    results = generate_rephrasings(
+        generate_text, prompts, key,
+        sessions_per_prompt=sessions_per_prompt,
+        rephrasings_per_session=rephrasings_per_session,
+    )
+    schemas.save_perturbations(cache_path, results)
+    log.info("Saved perturbations to %s", cache_path)
+    return results
+
+
+def rephraser_from_engine(engine, temperature: float = 0.9,
+                          max_new_tokens: int = 512):
+    """Build a `generate_text` closure from a ScoringEngine's model.
+
+    Uses the sampling decoder (temperature 0.9 parity with
+    perturb_prompts.py:802) over the engine's params/config/tokenizer.
+    """
+    from . import generate as gen_mod
+    from . import tokens as tok
+    import jax.numpy as jnp
+
+    def generate_text(texts: Sequence[str], key: jax.Array) -> List[str]:
+        ids_list = [engine.tokenizer(t).input_ids for t in texts]
+        bucket = tok.pick_bucket([len(i) for i in ids_list], engine.buckets)
+        toks_arr, mask = tok.left_pad_ids(
+            ids_list, bucket, tok.pad_token_id(engine.tokenizer))
+        gen = gen_mod.sample_decode(
+            engine.params, engine.cfg, jnp.asarray(toks_arr),
+            jnp.asarray(mask), key, temperature=temperature,
+            max_new_tokens=max_new_tokens)
+        gen_host = np.asarray(jax.device_get(gen))
+        return [engine.decode_completion(row) for row in gen_host]
+
+    return generate_text

@@ -1,0 +1,96 @@
+"""Profiling and throughput accounting.
+
+The reference's only "profiler" is dollar-cost accounting against the
+MODEL_PRICING table plus RAM/GPU telemetry strings (SURVEY.md §5;
+perturb_prompts.py:51-65,1021-1066, compare_base_vs_instruct.py:53-66).
+The TPU-native replacements:
+
+  - ThroughputMeter: prompts/sec/chip — the BASELINE.json headline metric —
+    computed from the same counters the cost table consumed.
+  - trace(): jax.profiler trace annotation around the sharded forward, so
+    sweeps show up named in TensorBoard/Perfetto traces.
+  - device_memory_stats(): per-device HBM usage, replacing the reference's
+    psutil/cuda telemetry prints.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class ThroughputMeter:
+    """Counts scored prompts and wall time; reports prompts/sec/chip."""
+
+    n_devices: int = 0
+    prompts: int = 0
+    tokens_in: int = 0
+    tokens_out: int = 0
+    elapsed: float = 0.0
+    _start: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.n_devices <= 0:
+            self.n_devices = jax.device_count()
+
+    @contextlib.contextmanager
+    def measure(self) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.elapsed += time.perf_counter() - start
+
+    def add(self, prompts: int, tokens_in: int = 0, tokens_out: int = 0) -> None:
+        self.prompts += prompts
+        self.tokens_in += tokens_in
+        self.tokens_out += tokens_out
+
+    @property
+    def prompts_per_sec(self) -> float:
+        return self.prompts / self.elapsed if self.elapsed > 0 else 0.0
+
+    @property
+    def prompts_per_sec_per_chip(self) -> float:
+        return self.prompts_per_sec / max(self.n_devices, 1)
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "prompts": self.prompts,
+            "tokens_in": self.tokens_in,
+            "tokens_out": self.tokens_out,
+            "elapsed_s": round(self.elapsed, 3),
+            "n_devices": self.n_devices,
+            "prompts_per_sec": round(self.prompts_per_sec, 4),
+            "prompts_per_sec_per_chip": round(self.prompts_per_sec_per_chip, 4),
+        }
+
+
+@contextlib.contextmanager
+def trace(name: str) -> Iterator[None]:
+    """Named jax.profiler annotation (visible in captured device traces)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def device_memory_stats() -> Dict[str, Dict[str, float]]:
+    """Per-device memory stats in GiB where the backend exposes them."""
+    out: Dict[str, Dict[str, float]] = {}
+    for dev in jax.devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            continue
+        if not stats:
+            continue
+        out[str(dev)] = {
+            k: round(v / 2**30, 3)
+            for k, v in stats.items()
+            if isinstance(v, (int, float)) and "bytes" in k
+        }
+    return out

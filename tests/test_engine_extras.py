@@ -1,0 +1,359 @@
+"""Tests for the sweep extras: on-pod rephraser (C3), multi-model sweep
+driver (C10/C15/C16), the preserved API backend (C7-C9), sampling decode,
+and the throughput meter."""
+
+import json
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+import torch
+
+from lir_tpu.backends import api as api_mod
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import RuntimeConfig
+from lir_tpu.data.prompts import LEGAL_PROMPTS
+from lir_tpu.engine import generate as gen_mod
+from lir_tpu.engine import grid as grid_mod
+from lir_tpu.engine.multi import (
+    ModelSpec,
+    base_instruct_pairs,
+    format_for,
+    run_model_comparison_sweep,
+)
+from lir_tpu.engine.rephrase import (
+    load_or_generate_perturbations,
+    parse_numbered_rephrasings,
+)
+from lir_tpu.engine.runner import ScoringEngine
+from lir_tpu.models.loader import config_from_hf, convert_decoder
+from lir_tpu.utils.profiling import ThroughputMeter
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _tiny_llama_params(vocab=1000, seed=0):
+    import transformers as tf
+    torch.manual_seed(seed)
+    hf = tf.LlamaForCausalLM(tf.LlamaConfig(
+        vocab_size=vocab, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, intermediate_size=128,
+        max_position_embeddings=256, tie_word_embeddings=False)).eval()
+    cfg, fam = config_from_hf(hf.config)
+    return convert_decoder(hf.state_dict(), cfg, fam), cfg, hf
+
+
+class TestRephraseParser:
+    def test_numbered_list(self):
+        text = (
+            "Here are 3 rephrasings:\n"
+            "1. First question?\n"
+            "2. Second question\n"
+            "   with a continuation line\n"
+            "3 Third without dot\n"
+        )
+        out = parse_numbered_rephrasings(text)
+        assert out == [
+            "First question?",
+            "Second question with a continuation line",
+            "Third without dot",
+        ]
+
+    def test_unnumbered_first_line(self):
+        assert parse_numbered_rephrasings("just one line") == ["just one line"]
+
+    def test_blank_and_preamble_skipped(self):
+        out = parse_numbered_rephrasings("\nHere are the items\n1. A?\n\n2. B?")
+        assert out == ["A?", "B?"]
+
+
+class TestRephraseCache:
+    def test_generate_and_cache_roundtrip(self, tmp_path):
+        calls = []
+
+        def fake_generate(texts, key):
+            calls.append(len(texts))
+            return [
+                "1. Variant one?\n2. Variant two?" for _ in texts
+            ]
+
+        prompts = LEGAL_PROMPTS[:2]
+        cache = tmp_path / "perturbations.json"
+        res = load_or_generate_perturbations(
+            cache, prompts, fake_generate, KEY,
+            sessions_per_prompt=4, rephrasings_per_session=2,
+        )
+        assert cache.exists()
+        assert len(res) == 2
+        # 4 sessions x 2 parsed rephrasings each.
+        assert len(res[0][1]) == 8
+
+        # Reload hits the cache: generator must NOT be called again.
+        n_calls = len(calls)
+        res2 = load_or_generate_perturbations(cache, prompts, fake_generate, KEY)
+        assert len(calls) == n_calls
+        assert res2 == res
+
+    def test_cache_invalidated_on_prompt_change(self, tmp_path):
+        def fake_generate(texts, key):
+            return ["1. X?" for _ in texts]
+
+        cache = tmp_path / "perturbations.json"
+        load_or_generate_perturbations(
+            cache, LEGAL_PROMPTS[:1], fake_generate, KEY,
+            sessions_per_prompt=1,
+        )
+        # Different prompt list -> cache invalid -> regenerated (2 entries).
+        res = load_or_generate_perturbations(
+            cache, LEGAL_PROMPTS[:2], fake_generate, KEY,
+            sessions_per_prompt=1,
+        )
+        assert len(res) == 2
+
+    def test_missing_cache_without_generator_raises(self, tmp_path):
+        with pytest.raises(RuntimeError, match="rephraser"):
+            load_or_generate_perturbations(
+                tmp_path / "missing.json", LEGAL_PROMPTS[:1], None
+            )
+
+
+class TestSampleDecode:
+    def test_shapes_and_determinism(self):
+        params, cfg, _ = _tiny_llama_params()
+        toks = np.full((2, 8), 5, dtype=np.int32)
+        mask = np.ones_like(toks)
+        import jax.numpy as jnp
+
+        g1 = gen_mod.sample_decode(
+            params, cfg, jnp.asarray(toks), jnp.asarray(mask), KEY,
+            temperature=0.9, max_new_tokens=6,
+        )
+        g2 = gen_mod.sample_decode(
+            params, cfg, jnp.asarray(toks), jnp.asarray(mask), KEY,
+            temperature=0.9, max_new_tokens=6,
+        )
+        assert g1.shape == (2, 6)
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+    def test_low_temperature_approaches_greedy(self):
+        params, cfg, _ = _tiny_llama_params()
+        import jax.numpy as jnp
+
+        toks = jnp.asarray(np.full((1, 8), 5, dtype=np.int32))
+        mask = jnp.ones_like(toks)
+        sampled = gen_mod.sample_decode(
+            params, cfg, toks, mask, KEY, temperature=1e-4, max_new_tokens=5
+        )
+        greedy, _ = gen_mod.greedy_decode(
+            params, cfg, toks, mask, max_new_tokens=5
+        )
+        np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
+
+
+class TestMultiModelSweep:
+    def _engine_factory(self):
+        params, cfg, _ = _tiny_llama_params(vocab=FakeTokenizer.VOCAB)
+
+        def factory(name):
+            if "broken" in name:
+                raise RuntimeError("load failure")
+            return ScoringEngine(
+                params, cfg, FakeTokenizer(),
+                RuntimeConfig(batch_size=8, max_new_tokens=4, max_seq_len=128),
+            )
+
+        return factory
+
+    def test_sweep_writes_csvs_and_handles_failure(self, tmp_path):
+        specs = [
+            ModelSpec("org/tiny-base", "base"),
+            ModelSpec("org/tiny-instruct", "instruct"),
+            ModelSpec("org/broken-model", "instruct"),
+        ]
+        questions = ["Is a cat an animal", "Is a rock an animal"]
+        res = run_model_comparison_sweep(
+            specs, self._engine_factory(), tmp_path, questions=questions,
+        )
+        d1 = pd.read_csv(tmp_path / "model_comparison_results.csv")
+        assert len(d1) == 6  # 3 models x 2 questions, incl. NaN rows
+        broken = d1[d1["model"] == "org/broken-model"]
+        assert broken["yes_prob"].isna().all()
+        assert (broken["model_output"] == "ERROR").all()
+
+        d2 = pd.read_csv(tmp_path / "instruct_model_comparison_results.csv")
+        assert set(d2["model"]) == {"org/tiny-instruct", "org/broken-model"}
+        assert "relative_prob" in d2.columns
+
+        assert (tmp_path / "sweep_session_log.txt").exists()
+        assert res["throughput"]["prompts"] == 4  # 2 ok models x 2 questions
+        assert res["per_model"]["org/broken-model"]["status"].startswith("error")
+
+    def test_formatter_routing(self):
+        assert "Question:" in format_for(ModelSpec("x/base-model", "base"))("Q?")
+        direct = format_for(ModelSpec("x/chat", "instruct"))("Q?")
+        assert direct.rstrip().endswith("without any other text.")
+        # bloom-7b1 gets the base scaffold despite being swept as 'base' in
+        # D1 (reference special case).
+        assert "Answer:" in format_for(
+            ModelSpec("bigscience/bloom-7b1", "base")
+        )("Q?")
+
+    def test_pair_expansion(self):
+        specs = base_instruct_pairs([("a/base", "a/chat"), ("b/base", "b/chat")])
+        assert [s.name for s in specs] == ["a/base", "a/chat", "b/base", "b/chat"]
+        assert [s.base_or_instruct for s in specs] == [
+            "base", "instruct", "base", "instruct",
+        ]
+
+
+class FakeTransport:
+    """In-memory BatchTransport: echoes deterministic completions."""
+
+    def __init__(self):
+        self.files = {}
+        self.batches = {}
+        self.poll_count = 0
+
+    def upload_jsonl(self, lines):
+        fid = f"file-{len(self.files)}"
+        self.files[fid] = list(lines)
+        return fid
+
+    def create_batch(self, file_id):
+        bid = f"batch-{len(self.batches)}"
+        self.batches[bid] = file_id
+        return bid
+
+    def batch_status(self, batch_id):
+        self.poll_count += 1
+        return "completed" if self.poll_count > 1 else "in_progress"
+
+    def batch_output_file(self, batch_id):
+        fid = self.batches[batch_id]
+        out = []
+        for line in self.files[fid]:
+            req = json.loads(line)
+            is_binary = req["custom_id"].endswith("_binary")
+            if is_binary:
+                content = "Covered"
+                logprobs = {
+                    "content": [
+                        {
+                            "token": "Covered",
+                            "logprob": -0.2,
+                            "top_logprobs": [
+                                {"token": "Covered", "logprob": -0.2},
+                                {"token": "Not", "logprob": -1.8},
+                            ],
+                        }
+                    ]
+                }
+            else:
+                content = "85"
+                logprobs = {
+                    "content": [
+                        {
+                            "token": "85",
+                            "logprob": -0.1,
+                            "top_logprobs": [
+                                {"token": "85", "logprob": -0.1},
+                                {"token": "90", "logprob": -2.0},
+                                {"token": "high", "logprob": -3.0},
+                            ],
+                        }
+                    ]
+                }
+            out.append(
+                json.dumps(
+                    {
+                        "custom_id": req["custom_id"],
+                        "response": {
+                            "body": {
+                                "choices": [
+                                    {
+                                        "message": {"content": content},
+                                        "logprobs": logprobs,
+                                    }
+                                ]
+                            }
+                        },
+                    }
+                )
+            )
+        ofid = f"out-{batch_id}"
+        self.files[ofid] = out
+        return ofid
+
+    def download_jsonl(self, file_id):
+        return self.files[file_id]
+
+
+class TestApiBackend:
+    def test_request_building_and_chunking(self):
+        cells = grid_mod.build_grid(
+            "gpt-x", LEGAL_PROMPTS[:2], [["v1", "v2"], ["v1"]]
+        )
+        requests, id_map = api_mod.build_batch_requests(cells, "gpt-x")
+        # 2 formats per cell; 3+2 cells.
+        assert len(requests) == 10
+        assert len(id_map) == 10
+        binary = [r for r in requests if r["custom_id"].endswith("_binary")]
+        assert all(r["body"]["top_logprobs"] == 20 for r in binary)
+        assert all(r["body"]["temperature"] == 0 for r in requests)
+
+        chunks = api_mod.chunk_requests(requests, max_batch_size=4)
+        assert [len(c) for c in chunks] == [4, 4, 2]
+
+    def test_reasoning_model_requests(self):
+        cells = grid_mod.build_grid("o3", LEGAL_PROMPTS[:1], [["v1"]])
+        requests, _ = api_mod.build_batch_requests(
+            cells, "o3", reasoning_model=True
+        )
+        assert all(r["body"]["max_completion_tokens"] == 2000 for r in requests)
+        assert all("temperature" not in r["body"] for r in requests)
+
+    def test_end_to_end_decode(self):
+        cells = grid_mod.build_grid("gpt-x", LEGAL_PROMPTS[:1], [["v1"]])
+        requests, id_map = api_mod.build_batch_requests(cells, "gpt-x")
+        transport = FakeTransport()
+        results = api_mod.run_batch(
+            transport, requests, poll_interval=0, sleep=lambda s: None
+        )
+        assert results is not None
+        scores = api_mod.decode_batch_results(results, id_map)
+        assert len(scores) == 2  # original + 1 rephrasing
+        s = next(iter(scores.values()))
+        assert s.token_1_prob == pytest.approx(np.exp(-0.2))
+        assert s.token_2_prob == pytest.approx(np.exp(-1.8))
+        assert s.confidence_value == 85
+        # E[v] over the two integer tokens only.
+        p85, p90 = np.exp(-0.1), np.exp(-2.0)
+        assert s.weighted_confidence == pytest.approx(
+            (85 * p85 + 90 * p90) / (p85 + p90)
+        )
+
+    def test_terminal_failure_returns_none(self):
+        class FailingTransport(FakeTransport):
+            def batch_status(self, batch_id):
+                return "failed"
+
+        cells = grid_mod.build_grid("gpt-x", LEGAL_PROMPTS[:1], [[]])
+        requests, _ = api_mod.build_batch_requests(cells, "gpt-x")
+        assert api_mod.run_batch(
+            FailingTransport(), requests, poll_interval=0, sleep=lambda s: None
+        ) is None
+
+
+class TestThroughputMeter:
+    def test_prompts_per_chip(self):
+        meter = ThroughputMeter(n_devices=8)
+        with meter.measure():
+            pass
+        meter.elapsed = 2.0
+        meter.add(prompts=160)
+        assert meter.prompts_per_sec == pytest.approx(80.0)
+        assert meter.prompts_per_sec_per_chip == pytest.approx(10.0)
+        summary = meter.summary()
+        assert summary["n_devices"] == 8
+        assert summary["prompts_per_sec_per_chip"] == pytest.approx(10.0)
