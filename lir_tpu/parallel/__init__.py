@@ -1,0 +1,15 @@
+"""Distributed layer: device mesh, parameter sharding, sequence parallelism.
+
+No hand-written communication code on the tensor-parallel path — sharding
+annotations let XLA emit the ICI collectives (SURVEY.md §5). The explicit
+collectives live in ring_attention.py (ppermute ring, all_to_all Ulysses)
+where the schedule IS the algorithm.
+"""
+
+from . import sharding  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    reference_attention,
+    ring_attention,
+    seq_sharded,
+    ulysses_attention,
+)

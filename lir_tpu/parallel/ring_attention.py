@@ -1,0 +1,161 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference never exceeds ~700-token prompts (SURVEY.md §5 "long-context:
+absent"), but this framework treats long-context as first-class: when a
+sequence no longer fits one chip's HBM, shard it over the mesh's ``seq``
+axis and compute exact attention with either
+
+  - ``ring_attention``: K/V blocks rotate around the ring via
+    ``lax.ppermute`` while each device holds its Q shard, accumulating with
+    an online (flash-style) softmax — communication overlaps compute and
+    peak memory is O(S/N) per device. (Liu et al., Ring Attention with
+    Blockwise Transformers, 2023.)
+  - ``ulysses_attention``: two ``lax.all_to_all`` reshards (seq-sharded ->
+    head-sharded and back) around a plain local attention — cheaper when
+    n_heads >= n_seq_shards and the full sequence fits once per device.
+    (Jacobs et al., DeepSpeed-Ulysses, 2023.)
+
+Both are exact: outputs match single-device softmax attention to float
+tolerance (verified against ``reference_attention`` in tests on a virtual
+8-device mesh). Layout matches models/decoder.py: (B, S, H, hd), with the S
+axis sharded over ``seq``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+def reference_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = True
+) -> jnp.ndarray:
+    """Plain softmax attention, (B, S, H, hd) layout — the single-device
+    ground truth the parallel kernels must match."""
+    B, S, H, hd = q.shape
+    scale = 1.0 / np.sqrt(hd)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_kernel(q, k, v, q_index, axis_name: str, axis_size: int,
+                 causal: bool):
+    """Per-device ring body. q/k/v: (B, Sl, H, hd) local shards; q_index is
+    this device's position on the ring (its global block offset / Sl)."""
+    B, Sl, H, hd = q.shape
+    scale = 1.0 / np.sqrt(hd)
+    qf = q.astype(jnp.float32) * scale
+
+    o0 = jnp.zeros((B, Sl, H, hd), jnp.float32)
+    m0 = jnp.full((B, H, Sl), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Sl), jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    q_pos = q_index * Sl + jnp.arange(Sl)
+
+    def step(j, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (q_index - j) % axis_size          # block's origin device
+        k_pos = src * Sl + jnp.arange(Sl)
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            allowed = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(allowed[None, None], s, -jnp.inf)
+
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # exp(-inf - -inf) guard: a fully-masked row keeps m = -inf.
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+
+        l = l * alpha + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        o = o * alpha.transpose(0, 2, 1)[..., None] + pv
+
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o, m_new, l, k_blk, v_blk)
+
+    o, m, l, _, _ = lax.fori_loop(0, axis_size, step, (o0, m0, l0, k, v))
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    mesh: Mesh, causal: bool = True, axis_name: str = "seq",
+) -> jnp.ndarray:
+    """Exact attention with the sequence axis sharded over `axis_name`.
+
+    q/k/v: (B, S, H, hd) GLOBAL shapes (S divisible by the axis size). GQA
+    callers repeat K/V heads to H before entry. Returns (B, S, H, hd) with
+    the same sharding as q.
+    """
+    axis_size = mesh.shape[axis_name]
+    spec = P(None, axis_name, None, None)
+
+    def kernel(q, k, v):
+        idx = lax.axis_index(axis_name)
+        return _ring_kernel(q, k, v, idx, axis_name, axis_size, causal)
+
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def ulysses_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    mesh: Mesh, causal: bool = True, axis_name: str = "seq",
+) -> jnp.ndarray:
+    """All-to-all sequence parallelism: reshard (S/N, H) -> (S, H/N), run
+    plain local attention over the full sequence, reshard back.
+
+    Requires H % axis_size == 0. Same global layout contract as
+    ring_attention.
+    """
+    axis_size = mesh.shape[axis_name]
+    H = q.shape[2]
+    if H % axis_size != 0:
+        raise ValueError(
+            f"ulysses needs n_heads ({H}) divisible by seq shards ({axis_size})"
+        )
+    spec = P(None, axis_name, None, None)
+
+    def kernel(q, k, v):
+        # (B, Sl, H, hd) -> (B, S, H/N, hd): split heads, gather sequence.
+        def to_heads(x):
+            return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        def to_seq(x):
+            return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+        out = reference_attention(qh, kh, vh, causal=causal)
+        return to_seq(out)
+
+    return shard_map(
+        kernel, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def seq_sharded(mesh: Mesh, axis_name: str = "seq") -> NamedSharding:
+    """NamedSharding for (B, S, H, hd) activations with S over `axis_name`."""
+    return NamedSharding(mesh, P(None, axis_name, None, None))
