@@ -1,0 +1,246 @@
+"""Unified command-line interface for the framework.
+
+The reference is driven by running eleven standalone scripts with hard-coded
+personal paths (SURVEY.md §5 config: "no argparse anywhere"). Here every
+experiment and analysis is one subcommand of ``python -m lir_tpu``:
+
+  sweep        word-meaning model-comparison sweep -> D1/D2 CSVs
+  perturb      perturbation grid sweep (with resume) -> D6 workbook
+  rephrase     generate/refresh perturbations.json with a local model
+  analyze      all statistical analyses over existing artifacts
+  survey       human-survey pipeline -> every survey JSON artifact
+  bench        the prompts/sec/chip benchmark
+
+Model weights must be local checkpoint directories (zero egress); pass
+--checkpoints pointing at a root containing ``<org>__<name>`` dirs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _add_sweep(sub) -> None:
+    p = sub.add_parser("sweep", help="word-meaning model comparison (D1/D2)")
+    p.add_argument("--checkpoints", type=Path, required=True)
+    p.add_argument("--models", nargs="+", required=True,
+                   help="repo ids; suffix ':base' or ':instruct' "
+                        "(default instruct)")
+    p.add_argument("--out", type=Path, default=Path("results/comparison"))
+    p.add_argument("--sweep-kind", choices=["base_vs_instruct", "instruct_only"],
+                   default="base_vs_instruct")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--mesh", type=str, default=None,
+                   help="dataxmodel, e.g. 1x8 for 8-way tensor parallel")
+
+
+def _add_perturb(sub) -> None:
+    p = sub.add_parser("perturb", help="perturbation grid sweep (D6)")
+    p.add_argument("--checkpoints", type=Path, required=True)
+    p.add_argument("--model", required=True)
+    p.add_argument("--perturbations", type=Path,
+                   default=Path("perturbations.json"))
+    p.add_argument("--out", type=Path,
+                   default=Path("results/perturbation_results.xlsx"))
+    p.add_argument("--subset-size", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--mesh", type=str, default=None)
+
+
+def _add_rephrase(sub) -> None:
+    p = sub.add_parser("rephrase", help="generate perturbations.json locally")
+    p.add_argument("--checkpoints", type=Path, required=True)
+    p.add_argument("--model", required=True,
+                   help="instruct model acting as the rephraser")
+    p.add_argument("--out", type=Path, default=Path("perturbations.json"))
+    p.add_argument("--sessions", type=int, default=100)
+    p.add_argument("--per-session", type=int, default=20)
+
+
+def _add_analyze(sub) -> None:
+    p = sub.add_parser("analyze", help="statistical analyses over artifacts")
+    p.add_argument("--perturbation-results", type=Path, default=None,
+                   help="D6 workbook -> perturbation distribution suite")
+    p.add_argument("--base-csv", type=Path, default=None,
+                   help="D1 -> base-vs-instruct deltas")
+    p.add_argument("--instruct-csv", type=Path, default=None,
+                   help="D2 -> model graph suite (+ kappa combiner when the "
+                        "D6 workbook is also given)")
+    p.add_argument("--out", type=Path, default=Path("results/analysis"))
+    p.add_argument("--no-figures", action="store_true")
+    p.add_argument("--n-simulations", type=int, default=100_000)
+
+
+def _add_survey(sub) -> None:
+    p = sub.add_parser("survey", help="human-survey analysis pipeline")
+    p.add_argument("--survey", type=Path, required=True)
+    p.add_argument("--instruct", type=Path, required=True)
+    p.add_argument("--base", type=Path, default=None)
+    p.add_argument("--out", type=Path, default=Path("results/survey"))
+    p.add_argument("--quick", action="store_true")
+
+
+def _parse_mesh(spec: Optional[str]):
+    if not spec:
+        return None
+    from .config import MeshConfig
+
+    data, model = (int(x) for x in spec.lower().split("x"))
+    return MeshConfig(data=data, model=model)
+
+
+def _parse_models(items: List[str]):
+    from .engine.multi import ModelSpec
+
+    specs = []
+    for item in items:
+        name, _, kind = item.partition(":")
+        specs.append(ModelSpec(name, kind or "instruct"))
+    return specs
+
+
+def cmd_sweep(args) -> None:
+    from .config import RuntimeConfig
+    from .engine.multi import run_model_comparison_sweep
+    from .models.factory import engine_factory
+
+    factory = engine_factory(
+        args.checkpoints, RuntimeConfig(batch_size=args.batch_size),
+        _parse_mesh(args.mesh),
+    )
+    run_model_comparison_sweep(
+        _parse_models(args.models), factory, args.out,
+        sweep_kind=args.sweep_kind,
+    )
+
+
+def cmd_perturb(args) -> None:
+    from .config import RuntimeConfig
+    from .data.prompts import LEGAL_PROMPTS
+    from .engine.rephrase import load_or_generate_perturbations
+    from .engine.sweep import run_perturbation_sweep
+    from .models.factory import engine_factory
+
+    factory = engine_factory(
+        args.checkpoints, RuntimeConfig(batch_size=args.batch_size),
+        _parse_mesh(args.mesh),
+    )
+    entries = load_or_generate_perturbations(
+        args.perturbations, LEGAL_PROMPTS, None
+    )
+    perturbations = [rephrasings for _, rephrasings in entries]
+    engine = factory(args.model)
+    rows = run_perturbation_sweep(
+        engine, args.model, LEGAL_PROMPTS, perturbations, args.out,
+        subset_size=args.subset_size,
+    )
+    log.info("perturbation sweep wrote %d rows", len(rows))
+
+
+def cmd_rephrase(args) -> None:
+    import jax
+
+    from .data.prompts import LEGAL_PROMPTS
+    from .engine.rephrase import (
+        load_or_generate_perturbations,
+        rephraser_from_engine,
+    )
+    from .models.factory import engine_factory
+
+    engine = engine_factory(args.checkpoints)(args.model)
+    load_or_generate_perturbations(
+        args.out, LEGAL_PROMPTS, rephraser_from_engine(engine),
+        jax.random.PRNGKey(42),
+        sessions_per_prompt=args.sessions,
+        rephrasings_per_session=args.per_session,
+    )
+
+
+def cmd_analyze(args) -> None:
+    ran = False
+    if args.perturbation_results:
+        from .analysis.perturbation import analyze_all_models
+
+        analyze_all_models(
+            args.perturbation_results, args.out / "perturbation",
+            n_simulations=args.n_simulations,
+            make_figures=not args.no_figures,
+        )
+        ran = True
+    if args.base_csv:
+        from .analysis.base_vs_instruct import run_base_vs_instruct_analysis
+
+        run_base_vs_instruct_analysis(
+            args.base_csv, args.out / "base_vs_instruct",
+            make_figures=not args.no_figures,
+        )
+        ran = True
+    if args.instruct_csv:
+        from .analysis.model_graph import run_model_graph_analysis
+
+        run_model_graph_analysis(
+            args.instruct_csv, args.out / "model_graph",
+            make_figures=not args.no_figures,
+        )
+        ran = True
+        if args.perturbation_results:
+            from .analysis.kappa_combined import run_kappa_analysis
+
+            run_kappa_analysis(
+                args.instruct_csv, args.perturbation_results,
+                args.out / "kappa", make_figures=not args.no_figures,
+            )
+    if not ran:
+        log.error("analyze: give at least one of --perturbation-results, "
+                  "--base-csv, --instruct-csv")
+        sys.exit(2)
+
+
+def cmd_survey(args) -> None:
+    from .survey.run import run_survey_pipeline
+
+    kwargs = {}
+    if args.quick:
+        kwargs = dict(n_bootstrap_standard=50, n_bootstrap_small=20,
+                      n_bootstrap_large=200)
+    run_survey_pipeline(args.survey, args.instruct, args.base, args.out,
+                        **kwargs)
+
+
+def cmd_bench(_args) -> None:
+    import runpy
+
+    runpy.run_path(str(Path(__file__).resolve().parent.parent / "bench.py"),
+                   run_name="__main__")
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="lir_tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+    _add_sweep(sub)
+    _add_perturb(sub)
+    _add_rephrase(sub)
+    _add_analyze(sub)
+    _add_survey(sub)
+    sub.add_parser("bench", help="prompts/sec/chip benchmark")
+
+    args = parser.parse_args(argv)
+    {
+        "sweep": cmd_sweep,
+        "perturb": cmd_perturb,
+        "rephrase": cmd_rephrase,
+        "analyze": cmd_analyze,
+        "survey": cmd_survey,
+        "bench": cmd_bench,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    main()

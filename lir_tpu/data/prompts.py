@@ -255,8 +255,22 @@ def format_base_prompt(question: str) -> str:
 
 
 def format_instruct_prompt(question: str) -> str:
-    """Direct question for instruction-tuned models."""
+    """Instruct formatting in the base-vs-instruct sweep (D1): the few-shot
+    prefix IS included (compare_base_vs_instruct.py:462-463 formats
+    ``{few_shot_examples}{prompt} ...`` for instruct models too)."""
     return f"{FEW_SHOT_PREFIX}{question}{_ANSWER_SUFFIX}"
+
+
+def format_instruct_direct(question: str) -> str:
+    """Instruct formatting in the instruct-only sweep (D2): the bare
+    question, no few-shot scaffold (compare_instruct_models.py:488)."""
+    return f"{question}{_ANSWER_SUFFIX}"
+
+
+def format_baichuan_prompt(question: str) -> str:
+    """Baichuan chat template in the instruct-only sweep
+    (compare_instruct_models.py:491-492)."""
+    return f"<human>: {question}{_ANSWER_SUFFIX}\n<bot>:"
 
 
 def rephrase_request(main_prompt: str, n: int = 20) -> str:

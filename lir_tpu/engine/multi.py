@@ -27,7 +27,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from ..data import schemas
 from ..data.prompts import (
     WORD_MEANING_QUESTIONS,
+    format_baichuan_prompt,
     format_base_prompt,
+    format_instruct_direct,
     format_instruct_prompt,
 )
 from ..utils.logging import get_logger, save_captured_output, start_capture
@@ -69,10 +71,20 @@ def nan_rows_for_model(
     ]
 
 
-def format_for(spec: ModelSpec) -> Callable[[str], str]:
-    """C14 prompt-formatter routing: few-shot 'Question:/Answer:' scaffold
-    for base models (plus bloom-7b1, compare_base_vs_instruct.py:463), the
-    direct form otherwise."""
+def format_for(spec: ModelSpec, sweep_kind: str = "base_vs_instruct"
+               ) -> Callable[[str], str]:
+    """C14 prompt-formatter routing.
+
+    ``base_vs_instruct`` (D1 semantics, compare_base_vs_instruct.py:462-463):
+    base models (plus bloom-7b1) get the few-shot 'Question:/Answer:'
+    scaffold; instruct models get the few-shot prefix + bare question.
+    ``instruct_only`` (D2 semantics, compare_instruct_models.py:488-492):
+    bare question, with the Baichuan chat template special case.
+    """
+    if sweep_kind == "instruct_only":
+        if "baichuan" in spec.name.lower():
+            return format_baichuan_prompt
+        return format_instruct_direct
     if spec.is_base or spec.name.lower() == "bigscience/bloom-7b1":
         return format_base_prompt
     return format_instruct_prompt
@@ -85,6 +97,7 @@ def run_model_comparison_sweep(
     questions: Sequence[str] = WORD_MEANING_QUESTIONS,
     write_base_csv: bool = True,
     write_instruct_csv: bool = True,
+    sweep_kind: str = "base_vs_instruct",
 ) -> Dict[str, object]:
     """Sweep every model over the 50 word-meaning questions, producing the
     D1 and/or D2 CSVs plus throughput metrics and a session log."""
@@ -103,7 +116,7 @@ def run_model_comparison_sweep(
             with meter.measure(), trace(f"sweep/{spec.name.split('/')[-1]}"):
                 rows = run_word_meaning_sweep(
                     engine, spec.name, spec.base_or_instruct,
-                    questions, format_for(spec),
+                    questions, format_for(spec, sweep_kind),
                 )
             meter.add(len(rows))
             n_found = sum(r.yes_no_found for r in rows)

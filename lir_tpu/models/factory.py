@@ -1,0 +1,168 @@
+"""High-level model loading: HF checkpoint directory -> ScoringEngine.
+
+The reference loads each model with ``AutoModelForCausalLM.from_pretrained
+(device_map="auto", 8-bit)`` (compare_base_vs_instruct.py:423-455) and
+routes t5/t0/tk-instruct through the Seq2Seq class
+(compare_instruct_models.py:471-475). Here the flow is:
+
+  local checkpoint dir -> AutoConfig/AutoTokenizer -> state dict
+  (safetensors preferred, torch .bin fallback) -> loader.convert_* ->
+  jax pytree (bf16 on TPU) -> optional Mesh sharding -> ScoringEngine
+
+Zero-egress discipline: everything is ``local_files_only`` — weights must
+already be on disk; nothing here talks to a hub.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import MeshConfig, RuntimeConfig
+from ..engine.runner import ScoringEngine
+from ..utils.logging import get_logger
+from . import loader
+from .registry import T5Config
+
+log = get_logger(__name__)
+
+# Routing rule "t5|t0|tk-instruct -> Seq2Seq" (compare_instruct_models.py:471-475).
+_ENCDEC_PATTERN = re.compile(r"(^|/)(t5|flan-t5|t0|tk-instruct)", re.IGNORECASE)
+
+
+def is_encoder_decoder(name_or_path: str, hf_cfg=None) -> bool:
+    if hf_cfg is not None and getattr(hf_cfg, "is_encoder_decoder", False):
+        return True
+    return bool(_ENCDEC_PATTERN.search(str(name_or_path)))
+
+
+class _LazyStateDict(Mapping[str, Any]):
+    """Read tensors straight from safetensors shards on demand — one tensor
+    resident at a time instead of a second full copy of a 7B checkpoint."""
+
+    def __init__(self, model_dir: Path):
+        from safetensors import safe_open
+
+        self._open = safe_open
+        self._index: Dict[str, Path] = {}
+        index_file = model_dir / "model.safetensors.index.json"
+        if index_file.exists():
+            weight_map = json.loads(index_file.read_text())["weight_map"]
+            for key, shard in weight_map.items():
+                self._index[key] = model_dir / shard
+        else:
+            single = model_dir / "model.safetensors"
+            if not single.exists():
+                raise FileNotFoundError(f"no safetensors found in {model_dir}")
+            with safe_open(single, framework="np") as f:
+                for key in f.keys():
+                    self._index[key] = single
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        path = self._index[key]
+        with self._open(path, framework="np") as f:
+            return f.get_tensor(key)
+
+    def __iter__(self):
+        return iter(self._index)
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+
+def load_state_dict(model_dir: Path) -> Mapping[str, Any]:
+    """safetensors (lazy) preferred; torch .bin fallback (full load)."""
+    model_dir = Path(model_dir)
+    try:
+        return _LazyStateDict(model_dir)
+    except FileNotFoundError:
+        pass
+    import torch
+
+    bins = sorted(model_dir.glob("pytorch_model*.bin"))
+    if not bins:
+        raise FileNotFoundError(
+            f"no safetensors or pytorch_model*.bin in {model_dir}"
+        )
+    state: Dict[str, Any] = {}
+    for b in bins:
+        state.update(torch.load(b, map_location="cpu", weights_only=True))
+    return state
+
+
+def load_engine(
+    model_dir: Path,
+    runtime: Optional[RuntimeConfig] = None,
+    mesh_cfg: Optional[MeshConfig] = None,
+    dtype=None,
+) -> ScoringEngine:
+    """Build a ready ScoringEngine from a local HF checkpoint directory."""
+    import jax
+    import transformers
+
+    model_dir = Path(model_dir)
+    hf_cfg = transformers.AutoConfig.from_pretrained(
+        model_dir, local_files_only=True, trust_remote_code=False
+    )
+    tokenizer = transformers.AutoTokenizer.from_pretrained(
+        model_dir, local_files_only=True, trust_remote_code=False
+    )
+    if dtype is None:
+        dtype = (jnp.bfloat16 if jax.devices()[0].platform != "cpu"
+                 else jnp.float32)
+
+    encdec = is_encoder_decoder(model_dir.name, hf_cfg)
+    state = load_state_dict(model_dir)
+    if encdec:
+        cfg: Any = loader.t5_config_from_hf(hf_cfg)
+        params = loader.convert_t5(state, cfg, dtype=dtype)
+    else:
+        cfg, family = loader.config_from_hf(hf_cfg)
+        params = loader.convert_decoder(state, cfg, family, dtype=dtype)
+        if mesh_cfg is not None and mesh_cfg.n_devices > 1:
+            from ..parallel import sharding
+
+            mesh = sharding.build_mesh(mesh_cfg)
+            params = sharding.shard_params(params, cfg, mesh)
+            log.info(
+                "sharded %s over mesh %s", model_dir.name,
+                dict(zip(mesh.axis_names, mesh.devices.shape)),
+            )
+
+    log.info("loaded %s (%s, %s)", model_dir.name,
+             "enc-dec" if encdec else "decoder", np.dtype(dtype).name)
+    return ScoringEngine(
+        params, cfg, tokenizer, runtime or RuntimeConfig(),
+        encoder_decoder=encdec,
+    )
+
+
+def engine_factory(
+    checkpoint_root: Path,
+    runtime: Optional[RuntimeConfig] = None,
+    mesh_cfg: Optional[MeshConfig] = None,
+):
+    """EngineFactory for engine.multi: maps an HF repo id to
+    ``checkpoint_root/<org>__<name>`` or ``checkpoint_root/<name>``."""
+    checkpoint_root = Path(checkpoint_root)
+
+    def factory(model_name: str) -> ScoringEngine:
+        candidates = [
+            checkpoint_root / model_name.replace("/", "__"),
+            checkpoint_root / model_name.split("/")[-1],
+            checkpoint_root / model_name,
+        ]
+        for cand in candidates:
+            if cand.is_dir():
+                return load_engine(cand, runtime, mesh_cfg)
+        raise FileNotFoundError(
+            f"no local checkpoint for {model_name} under {checkpoint_root} "
+            f"(tried {[str(c) for c in candidates]})"
+        )
+
+    return factory

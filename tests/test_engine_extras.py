@@ -191,13 +191,22 @@ class TestMultiModelSweep:
 
     def test_formatter_routing(self):
         assert "Question:" in format_for(ModelSpec("x/base-model", "base"))("Q?")
-        direct = format_for(ModelSpec("x/chat", "instruct"))("Q?")
-        assert direct.rstrip().endswith("without any other text.")
-        # bloom-7b1 gets the base scaffold despite being swept as 'base' in
-        # D1 (reference special case).
+        # D1 semantics: instruct models still get the few-shot prefix.
+        d1_instruct = format_for(ModelSpec("x/chat", "instruct"))("Q?")
+        assert d1_instruct.startswith("Question:")
+        assert d1_instruct.rstrip().endswith("without any other text.")
+        # bloom-7b1 gets the base scaffold (reference special case).
         assert "Answer:" in format_for(
             ModelSpec("bigscience/bloom-7b1", "base")
         )("Q?")
+        # D2 semantics: bare question, Baichuan chat template.
+        d2 = format_for(ModelSpec("x/chat", "instruct"), "instruct_only")("Q?")
+        assert d2.startswith("Q?")
+        bc = format_for(
+            ModelSpec("baichuan-inc/Baichuan2-7B-Chat", "instruct"),
+            "instruct_only",
+        )("Q?")
+        assert bc.startswith("<human>:") and bc.endswith("<bot>:")
 
     def test_pair_expansion(self):
         specs = base_instruct_pairs([("a/base", "a/chat"), ("b/base", "b/chat")])
