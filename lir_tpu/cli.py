@@ -39,6 +39,9 @@ def _add_sweep(sub) -> None:
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--mesh", type=str, default=None,
                    help="dataxmodel, e.g. 1x8 for 8-way tensor parallel")
+    p.add_argument("--param-cache", type=Path, default=None,
+                   help="orbax cache root: convert HF weights once, restore "
+                        "fast afterwards")
 
 
 def _add_perturb(sub) -> None:
@@ -52,6 +55,7 @@ def _add_perturb(sub) -> None:
     p.add_argument("--subset-size", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--mesh", type=str, default=None)
+    p.add_argument("--param-cache", type=Path, default=None)
 
 
 def _add_rephrase(sub) -> None:
@@ -113,7 +117,7 @@ def cmd_sweep(args) -> None:
 
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(batch_size=args.batch_size),
-        _parse_mesh(args.mesh),
+        _parse_mesh(args.mesh), cache_root=args.param_cache,
     )
     run_model_comparison_sweep(
         _parse_models(args.models), factory, args.out,
@@ -130,7 +134,7 @@ def cmd_perturb(args) -> None:
 
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(batch_size=args.batch_size),
-        _parse_mesh(args.mesh),
+        _parse_mesh(args.mesh), cache_root=args.param_cache,
     )
     entries = load_or_generate_perturbations(
         args.perturbations, LEGAL_PROMPTS, None

@@ -100,8 +100,13 @@ def load_engine(
     runtime: Optional[RuntimeConfig] = None,
     mesh_cfg: Optional[MeshConfig] = None,
     dtype=None,
+    cache_root: Optional[Path] = None,
 ) -> ScoringEngine:
-    """Build a ready ScoringEngine from a local HF checkpoint directory."""
+    """Build a ready ScoringEngine from a local HF checkpoint directory.
+
+    With `cache_root`, the converted pytree is cached via models.cache: the
+    HF-layout conversion happens once per model ever, subsequent loads
+    restore orbax buffers directly (sharded, when a mesh is given)."""
     import jax
     import transformers
 
@@ -117,22 +122,31 @@ def load_engine(
                  else jnp.float32)
 
     encdec = is_encoder_decoder(model_dir.name, hf_cfg)
-    state = load_state_dict(model_dir)
-    if encdec:
-        cfg: Any = loader.t5_config_from_hf(hf_cfg)
-        params = loader.convert_t5(state, cfg, dtype=dtype)
-    else:
-        cfg, family = loader.config_from_hf(hf_cfg)
-        params = loader.convert_decoder(state, cfg, family, dtype=dtype)
-        if mesh_cfg is not None and mesh_cfg.n_devices > 1:
-            from ..parallel import sharding
 
-            mesh = sharding.build_mesh(mesh_cfg)
-            params = sharding.shard_params(params, cfg, mesh)
-            log.info(
-                "sharded %s over mesh %s", model_dir.name,
-                dict(zip(mesh.axis_names, mesh.devices.shape)),
-            )
+    from . import cache as cache_mod
+
+    if cache_root is not None and cache_mod.has_cached(cache_root, model_dir.name):
+        params, cfg = cache_mod.load_params(cache_root, model_dir.name)
+    else:
+        state = load_state_dict(model_dir)
+        if encdec:
+            cfg = loader.t5_config_from_hf(hf_cfg)
+            params = loader.convert_t5(state, cfg, dtype=dtype)
+        else:
+            cfg, family = loader.config_from_hf(hf_cfg)
+            params = loader.convert_decoder(state, cfg, family, dtype=dtype)
+        if cache_root is not None:
+            cache_mod.save_params(cache_root, model_dir.name, params, cfg)
+
+    if not encdec and mesh_cfg is not None and mesh_cfg.n_devices > 1:
+        from ..parallel import sharding
+
+        mesh = sharding.build_mesh(mesh_cfg)
+        params = sharding.shard_params(params, cfg, mesh)
+        log.info(
+            "sharded %s over mesh %s", model_dir.name,
+            dict(zip(mesh.axis_names, mesh.devices.shape)),
+        )
 
     log.info("loaded %s (%s, %s)", model_dir.name,
              "enc-dec" if encdec else "decoder", np.dtype(dtype).name)
@@ -146,6 +160,7 @@ def engine_factory(
     checkpoint_root: Path,
     runtime: Optional[RuntimeConfig] = None,
     mesh_cfg: Optional[MeshConfig] = None,
+    cache_root: Optional[Path] = None,
 ):
     """EngineFactory for engine.multi: maps an HF repo id to
     ``checkpoint_root/<org>__<name>`` or ``checkpoint_root/<name>``."""
@@ -159,7 +174,8 @@ def engine_factory(
         ]
         for cand in candidates:
             if cand.is_dir():
-                return load_engine(cand, runtime, mesh_cfg)
+                return load_engine(cand, runtime, mesh_cfg,
+                                   cache_root=cache_root)
         raise FileNotFoundError(
             f"no local checkpoint for {model_name} under {checkpoint_root} "
             f"(tried {[str(c) for c in candidates]})"

@@ -91,3 +91,34 @@ def test_engine_factory_resolution(tiny_checkpoint, monkeypatch):
     assert engine.cfg.n_layers == 2
     with pytest.raises(FileNotFoundError, match="no local checkpoint"):
         factory("org/absent-model")
+
+
+def test_params_cache_roundtrip(tiny_checkpoint, tmp_path, monkeypatch):
+    """Convert-once semantics: second load restores from the orbax cache
+    without touching the safetensors state dict."""
+    import transformers as tf
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.models import cache as cache_mod
+    from lir_tpu.models import factory as factory_mod
+
+    monkeypatch.setattr(
+        tf.AutoTokenizer, "from_pretrained",
+        classmethod(lambda cls, *a, **k: FakeTokenizer()),
+    )
+    path, _ = tiny_checkpoint
+    cache_root = tmp_path / "param_cache"
+
+    e1 = load_engine(path, cache_root=cache_root)
+    assert cache_mod.has_cached(cache_root, path.name)
+
+    # Break the state-dict path: a cache hit must never call it.
+    monkeypatch.setattr(
+        factory_mod, "load_state_dict",
+        lambda _p: (_ for _ in ()).throw(AssertionError("cache missed")),
+    )
+    e2 = load_engine(path, cache_root=cache_root)
+    assert e2.cfg == e1.cfg
+    np.testing.assert_allclose(
+        np.asarray(e2.params["tok_embed"]), np.asarray(e1.params["tok_embed"])
+    )
