@@ -44,12 +44,18 @@ def main() -> None:
     rng = np.random.default_rng(0)
     toks = jnp.asarray(rng.integers(3, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)
     mask = jnp.ones_like(toks)
+    yes_ids = jnp.full((BATCH,), 1, jnp.int32)
+    no_ids = jnp.full((BATCH,), 2, jnp.int32)
+    digit_ids = jnp.arange(10, 110, dtype=jnp.int32)
+    digit_vals = jnp.arange(0, 100, dtype=jnp.float32)
 
     def step(params, toks, mask):
-        gen, logits = generate.greedy_decode(params, cfg, toks, mask,
-                                             max_new_tokens=NEW_TOKENS)
-        return score.readout_from_step_logits(logits, gen, jnp.int32(1),
-                                              jnp.int32(2))
+        # The production scoring path: fused in-scan readout (no (B, T, V)
+        # logit stack leaves the device).
+        fused = generate.greedy_decode_fused(
+            params, cfg, toks, mask, yes_ids, no_ids, digit_ids, digit_vals,
+            max_new_tokens=NEW_TOKENS)
+        return score.readout_from_fused(fused, yes_ids, no_ids)
 
     # Warmup/compile.
     jax.block_until_ready(step(params, toks, mask))

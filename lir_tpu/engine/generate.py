@@ -11,6 +11,7 @@ a no-op).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Tuple
 
@@ -21,6 +22,90 @@ from jax import lax
 from ..models import decoder
 from ..models.registry import ModelConfig, T5Config
 from ..models import encdec
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class FusedDecodeOut:
+    """Per-step readout captured inside the decode scan — everything the
+    sweeps consume, WITHOUT materializing the (B, T_new, V) logit stack.
+
+    At seq 256 / vocab 32k / 10 steps the full stack is ~50 MB of HBM
+    traffic per batch; this struct is ~100 floats per row. The fused path is
+    the production scorer; `greedy_decode` (full capture) remains for
+    debugging and parity tests.
+    """
+
+    generated: jax.Array      # (B, T_new) int32
+    p_yes: jax.Array          # (B, T_new) fp32 softmax prob of the yes id
+    p_no: jax.Array           # (B, T_new) fp32
+    top2_ids: jax.Array       # (B, T_new, 2) int32 — the top-2 match rule
+    topk_logprobs: jax.Array  # (B, K) fp32 at position 0 (D6 log-prob map)
+    topk_ids: jax.Array       # (B, K) int32
+    weighted_confidence: jax.Array  # (B,) fp32 E[v] over digit ids at pos 0
+
+
+def _small_readout(logits: jax.Array, yes_ids: jax.Array, no_ids: jax.Array):
+    """(B, V) fp32 logits -> (p_yes, p_no, top2_ids): O(B*V) compute, O(B)
+    output."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    l_yes = jnp.take_along_axis(logits, yes_ids[:, None], axis=1)[:, 0]
+    l_no = jnp.take_along_axis(logits, no_ids[:, None], axis=1)[:, 0]
+    p_yes = jnp.exp(l_yes - lse)
+    p_no = jnp.exp(l_no - lse)
+    _, top2 = lax.top_k(logits, 2)
+    return p_yes, p_no, top2.astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_tokens", "topk"))
+def greedy_decode_fused(params, cfg: ModelConfig, tokens: jax.Array,
+                        attn_mask: jax.Array, yes_ids: jax.Array,
+                        no_ids: jax.Array, digit_ids: jax.Array,
+                        digit_vals: jax.Array, max_new_tokens: int = 50,
+                        topk: int = 20) -> FusedDecodeOut:
+    """Greedy decode with the C13/D6 readouts fused into the scan.
+
+    yes_ids/no_ids: (B,) per-row target token ids (rows of one batch may
+    score different prompts with different target tokens). digit_ids/vals:
+    the integer-token table for the weighted-confidence readout (pass empty
+    arrays to skip: the gather on an empty axis is free).
+    """
+    B, S = tokens.shape
+    T = S + max_new_tokens
+    logits0, cache, pos0 = decoder.prefill(params, cfg, tokens, attn_mask, T)
+    cache_mask0 = jnp.pad(attn_mask, ((0, 0), (0, max_new_tokens)))
+
+    # Position-0 extras from the prefill logits (the first generated
+    # position): top-k logprob map + weighted confidence.
+    logp0 = logits0 - jax.scipy.special.logsumexp(
+        logits0, axis=-1, keepdims=True)
+    tk_vals, tk_ids = lax.top_k(logp0, topk)
+    p_digits = jnp.exp(logp0[:, digit_ids])                    # (B, K)
+    mass = jnp.maximum(p_digits.sum(axis=-1), 1e-10)
+    wconf = (p_digits * digit_vals[None, :]).sum(axis=-1) / mass
+
+    def step(carry, t):
+        logits, cache, cache_mask = carry
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        p_yes, p_no, top2 = _small_readout(logits, yes_ids, no_ids)
+        cache_mask = cache_mask.at[:, S + t].set(1)
+        new_logits, cache = decoder.decode_step(
+            params, cfg, cache, nxt, pos0 + t, S + t, cache_mask)
+        return (new_logits, cache, cache_mask), (nxt, p_yes, p_no, top2)
+
+    (_, _, _), (gen, p_yes, p_no, top2) = lax.scan(
+        step, (logits0, cache, cache_mask0), jnp.arange(max_new_tokens))
+
+    return FusedDecodeOut(
+        generated=jnp.swapaxes(gen, 0, 1),
+        p_yes=jnp.swapaxes(p_yes, 0, 1),
+        p_no=jnp.swapaxes(p_no, 0, 1),
+        top2_ids=jnp.swapaxes(top2, 0, 1),
+        topk_logprobs=tk_vals,
+        topk_ids=tk_ids,
+        weighted_confidence=wconf,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))

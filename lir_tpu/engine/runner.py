@@ -65,6 +65,15 @@ class ScoringEngine:
         # Length buckets: powers of two up to max_seq_len (≲700-token prompts).
         self.buckets = [b for b in (64, 128, 256, 512, 1024)
                         if b <= self.rt.max_seq_len] or [self.rt.max_seq_len]
+        self._digit_table: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    @property
+    def digit_table(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(token ids, values) of single-token integers 0..100, resolved
+        once per tokenizer (feeds the weighted-confidence readout)."""
+        if self._digit_table is None:
+            self._digit_table = tok.integer_token_table(self.tokenizer)
+        return self._digit_table
 
     # -- building blocks ----------------------------------------------------
 
@@ -83,6 +92,27 @@ class ScoringEngine:
                 max_new_tokens=self.rt.max_new_tokens)
         return generate.greedy_decode(
             self.params, self.cfg, jnp.asarray(toks_arr), jnp.asarray(mask),
+            max_new_tokens=self.rt.max_new_tokens)
+
+    def decode_fused(self, prompts: Sequence[str], yes_ids: np.ndarray,
+                     no_ids: np.ndarray, with_digits: bool = False):
+        """The production scoring path: one jitted decode with the C13/D6
+        readouts fused into the scan (no (B, T, V) logit stack). Decoder-only
+        models only; T5 keeps the capture path (tiny vocab stacks)."""
+        assert not self.encoder_decoder
+        ids_list = [self.tokenizer(p).input_ids for p in prompts]
+        bucket = tok.pick_bucket([len(i) for i in ids_list], self.buckets)
+        toks_arr, mask = tok.left_pad_ids(ids_list, bucket,
+                                          tok.pad_token_id(self.tokenizer))
+        if with_digits:
+            digit_ids, digit_vals = self.digit_table
+        else:
+            digit_ids = np.zeros((0,), np.int32)
+            digit_vals = np.zeros((0,), np.float32)
+        return generate.greedy_decode_fused(
+            self.params, self.cfg, jnp.asarray(toks_arr), jnp.asarray(mask),
+            jnp.asarray(yes_ids, jnp.int32), jnp.asarray(no_ids, jnp.int32),
+            jnp.asarray(digit_ids), jnp.asarray(digit_vals),
             max_new_tokens=self.rt.max_new_tokens)
 
     def decode_completion(self, generated_ids: np.ndarray) -> str:
@@ -112,10 +142,18 @@ class ScoringEngine:
         B = self.rt.batch_size
         padded_prompts = batch_prompts + [batch_prompts[-1]] * (B - n)
 
-        gen, step_logits = self.decode_prompts(padded_prompts)
-        res = score.readout_from_step_logits(
-            step_logits, gen, jnp.int32(self.yes_id), jnp.int32(self.no_id),
-            scan_positions=self.rt.scan_positions)
+        if self.encoder_decoder:
+            gen, step_logits = self.decode_prompts(padded_prompts)
+            res = score.readout_from_step_logits(
+                step_logits, gen, jnp.int32(self.yes_id),
+                jnp.int32(self.no_id), scan_positions=self.rt.scan_positions)
+        else:
+            yes_ids = np.full((B,), self.yes_id, np.int32)
+            no_ids = np.full((B,), self.no_id, np.int32)
+            fused = self.decode_fused(padded_prompts, yes_ids, no_ids)
+            res = score.readout_from_fused(
+                fused, jnp.asarray(yes_ids), jnp.asarray(no_ids),
+                scan_positions=self.rt.scan_positions)
 
         res = jax.device_get(res)
         out = []

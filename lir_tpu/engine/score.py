@@ -81,6 +81,38 @@ def readout_from_step_logits(step_logits: jax.Array, generated: jax.Array,
     )
 
 
+def readout_from_fused(fused, yes_ids: jax.Array, no_ids: jax.Array,
+                       scan_positions: int = MAX_LOOK_AHEAD) -> YesNoScores:
+    """The same C13 scan-position rule applied to a FusedDecodeOut (per-step
+    p_yes/p_no/top-2 captured in-scan instead of full logit stacks).
+
+    yes_ids/no_ids: (B,) per-row target ids — must match the ids the fused
+    decode ran with."""
+    top2 = fused.top2_ids[:, :scan_positions, :]              # (B, P, 2)
+    is_target = ((top2 == yes_ids[:, None, None])
+                 | (top2 == no_ids[:, None, None]))
+    found_at = jnp.any(is_target, axis=-1)                    # (B, P)
+    any_found = jnp.any(found_at, axis=-1)
+    first_pos = jnp.argmax(found_at, axis=-1)
+    position = jnp.where(any_found, first_pos, 0).astype(jnp.int32)
+
+    yes_prob = jnp.take_along_axis(fused.p_yes, position[:, None], axis=1)[:, 0]
+    no_prob = jnp.take_along_axis(fused.p_no, position[:, None], axis=1)[:, 0]
+    eps = 1e-10
+    denom = yes_prob + no_prob
+    return YesNoScores(
+        yes_prob=yes_prob,
+        no_prob=no_prob,
+        yes_logprob=jnp.log(yes_prob + eps),
+        no_logprob=jnp.log(no_prob + eps),
+        odds_ratio=yes_prob / (no_prob + eps),
+        relative_prob=jnp.where(denom > 0, yes_prob / (denom + eps), jnp.nan),
+        position_found=position,
+        yes_no_found=any_found,
+        generated=fused.generated,
+    )
+
+
 def topk_logprobs(step_logits: jax.Array, k: int = 20, position: int = 0):
     """Top-k (logprob, token_id) at one generated position — fills the D6
     'Log Probabilities' column the API backend got from OpenAI's

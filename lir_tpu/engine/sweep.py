@@ -99,9 +99,6 @@ def run_perturbation_sweep(
                                  encoder_decoder=engine.encoder_decoder)
         for pi, p in enumerate(prompts)
     }
-    digit_ids, digit_vals = tok.integer_token_table(engine.tokenizer)
-    digit_ids_j = jnp.asarray(digit_ids)
-    digit_vals_j = jnp.asarray(digit_vals)
 
     rows: List[schemas.PerturbationRow] = []
     pending_rows: List[schemas.PerturbationRow] = []
@@ -112,24 +109,23 @@ def run_perturbation_sweep(
         pad = [batch[-1]] * (B - n)
         full = list(batch) + pad
 
-        # --- binary format: first-position target-token probabilities
-        gen, step_logits = engine.decode_prompts([c.binary_prompt for c in full])
-
+        # --- binary format: first-position target-token probabilities.
+        # Fused decode: per-step target probs + top-2 + position-0 top-20
+        # captured in-scan, no (B, T, V) logit stack.
         t1 = np.asarray([target_ids[c.prompt_idx][0] for c in full], np.int32)
         t2 = np.asarray([target_ids[c.prompt_idx][1] for c in full], np.int32)
-        res = score_mod.readout_from_step_logits(
-            step_logits, gen, jnp.asarray(t1), jnp.asarray(t2),
-            scan_positions=1)
-        lp_vals, lp_ids = score_mod.topk_logprobs(step_logits, k=20)
+        fused = engine.decode_fused(
+            [c.binary_prompt for c in full], t1, t2)
+        res = score_mod.readout_from_fused(
+            fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
         res, lp_vals, lp_ids, gen_host = jax.device_get(
-            (res, lp_vals, lp_ids, gen))
+            (res, fused.topk_logprobs, fused.topk_ids, fused.generated))
 
         # --- confidence format: decoded integer + weighted E[v]
-        cgen, cstep_logits = engine.decode_prompts(
-            [c.confidence_prompt for c in full])
-        wconf = jax.device_get(score_mod.weighted_confidence(
-            cstep_logits, digit_ids_j, digit_vals_j))
-        cgen_host = jax.device_get(cgen)
+        cfused = engine.decode_fused(
+            [c.confidence_prompt for c in full], t1, t2, with_digits=True)
+        wconf, cgen_host = jax.device_get(
+            (cfused.weighted_confidence, cfused.generated))
 
         for j, cell in enumerate(batch):
             completion = engine.decode_completion(gen_host[j])

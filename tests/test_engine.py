@@ -169,3 +169,56 @@ def test_sharded_greedy_decode():
     np.testing.assert_array_equal(np.asarray(gen), np.asarray(ref_gen))
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
                                atol=1e-3, rtol=1e-3)
+
+
+def test_fused_decode_matches_capture_path():
+    """The fused in-scan readout must equal the full-logit-capture path
+    bit-for-bit on every field the sweeps consume."""
+    from lir_tpu.engine import generate as gen_mod
+    from lir_tpu.engine import score as score_mod
+    from lir_tpu.engine import tokens as tok_mod
+
+    params, cfg, _ = _tiny_llama_params(vocab=FakeTokenizer.VOCAB)
+    tokenizer = FakeTokenizer()
+    prompts = ["Is a cat an animal Yes or No",
+               "Is a rock an animal Yes or No",
+               "some other prompt entirely"]
+    toks, mask = tok_mod.left_pad_batch(tokenizer, prompts, 16)
+    toks_j, mask_j = jnp.asarray(toks), jnp.asarray(mask)
+
+    B = len(prompts)
+    yes_ids = np.full((B,), FakeTokenizer.YES, np.int32)
+    no_ids = np.full((B,), FakeTokenizer.NO, np.int32)
+    digit_ids, digit_vals = tok_mod.integer_token_table(tokenizer)
+
+    gen, step_logits = gen_mod.greedy_decode(params, cfg, toks_j, mask_j,
+                                             max_new_tokens=8)
+    ref = score_mod.readout_from_step_logits(
+        step_logits, gen, jnp.asarray(yes_ids), jnp.asarray(no_ids),
+        scan_positions=8)
+    ref_topk_vals, ref_topk_ids = score_mod.topk_logprobs(step_logits, k=10)
+    ref_wconf = score_mod.weighted_confidence(
+        step_logits, jnp.asarray(digit_ids), jnp.asarray(digit_vals))
+
+    fused = gen_mod.greedy_decode_fused(
+        params, cfg, toks_j, mask_j, jnp.asarray(yes_ids),
+        jnp.asarray(no_ids), jnp.asarray(digit_ids), jnp.asarray(digit_vals),
+        max_new_tokens=8, topk=10)
+    out = score_mod.readout_from_fused(
+        fused, jnp.asarray(yes_ids), jnp.asarray(no_ids), scan_positions=8)
+
+    np.testing.assert_array_equal(np.asarray(out.generated), np.asarray(ref.generated))
+    np.testing.assert_array_equal(np.asarray(out.position_found),
+                                  np.asarray(ref.position_found))
+    np.testing.assert_array_equal(np.asarray(out.yes_no_found),
+                                  np.asarray(ref.yes_no_found))
+    np.testing.assert_allclose(np.asarray(out.yes_prob),
+                               np.asarray(ref.yes_prob), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.no_prob),
+                               np.asarray(ref.no_prob), rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(fused.topk_ids),
+                                  np.asarray(ref_topk_ids))
+    np.testing.assert_allclose(np.asarray(fused.topk_logprobs),
+                               np.asarray(ref_topk_vals), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(fused.weighted_confidence),
+                               np.asarray(ref_wconf), rtol=1e-5)
