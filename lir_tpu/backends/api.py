@@ -99,39 +99,49 @@ def build_batch_requests(
     cells: Sequence[GridCell],
     model: str,
     reasoning_model: bool = False,
+    reasoning_runs: int = 10,
 ) -> Tuple[List[Dict[str, object]], Dict[str, GridCell]]:
     """Expand grid cells into chat-completion batch requests with a
     custom_id -> cell map (perturb_prompts.py:190-269). Binary requests get
-    temperature 0, logprobs top-20; confidence requests are plain."""
+    temperature 0, logprobs top-20; confidence requests are plain.
+    Reasoning models (no logprobs exposed) repeat each binary request
+    ``reasoning_runs`` times; the decoder averages answer counts
+    (REASONING_MODEL_RUNS, perturb_prompts.py:47,220,412-446)."""
     requests: List[Dict[str, object]] = []
     id_map: Dict[str, GridCell] = {}
-    for cell in cells:
-        for fmt, prompt in (
-            ("binary", cell.binary_prompt),
-            ("confidence", cell.confidence_prompt),
-        ):
-            custom_id = f"p{cell.prompt_idx}_r{cell.rephrase_idx}_{fmt}"
-            body: Dict[str, object] = {
-                "model": model,
-                "messages": [{"role": "user", "content": prompt}],
+
+    def add(custom_id: str, cell: GridCell, fmt: str, prompt: str) -> None:
+        body: Dict[str, object] = {
+            "model": model,
+            "messages": [{"role": "user", "content": prompt}],
+        }
+        if reasoning_model:
+            body["max_completion_tokens"] = 2000
+        else:
+            body["temperature"] = 0
+            body["max_tokens"] = 500
+            if fmt == "binary":
+                body["logprobs"] = True
+                body["top_logprobs"] = 20
+        requests.append(
+            {
+                "custom_id": custom_id,
+                "method": "POST",
+                "url": "/v1/chat/completions",
+                "body": body,
             }
-            if reasoning_model:
-                body["max_completion_tokens"] = 2000
-            else:
-                body["temperature"] = 0
-                body["max_tokens"] = 500
-                if fmt == "binary":
-                    body["logprobs"] = True
-                    body["top_logprobs"] = 20
-            requests.append(
-                {
-                    "custom_id": custom_id,
-                    "method": "POST",
-                    "url": "/v1/chat/completions",
-                    "body": body,
-                }
-            )
-            id_map[custom_id] = cell
+        )
+        id_map[custom_id] = cell
+
+    for cell in cells:
+        base = f"p{cell.prompt_idx}_r{cell.rephrase_idx}"
+        if reasoning_model:
+            for run in range(reasoning_runs):
+                add(f"{base}_binary_run{run}", cell, "binary",
+                    cell.binary_prompt)
+        else:
+            add(f"{base}_binary", cell, "binary", cell.binary_prompt)
+        add(f"{base}_confidence", cell, "confidence", cell.confidence_prompt)
     return requests, id_map
 
 
@@ -203,6 +213,7 @@ class ApiScore:
     log_probabilities: str = ""
     confidence_value: Optional[int] = None
     weighted_confidence: Optional[float] = None
+    run_responses: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def odds_ratio(self) -> float:
@@ -260,12 +271,14 @@ def decode_batch_results(
     """Re-key raw batch result objects by custom_id and extract the
     measurement fields (perturb_prompts.py:352-549)."""
     scores: Dict[str, ApiScore] = {}
+    id_pattern = re.compile(r"^(p\d+_r\d+)_(binary(?:_run\d+)?|confidence)$")
     for obj in results:
         custom_id = str(obj.get("custom_id", ""))
-        base_id, _, fmt = custom_id.rpartition("_")
+        m_id = id_pattern.match(custom_id)
         cell = id_map.get(custom_id)
-        if cell is None:
+        if cell is None or m_id is None:
             continue
+        base_id, fmt = m_id.group(1), m_id.group(2)
         body = (
             obj.get("response", {}).get("body", {})
             if isinstance(obj.get("response"), dict)
@@ -289,9 +302,52 @@ def decode_batch_results(
                     for e in (content[0].get("top_logprobs", []) if content else [])
                 }
             )
+        elif fmt.startswith("binary_run"):
+            # Reasoning-model run: counted later in _finalize_reasoning.
+            score.run_responses.append(text.strip())
         else:
             score.confidence_text = text
             m = re.search(r"\b(\d+)\b", text)
             score.confidence_value = int(m.group(1)) if m else None
             score.weighted_confidence = _weighted_confidence(content)
+
+    _finalize_reasoning(scores, id_map)
     return scores
+
+
+def _finalize_reasoning(
+    scores: Dict[str, ApiScore], id_map: Dict[str, GridCell]
+) -> None:
+    """Average answer counts over reasoning runs (perturb_prompts.py:412-446):
+    Token_i_Prob = (runs whose text contains target_i) / n_runs; the stored
+    response is the most common run text."""
+    cells_by_base = {
+        cid.rsplit("_", 2)[0] if "_run" in cid else cid.rsplit("_", 1)[0]: cell
+        for cid, cell in id_map.items()
+    }
+    for base_id, score in scores.items():
+        if not score.run_responses:
+            continue
+        cell = cells_by_base.get(base_id)
+        if cell is None:
+            continue
+        t1, t2 = cell.target_tokens
+        n = len(score.run_responses)
+        # if/elif order preserved from the reference (:423-426): a response
+        # matching both targets (e.g. "Not Covered" contains "Covered")
+        # counts toward token 1 only.
+        c1 = c2 = 0
+        for r in score.run_responses:
+            if t1 in r:
+                c1 += 1
+            elif t2 in r:
+                c2 += 1
+        score.token_1_prob = c1 / n
+        score.token_2_prob = c2 / n
+        score.response_text = max(
+            set(score.run_responses), key=score.run_responses.count
+        )
+        # Reasoning models expose no logprobs; weighted confidence falls
+        # back to the parsed integer (perturb_prompts.py:446).
+        if score.weighted_confidence is None:
+            score.weighted_confidence = score.confidence_value

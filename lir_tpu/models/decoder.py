@@ -183,7 +183,9 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
         cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
         k_all, v_all = ck, cv
     else:
-        ck = cv = None
+        # Prefill/forward: hand back this layer's (post-rope) k/v so prefill
+        # can fill the cache without re-projecting them.
+        ck, cv = k, v
         k_all, v_all = k, v
 
     attn = _attention(q, k_all, v_all, bias, cfg)
@@ -320,21 +322,10 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
         sin, cos = _rope_sincos(positions, cfg.rotary_dim, cfg.rope_theta)
     bias = _causal_bias(attn_mask, positions, cfg)
 
-    # Scan layers, capturing k/v (B, S, K, hd) per layer into a (L, ...) stack.
+    # Scan layers, capturing each block's (post-rope) k/v — returned by
+    # _block itself, no re-projection — into a (L, ...) stack.
     def body(h, lp):
-        h_in = h
-        h_out, _ = _block(h_in, lp, cfg, sin, cos, bias, None, None)
-        # Recompute k/v cheaply for capture: done inside _block normally; to
-        # avoid double compute we inline the projection here.
-        a_in = _norm(h_in, lp["ln1"], cfg)
-        k = jnp.einsum("bsd,de->bse", a_in, lp["wk"])
-        v = jnp.einsum("bsd,de->bse", a_in, lp["wv"])
-        if cfg.qkv_bias:
-            k, v = k + lp["bk"], v + lp["bv"]
-        k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-        v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-        if cfg.pos_embedding == "rotary":
-            k = _apply_rope(k, sin, cos, cfg.rotary_dim)
+        h_out, (k, v) = _block(h, lp, cfg, sin, cos, bias, None, None)
         return h_out, (k, v)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])

@@ -366,3 +366,41 @@ class TestThroughputMeter:
         summary = meter.summary()
         assert summary["n_devices"] == 8
         assert summary["prompts_per_sec_per_chip"] == pytest.approx(10.0)
+
+
+class TestReasoningRuns:
+    def test_run_requests_and_averaging(self):
+        cells = grid_mod.build_grid("o3", LEGAL_PROMPTS[:1], [[]])
+        requests, id_map = api_mod.build_batch_requests(
+            cells, "o3", reasoning_model=True, reasoning_runs=4
+        )
+        # 1 cell -> 4 binary runs + 1 confidence.
+        assert len(requests) == 5
+        run_ids = [r["custom_id"] for r in requests if "_run" in r["custom_id"]]
+        assert len(run_ids) == 4
+
+        # Synthesize results: 3 runs answer "Covered", 1 answers
+        # "Not Covered" (which contains both targets -> counts as token 1
+        # under the reference's if/elif order).
+        results = []
+        answers = ["Covered", "Covered", "Covered", "Not Covered"]
+        for cid, ans in zip(run_ids, answers):
+            results.append({
+                "custom_id": cid,
+                "response": {"body": {"choices": [
+                    {"message": {"content": ans}, "logprobs": None}
+                ]}},
+            })
+        results.append({
+            "custom_id": "p0_r0_confidence",
+            "response": {"body": {"choices": [
+                {"message": {"content": "The answer is 73"}, "logprobs": None}
+            ]}},
+        })
+        scores = api_mod.decode_batch_results(results, id_map)
+        s = scores["p0_r0"]
+        assert s.token_1_prob == pytest.approx(1.0)  # all 4 contain "Covered"
+        assert s.token_2_prob == pytest.approx(0.0)
+        assert s.response_text == "Covered"
+        assert s.confidence_value == 73
+        assert s.weighted_confidence == 73
