@@ -326,3 +326,34 @@ class TestSimulated:
             matched = np.isfinite(vals[gi]).sum()
             if usable[gi]:
                 assert matched >= 8
+
+
+class TestGoldenPins:
+    """Headline numbers pinned from the committed reference data — the
+    ≤1% deviation gate made executable (BASELINE.md north star). All are
+    deterministic point estimates (no bootstrap randomness)."""
+
+    def test_exclusion_pins(self, clean):
+        _, stats = clean
+        assert stats["duration_excluded"] == 0
+        assert stats["identical_excluded"] == 5
+        assert stats["attention_failed"] == 56
+        assert stats["final_count"] == 446
+
+    def test_human_llm_correlation_pin(self, clean, survey, instruct_df, matches):
+        clean_df, _ = clean
+        _, cols = survey
+        h_stats = human_responses_by_question(clean_df, cols)
+        l_stats = llm_responses_by_question(instruct_df)
+        res = human_llm_correlation(h_stats, l_stats, matches, KEY, n_bootstrap=10)
+        assert res["correlation"] == pytest.approx(0.48526, abs=1e-4)
+        assert res["p_value"] == pytest.approx(3.545e-4, rel=1e-2)
+
+    def test_cross_prompt_pins(self, clean, instruct_df, matches):
+        clean_df, _ = clean
+        human = human_cross_prompt_correlations(clean_df, KEY, n_bootstrap=2)
+        assert human["n_pairs"] == 19595
+        assert human["mean_correlation"] == pytest.approx(0.32270, abs=1e-4)
+        llm = llm_cross_prompt_correlations(instruct_df, matches, KEY, n_bootstrap=2)
+        assert llm["n_pairs"] == 140
+        assert llm["mean_correlation"] == pytest.approx(0.029167, abs=1e-4)
