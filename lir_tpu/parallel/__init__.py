@@ -13,3 +13,9 @@ from .ring_attention import (  # noqa: F401
     seq_sharded,
     ulysses_attention,
 )
+from .multihost import (  # noqa: F401
+    barrier,
+    gather_rows,
+    host_shard,
+    is_multiprocess,
+)

@@ -1,0 +1,60 @@
+"""Multi-host result gathering and process coordination.
+
+SURVEY.md §5 names the mechanism for collecting sweep results across hosts:
+``jax.experimental.multihost_utils.process_allgather`` over ICI/DCN — the
+TPU-native replacement for the reference's "download the batch output file"
+step (perturb_prompts.py:332-345). On a single-process run (one host, any
+number of chips) every helper degrades to the identity, so sweep drivers
+call them unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def gather_rows(local_rows: np.ndarray) -> np.ndarray:
+    """All-gather per-host result rows to every host.
+
+    `local_rows`: (n_local, ...) numeric array of this host's scored rows
+    (row order within a host is preserved; hosts are concatenated in
+    process-index order). Single-process: returns the input unchanged.
+    """
+    if not is_multiprocess():
+        return np.asarray(local_rows)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray(local_rows))
+    return np.reshape(gathered, (-1,) + np.asarray(local_rows).shape[1:])
+
+
+def barrier(name: str) -> None:
+    """Synchronize hosts at a named point (e.g. before a manifest flush so
+    one host's resume view can't run ahead of another's writes)."""
+    if not is_multiprocess():
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def host_shard(items, process_index: int | None = None,
+               process_count: int | None = None):
+    """Deterministic round-robin split of a work list across hosts: host i
+    takes items[i::N]. Complementary to gather_rows: every host sweeps its
+    shard, then rows are all-gathered (grid order is restored by the
+    manifest keys, not list position)."""
+    i = jax.process_index() if process_index is None else process_index
+    n = jax.process_count() if process_count is None else process_count
+    return list(items)[i::n]

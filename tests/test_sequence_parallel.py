@@ -105,3 +105,32 @@ def test_ring_matches_ulysses(seq_mesh):
     a = ring_attention(q, k, v, seq_mesh)
     b = ulysses_attention(q, k, v, seq_mesh)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+class TestMultihost:
+    """Single-process degradations of the multi-host helpers (a real
+    multi-process run needs multiple hosts; the sharding math is
+    process-count-parameterized so it is testable here)."""
+
+    def test_gather_identity_single_process(self):
+        from lir_tpu.parallel import gather_rows
+
+        rows = np.arange(12, dtype=np.float32).reshape(4, 3)
+        np.testing.assert_array_equal(gather_rows(rows), rows)
+
+    def test_host_shard_partition(self):
+        from lir_tpu.parallel import host_shard
+
+        items = list(range(10))
+        shards = [host_shard(items, i, 3) for i in range(3)]
+        assert shards[0] == [0, 3, 6, 9]
+        assert shards[1] == [1, 4, 7]
+        assert shards[2] == [2, 5, 8]
+        # Partition: disjoint and complete.
+        merged = sorted(x for s in shards for x in s)
+        assert merged == items
+
+    def test_barrier_noop_single_process(self):
+        from lir_tpu.parallel import barrier
+
+        barrier("test-point")  # must not raise
