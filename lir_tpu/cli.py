@@ -168,6 +168,9 @@ def cmd_rephrase(args) -> None:
 
 
 def cmd_analyze(args) -> None:
+    from .utils.profiling import ensure_cpu_backend
+
+    ensure_cpu_backend()  # host statistics: never run over a tunneled TPU
     ran = False
     if args.perturbation_results:
         from .analysis.perturbation import analyze_all_models
@@ -208,6 +211,9 @@ def cmd_analyze(args) -> None:
 
 
 def cmd_survey(args) -> None:
+    from .utils.profiling import ensure_cpu_backend
+
+    ensure_cpu_backend()  # host statistics: never run over a tunneled TPU
     from .survey.run import run_survey_pipeline
 
     kwargs = {}

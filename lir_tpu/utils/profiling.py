@@ -94,3 +94,21 @@ def device_memory_stats() -> Dict[str, Dict[str, float]]:
             if isinstance(v, (int, float)) and "bytes" in k
         }
     return out
+
+
+def ensure_cpu_backend() -> bool:
+    """Force the CPU backend for statistics-only work.
+
+    The analysis/survey layers are host statistics: tiny kernels where an
+    accelerator buys nothing, and under a tunneled-TPU environment (axon)
+    every launch round-trips over HTTP — orders of magnitude slower than
+    local CPU. Call before any jax computation; returns False when the
+    backend was already initialized to something else (work proceeds there).
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except Exception:
+        return jax.default_backend() == "cpu"
