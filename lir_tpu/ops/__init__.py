@@ -1,0 +1,5 @@
+"""Custom Pallas TPU kernels for ops where XLA's default lowering is
+memory-bound (SURVEY.md §2.5: none were *required* for reference parity;
+flash attention extends the framework's long-context ceiling)."""
+
+from .flash_attention import flash_attention  # noqa: F401

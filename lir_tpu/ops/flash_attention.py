@@ -1,0 +1,128 @@
+"""Pallas flash attention: exact attention without the (S, S) score matrix.
+
+The single-chip complement to parallel/ring_attention.py: within one chip,
+XLA's default attention materializes the (B, H, S, S) score tensor in HBM
+(O(S^2) memory); this kernel streams K/V blocks through VMEM with an online
+softmax, so peak memory is O(S * hd) and the score tile lives entirely
+on-chip. Use when a long sequence fits one chip's weights but not its
+attention scores; shard over the mesh's ``seq`` axis (ring attention) when
+it doesn't.
+
+Layout contract matches models/decoder.py and parallel/ring_attention.py:
+(B, S, H, hd), causal or full. Exact: tested against reference_attention on
+CPU (interpret mode) and on the real chip.
+
+Kernel design (pallas_guide.md patterns):
+  grid = (B, H, S / BLOCK_Q); each program owns one query tile in VMEM and
+  fori_loops over K/V tiles with ``pl.ds`` dynamic slices, carrying the
+  (m, l, acc) online-softmax state as loop values. Causal programs stop at
+  the diagonal block (traced fori_loop bound), so the lower-triangle work is
+  ~halved. Matmuls request fp32 accumulation (preferred_element_type).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
+                  block_q: int, block_k: int, sm_scale: float):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (bq, hd)
+    seq_len = k_ref.shape[2]
+    n_kblocks = seq_len // block_k
+
+    q_pos = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0)[:, 0]
+
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos[:, None] >= k_pos, s, -jnp.inf)
+
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    if causal:
+        # Only blocks at or below this query tile's diagonal contribute.
+        n_iter = lax.min(
+            jnp.int32(n_kblocks),
+            (qi * block_q + block_q + block_k - 1) // block_k,
+        )
+    else:
+        n_iter = n_kblocks
+    m, l, acc = lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def flash_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Exact attention, (B, S, H, hd) layout, O(S*hd) memory.
+
+    S must be divisible by the block sizes (blocks shrink automatically for
+    short sequences). ``interpret=True`` runs the kernel in the Pallas
+    interpreter (CPU tests).
+    """
+    B, S, H, hd = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        raise ValueError(
+            f"seq len {S} must be divisible by blocks ({block_q}, {block_k})"
+        )
+    sm_scale = 1.0 / np.sqrt(hd)
+
+    # Kernel-friendly layout: (B, H, S, hd).
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, block_q=block_q, block_k=block_k,
+        sm_scale=sm_scale)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, hd),
+                               lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2)
