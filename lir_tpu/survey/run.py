@@ -92,12 +92,20 @@ def run_survey_pipeline(
         consolidated.format_report(analysis)
     )
 
-    # C39 — point agreement metrics.
+    # C39 — point agreement metrics + figures.
     log.info("Running human-LLM agreement metrics")
     human_avgs = human_llm.human_averages_from_detailed(detailed, canonical)
     point_results = human_llm.analyze_all_models(human_avgs, instruct_df, base_df)
     human_llm.write_agreement_analysis(
         point_results, human_avgs, out_dir / "llm_human_agreement_analysis.json"
+    )
+    from ..report import survey_figures
+
+    survey_figures.best_worst_agreement_plot(
+        point_results, out_dir / "best_worst_model_agreement.png"
+    )
+    survey_figures.mae_comparison_plot(
+        point_results, out_dir / "model_mae_comparison.png"
     )
 
     # C41 / D9 — question-resampled bootstrap.
@@ -144,6 +152,12 @@ def run_survey_pipeline(
         pv = pvalues_mod.run_pvalue_analysis(instruct_df, base_df, survey_df)
         pvalues_mod.write_pvalue_analysis(
             pv, out_dir / "correlation_pvalues_analysis.json"
+        )
+        from ..report import survey_figures
+
+        survey_figures.correlation_pvalue_panel(
+            pv["llm_correlations"], pv["human_correlations"],
+            out_dir / "correlation_pvalue_distributions.png",
         )
         results["pvalues"] = pv
 
