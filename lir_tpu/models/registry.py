@@ -62,6 +62,12 @@ class ModelConfig:
     tie_embeddings: bool = False
     logit_softcap: Optional[float] = None
 
+    # Attention backend: route full-sequence self-attention through the
+    # Pallas flash kernel (O(S*hd) memory) instead of the dense score
+    # matrix. ALiBi models keep the dense path (the kernel has no additive
+    # bias), as do decode steps and non-block-divisible sequences.
+    use_flash_attention: bool = False
+
     def __post_init__(self) -> None:
         if self.head_dim is None:
             object.__setattr__(self, "head_dim", self.hidden_size // self.n_heads)

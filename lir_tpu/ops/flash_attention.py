@@ -29,17 +29,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
+def _flash_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, *, causal: bool,
                   block_q: int, block_k: int, sm_scale: float):
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (bq, hd)
     seq_len = k_ref.shape[2]
     n_kblocks = seq_len // block_k
+    b = pl.program_id(0)
+    kv_start = start_ref[b, 0]  # leading pad count for this batch row
 
     q_pos = qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0)[:, 0]
@@ -53,10 +56,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
         k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (bq, bk)
+        k_pos = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        valid = k_pos >= kv_start                 # left-pad keys masked out
         if causal:
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s = jnp.where(q_pos[:, None] >= k_pos, s, -jnp.inf)
+            valid = valid & (q_pos[:, None] >= k_pos)
+        s = jnp.where(valid, s, -jnp.inf)
 
         m_new = jnp.maximum(m, s.max(axis=-1))
         alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
@@ -84,12 +89,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
 def flash_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     causal: bool = True,
+    kv_start: jnp.ndarray | None = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Exact attention, (B, S, H, hd) layout, O(S*hd) memory.
 
+    ``kv_start``: optional (B,) int32 count of leading (left-pad) positions
+    per row; keys before it are masked, matching the decoder's left-padded
+    batch convention (pad-position query rows come back 0 and are ignored
+    downstream, exactly like the dense path's uniform-garbage pad rows).
     S must be divisible by the block sizes (blocks shrink automatically for
     short sequences). ``interpret=True`` runs the kernel in the Pallas
     interpreter (CPU tests).
@@ -102,6 +112,8 @@ def flash_attention(
             f"seq len {S} must be divisible by blocks ({block_q}, {block_k})"
         )
     sm_scale = 1.0 / np.sqrt(hd)
+    if kv_start is None:
+        kv_start = jnp.zeros((B,), jnp.int32)
 
     # Kernel-friendly layout: (B, H, S, hd).
     qt = jnp.swapaxes(q, 1, 2)
@@ -116,6 +128,11 @@ def flash_attention(
         kernel,
         grid=(B, H, S // block_q),
         in_specs=[
+            # Per-row pad counts live whole in SMEM (TPU lowering wants
+            # full-array blocks for tiny 2D scalars); programs index by
+            # their batch id.
+            pl.BlockSpec(index_map=lambda b, h, i: (0, 0),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
@@ -124,5 +141,5 @@ def flash_attention(
                                lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
-    )(qt, kt, vt)
+    )(jnp.asarray(kv_start, jnp.int32)[:, None], qt, kt, vt)
     return jnp.swapaxes(out, 1, 2)
