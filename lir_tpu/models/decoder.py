@@ -142,31 +142,33 @@ def alibi_slopes(n_heads: int) -> jax.Array:
 
 def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
                cfg: ModelConfig,
-               kv_start: Optional[jax.Array] = None) -> jax.Array:
+               key_mask: Optional[jax.Array] = None) -> jax.Array:
     """q: (B,S,H,hd); k,v: (B,T,K,hd); bias: (B,H|1,S,T) additive fp32.
 
     With ``cfg.use_flash_attention``, full-sequence self-attention routes
-    through the Pallas flash kernel (left-pad masking via kv_start); decode
-    steps, ALiBi, and non-block-divisible lengths keep the dense path."""
+    through the Pallas flash kernel, masking keys with the batch's actual
+    attention mask (any padding pattern); decode steps, ALiBi, and
+    non-block-divisible lengths keep the dense path."""
     B, S, H, hd = q.shape
     K = k.shape[2]
     if K != H:  # GQA/MQA: repeat kv heads
         k = jnp.repeat(k, H // K, axis=2)
         v = jnp.repeat(v, H // K, axis=2)
 
+    from ..ops.flash_attention import (
+        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_attention,
+    )
+
+    block = max(DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
     flash_ok = (
         cfg.use_flash_attention
-        and kv_start is not None
+        and key_mask is not None
         and k.shape[1] == S
         and cfg.pos_embedding != "alibi"
-        and S >= 128
-        and S % 128 == 0
+        and S % block == 0
     )
     if flash_ok:
-        from ..ops.flash_attention import flash_attention
-
-        out = flash_attention(q, k, v, causal=True, kv_start=kv_start,
-                              block_q=128, block_k=128)
+        out = flash_attention(q, k, v, causal=True, key_mask=key_mask)
         return out.reshape(B, S, H * hd)
 
     scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
@@ -179,7 +181,7 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
 def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
            bias: jax.Array, cache_kv: Optional[Tuple[jax.Array, jax.Array]],
            cache_index: Optional[jax.Array],
-           kv_start: Optional[jax.Array] = None):
+           key_mask: Optional[jax.Array] = None):
     """One transformer block. Returns (new_x, (k_full, v_full))."""
     B, S, _ = x.shape
     H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -210,7 +212,7 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
         ck, cv = k, v
         k_all, v_all = k, v
 
-    attn = _attention(q, k_all, v_all, bias, cfg, kv_start=kv_start)
+    attn = _attention(q, k_all, v_all, bias, cfg, key_mask=key_mask)
     attn = jnp.einsum("bse,ed->bsd", attn, lp["wo"])
     if cfg.attn_out_bias:
         attn = attn + lp["bo"]
@@ -290,14 +292,14 @@ def mask_positions(attn_mask: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def _scan_blocks(params: Params, cfg: ModelConfig, x, sin, cos, bias,
-                 cache=None, cache_index=None, kv_start=None):
+                 cache=None, cache_index=None, key_mask=None):
     """lax.scan over the stacked layer params."""
     def body(carry, xs):
         h = carry
         if cache is None:
             lp = xs
             h, _ = _block(h, lp, cfg, sin, cos, bias, None, None,
-                          kv_start=kv_start)
+                          key_mask=key_mask)
             return h, None
         lp, (ck, cv) = xs
         h, (nk, nv) = _block(h, lp, cfg, sin, cos, bias, (ck, cv), cache_index)
@@ -319,8 +321,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     if cfg.pos_embedding == "rotary":
         sin, cos = _rope_sincos(positions, cfg.rotary_dim, cfg.rope_theta)
     bias = _causal_bias(attn_mask, positions, cfg)
-    kv_start = (tokens.shape[1] - attn_mask.sum(axis=-1)).astype(jnp.int32)
-    x, _ = _scan_blocks(params, cfg, x, sin, cos, bias, kv_start=kv_start)
+    x, _ = _scan_blocks(params, cfg, x, sin, cos, bias, key_mask=attn_mask)
     return _unembed(params, cfg, x)
 
 
@@ -345,13 +346,12 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
     if cfg.pos_embedding == "rotary":
         sin, cos = _rope_sincos(positions, cfg.rotary_dim, cfg.rope_theta)
     bias = _causal_bias(attn_mask, positions, cfg)
-    kv_start = (S - attn_mask.sum(axis=-1)).astype(jnp.int32)
 
     # Scan layers, capturing each block's (post-rope) k/v — returned by
     # _block itself, no re-projection — into a (L, ...) stack.
     def body(h, lp):
         h_out, (k, v) = _block(h, lp, cfg, sin, cos, bias, None, None,
-                               kv_start=kv_start)
+                               key_mask=attn_mask)
         return h_out, (k, v)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])

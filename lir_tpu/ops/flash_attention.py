@@ -9,15 +9,18 @@ attention scores; shard over the mesh's ``seq`` axis (ring attention) when
 it doesn't.
 
 Layout contract matches models/decoder.py and parallel/ring_attention.py:
-(B, S, H, hd), causal or full. Exact: tested against reference_attention on
-CPU (interpret mode) and on the real chip.
+(B, S, H, hd), causal or full, with an optional per-row key validity mask
+(any pattern — masking semantics equal the dense path's additive bias for
+every real-token position; masked-query rows come back 0 and are ignored
+downstream, exactly like the dense path's uniform-garbage pad rows).
 
 Kernel design (pallas_guide.md patterns):
   grid = (B, H, S / BLOCK_Q); each program owns one query tile in VMEM and
   fori_loops over K/V tiles with ``pl.ds`` dynamic slices, carrying the
   (m, l, acc) online-softmax state as loop values. Causal programs stop at
-  the diagonal block (traced fori_loop bound), so the lower-triangle work is
-  ~halved. Matmuls request fp32 accumulation (preferred_element_type).
+  the diagonal block, and the loop starts at the row's first valid key
+  block (both traced fori_loop bounds), so left-pad and upper-triangle work
+  is skipped. Matmuls request fp32 accumulation (preferred_element_type).
 """
 
 from __future__ import annotations
@@ -35,14 +38,14 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _flash_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, *, causal: bool,
-                  block_q: int, block_k: int, sm_scale: float):
+def _flash_kernel(start_ref, mask_ref, q_ref, k_ref, v_ref, o_ref, *,
+                  causal: bool, block_q: int, block_k: int, sm_scale: float):
+    b = pl.program_id(0)
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (bq, hd)
     seq_len = k_ref.shape[2]
     n_kblocks = seq_len // block_k
-    b = pl.program_id(0)
-    kv_start = start_ref[b, 0]  # leading pad count for this batch row
+    first_valid = start_ref[b, 0]  # index of the row's first valid key
 
     q_pos = qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0)[:, 0]
@@ -56,10 +59,11 @@ def _flash_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, *, causal: bool,
         k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (bq, bk)
-        k_pos = j * block_k + lax.broadcasted_iota(
-            jnp.int32, (1, block_k), 1)
-        valid = k_pos >= kv_start                 # left-pad keys masked out
+        kmask = mask_ref[0, 0, pl.ds(j * block_k, block_k)] > 0  # (bk,)
+        valid = kmask[None, :]
         if causal:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
             valid = valid & (q_pos[:, None] >= k_pos)
         s = jnp.where(valid, s, -jnp.inf)
 
@@ -72,15 +76,17 @@ def _flash_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, *, causal: bool,
             p, v, preferred_element_type=jnp.float32)
         return m_new, l, acc
 
+    # Blocks before the row's first valid key contribute nothing; causal
+    # programs additionally stop at their diagonal block.
+    lower = first_valid // block_k
     if causal:
-        # Only blocks at or below this query tile's diagonal contribute.
-        n_iter = lax.min(
+        upper = lax.min(
             jnp.int32(n_kblocks),
             (qi * block_q + block_q + block_k - 1) // block_k,
         )
     else:
-        n_iter = n_kblocks
-    m, l, acc = lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
+        upper = n_kblocks
+    m, l, acc = lax.fori_loop(lower, upper, body, (m0, l0, acc0))
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
 
 
@@ -89,17 +95,17 @@ def _flash_kernel(start_ref, q_ref, k_ref, v_ref, o_ref, *, causal: bool,
 def flash_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     causal: bool = True,
-    kv_start: jnp.ndarray | None = None,
+    key_mask: jnp.ndarray | None = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Exact attention, (B, S, H, hd) layout, O(S*hd) memory.
 
-    ``kv_start``: optional (B,) int32 count of leading (left-pad) positions
-    per row; keys before it are masked, matching the decoder's left-padded
-    batch convention (pad-position query rows come back 0 and are ignored
-    downstream, exactly like the dense path's uniform-garbage pad rows).
+    ``key_mask``: optional (B, S) {0,1} validity mask over key positions —
+    any pattern (left pad, right pad, holes). Equivalent to the dense
+    path's additive key-mask bias for every valid query position; rows of
+    fully-masked queries return 0.
     S must be divisible by the block sizes (blocks shrink automatically for
     short sequences). ``interpret=True`` runs the kernel in the Pallas
     interpreter (CPU tests).
@@ -112,8 +118,12 @@ def flash_attention(
             f"seq len {S} must be divisible by blocks ({block_q}, {block_k})"
         )
     sm_scale = 1.0 / np.sqrt(hd)
-    if kv_start is None:
-        kv_start = jnp.zeros((B,), jnp.int32)
+    if key_mask is None:
+        key_mask = jnp.ones((B, S), jnp.int32)
+    key_mask = jnp.asarray(key_mask, jnp.int32)
+    # First valid key index per row (loop lower bound; 0 when all-masked —
+    # such rows are garbage on every path).
+    first_valid = jnp.argmax(key_mask, axis=-1).astype(jnp.int32)
 
     # Kernel-friendly layout: (B, H, S, hd).
     qt = jnp.swapaxes(q, 1, 2)
@@ -128,11 +138,13 @@ def flash_attention(
         kernel,
         grid=(B, H, S // block_q),
         in_specs=[
-            # Per-row pad counts live whole in SMEM (TPU lowering wants
-            # full-array blocks for tiny 2D scalars); programs index by
-            # their batch id.
+            # Per-row first-valid index: whole (B, 1) array in SMEM (TPU
+            # lowering wants full-array blocks for tiny scalars); programs
+            # index it by their batch id.
             pl.BlockSpec(index_map=lambda b, h, i: (0, 0),
                          memory_space=pltpu.SMEM),
+            # Key mask as (B, 1, S): one (1, 1, S) block per program.
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
@@ -141,5 +153,5 @@ def flash_attention(
                                lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
-    )(jnp.asarray(kv_start, jnp.int32)[:, None], qt, kt, vt)
+    )(first_valid[:, None], key_mask[:, None, :], qt, kt, vt)
     return jnp.swapaxes(out, 1, 2)

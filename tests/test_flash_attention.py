@@ -48,10 +48,42 @@ def test_indivisible_seq_rejected():
         flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
 
 
+def test_masked_attention_matches_reference():
+    """key_mask semantics: masked keys excluded for every padding pattern."""
+    import jax.numpy as jnp
+
+    q, k, v = _qkv(S=128)
+    mask = np.ones((2, 128), np.int32)
+    mask[0, :30] = 0    # left padding
+    mask[1, 100:] = 0   # right padding
+
+    out = flash_attention(q, k, v, causal=True,
+                          key_mask=jnp.asarray(mask), block_q=64, block_k=64,
+                          interpret=True)
+    # Dense reference with the same key-mask + causal semantics.
+    S = 128
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = np.einsum("bqhd,bkhd->bhqk", np.asarray(q), np.asarray(k)) * scale
+    allowed = (np.tril(np.ones((S, S), bool))[None, None]
+               & (mask[:, None, None, :] > 0))
+    s = np.where(allowed, s, -np.inf)
+    with np.errstate(invalid="ignore", over="ignore"):
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = np.where(np.isfinite(s), p, 0.0)
+        denom = p.sum(-1, keepdims=True)
+        p = np.where(denom > 0, p / np.maximum(denom, 1e-30), 0.0)
+    expected = np.einsum("bhqk,bkhd->bqhd", p, np.asarray(v))
+    valid_q = allowed.any(-1)[:, 0]  # queries with at least one valid key
+    np.testing.assert_allclose(
+        np.asarray(out)[valid_q], expected[valid_q], atol=2e-5
+    )
+
+
 def test_decoder_flash_routing_matches_dense():
-    """A flash-enabled decoder forward (left-padded batch) matches the dense
-    path on the real token positions."""
+    """A flash-enabled decoder forward matches the dense path on real token
+    positions, for both left- and right-padded rows."""
     import dataclasses
+    import importlib
 
     from lir_tpu.models import decoder
     from lir_tpu.models.registry import ModelConfig
@@ -65,7 +97,8 @@ def test_decoder_flash_routing_matches_dense():
     S = 128
     toks = jnp.asarray(rng.integers(3, 256, (2, S)), jnp.int32)
     mask = np.ones((2, S), np.int32)
-    mask[0, :17] = 0  # left padding on row 0
+    mask[0, :17] = 0    # left padding on row 0
+    mask[1, 120:] = 0   # right padding on row 1
     mask = jnp.asarray(mask)
 
     dense = decoder.forward(params, cfg, toks, mask)
@@ -73,8 +106,6 @@ def test_decoder_flash_routing_matches_dense():
     # Interpret mode so the kernel runs on CPU under the test harness.
     # (The package re-exports the function under the module's name, so
     # resolve the module itself for monkeypatching.)
-    import importlib
-
     fa = importlib.import_module("lir_tpu.ops.flash_attention")
     orig = fa.flash_attention
 
@@ -82,13 +113,11 @@ def test_decoder_flash_routing_matches_dense():
         kwargs["interpret"] = True
         return orig(*args, **kwargs)
 
-    fa_flash = fa.flash_attention
     try:
         fa.flash_attention = interp
-        import lir_tpu.models.decoder as dec
-        flash = dec.forward(params, cfg_flash, toks, mask)
+        flash = decoder.forward(params, cfg_flash, toks, mask)
     finally:
-        fa.flash_attention = fa_flash
+        fa.flash_attention = orig
 
     # Compare only real-token positions (pad rows are garbage on both
     # paths, by design).
