@@ -42,6 +42,8 @@ def _add_sweep(sub) -> None:
     p.add_argument("--param-cache", type=Path, default=None,
                    help="orbax cache root: convert HF weights once, restore "
                         "fast afterwards")
+    p.add_argument("--int8", action="store_true",
+                   help="weight-only int8 quantization (7B fits one chip)")
 
 
 def _add_perturb(sub) -> None:
@@ -56,6 +58,7 @@ def _add_perturb(sub) -> None:
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--mesh", type=str, default=None)
     p.add_argument("--param-cache", type=Path, default=None)
+    p.add_argument("--int8", action="store_true")
 
 
 def _add_rephrase(sub) -> None:
@@ -118,6 +121,7 @@ def cmd_sweep(args) -> None:
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(batch_size=args.batch_size),
         _parse_mesh(args.mesh), cache_root=args.param_cache,
+        quantize_int8=args.int8,
     )
     run_model_comparison_sweep(
         _parse_models(args.models), factory, args.out,
@@ -135,6 +139,7 @@ def cmd_perturb(args) -> None:
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(batch_size=args.batch_size),
         _parse_mesh(args.mesh), cache_root=args.param_cache,
+        quantize_int8=args.int8,
     )
     entries = load_or_generate_perturbations(
         args.perturbations, LEGAL_PROMPTS, None

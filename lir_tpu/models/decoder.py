@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import ModelConfig
+from .quant import QuantTensor, matmul as _mm
 
 Params = Dict[str, Any]
 
@@ -187,9 +188,9 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
     H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h_attn_in = _norm(x, lp["ln1"], cfg)
-    q = jnp.einsum("bsd,de->bse", h_attn_in, lp["wq"])
-    k = jnp.einsum("bsd,de->bse", h_attn_in, lp["wk"])
-    v = jnp.einsum("bsd,de->bse", h_attn_in, lp["wv"])
+    q = _mm(h_attn_in, lp["wq"])
+    k = _mm(h_attn_in, lp["wk"])
+    v = _mm(h_attn_in, lp["wv"])
     if cfg.qkv_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(B, S, H, hd)
@@ -213,7 +214,7 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
         k_all, v_all = k, v
 
     attn = _attention(q, k_all, v_all, bias, cfg, key_mask=key_mask)
-    attn = jnp.einsum("bse,ed->bsd", attn, lp["wo"])
+    attn = _mm(attn, lp["wo"])
     if cfg.attn_out_bias:
         attn = attn + lp["bo"]
 
@@ -223,15 +224,15 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
         x = x + attn
         mlp_in = _norm(x, lp["ln2"], cfg)
 
-    up = jnp.einsum("bsd,df->bsf", mlp_in, lp["w_up"])
+    up = _mm(mlp_in, lp["w_up"])
     if cfg.mlp_bias:
         up = up + lp["b_up"]
     if cfg.gated_mlp:
-        gate = jnp.einsum("bsd,df->bsf", mlp_in, lp["w_gate"])
+        gate = _mm(mlp_in, lp["w_gate"])
         hidden = _act(gate, cfg.activation) * up
     else:
         hidden = _act(up, cfg.activation)
-    mlp = jnp.einsum("bsf,fd->bsd", hidden, lp["w_down"])
+    mlp = _mm(hidden, lp["w_down"])
     if cfg.mlp_bias:
         mlp = mlp + lp["b_down"]
 
@@ -254,7 +255,11 @@ def _unembed(params: Params, cfg: ModelConfig, x: jax.Array) -> jax.Array:
     if cfg.final_norm:
         x = _norm(x, params["final_ln"], cfg)
     head = params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32), head.astype(jnp.float32))
+    if isinstance(head, QuantTensor):
+        logits = _mm(x.astype(jnp.float32), head)
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                            head.astype(jnp.float32))
     if cfg.logit_softcap:
         logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
     return logits

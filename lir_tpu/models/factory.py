@@ -101,6 +101,7 @@ def load_engine(
     mesh_cfg: Optional[MeshConfig] = None,
     dtype=None,
     cache_root: Optional[Path] = None,
+    quantize_int8: bool = False,
 ) -> ScoringEngine:
     """Build a ready ScoringEngine from a local HF checkpoint directory.
 
@@ -138,6 +139,21 @@ def load_engine(
         if cache_root is not None:
             cache_mod.save_params(cache_root, model_dir.name, params, cfg)
 
+    if quantize_int8 and not encdec:
+        if mesh_cfg is not None and mesh_cfg.n_devices > 1:
+            raise ValueError(
+                "int8 quantization targets single-chip fit; combine with a "
+                "multi-device mesh is unsupported — drop --mesh or --int8"
+            )
+        from . import quant
+
+        before = quant.param_bytes(params)
+        params = quant.quantize_decoder_params(params)
+        log.info(
+            "int8-quantized %s: %.2f GB -> %.2f GB", model_dir.name,
+            before / 2**30, quant.param_bytes(params) / 2**30,
+        )
+
     if not encdec and mesh_cfg is not None and mesh_cfg.n_devices > 1:
         from ..parallel import sharding
 
@@ -161,6 +177,7 @@ def engine_factory(
     runtime: Optional[RuntimeConfig] = None,
     mesh_cfg: Optional[MeshConfig] = None,
     cache_root: Optional[Path] = None,
+    quantize_int8: bool = False,
 ):
     """EngineFactory for engine.multi: maps an HF repo id to
     ``checkpoint_root/<org>__<name>`` or ``checkpoint_root/<name>``."""
@@ -175,7 +192,8 @@ def engine_factory(
         for cand in candidates:
             if cand.is_dir():
                 return load_engine(cand, runtime, mesh_cfg,
-                                   cache_root=cache_root)
+                                   cache_root=cache_root,
+                                   quantize_int8=quantize_int8)
         raise FileNotFoundError(
             f"no local checkpoint for {model_name} under {checkpoint_root} "
             f"(tried {[str(c) for c in candidates]})"

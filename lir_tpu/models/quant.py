@@ -1,0 +1,93 @@
+"""Weight-only int8 quantization for the decoder's linear layers.
+
+The reference's 8-bit mode is bitsandbytes
+``BitsAndBytesConfig(load_in_8bit=True)`` (compare_base_vs_instruct.py:
+431-435), used so a 7B model fits one GPU. The TPU-native equivalent:
+symmetric per-output-channel int8 weights with fp32 scales, dequantized
+inside the matmul (``(x @ q) * scale``) — HBM for the big matrices halves
+versus bf16, so a 7B model (~7 GB int8) fits a single v5e chip without
+tensor parallelism. Activations stay bf16/fp32; the readout's fp32 softmax
+path is unchanged.
+
+A ``QuantTensor`` is a registered pytree node, so quantized layer stacks
+ride ``lax.scan`` (the leading L axis slices both payload and scales) and
+``jax.tree`` utilities transparently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QuantTensor:
+    """Symmetric per-output-channel int8 weight: w ≈ q * scale.
+
+    q: int8, original shape (..., D_in, D_out); scale: fp32 (..., D_out).
+    """
+
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def dequant(self, dtype=jnp.float32) -> jax.Array:
+        return (self.q.astype(dtype) * self.scale[..., None, :].astype(dtype))
+
+
+def quantize(w: jax.Array) -> QuantTensor:
+    """Quantize a (..., D_in, D_out) weight to int8 with per-output-column
+    scales (amax / 127, zero-safe)."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(w.astype(jnp.float32) / scale[..., None, :]), -127, 127
+    ).astype(jnp.int8)
+    return QuantTensor(q=q, scale=scale)
+
+
+def matmul(x: jax.Array, w) -> jax.Array:
+    """x @ w for dense or QuantTensor weights: (..., D_in) x (D_in, D_out).
+
+    Weight-only dequant happens on the narrow output side:
+    (x @ q) * scale == x @ (q * scale) for per-output-column scales.
+    """
+    if isinstance(w, QuantTensor):
+        y = jnp.einsum("...d,de->...e", x, w.q.astype(x.dtype))
+        return y * w.scale.astype(x.dtype)
+    return jnp.einsum("...d,de->...e", x, w)
+
+
+# The per-layer matrices worth quantizing (biases/norms stay dense).
+_LAYER_MATRICES = ("wq", "wk", "wv", "wo", "w_up", "w_gate", "w_down")
+
+
+def quantize_decoder_params(params: Params) -> Params:
+    """Quantize the big linear weights of a converted decoder param tree
+    (stacked layer matrices + lm_head); everything else passes through."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in _LAYER_MATRICES:
+        if name in layers:
+            layers[name] = quantize(layers[name])
+    out["layers"] = layers
+    if "lm_head" in params:
+        out["lm_head"] = quantize(params["lm_head"])
+    return out
+
+
+def param_bytes(params) -> int:
+    """Total payload bytes of a param tree (QuantTensor-aware)."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total
