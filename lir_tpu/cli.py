@@ -85,6 +85,23 @@ def _add_analyze(sub) -> None:
     p.add_argument("--n-simulations", type=int, default=100_000)
 
 
+def _add_repro(sub) -> None:
+    p = sub.add_parser(
+        "repro",
+        help="regenerate the full published analysis from a reference-style "
+             "data directory (D1/D2/D3 CSVs) in one shot",
+    )
+    p.add_argument("--data", type=Path, required=True,
+                   help="directory holding model_comparison_results.csv, "
+                        "instruct_model_comparison_results.csv, "
+                        "word_meaning_survey_results.csv")
+    p.add_argument("--perturbation-results", type=Path, default=None,
+                   help="optional D6 workbook for the perturbation suite")
+    p.add_argument("--out", type=Path, default=Path("results/repro"))
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--no-figures", action="store_true")
+
+
 def _add_survey(sub) -> None:
     p = sub.add_parser("survey", help="human-survey analysis pipeline")
     p.add_argument("--survey", type=Path, required=True)
@@ -215,6 +232,52 @@ def cmd_analyze(args) -> None:
         sys.exit(2)
 
 
+def cmd_repro(args) -> None:
+    """Survey pipeline + every CSV-driven analysis in one pass."""
+    from .utils.profiling import ensure_cpu_backend
+
+    ensure_cpu_backend()
+    from .analysis.base_vs_instruct import run_base_vs_instruct_analysis
+    from .analysis.model_graph import run_model_graph_analysis
+    from .survey.run import run_survey_pipeline
+
+    data = args.data
+    base_csv = data / "model_comparison_results.csv"
+    instruct_csv = data / "instruct_model_comparison_results.csv"
+    survey_csv = data / "word_meaning_survey_results.csv"
+    figures = not args.no_figures
+
+    kwargs = {}
+    if args.quick:
+        kwargs = dict(n_bootstrap_standard=50, n_bootstrap_small=20,
+                      n_bootstrap_large=200)
+    run_survey_pipeline(
+        survey_csv, instruct_csv,
+        base_csv if base_csv.exists() else None,
+        args.out / "survey", **kwargs,
+    )
+    if base_csv.exists():
+        run_base_vs_instruct_analysis(
+            base_csv, args.out / "base_vs_instruct", make_figures=figures)
+    run_model_graph_analysis(
+        instruct_csv, args.out / "model_graph",
+        n_bootstrap=50 if args.quick else 1000, make_figures=figures)
+    if args.perturbation_results:
+        from .analysis.kappa_combined import run_kappa_analysis
+        from .analysis.perturbation import analyze_all_models
+
+        analyze_all_models(
+            args.perturbation_results, args.out / "perturbation",
+            n_simulations=2000 if args.quick else 100_000,
+            make_figures=figures,
+        )
+        run_kappa_analysis(
+            instruct_csv, args.perturbation_results, args.out / "kappa",
+            n_bootstrap=100 if args.quick else 1000, make_figures=figures,
+        )
+    log.info("repro complete; artifacts under %s", args.out)
+
+
 def cmd_survey(args) -> None:
     from .utils.profiling import ensure_cpu_backend
 
@@ -243,6 +306,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     _add_perturb(sub)
     _add_rephrase(sub)
     _add_analyze(sub)
+    _add_repro(sub)
     _add_survey(sub)
     sub.add_parser("bench", help="prompts/sec/chip benchmark")
 
@@ -252,6 +316,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         "perturb": cmd_perturb,
         "rephrase": cmd_rephrase,
         "analyze": cmd_analyze,
+        "repro": cmd_repro,
         "survey": cmd_survey,
         "bench": cmd_bench,
     }[args.command](args)
