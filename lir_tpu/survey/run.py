@@ -116,6 +116,9 @@ def run_survey_pipeline(
     d9_payload = human_llm.bootstrap_results_payload(
         boot_results, keys[2], n_bootstrap_standard, n_bootstrap_large
     )
+    # Matched-pairs analysis (reference stdout, :392-444) rides along in the
+    # D9 JSON under an extra key — consumers read model_results only.
+    d9_payload["matched_pairs"] = human_llm.matched_pairs_analysis(boot_results)
     human_llm.write_bootstrap_results(
         d9_payload, out_dir / "llm_human_agreement_bootstrap.json"
     )
