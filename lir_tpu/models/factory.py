@@ -27,7 +27,6 @@ from ..config import MeshConfig, RuntimeConfig
 from ..engine.runner import ScoringEngine
 from ..utils.logging import get_logger
 from . import loader
-from .registry import T5Config
 
 log = get_logger(__name__)
 

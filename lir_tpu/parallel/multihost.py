@@ -10,8 +10,6 @@ call them unconditionally.
 
 from __future__ import annotations
 
-from typing import Any
-
 import jax
 import numpy as np
 

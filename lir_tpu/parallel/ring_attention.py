@@ -23,9 +23,6 @@ axis sharded over ``seq``.
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np

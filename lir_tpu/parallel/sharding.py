@@ -22,7 +22,7 @@ replicated attention + sharded MLP rather than failing.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import numpy as np

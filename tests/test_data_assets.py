@@ -1,7 +1,6 @@
 """Data-asset integrity: the experiment stimuli match the reference study."""
 
 import pandas as pd
-import pytest
 
 from lir_tpu.data import (
     LEGAL_PROMPTS,

@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from scipy import stats as scipy_stats
 from sklearn.metrics import cohen_kappa_score
 

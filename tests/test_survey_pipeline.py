@@ -4,8 +4,6 @@ committed reference data (D2/D3) — the free regression fixtures of
 SURVEY.md §4.
 """
 
-import json
-
 import jax
 import numpy as np
 import pandas as pd
