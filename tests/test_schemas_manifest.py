@@ -169,3 +169,54 @@ def test_atomic_write(tmp_path):
     import json
 
     assert json.loads(path.read_text()) == {"a": 2}
+
+
+def _demo_row():
+    from lir_tpu.data.schemas import PerturbationRow
+
+    return PerturbationRow(
+        model="m", original_main="o", response_format="rf",
+        confidence_format="cf", rephrased_main="r",
+        full_rephrased_prompt="frp", full_confidence_prompt="fcp",
+        model_response="Covered", model_confidence_response="85",
+        log_probabilities="{}", token_1_prob=0.8, token_2_prob=0.2,
+        confidence_value=85, weighted_confidence=84.2)
+
+
+def test_append_schema_mismatch_backs_up(tmp_path):
+    """Column drift between runs: the old artifact is backed up, never
+    silently merged (perturb_prompts.py:994-1006)."""
+    import pandas as pd
+
+    from lir_tpu.data import schemas
+
+    path = tmp_path / "results.csv"
+    pd.DataFrame({"wrong": [1], "columns": [2]}).to_csv(path, index=False)
+    schemas.write_perturbation_results([_demo_row()], path, append=True)
+
+    backup = tmp_path / "results_backup.csv"
+    assert backup.exists()
+    assert list(pd.read_csv(backup).columns) == ["wrong", "columns"]
+    fresh = pd.read_csv(path)
+    assert list(fresh.columns) == list(schemas.PERTURBATION_COLUMNS)
+    assert len(fresh) == 1
+
+
+def test_append_corrupt_file_writes_sidecar(tmp_path):
+    """A truncated/corrupt prior artifact is left in place; new rows land in
+    a _new sidecar, and later flushes append to it (perturb_prompts.py:
+    1007-1011 semantics)."""
+    import pandas as pd
+
+    from lir_tpu.data import schemas
+
+    path = tmp_path / "results.csv"
+    path.write_bytes(b"\x00\x01 not a csv \xff")
+    schemas.write_perturbation_results([_demo_row()], path, append=True)
+    sidecar = tmp_path / "results_new.csv"
+    assert sidecar.exists()
+    assert path.read_bytes().startswith(b"\x00\x01")  # original untouched
+    assert len(pd.read_csv(sidecar)) == 1
+
+    schemas.write_perturbation_results([_demo_row()], path, append=True)
+    assert len(pd.read_csv(sidecar)) == 2  # second flush appended
