@@ -113,12 +113,18 @@ def run_model_comparison_sweep(
         engine: Optional[ScoringEngine] = None
         try:
             engine = engine_factory(spec.name)
+            fmt = format_for(spec, sweep_kind)
             with meter.measure(), trace(f"sweep/{spec.name.split('/')[-1]}"):
                 rows = run_word_meaning_sweep(
-                    engine, spec.name, spec.base_or_instruct,
-                    questions, format_for(spec, sweep_kind),
+                    engine, spec.name, spec.base_or_instruct, questions, fmt,
                 )
-            meter.add(len(rows))
+            # Token accounting — the counters the reference priced into
+            # dollars (perturb_prompts.py:1021-1066) feed throughput here.
+            tokens_in = sum(
+                len(engine.tokenizer(fmt(q)).input_ids) for q in questions
+            )
+            meter.add(len(rows), tokens_in=tokens_in,
+                      tokens_out=len(rows) * engine.rt.max_new_tokens)
             n_found = sum(r.yes_no_found for r in rows)
             per_model[spec.name] = {
                 "rows": len(rows),
