@@ -46,6 +46,15 @@ def reference_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def _repeat_kv(q, k, v):
+    """Repeat K/V heads up to the query head count (GQA/MQA callers)."""
+    H, K = q.shape[2], k.shape[2]
+    if K != H:
+        k = jnp.repeat(k, H // K, axis=2)
+        v = jnp.repeat(v, H // K, axis=2)
+    return k, v
+
+
 def _ring_kernel(q, k, v, q_index, axis_name: str, axis_size: int,
                  causal: bool):
     """Per-device ring body. q/k/v: (B, Sl, H, hd) local shards; q_index is
@@ -96,10 +105,11 @@ def ring_attention(
 ) -> jnp.ndarray:
     """Exact attention with the sequence axis sharded over `axis_name`.
 
-    q/k/v: (B, S, H, hd) GLOBAL shapes (S divisible by the axis size). GQA
-    callers repeat K/V heads to H before entry. Returns (B, S, H, hd) with
-    the same sharding as q.
+    q/k/v: (B, S, H, hd) GLOBAL shapes (S divisible by the axis size).
+    GQA/MQA K/V (fewer heads than q) are repeated internally. Returns
+    (B, S, H, hd) with the same sharding as q.
     """
+    k, v = _repeat_kv(q, k, v)
     axis_size = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
 
@@ -124,6 +134,7 @@ def ulysses_attention(
     Requires H % axis_size == 0. Same global layout contract as
     ring_attention.
     """
+    k, v = _repeat_kv(q, k, v)
     axis_size = mesh.shape[axis_name]
     H = q.shape[2]
     if H % axis_size != 0:

@@ -134,3 +134,17 @@ class TestMultihost:
         from lir_tpu.parallel import barrier
 
         barrier("test-point")  # must not raise
+
+
+def test_ring_attention_gqa_repeat(seq_mesh):
+    """K/V with fewer heads than q are repeated internally (GQA)."""
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(2, 64, 8, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32)
+    k_full = jnp.repeat(k, 4, axis=2)
+    v_full = jnp.repeat(v, 4, axis=2)
+    expected = reference_attention(q, k_full, v_full, causal=True)
+    out = ring_attention(q, k, v, seq_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5)
