@@ -85,6 +85,37 @@ def quantize_decoder_params(params: Params) -> Params:
     return out
 
 
+def random_quantized_params(cfg, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random param tree at FULL size with the big matrices born int8.
+
+    For real-size throughput/fit work (a 7B tree) the bf16 intermediate of
+    init_params -> quantize would transiently double HBM; here each
+    QuantTensor is generated directly (int8 payload + constant scale), so
+    peak memory is the final int8 footprint. Layout matches
+    decoder.init_params exactly (quantize_decoder_params of it would give
+    the same tree structure)."""
+    from . import decoder
+
+    shapes = jax.eval_shape(lambda k: decoder.init_params(cfg, k, dtype=dtype),
+                            key)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+    quant_names = set(_LAYER_MATRICES) | {"lm_head"}
+
+    leaves = []
+    for i, (path, leaf) in enumerate(flat):
+        leaf_key = jax.random.fold_in(key, i)
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in quant_names:
+            q = jax.random.randint(leaf_key, leaf.shape, -127, 128, jnp.int8)
+            scale = jnp.full(leaf.shape[:-2] + leaf.shape[-1:],
+                             0.02 / 127.0, jnp.float32)
+            leaves.append(QuantTensor(q=q, scale=scale))
+        else:
+            leaves.append((0.02 * jax.random.normal(leaf_key, leaf.shape))
+                          .astype(leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def param_bytes(params) -> int:
     """Total payload bytes of a param tree (QuantTensor-aware)."""
     total = 0

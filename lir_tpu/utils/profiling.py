@@ -71,6 +71,57 @@ class ThroughputMeter:
         }
 
 
+# Published peak dense-matmul throughput per chip (bf16 FLOPS). Weight-only
+# int8 still computes in bf16 on the MXU, so bf16 peak is the MFU denominator
+# for every mode this framework runs. Keys are jax Device.device_kind strings.
+CHIP_PEAK_BF16_FLOPS = {
+    "TPU v5 lite": 197e12,      # v5e
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,      # v6e / Trillium
+}
+
+
+def chip_peak_flops(device=None) -> Optional[float]:
+    """Peak bf16 FLOPS of the given (default: first) device, or None when
+    the chip kind is unknown (e.g. CPU) — callers skip the MFU gate then."""
+    if device is None:
+        device = jax.devices()[0]
+    return CHIP_PEAK_BF16_FLOPS.get(getattr(device, "device_kind", ""))
+
+
+def decoder_matmul_params(cfg) -> int:
+    """Matmul-visible parameter count of one ModelConfig decoder: the per-layer
+    linear weights plus the lm_head. Embedding lookups do no matmul FLOPs."""
+    D, hd = cfg.hidden_size, cfg.head_dim
+    H, K, F = cfg.n_heads, cfg.n_kv_heads, cfg.intermediate_size
+    per_layer = (D * H * hd          # wq
+                 + 2 * D * K * hd    # wk, wv
+                 + H * hd * D        # wo
+                 + 2 * D * F         # w_up, w_down
+                 + (D * F if cfg.gated_mlp else 0))
+    return cfg.n_layers * per_layer + D * cfg.vocab_size  # + lm_head
+
+
+def scoring_step_flops(cfg, batch: int, seq: int, new_tokens: int) -> float:
+    """Total matmul FLOPs (2 per MAC) of one fused scoring step: prefill of
+    (batch, seq) + `new_tokens` KV-cached greedy decode steps. The lm_head
+    runs once at the prefill's last position and once per decode step
+    (decoder.prefill/_unembed). Attention score/value matmuls included."""
+    D, hd = cfg.hidden_size, cfg.head_dim
+    H, L, V = cfg.n_heads, cfg.n_layers, cfg.vocab_size
+    p_layers = decoder_matmul_params(cfg) - D * V
+    head = 2 * D * V * batch
+    prefill = 2 * p_layers * batch * seq + head
+    prefill += 4 * batch * H * seq * seq * hd * L      # scores + weighted sum
+    decode = 0.0
+    for t in range(new_tokens):
+        decode += 2 * p_layers * batch + head
+        decode += 4 * batch * H * (seq + t + 1) * hd * L
+    return float(prefill + decode)
+
+
 @contextlib.contextmanager
 def trace(name: str) -> Iterator[None]:
     """Named jax.profiler annotation (visible in captured device traces)."""
