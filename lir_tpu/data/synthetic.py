@@ -1,0 +1,89 @@
+"""Deterministic synthetic D6 fixture for differential-parity testing.
+
+The reference's perturbation workbook (D6, `combined_results.xlsx`,
+perturb_prompts.py:964-1016) is a *generated* artifact — the upstream repo
+commits only D1-D4, so no real D6 exists to test against. For differential
+parity (running the reference's own `calculate_cohens_kappa.py` and our
+`analysis/` pipeline on IDENTICAL inputs and diffing the outputs) we need a
+D6 whose values are fixed forever: this module generates one from a pinned
+seed with numpy only, so the tools/ capture script and the tests/ diff both
+reconstruct byte-identical values.
+
+The synthetic rows use the five real legal prompts (data/prompts.py — the
+keyword matcher in calculate_cohens_kappa.py:230-241 matches on their text)
+with per-prompt yes-lean levels spanning the kappa interpretation bands, so
+the diff exercises agree_percent/self-kappa over a meaningful range.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import List
+
+import numpy as np
+import pandas as pd
+
+from .prompts import LEGAL_PROMPTS
+from .schemas import PERTURBATION_COLUMNS
+
+SYNTH_SEED = 20260730
+N_REPHRASINGS = 200          # per prompt (reference scale ~2000; 200 keeps
+                             # the fixture fast while self-kappa stays stable)
+SYNTH_MODEL = "synthetic-scorer-v1"
+
+# Per-prompt P(token_1 wins): spans near-coin-flip to near-unanimous.
+_YES_LEAN = (0.55, 0.72, 0.38, 0.9, 0.65)
+
+
+def synthetic_perturbation_frame() -> pd.DataFrame:
+    """The deterministic D6 dataframe (binary-format rows only — the kappa
+    path consumes Token_1/2_Prob; confidence columns carry E[v] draws)."""
+    rng = np.random.default_rng(SYNTH_SEED)
+    records: List[dict] = []
+    for prompt, lean in zip(LEGAL_PROMPTS, _YES_LEAN):
+        for i in range(N_REPHRASINGS):
+            # Relative prob drawn around the lean with clipping to (0, 1).
+            rel = float(np.clip(rng.normal(lean, 0.18), 1e-3, 1 - 1e-3))
+            total = float(rng.uniform(0.7, 0.99))
+            t1, t2 = rel * total, (1 - rel) * total
+            conf = float(np.clip(rng.normal(70, 15), 0, 100))
+            logprobs = {prompt.target_tokens[0]: float(np.log(t1)),
+                        prompt.target_tokens[1]: float(np.log(t2))}
+            records.append({
+                "Model": SYNTH_MODEL,
+                "Original Main Part": prompt.main,
+                "Response Format": prompt.response_format,
+                "Confidence Format": prompt.confidence_format,
+                "Rephrased Main Part": f"[rephrasing {i}] {prompt.main}",
+                "Full Rephrased Prompt": prompt.rephrased_binary(
+                    f"[rephrasing {i}] {prompt.main}"),
+                "Full Confidence Prompt": prompt.rephrased_confidence(
+                    f"[rephrasing {i}] {prompt.main}"),
+                "Model Response": prompt.target_tokens[0] if rel > 0.5
+                else prompt.target_tokens[1],
+                "Model Confidence Response": str(int(round(conf))),
+                "Log Probabilities": json.dumps(logprobs),
+                "Token_1_Prob": t1,
+                "Token_2_Prob": t2,
+                "Odds_Ratio": t1 / t2,
+                "Confidence Value": float(int(round(conf))),
+                "Weighted Confidence": conf,
+            })
+    return pd.DataFrame(records, columns=list(PERTURBATION_COLUMNS))
+
+
+def write_synthetic_d6(path: Path) -> Path:
+    """Write the fixture as .xlsx (falling back to .csv without openpyxl);
+    returns the path actually written."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    df = synthetic_perturbation_frame()
+    if path.suffix == ".xlsx":
+        try:
+            df.to_excel(path, index=False)
+            return path
+        except (ImportError, ModuleNotFoundError):
+            path = path.with_suffix(".csv")
+    df.to_csv(path, index=False)
+    return path

@@ -115,6 +115,29 @@ _RESAMPLED_CORR_JIT = {
 }
 
 
+def _masked_corr_matrix_f64(x: np.ndarray, method: str) -> np.ndarray:
+    """Pairwise-complete correlation matrix in float64 (pandas-compatible
+    down to rank-tie handling); host numpy — this is the tiny deterministic
+    point estimate, not the bootstrap hot path."""
+    from scipy.stats import rankdata
+
+    n = x.shape[1]
+    out = np.full((n, n), np.nan)
+    for i in range(n):
+        for j in range(i, n):
+            m = np.isfinite(x[:, i]) & np.isfinite(x[:, j])
+            if int(m.sum()) < 2:
+                continue
+            xi, xj = x[m, i], x[m, j]
+            if method == "spearman":
+                xi, xj = rankdata(xi), rankdata(xj)
+            dx, dy = xi - xi.mean(), xj - xj.mean()
+            denom = np.sqrt((dx * dx).sum() * (dy * dy).sum())
+            if denom > 0:
+                out[i, j] = out[j, i] = float((dx * dy).sum() / denom)
+    return out
+
+
 def _pair_values(matrix: np.ndarray) -> np.ndarray:
     iu = np.triu_indices(matrix.shape[0], k=1)
     vals = matrix[iu]
@@ -134,10 +157,15 @@ def bootstrap_correlation_matrix(
 
     `pivot` is (n_prompts, n_models), NaN allowed.
     """
-    x = jnp.asarray(np.asarray(pivot, dtype=np.float64))
-    corr_fn = masked_pearson_matrix if method == "pearson" else masked_spearman_matrix
+    x64 = np.asarray(pivot, dtype=np.float64)
+    x = jnp.asarray(x64)
 
-    original = np.asarray(corr_fn(x))
+    # The deterministic point matrix is computed host-side in float64:
+    # jnp downcasts to f32 (x64 off), and for Spearman an f32-collapsed tie
+    # can flip ranks vs pandas' f64 path — the executed-reference diff
+    # (tests/test_reference_differential.py) caught exactly that. The
+    # bootstrap resamples stay on-device in f32 (CI-level quantities).
+    original = _masked_corr_matrix_f64(x64, method)
     original_vals = _pair_values(original)
 
     idx = resample_indices(key, n_bootstrap, x.shape[0])

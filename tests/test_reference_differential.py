@@ -1,0 +1,282 @@
+"""Differential parity against the EXECUTED reference (VERDICT r1 #2).
+
+tools/reference_differential.py ran the reference's own analysis scripts
+(model_comparison_graph.py, calculate_cohens_kappa.py,
+survey_analysis_consolidated.py, analyze_llm_agreement_simple_bootstrap.py)
+on the committed data CSVs + the pinned synthetic D6 + our regenerated D7,
+capturing every numeric artifact into tests/golden/reference_executed.json.
+These tests recompute the same quantities with lir_tpu's pipelines from the
+IDENTICAL inputs and diff them under the BASELINE ≤1% gate (deterministic
+point estimates) or a CI-width tolerance (bootstrap quantities — the two
+sides use different RNGs by design; SURVEY.md §7 hard part 6).
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+GOLDEN_PATH = Path(__file__).parent / "golden" / "reference_executed.json"
+KEY = jax.random.PRNGKey(7)
+
+REL = 0.01          # the ≤1% gate for deterministic point estimates
+BOOT_ABS = 0.03     # |Δ| tolerance for independently-resampled bootstrap means
+CI_ABS = 0.06       # |Δ| tolerance for CI endpoints
+
+
+@pytest.fixture(scope="module")
+def golden():
+    if not GOLDEN_PATH.exists():
+        pytest.skip("run tools/reference_differential.py first")
+    return json.loads(GOLDEN_PATH.read_text())
+
+
+@pytest.fixture(scope="module")
+def instruct_df(reference_data_dir):
+    df = pd.read_csv(f"{reference_data_dir}/instruct_model_comparison_results.csv")
+    df = df[~df["model"].str.contains("opt-iml-1.3b")]
+    return df[~df["model"].str.contains("mistral", case=False)]
+
+
+def _close(a, b, rel=REL, abs_tol=0.0):
+    a, b = float(a), float(b)
+    if np.isnan(a) and np.isnan(b):
+        return True
+    return abs(a - b) <= max(abs_tol, rel * abs(b))
+
+
+# ---------------------------------------------------------------------------
+# model_comparison_graph.py — correlation suite + aggregate kappa
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("method", ["pearson", "spearman"])
+def test_correlation_suite_vs_executed_reference(golden, instruct_df, method):
+    from lir_tpu.stats import bootstrap_correlation_matrix
+
+    ref = golden["model_comparison_graph"][method]
+    pivot = instruct_df.pivot_table(
+        index="prompt", columns="model", values="relative_prob")
+    pivot = pivot[ref["models"]]            # reference column order
+    res = bootstrap_correlation_matrix(
+        pivot.values, KEY, n_bootstrap=500, method=method)
+
+    # Deterministic point estimates: the ≤1% gate.
+    assert _close(res["mean_correlation"], ref["mean_correlation"], abs_tol=1e-4)
+    assert _close(res["median_correlation"], ref["median_correlation"], abs_tol=1e-4)
+    assert _close(res["std_correlation"], ref["std_correlation"], abs_tol=1e-4)
+    assert _close(res["min_correlation"], ref["min_correlation"], abs_tol=1e-4)
+    assert _close(res["max_correlation"], ref["max_correlation"], abs_tol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(res["correlation_matrix"]),
+        np.asarray(ref["correlation_matrix"]), rtol=REL, atol=1e-6)
+    # Bootstrap CIs: different resampling RNGs -> width-level tolerance.
+    for lo_hi, ours in (("mean_ci", res["mean_ci"]),
+                        ("median_ci", res["median_ci"])):
+        assert _close(ours[0], ref[lo_hi][0], abs_tol=CI_ABS)
+        assert _close(ours[1], ref[lo_hi][1], abs_tol=CI_ABS)
+
+
+def test_aggregate_kappa_vs_executed_reference(golden, instruct_df):
+    from lir_tpu.stats import aggregate_kappa
+
+    ref = golden["model_comparison_graph"]["aggregate_kappa"]
+    pivot = instruct_df.pivot_table(
+        index="prompt", columns="model", values="relative_prob")
+    binary = (pivot.dropna() > 0.5).astype(int).values
+    res = aggregate_kappa(binary, KEY, n_boot=1000)
+
+    assert res["n_models"] == int(ref["n_models"])
+    assert _close(res["aggregate_kappa"], ref["aggregate_kappa"], abs_tol=1e-6)
+    assert _close(res["observed_agreement"], ref["observed_agreement"], abs_tol=1e-6)
+    assert _close(res["chance_agreement"], ref["chance_agreement"], abs_tol=1e-6)
+    assert _close(res["kappa_ci_lower"], ref["kappa_ci_lower"], abs_tol=CI_ABS)
+    assert _close(res["kappa_ci_upper"], ref["kappa_ci_upper"], abs_tol=CI_ABS)
+
+
+# ---------------------------------------------------------------------------
+# calculate_cohens_kappa.py — two-source kappa combiner on identical inputs
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def kappa_run(reference_data_dir, tmp_path_factory):
+    from lir_tpu.analysis.kappa_combined import run_kappa_analysis
+    from lir_tpu.data import synthetic
+
+    out = tmp_path_factory.mktemp("kappa")
+    d6 = synthetic.write_synthetic_d6(out / "combined_results.csv")
+    return run_kappa_analysis(
+        Path(reference_data_dir) / "instruct_model_comparison_results.csv",
+        d6, out, n_bootstrap=1000, make_figures=False)
+
+
+def test_perturbation_self_kappa_vs_executed_reference(golden, kappa_run):
+    ref = pd.DataFrame(golden["calculate_cohens_kappa"]["perturbation_kappa_metrics"])
+    ours = kappa_run["perturbation_kappa"].set_index("prompt")
+    ref = ref.set_index("prompt")
+    assert set(ours.index) == set(ref.index)
+    for prompt in ref.index:
+        r, o = ref.loc[prompt], ours.loc[prompt]
+        assert int(o["n_variations"]) == int(r["n_variations"])
+        # agree_percent is deterministic on identical inputs: exact-ish.
+        assert _close(o["agree_percent"], r["agree_percent"], abs_tol=1e-9)
+        # self-kappa: 1000 independent bootstrap pairs on each side. The
+        # statistic's expectation is ~0 by construction (unpaired samples);
+        # both sides must land in the same tight band. On near-constant
+        # decisions sklearn's cohen_kappa_score is 0/0 -> the executed
+        # reference records NaN (its degenerate-input behavior); ours
+        # defines those resamples as 0 — accept a finite near-zero value.
+        if np.isnan(r["self_kappa"]):
+            assert abs(float(o["self_kappa"])) < 0.05
+        else:
+            assert _close(o["self_kappa"], r["self_kappa"], abs_tol=0.02)
+
+
+def test_model_agree_percent_vs_executed_reference(golden, kappa_run):
+    """agree_percent/n_models per word-meaning prompt match the executed
+    reference. Its avg_pairwise_kappa is NaN for every prompt (the
+    single-observation cohen_kappa_score defect, calculate_cohens_kappa.py:
+    124-127, executed and confirmed) — a documented defect-to-fix, so our
+    real-valued kappa column is intentionally NOT diffed against it."""
+    ref = pd.DataFrame(golden["calculate_cohens_kappa"]["model_kappa_metrics"])
+    assert ref["avg_pairwise_kappa"].isna().all()  # the defect, as executed
+    ours = kappa_run["model_kappa"].set_index("prompt")
+    ref = ref.set_index("prompt")
+    shared = set(ours.index) & set(ref.index)
+    assert len(shared) == len(ref)
+    for prompt in shared:
+        assert int(ours.loc[prompt, "n_models"]) == int(ref.loc[prompt, "n_models"])
+        assert _close(ours.loc[prompt, "agree_percent"],
+                      ref.loc[prompt, "agree_percent"], abs_tol=1e-9)
+
+
+def test_combined_kappa_prompt_matching_vs_executed_reference(golden, kappa_run):
+    """The keyword matcher must select the same legal-prompt titles from the
+    same two datasets as the executed reference."""
+    ref = pd.DataFrame(golden["calculate_cohens_kappa"]["combined_kappa_results"])
+    ours = kappa_run["combined_frame"]
+    assert set(ours["Prompt"]) == set(ref["Prompt"])
+    ref = ref.set_index("Prompt")
+    ours = ours.set_index("Prompt")
+    for title in ref.index:
+        # Perturbation-side kappa feeding the combination: same tight band
+        # (NaN in the executed reference = its degenerate constant-decision
+        # behavior; ours is defined as ~0 there).
+        r = float(ref.loc[title, "Perturbation Kappa"])
+        o = float(ours.loc[title, "Perturbation Kappa"])
+        if np.isnan(r):
+            assert abs(o) < 0.05
+        else:
+            assert _close(o, r, abs_tol=0.02)
+
+
+# ---------------------------------------------------------------------------
+# survey_analysis_consolidated.py — full survey pipeline on identical inputs
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def survey_run(reference_data_dir, tmp_path_factory):
+    from lir_tpu.survey.run import run_survey_pipeline
+
+    out = tmp_path_factory.mktemp("survey")
+    run_survey_pipeline(
+        Path(reference_data_dir) / "word_meaning_survey_results.csv",
+        Path(reference_data_dir) / "instruct_model_comparison_results.csv",
+        Path(reference_data_dir) / "model_comparison_results.csv",
+        out, n_bootstrap_standard=300, n_bootstrap_small=100,
+        n_bootstrap_large=1000, run_simulated_individuals=False)
+    return {
+        "consolidated": json.loads(
+            (out / "consolidated_analysis_results.json").read_text()),
+        "bootstrap": json.loads(
+            (out / "llm_human_agreement_bootstrap.json").read_text()),
+    }
+
+
+def test_exclusion_stats_vs_executed_reference(golden, survey_run):
+    ref = golden["survey_consolidated"]["exclusion_stats"]
+    ours = survey_run["consolidated"]["exclusion_stats"]
+    for k in ("attention_failed", "duration_excluded", "identical_excluded",
+              "final_count", "total_excluded"):
+        assert int(ours[k]) == int(ref[k]), k
+    assert _close(ours["median_duration"], ref["median_duration"], abs_tol=1e-9)
+
+
+def test_question_matching_vs_executed_reference(golden, survey_run):
+    ref = golden["survey_consolidated"]["matching_stats"]
+    ours = survey_run["consolidated"]["matching_stats"]
+    assert ours["n_matched"] == ref["n_matched"] == 50
+    assert ours["matches"] == ref["matches"]
+
+
+def test_human_llm_correlation_vs_executed_reference(golden, survey_run):
+    ref = golden["survey_consolidated"]["human_llm_correlation"]
+    ours = survey_run["consolidated"]["human_llm_correlation"]
+    assert ours["n_questions"] == ref["n_questions"]
+    assert _close(ours["correlation"], ref["correlation"])
+    assert _close(ours["p_value"], ref["p_value"], rel=0.05)
+    assert _close(ours["ci_lower"], ref["ci_lower"], abs_tol=CI_ABS)
+    assert _close(ours["ci_upper"], ref["ci_upper"], abs_tol=CI_ABS)
+
+
+def test_per_item_agreement_vs_executed_reference(golden, survey_run):
+    for side in ("human", "llm"):
+        ref = golden["survey_consolidated"]["per_item_agreement"][side]
+        ours = survey_run["consolidated"]["per_item_agreement"][side]
+        assert ours["n_items"] == ref["n_items"]
+        assert _close(ours["overall_mean"], ref["overall_mean"])
+        assert _close(ours["overall_std"], ref["overall_std"], rel=0.05)
+
+
+def test_meta_correlation_vs_executed_reference(golden, survey_run):
+    ref = golden["survey_consolidated"]["meta_correlation"]
+    ours = survey_run["consolidated"]["meta_correlation"]
+    assert ours["n_matched_items"] == ref["n_matched_items"]
+    assert _close(ours["correlation"], ref["correlation"], abs_tol=1e-4)
+    assert _close(ours["human_mean_agreement"], ref["human_mean_agreement"])
+    assert _close(ours["llm_mean_agreement"], ref["llm_mean_agreement"])
+
+
+def test_cross_prompt_correlations_vs_executed_reference(golden, survey_run):
+    ref = golden["survey_consolidated"]["cross_prompt_correlations"]
+    ours = survey_run["consolidated"]["cross_prompt_correlations"]
+    for side in ("human", "llm"):
+        assert ours[side]["n_pairs"] == ref[side]["n_pairs"]
+        assert _close(ours[side]["mean_correlation"],
+                      ref[side]["mean_correlation"], abs_tol=1e-6)
+    assert _close(ours["difference"]["mean_difference"],
+                  ref["difference"]["mean_difference"], abs_tol=BOOT_ABS)
+
+
+# ---------------------------------------------------------------------------
+# analyze_llm_agreement_simple_bootstrap.py — D9 on identical inputs
+# ---------------------------------------------------------------------------
+
+def test_bootstrap_agreement_vs_executed_reference(golden, survey_run):
+    ref_models = {r["model"]: r for r in
+                  golden["llm_human_agreement_bootstrap"]["model_results"]}
+    our_models = {r["model"]: r for r in
+                  survey_run["bootstrap"]["model_results"]}
+    assert set(our_models) == set(ref_models)
+    for name, ref in ref_models.items():
+        ours = our_models[name]
+        assert ours["model_type"] == ref["model_type"]
+        # Bootstrap means concentrate around the deterministic full-sample
+        # metric; both sides must agree to BOOT_ABS despite different RNGs.
+        assert _close(ours["mae_mean"], ref["mae_mean"], abs_tol=BOOT_ABS)
+        assert _close(ours["pearson_r_mean"], ref["pearson_r_mean"],
+                      abs_tol=2 * BOOT_ABS)
+
+
+def test_overall_comparison_vs_executed_reference(golden, survey_run):
+    ref = golden["llm_human_agreement_bootstrap"]["overall_comparison"]
+    ours = survey_run["bootstrap"]["overall_comparison"]
+    assert ours["base_models_count"] == ref["base_models_count"]
+    assert ours["instruct_models_count"] == ref["instruct_models_count"]
+    for metric in ("mae",):
+        r, o = ref["metrics"][metric], ours["metrics"][metric]
+        assert _close(o["base_mean"], r["base_mean"], abs_tol=BOOT_ABS)
+        assert _close(o["instruct_mean"], r["instruct_mean"], abs_tol=BOOT_ABS)
+        assert _close(o["difference"], r["difference"], abs_tol=2 * BOOT_ABS)
