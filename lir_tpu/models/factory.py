@@ -139,11 +139,6 @@ def load_engine(
             cache_mod.save_params(cache_root, model_dir.name, params, cfg)
 
     if quantize_int8 and not encdec:
-        if mesh_cfg is not None and mesh_cfg.n_devices > 1:
-            raise ValueError(
-                "int8 quantization targets single-chip fit; combine with a "
-                "multi-device mesh is unsupported — drop --mesh or --int8"
-            )
         from . import quant
 
         before = quant.param_bytes(params)

@@ -106,15 +106,37 @@ def decoder_param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
     return specs
 
 
+def quant_scale_spec(spec: P) -> P:
+    """Spec for a QuantTensor's per-output-channel scale, derived from the
+    dense weight's spec: keep the leading (layer-stack) axes, keep the OUTPUT
+    axis. Column-parallel weights (output axis sharded on 'model') get
+    model-sharded scales; row-parallel weights (input axis sharded) have
+    per-output scales that are replicated — exactly the bitsandbytes-on-
+    multi-GPU composition the reference ran (compare_base_vs_instruct.py:
+    424-435: load_in_8bit + device_map='auto')."""
+    return P(*spec[:-2], spec[-1])
+
+
 def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
-    """device_put every param with its NamedSharding (single host)."""
+    """device_put every param with its NamedSharding (single host).
+
+    int8 trees compose: a QuantTensor's payload takes the dense weight's
+    spec, its scale the derived output-axis spec (quant_scale_spec)."""
+    from ..models.quant import QuantTensor
+
     specs = decoder_param_specs(cfg, mesh)
 
     def place(leaf, spec):
+        if isinstance(leaf, QuantTensor):
+            return QuantTensor(
+                q=jax.device_put(leaf.q, NamedSharding(mesh, spec)),
+                scale=jax.device_put(
+                    leaf.scale, NamedSharding(mesh, quant_scale_spec(spec))),
+            )
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree.map(place, params, specs,
-                        is_leaf=lambda x: isinstance(x, P))
+                        is_leaf=lambda x: isinstance(x, QuantTensor))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

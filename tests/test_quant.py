@@ -90,12 +90,15 @@ class TestQuantizedDecoder:
         assert all(np.isfinite(r.yes_prob) for r in rows)
 
 
-def test_factory_int8_mesh_conflict(tmp_path):
+def test_factory_int8_mesh_composes(tmp_path):
+    """int8 + multi-device mesh is a supported combination now (VERDICT r1
+    #6; the reference composed 8-bit with multi-device placement,
+    compare_base_vs_instruct.py:424-435). The factory no longer rejects it —
+    with no checkpoint on disk only FileNotFoundError remains."""
     from lir_tpu.config import MeshConfig
     from lir_tpu.models.factory import load_engine
 
-    with pytest.raises((ValueError, FileNotFoundError)):
-        # Either the conflict check or the missing checkpoint fires first;
-        # with a real checkpoint the conflict check is what callers see.
-        load_engine(tmp_path, mesh_cfg=MeshConfig(data=1, model=8),
+    with pytest.raises(OSError):  # AutoConfig: no checkpoint at the path
+        load_engine(tmp_path / "nonexistent",
+                    mesh_cfg=MeshConfig(data=1, model=8),
                     quantize_int8=True)
