@@ -332,21 +332,14 @@ def _finalize_reasoning(
         if cell is None:
             continue
         t1, t2 = cell.target_tokens
-        n = len(score.run_responses)
-        # if/elif order preserved from the reference (:423-426): a response
-        # matching both targets (e.g. "Not Covered" contains "Covered")
-        # counts toward token 1 only.
-        c1 = c2 = 0
-        for r in score.run_responses:
-            if t1 in r:
-                c1 += 1
-            elif t2 in r:
-                c2 += 1
-        score.token_1_prob = c1 / n
-        score.token_2_prob = c2 / n
-        score.response_text = max(
-            set(score.run_responses), key=score.run_responses.count
-        )
+        # Shared with the local sampled scorer (engine/score.py) so the two
+        # reasoning paths cannot drift on the if/elif counting order or the
+        # most-common tie-break.
+        from ..engine.score import count_averaged_responses
+
+        (score.token_1_prob, score.token_2_prob,
+         score.response_text) = count_averaged_responses(
+            score.run_responses, t1, t2)
         # Reasoning models expose no logprobs; weighted confidence falls
         # back to the parsed integer (perturb_prompts.py:446).
         if score.weighted_confidence is None:

@@ -57,6 +57,19 @@ class RuntimeConfig:
     max_seq_len: int = 1024           # legal prompt + format ≲ 700 tokens (SURVEY §5)
     remat: bool = False               # jax.checkpoint the blocks for big models
 
+    # Perturbation-sweep decode budget. The sweep's numeric readouts consume
+    # ONLY position 0 (Token_1/2_Prob, top-20 map, E[v] — perturb_prompts.py:
+    # 474-526), so by default each binary cell decodes a few tokens instead
+    # of the full `max_new_tokens`=50 — a ~10x cut in decode-step compute.
+    # The confidence call keeps a larger budget: its *parsed* integer may sit
+    # several tokens into a verbose reply ("I am about 85% sure"), and a
+    # truncated decode would silently null 'Confidence Value'.
+    # `sweep_full_completions=True` restores 50-token 'Model Response' /
+    # 'Model Confidence Response' text parity with the reference.
+    sweep_decode_tokens: int = 4
+    sweep_confidence_tokens: int = 16
+    sweep_full_completions: bool = False
+
 
 @dataclasses.dataclass(frozen=True)
 class PerturbationConfig:

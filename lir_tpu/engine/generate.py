@@ -146,26 +146,42 @@ def sample_decode(params, cfg: ModelConfig, tokens: jax.Array,
     rephrases with temperature 0.9 via the Anthropic API,
     perturb_prompts.py:799-809; here the sampler runs on the local model).
 
+    ``key`` is either one PRNG key (a fresh subkey per step; a row's draws
+    then depend on its batch position) or per-row keys shaped (B, 2) — each
+    row gets its own stream folded per step, so a row's sample depends ONLY
+    on its key, not on which batch it rides in (resume-deterministic
+    reasoning sweeps key rows by grid-cell identity).
+
     Returns generated (B, max_new_tokens) int32. Per-step logits are not
     captured — rephrasings need text only, and dropping the (B, T, V) stack
     keeps HBM free for long sample runs."""
     B, S = tokens.shape
     T = S + max_new_tokens
+    per_row = key.ndim == 2
     logits0, cache, pos0 = decoder.prefill(params, cfg, tokens, attn_mask, T)
     cache_mask0 = jnp.pad(attn_mask, ((0, 0), (0, max_new_tokens)))
 
     def step(carry, xs):
         logits, cache, cache_mask = carry
         t, step_key = xs
-        nxt = jax.random.categorical(
-            step_key, logits / jnp.maximum(temperature, 1e-6), axis=-1
-        ).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        if per_row:
+            nxt = jax.vmap(jax.random.categorical)(step_key, scaled)
+        else:
+            nxt = jax.random.categorical(step_key, scaled, axis=-1)
+        nxt = nxt.astype(jnp.int32)
         cache_mask = cache_mask.at[:, S + t].set(1)
         new_logits, cache = decoder.decode_step(
             params, cfg, cache, nxt, pos0 + t, S + t, cache_mask)
         return (new_logits, cache, cache_mask), nxt
 
-    keys = jax.random.split(key, max_new_tokens)
+    if per_row:
+        # (T, B, 2): row b's stream at step t = fold_in(keys[b], t).
+        keys = jax.vmap(
+            lambda t: jax.vmap(lambda k: jax.random.fold_in(k, t))(key)
+        )(jnp.arange(max_new_tokens))
+    else:
+        keys = jax.random.split(key, max_new_tokens)
     (_, _, _), gen = lax.scan(
         step, (logits0, cache, cache_mask0),
         (jnp.arange(max_new_tokens), keys))

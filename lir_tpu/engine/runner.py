@@ -25,6 +25,14 @@ from ..config import RuntimeConfig
 from . import generate, score, tokens as tok
 
 
+def _tail_batch(n: int, cap: int) -> int:
+    """Smallest power of two >= n, capped at the configured batch size."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
 @dataclasses.dataclass
 class PromptScore:
     """One prompt's raw measurement. Sweep drivers wrap this into
@@ -40,6 +48,20 @@ class PromptScore:
     relative_prob: float
     position_found: int
     yes_no_found: bool
+
+
+@dataclasses.dataclass
+class SampledScore:
+    """n-run count-averaged measurement (reasoning-model mode,
+    perturb_prompts.py:412-446): probabilities are answer-count fractions,
+    not logit softmaxes."""
+
+    prompt: str
+    response: str               # most common run text
+    all_responses: List[str]
+    token_1_prob: float
+    token_2_prob: float
+    odds_ratio: float
 
 
 class ScoringEngine:
@@ -82,38 +104,37 @@ class ScoringEngine:
         """Tokenize once, left-pad into the smallest fitting bucket, run one
         jitted greedy decode. Returns (generated (B, T_new) int32,
         step_logits (B, T_new, V) fp32)."""
-        ids_list = [self.tokenizer(p).input_ids for p in prompts]
-        bucket = tok.pick_bucket([len(i) for i in ids_list], self.buckets)
-        toks_arr, mask = tok.left_pad_ids(ids_list, bucket,
-                                          tok.pad_token_id(self.tokenizer))
+        toks, mask = self._pad_batch(prompts)
         if self.encoder_decoder:
             return generate.t5_greedy_decode(
-                self.params, self.cfg, jnp.asarray(toks_arr), jnp.asarray(mask),
+                self.params, self.cfg, toks, mask,
                 max_new_tokens=self.rt.max_new_tokens)
         return generate.greedy_decode(
-            self.params, self.cfg, jnp.asarray(toks_arr), jnp.asarray(mask),
+            self.params, self.cfg, toks, mask,
             max_new_tokens=self.rt.max_new_tokens)
 
     def decode_fused(self, prompts: Sequence[str], yes_ids: np.ndarray,
-                     no_ids: np.ndarray, with_digits: bool = False):
+                     no_ids: np.ndarray, with_digits: bool = False,
+                     max_new_tokens: Optional[int] = None):
         """The production scoring path: one jitted decode with the C13/D6
         readouts fused into the scan (no (B, T, V) logit stack). Decoder-only
-        models only; T5 keeps the capture path (tiny vocab stacks)."""
+        models only; T5 keeps the capture path (tiny vocab stacks).
+
+        ``max_new_tokens`` overrides the runtime default (the perturbation
+        sweep passes its short per-cell budget, config.RuntimeConfig)."""
         assert not self.encoder_decoder
-        ids_list = [self.tokenizer(p).input_ids for p in prompts]
-        bucket = tok.pick_bucket([len(i) for i in ids_list], self.buckets)
-        toks_arr, mask = tok.left_pad_ids(ids_list, bucket,
-                                          tok.pad_token_id(self.tokenizer))
+        toks, mask = self._pad_batch(prompts)
         if with_digits:
             digit_ids, digit_vals = self.digit_table
         else:
             digit_ids = np.zeros((0,), np.int32)
             digit_vals = np.zeros((0,), np.float32)
         return generate.greedy_decode_fused(
-            self.params, self.cfg, jnp.asarray(toks_arr), jnp.asarray(mask),
+            self.params, self.cfg, toks, mask,
             jnp.asarray(yes_ids, jnp.int32), jnp.asarray(no_ids, jnp.int32),
             jnp.asarray(digit_ids), jnp.asarray(digit_vals),
-            max_new_tokens=self.rt.max_new_tokens)
+            max_new_tokens=(self.rt.max_new_tokens if max_new_tokens is None
+                            else max_new_tokens))
 
     def decode_completion(self, generated_ids: np.ndarray) -> str:
         """Token ids -> text, stopping at the first EOS (HF generate parity —
@@ -122,7 +143,90 @@ class ScoringEngine:
         trimmed = tok.trim_at_eos(np.asarray(generated_ids).tolist(), self.eos_id)
         return self.tokenizer.decode(trimmed, skip_special_tokens=True).strip()
 
+    def _pad_batch(self, prompts: Sequence[str]) -> Tuple[jax.Array, jax.Array]:
+        """Tokenize + left-pad into the smallest fitting bucket."""
+        ids_list = [self.tokenizer(p).input_ids for p in prompts]
+        bucket = tok.pick_bucket([len(i) for i in ids_list], self.buckets)
+        toks_arr, mask = tok.left_pad_ids(ids_list, bucket,
+                                          tok.pad_token_id(self.tokenizer))
+        return jnp.asarray(toks_arr), jnp.asarray(mask)
+
+    def _sample_from_ids(self, toks: jax.Array, mask: jax.Array,
+                         key: jax.Array, temperature: float,
+                         max_new_tokens: Optional[int]) -> List[str]:
+        gen = generate.sample_decode(
+            self.params, self.cfg, toks, mask, key, temperature=temperature,
+            max_new_tokens=(self.rt.max_new_tokens if max_new_tokens is None
+                            else max_new_tokens))
+        gen = np.asarray(jax.device_get(gen))
+        return [self.decode_completion(gen[j]) for j in range(gen.shape[0])]
+
+    def sample_completions(self, prompts: Sequence[str], key: jax.Array,
+                           temperature: float = 1.0,
+                           max_new_tokens: Optional[int] = None) -> List[str]:
+        """One temperature-sampled completion per prompt (single jitted
+        call; same bucketing as the greedy paths)."""
+        toks, mask = self._pad_batch(prompts)
+        return self._sample_from_ids(toks, mask, key, temperature,
+                                     max_new_tokens)
+
     # -- public API ---------------------------------------------------------
+
+    def score_prompts_sampled(
+        self, prompts: Sequence[str],
+        target_texts: Sequence[Tuple[str, str]],
+        n_runs: int = 10, key: Optional[jax.Array] = None,
+        temperature: float = 1.0,
+        max_new_tokens: Optional[int] = None,
+    ) -> List[SampledScore]:
+        """Reasoning-model scoring: n sampled runs per prompt, answer-count
+        averaging (VERDICT r1 #7; perturb_prompts.py:412-446 locally).
+
+        ``key`` may be per-prompt keys shaped (B, 2): each prompt then owns
+        its PRNG stream, so results do not depend on batch composition (the
+        sweep keys rows by grid-cell identity -> resume-deterministic).
+
+        The reference's reasoning models expose no logprobs, so it samples
+        each binary prompt REASONING_MODEL_RUNS times (API default
+        temperature) and sets Token_i_Prob = (runs whose text contains
+        target_i) / n_runs, if/elif order — a text containing both targets
+        (e.g. "Not Covered" contains "Covered") counts toward token 1 only;
+        the stored response is the most common run text. Runs loop outside
+        jit on purpose: vmapping the decode over runs would multiply the KV
+        cache by n_runs (a 7B batch-32 cache is ~4.5 GB — x10 cannot fit
+        HBM); each run reuses the same compiled sample_decode executable.
+        """
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        per_row = getattr(key, "ndim", 1) == 2   # (B, 2): per-prompt streams
+        all_runs: List[List[str]] = [[] for _ in prompts]
+        # Tokenize/pad ONCE; only the PRNG key varies across runs.
+        toks, mask = self._pad_batch(prompts)
+        for run in range(n_runs):
+            if per_row:
+                run_key = jax.vmap(
+                    lambda k: jax.random.fold_in(k, run))(key)
+            else:
+                run_key = jax.random.fold_in(key, run)
+            texts = self._sample_from_ids(
+                toks, mask, run_key, temperature, max_new_tokens)
+            for j, t in enumerate(texts):
+                all_runs[j].append(t.strip())
+
+        out: List[SampledScore] = []
+        for j, prompt in enumerate(prompts):
+            t1, t2 = target_texts[j]
+            p1, p2, most_common = score.count_averaged_responses(
+                all_runs[j], t1, t2)
+            out.append(SampledScore(
+                prompt=prompt,
+                response=most_common,
+                all_responses=list(all_runs[j]),
+                token_1_prob=p1,
+                token_2_prob=p2,
+                odds_ratio=(p1 / p2) if p2 > 0 else float("inf"),
+            ))
+        return out
 
     def score_prompts(self, prompts: Sequence[str]) -> List[PromptScore]:
         """Score every prompt; one jitted call per full batch."""
@@ -140,7 +244,10 @@ class ScoringEngine:
     def _score_batch(self, batch_prompts: List[str]) -> List[PromptScore]:
         n = len(batch_prompts)
         B = self.rt.batch_size
-        padded_prompts = batch_prompts + [batch_prompts[-1]] * (B - n)
+        # Tail bucket: pad to the next power of two, not the full B (at most
+        # one extra compile; stops re-scoring the last prompt B-n times).
+        bsz = B if n == B else _tail_batch(n, B)
+        padded_prompts = batch_prompts + [batch_prompts[-1]] * (bsz - n)
 
         if self.encoder_decoder:
             gen, step_logits = self.decode_prompts(padded_prompts)
@@ -148,8 +255,8 @@ class ScoringEngine:
                 step_logits, gen, jnp.int32(self.yes_id),
                 jnp.int32(self.no_id), scan_positions=self.rt.scan_positions)
         else:
-            yes_ids = np.full((B,), self.yes_id, np.int32)
-            no_ids = np.full((B,), self.no_id, np.int32)
+            yes_ids = np.full((bsz,), self.yes_id, np.int32)
+            no_ids = np.full((bsz,), self.no_id, np.int32)
             fused = self.decode_fused(padded_prompts, yes_ids, no_ids)
             res = score.readout_from_fused(
                 fused, jnp.asarray(yes_ids), jnp.asarray(no_ids),

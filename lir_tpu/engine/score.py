@@ -113,6 +113,30 @@ def readout_from_fused(fused, yes_ids: jax.Array, no_ids: jax.Array,
     )
 
 
+def count_averaged_responses(runs, target_1: str, target_2: str):
+    """Reasoning-model answer-count averaging (perturb_prompts.py:412-446),
+    shared by the local sampled scorer and the API batch decoder so the two
+    paths cannot drift.
+
+    if/elif order preserved from the reference (:423-426): a response
+    containing BOTH targets (e.g. "Not Covered" contains "Covered") counts
+    toward target 1 only. Returns (p1, p2, most_common_response) where the
+    most-common pick is deterministic (first-seen wins ties — max(set(...))
+    would depend on string hashing).
+    """
+    from collections import Counter
+
+    n = len(runs)
+    c1 = c2 = 0
+    for r in runs:
+        if target_1 in r:
+            c1 += 1
+        elif target_2 in r:
+            c2 += 1
+    most_common = Counter(runs).most_common(1)[0][0] if runs else ""
+    return (c1 / n if n else 0.0, c2 / n if n else 0.0, most_common)
+
+
 def topk_logprobs(step_logits: jax.Array, k: int = 20, position: int = 0):
     """Top-k (logprob, token_id) at one generated position — fills the D6
     'Log Probabilities' column the API backend got from OpenAI's

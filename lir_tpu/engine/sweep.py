@@ -27,7 +27,7 @@ from ..utils.manifest import SweepManifest
 from . import grid as grid_mod
 from . import score as score_mod
 from . import tokens as tok
-from .runner import ScoringEngine
+from .runner import ScoringEngine, _tail_batch
 
 log = get_logger(__name__)
 
@@ -53,15 +53,33 @@ def run_word_meaning_sweep(
     return rows
 
 
-def _parse_confidence(text: str) -> Optional[int]:
-    """First integer in the response (perturb_prompts.py:500-502)."""
+def _parse_confidence(text: str, complete: bool = True) -> Optional[int]:
+    """First integer in the response (perturb_prompts.py:500-502).
+
+    ``complete=False`` marks a decode that hit its token budget without
+    emitting EOS: an integer whose digits touch the end of such text may be
+    cut mid-number ("...about 85" truncated to "...about 8"), so it is
+    rejected (None) rather than silently recorded wrong. An integer followed
+    by more text is always safe.
+    """
     m = re.search(r"\b(\d+)\b", text)
     if m is None:
+        return None
+    if not complete and m.end() == len(text.rstrip()):
         return None
     try:
         return int(m.group(1))
     except ValueError:
         return None
+
+
+def _decode_complete(generated_row: np.ndarray, eos_id) -> bool:
+    """True when the fixed-length decode emitted EOS (the reply finished
+    inside the budget). Tokenizers without EOS can't signal completion;
+    treat their output as complete (legacy behavior)."""
+    if eos_id is None:
+        return True
+    return bool(np.any(np.asarray(generated_row) == eos_id))
 
 
 def run_perturbation_sweep(
@@ -70,6 +88,7 @@ def run_perturbation_sweep(
     results_path: Path, manifest: Optional[SweepManifest] = None,
     subset_size: Optional[int] = None, seed: int = 42,
     checkpoint_every: int = CHECKPOINT_EVERY,
+    reasoning: bool = False, reasoning_runs: int = 10,
 ) -> List[schemas.PerturbationRow]:
     """Run (or resume) the perturbation grid for one model, writing D6 rows.
 
@@ -83,6 +102,12 @@ def run_perturbation_sweep(
     - Confidence value = first integer in the decoded confidence response;
       Weighted Confidence = E[v] over integer tokens in [0,100] at the first
       confidence position.
+
+    ``reasoning=True`` is the local reasoning-model mode (REASONING_MODEL_
+    RUNS, perturb_prompts.py:47,412-446): each binary prompt is sampled
+    ``reasoning_runs`` times and Token_i_Prob becomes the answer-count
+    fraction (runner.score_prompts_sampled); Weighted Confidence equals the
+    parsed confidence integer (:459-464) and no logprob map is stored.
     """
     results_path = schemas.resolve_results_path(results_path)
     manifest = manifest or SweepManifest(
@@ -103,11 +128,37 @@ def run_perturbation_sweep(
     rows: List[schemas.PerturbationRow] = []
     pending_rows: List[schemas.PerturbationRow] = []
     B = engine.rt.batch_size
+    # Only position 0 feeds the D6 readouts; decode just enough tokens for
+    # the confidence integer / leading response text unless full-completion
+    # parity is requested (config.RuntimeConfig.sweep_decode_tokens).
+    # Reasoning mode ignores these budgets on purpose: its models emit
+    # chain-of-thought BEFORE the answer, so every sampled run gets the full
+    # max_new_tokens (the reference gives them max_completion_tokens=2000,
+    # perturb_prompts.py:249-252).
+    new_tokens = (engine.rt.max_new_tokens if engine.rt.sweep_full_completions
+                  else min(engine.rt.sweep_decode_tokens,
+                           engine.rt.max_new_tokens))
+    conf_tokens = (engine.rt.max_new_tokens
+                   if engine.rt.sweep_full_completions
+                   else min(engine.rt.sweep_confidence_tokens,
+                            engine.rt.max_new_tokens))
     for start in range(0, len(todo), B):
         batch = todo[start:start + B]
         n = len(batch)
-        pad = [batch[-1]] * (B - n)
-        full = list(batch) + pad
+        # Tail bucket: pad to the next power of two instead of the full B —
+        # at most one extra compile per sweep, and the final bucket stops
+        # re-scoring batch[-1] up to B-1 times (VERDICT r1 weak #6).
+        bsz = B if n == B else _tail_batch(n, B)
+        full = list(batch) + [batch[-1]] * (bsz - n)
+
+        if reasoning:
+            pending_rows, rows = _reasoning_batch(
+                engine, model_name, prompts, batch, full, seed,
+                reasoning_runs, pending_rows, rows)
+            if len(pending_rows) >= checkpoint_every:
+                _flush(pending_rows, results_path, manifest)
+                pending_rows = []
+            continue
 
         # --- binary format: first-position target-token probabilities.
         # Fused decode: per-step target probs + top-2 + position-0 top-20
@@ -115,7 +166,7 @@ def run_perturbation_sweep(
         t1 = np.asarray([target_ids[c.prompt_idx][0] for c in full], np.int32)
         t2 = np.asarray([target_ids[c.prompt_idx][1] for c in full], np.int32)
         fused = engine.decode_fused(
-            [c.binary_prompt for c in full], t1, t2)
+            [c.binary_prompt for c in full], t1, t2, max_new_tokens=new_tokens)
         res = score_mod.readout_from_fused(
             fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
         res, lp_vals, lp_ids, gen_host = jax.device_get(
@@ -123,13 +174,18 @@ def run_perturbation_sweep(
 
         # --- confidence format: decoded integer + weighted E[v]
         cfused = engine.decode_fused(
-            [c.confidence_prompt for c in full], t1, t2, with_digits=True)
+            [c.confidence_prompt for c in full], t1, t2, with_digits=True,
+            max_new_tokens=conf_tokens)
         wconf, cgen_host = jax.device_get(
             (cfused.weighted_confidence, cfused.generated))
 
         for j, cell in enumerate(batch):
             completion = engine.decode_completion(gen_host[j])
             conf_text = engine.decode_completion(cgen_host[j])
+            # A short confidence decode that never reached EOS may have cut
+            # an integer mid-number; don't trust an end-of-text match then.
+            conf_complete = (engine.rt.sweep_full_completions
+                             or _decode_complete(cgen_host[j], engine.eos_id))
             logprob_map = {
                 int(i): round(float(v), 6)
                 for i, v in zip(lp_ids[j], lp_vals[j])
@@ -147,7 +203,7 @@ def run_perturbation_sweep(
                 log_probabilities=json.dumps(logprob_map),
                 token_1_prob=float(res.yes_prob[j]),
                 token_2_prob=float(res.no_prob[j]),
-                confidence_value=_parse_confidence(conf_text),
+                confidence_value=_parse_confidence(conf_text, conf_complete),
                 weighted_confidence=float(wconf[j]),
             )
             rows.append(row)
@@ -160,6 +216,55 @@ def run_perturbation_sweep(
     if pending_rows:
         _flush(pending_rows, results_path, manifest)
     return rows
+
+
+def _reasoning_batch(engine, model_name, prompts, batch, full, seed,
+                     reasoning_runs, pending_rows, rows):
+    """Score one padded bucket in reasoning mode: n sampled binary runs with
+    count averaging + one sampled confidence response per cell.
+
+    Rows are keyed by GRID-CELL IDENTITY (prompt_idx, rephrase_idx), not by
+    position in the todo list or the batch — a resumed or subset sweep
+    samples exactly what an uninterrupted run would for every cell."""
+    base = jax.random.PRNGKey(seed)
+    cell_keys = jnp.stack([
+        jax.random.fold_in(jax.random.fold_in(base, c.prompt_idx),
+                           c.rephrase_idx)
+        for c in full])
+    targets = [prompts[c.prompt_idx].target_tokens for c in full]
+    sampled = engine.score_prompts_sampled(
+        [c.binary_prompt for c in full], targets, n_runs=reasoning_runs,
+        key=cell_keys)
+    conf_keys = jax.vmap(
+        lambda k: jax.random.fold_in(k, 10_000))(cell_keys)
+    conf_texts = engine.sample_completions(
+        [c.confidence_prompt for c in full], conf_keys)
+
+    for j, cell in enumerate(batch):
+        s = sampled[j]
+        conf_text = conf_texts[j].strip()
+        conf_val = _parse_confidence(conf_text)
+        row = schemas.PerturbationRow(
+            model=model_name,
+            original_main=cell.original_main,
+            response_format=cell.response_format,
+            confidence_format=cell.confidence_format,
+            rephrased_main=cell.rephrased_main,
+            full_rephrased_prompt=cell.binary_prompt,
+            full_confidence_prompt=cell.confidence_prompt,
+            model_response=s.response,
+            model_confidence_response=conf_text,
+            log_probabilities="",       # reasoning models expose no logprobs
+            token_1_prob=s.token_1_prob,
+            token_2_prob=s.token_2_prob,
+            # weighted confidence equals the raw parsed integer in reasoning
+            # mode (perturb_prompts.py:459-464)
+            confidence_value=conf_val,
+            weighted_confidence=None if conf_val is None else float(conf_val),
+        )
+        rows.append(row)
+        pending_rows.append(row)
+    return pending_rows, rows
 
 
 def _flush(rows: List[schemas.PerturbationRow], results_path: Path,

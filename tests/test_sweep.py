@@ -108,3 +108,106 @@ def test_word_meaning_sweep_rows():
         assert r.prompt == q
         assert r.model == "tiny-llama"
         assert 0 <= r.yes_prob <= 1 and 0 <= r.no_prob <= 1
+
+
+def test_reasoning_count_averaging_matches_api_decoder():
+    """VERDICT r1 #7: the local n-run averaging must binarize with the same
+    if/elif order as the API decoder (perturb_prompts.py:423-426) — a text
+    containing BOTH targets ("Not Covered" contains "Covered") counts toward
+    token 1 only."""
+    from lir_tpu.backends import api
+    from lir_tpu.engine.grid import GridCell
+
+    runs = ["Not Covered", "Covered", "Covered", "no idea", "Not"]
+    targets = ("Covered", "Not")
+
+    # API side: feed the same run texts through _finalize_reasoning.
+    cell = GridCell(prompt_idx=0, rephrase_idx=0, model="m",
+                    original_main="o", rephrased_main="r",
+                    response_format="f", confidence_format="c",
+                    target_tokens=targets)
+    score = api.ApiScore(custom_id="p0_r0")
+    score.run_responses = list(runs)
+    scores = {"p0_r0": score}
+    api._finalize_reasoning(scores, {"p0_r0_binary_run0": cell})
+
+    # Local side: scripted sampler returning one run text per call.
+    engine = _engine(batch_size=2, max_new=4)
+    it = iter(runs)
+
+    def scripted(toks, mask, key, temperature, max_new_tokens):
+        return [next(it)] * int(toks.shape[0])
+
+    engine._sample_from_ids = scripted
+    res = engine.score_prompts_sampled(
+        ["b"], [targets], n_runs=len(runs))[0]
+
+    assert res.token_1_prob == score.token_1_prob == 3 / 5
+    assert res.token_2_prob == score.token_2_prob == 1 / 5
+    assert res.odds_ratio == score.token_1_prob / score.token_2_prob
+    assert res.response == "Covered"  # most common (2x exact)
+
+
+def test_reasoning_sweep_writes_count_fraction_rows(tmp_path):
+    """End-to-end reasoning mode on the tiny model: D6 rows carry count
+    fractions (multiples of 1/n_runs) and Weighted Confidence equals the
+    parsed integer (perturb_prompts.py:459-464)."""
+    engine = _engine(batch_size=4, max_new=4)
+    out = tmp_path / "results.csv"
+    rows = run_perturbation_sweep(
+        engine, "tiny-reasoner", PROMPTS, PERTURBATIONS, out,
+        reasoning=True, reasoning_runs=4)
+    # grid = original + rephrasings per prompt: (1+2) + (1+1) = 5 cells
+    assert len(rows) == 5
+    for r in rows:
+        for p in (r.token_1_prob, r.token_2_prob):
+            assert abs(p * 4 - round(p * 4)) < 1e-9
+        assert r.log_probabilities == ""
+        if r.confidence_value is None:
+            assert r.weighted_confidence is None
+        else:
+            assert r.weighted_confidence == float(r.confidence_value)
+    df = pd.read_csv(out)
+    assert len(df) == 5
+
+
+def test_reasoning_resume_is_cell_deterministic(tmp_path):
+    """PRNG streams are keyed by grid-cell identity, so a resumed sweep
+    (different todo/batch composition) samples exactly what the
+    uninterrupted run sampled for every remaining cell."""
+    engine = _engine(batch_size=4, max_new=4)
+    full_rows = run_perturbation_sweep(
+        engine, "m", PROMPTS, PERTURBATIONS, tmp_path / "full.csv",
+        reasoning=True, reasoning_runs=3)
+    by_cell = {(r.original_main, r.rephrased_main): r for r in full_rows}
+
+    # Pre-mark the first three cells done; the "resumed" run scores only the
+    # remaining two, in a smaller tail bucket.
+    manifest = SweepManifest(tmp_path / "resumed.manifest.jsonl",
+                             grid_mod.RESUME_KEY_FIELDS)
+    manifest.mark_done_many([
+        {"model": "m", "original_main": r.original_main,
+         "rephrased_main": r.rephrased_main} for r in full_rows[:3]])
+    resumed = run_perturbation_sweep(
+        engine, "m", PROMPTS, PERTURBATIONS, tmp_path / "resumed.csv",
+        manifest=manifest, reasoning=True, reasoning_runs=3)
+    assert len(resumed) == 2
+    for r in resumed:
+        ref = by_cell[(r.original_main, r.rephrased_main)]
+        assert r.token_1_prob == ref.token_1_prob
+        assert r.token_2_prob == ref.token_2_prob
+        assert r.model_response == ref.model_response
+        assert r.model_confidence_response == ref.model_confidence_response
+
+
+def test_parse_confidence_truncation_guard():
+    """A budget-limited decode that never reached EOS must not trust an
+    integer whose digits touch the end of the text (possibly cut mid-number:
+    '...about 85' truncated to '...about 8')."""
+    from lir_tpu.engine.sweep import _parse_confidence
+
+    assert _parse_confidence("I am about 85% sure", complete=False) == 85
+    assert _parse_confidence("confidence: 85", complete=True) == 85
+    assert _parse_confidence("confidence: 8", complete=False) is None
+    assert _parse_confidence("confidence: 85 .", complete=False) == 85
+    assert _parse_confidence("no number here", complete=False) is None
