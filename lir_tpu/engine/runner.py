@@ -154,12 +154,23 @@ class ScoringEngine:
     def _sample_from_ids(self, toks: jax.Array, mask: jax.Array,
                          key: jax.Array, temperature: float,
                          max_new_tokens: Optional[int]) -> List[str]:
+        return self._sample_from_ids_raw(toks, mask, key, temperature,
+                                         max_new_tokens)[0]
+
+    def _sample_from_ids_raw(self, toks: jax.Array, mask: jax.Array,
+                             key: jax.Array, temperature: float,
+                             max_new_tokens: Optional[int]
+                             ) -> Tuple[List[str], np.ndarray]:
+        """(decoded texts, raw generated ids) — callers that must know
+        whether the reply finished inside the budget (EOS emitted) need the
+        ids, not just the EOS-trimmed text."""
         gen = generate.sample_decode(
             self.params, self.cfg, toks, mask, key, temperature=temperature,
             max_new_tokens=(self.rt.max_new_tokens if max_new_tokens is None
                             else max_new_tokens))
         gen = np.asarray(jax.device_get(gen))
-        return [self.decode_completion(gen[j]) for j in range(gen.shape[0])]
+        return ([self.decode_completion(gen[j])
+                 for j in range(gen.shape[0])], gen)
 
     def sample_completions(self, prompts: Sequence[str], key: jax.Array,
                            temperature: float = 1.0,
@@ -169,6 +180,15 @@ class ScoringEngine:
         toks, mask = self._pad_batch(prompts)
         return self._sample_from_ids(toks, mask, key, temperature,
                                      max_new_tokens)
+
+    def sample_completions_with_ids(
+            self, prompts: Sequence[str], key: jax.Array,
+            temperature: float = 1.0,
+            max_new_tokens: Optional[int] = None
+    ) -> Tuple[List[str], np.ndarray]:
+        toks, mask = self._pad_batch(prompts)
+        return self._sample_from_ids_raw(toks, mask, key, temperature,
+                                         max_new_tokens)
 
     # -- public API ---------------------------------------------------------
 

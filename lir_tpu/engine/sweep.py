@@ -237,13 +237,16 @@ def _reasoning_batch(engine, model_name, prompts, batch, full, seed,
         key=cell_keys)
     conf_keys = jax.vmap(
         lambda k: jax.random.fold_in(k, 10_000))(cell_keys)
-    conf_texts = engine.sample_completions(
+    conf_texts, conf_ids = engine.sample_completions_with_ids(
         [c.confidence_prompt for c in full], conf_keys)
 
     for j, cell in enumerate(batch):
         s = sampled[j]
         conf_text = conf_texts[j].strip()
-        conf_val = _parse_confidence(conf_text)
+        # Same mid-number truncation guard as the greedy path: a reply that
+        # never reached EOS may have been cut inside its integer.
+        conf_val = _parse_confidence(
+            conf_text, _decode_complete(conf_ids[j], engine.eos_id))
         row = schemas.PerturbationRow(
             model=model_name,
             original_main=cell.original_main,

@@ -34,6 +34,12 @@ from .quant import QuantTensor, matmul as _mm
 
 Params = Dict[str, Any]
 
+# Test hook: when True, the flash-attention route also engages on CPU with
+# the Pallas interpreter, so the DECODER-LEVEL routing (mask plumbing, ALiBi
+# slopes/positions wiring) is testable without a chip. Production leaves
+# this False: CPU runs dense.
+FLASH_INTERPRET_ON_CPU = False
+
 
 # ---------------------------------------------------------------------------
 # Param init (random weights for tests; real weights come from models/loader.py)
@@ -146,10 +152,14 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
                key_mask: Optional[jax.Array] = None) -> jax.Array:
     """q: (B,S,H,hd); k,v: (B,T,K,hd); bias: (B,H|1,S,T) additive fp32.
 
-    With ``cfg.use_flash_attention``, full-sequence self-attention routes
-    through the Pallas flash kernel, masking keys with the batch's actual
-    attention mask (any padding pattern); decode steps, ALiBi, and
-    non-block-divisible lengths keep the dense path."""
+    With ``cfg.use_flash_attention``, full-sequence self-attention (the
+    prefill) routes through the Pallas flash kernel, masking keys with the
+    batch's actual attention mask (any padding pattern); ALiBi families
+    (bloom) pass their per-head slopes + mask-aware key positions into the
+    kernel. Decode steps keep the dense path ON PURPOSE: a decode query is
+    one position, so its score row is (B, H, 1, T) — already O(T) memory
+    with no (S, T) tile to avoid; a flash kernel would only add launch
+    overhead per step. Non-block-divisible lengths also fall back dense."""
     B, S, H, hd = q.shape
     K = k.shape[2]
     if K != H:  # GQA/MQA: repeat kv heads
@@ -165,11 +175,25 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
         cfg.use_flash_attention
         and key_mask is not None
         and k.shape[1] == S
-        and cfg.pos_embedding != "alibi"
-        and S % block == 0
+        # Blocks shrink to S when S <= block, so every power-of-two bucket
+        # (64..1024) qualifies; only ragged lengths fall back dense.
+        and (S % block == 0 or S <= block)
+        # Pallas lowers on TPU only; CPU (tests, virtual meshes) runs dense
+        # unless the interpreter test hook is on.
+        and (jax.default_backend() == "tpu" or FLASH_INTERPRET_ON_CPU)
     )
     if flash_ok:
-        out = flash_attention(q, k, v, causal=True, key_mask=key_mask)
+        interpret = (FLASH_INTERPRET_ON_CPU
+                     and jax.default_backend() != "tpu")
+        if cfg.pos_embedding == "alibi":
+            out = flash_attention(
+                q, k, v, causal=True, key_mask=key_mask,
+                alibi_slopes=alibi_slopes(cfg.n_heads),
+                key_positions=mask_positions(key_mask),
+                interpret=interpret)
+        else:
+            out = flash_attention(q, k, v, causal=True, key_mask=key_mask,
+                                  interpret=interpret)
         return out.reshape(B, S, H * hd)
 
     scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)

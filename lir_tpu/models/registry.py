@@ -131,16 +131,20 @@ def gptneox(name: str = "pythia-6.9b", *, hidden: int = 4096, layers: int = 32,
     )
 
 
+# 7B-class presets default to flash-attention prefill (VERDICT r1 #4): at
+# these sizes the dense (B, H, S, S) score tensor is the HBM hot spot the
+# Pallas kernel exists to remove. ALiBi (bloom) is supported in-kernel.
+
 def llama2_7b() -> ModelConfig:
     return ModelConfig(name="llama-2-7b", vocab_size=32000, hidden_size=4096,
                        n_layers=32, n_heads=32, intermediate_size=11008,
-                       max_seq_len=4096)
+                       max_seq_len=4096, use_flash_attention=True)
 
 
 def mistral_7b() -> ModelConfig:
     return ModelConfig(name="mistral-7b", vocab_size=32000, hidden_size=4096,
                        n_layers=32, n_heads=32, n_kv_heads=8, intermediate_size=14336,
-                       max_seq_len=4096)
+                       max_seq_len=4096, use_flash_attention=True)
 
 
 def qwen_7b() -> ModelConfig:
@@ -148,13 +152,14 @@ def qwen_7b() -> ModelConfig:
     # upstream; re-implemented here).
     return ModelConfig(name="qwen-7b", vocab_size=151936, hidden_size=4096,
                        n_layers=32, n_heads=32, intermediate_size=11008,
-                       max_seq_len=2048, qkv_bias=True)
+                       max_seq_len=2048, qkv_bias=True,
+                       use_flash_attention=True)
 
 
 def baichuan2_7b() -> ModelConfig:
     return ModelConfig(name="baichuan2-7b", vocab_size=125696, hidden_size=4096,
                        n_layers=32, n_heads=32, intermediate_size=11008,
-                       max_seq_len=4096)
+                       max_seq_len=4096, use_flash_attention=True)
 
 
 def falcon_7b() -> ModelConfig:
@@ -163,6 +168,7 @@ def falcon_7b() -> ModelConfig:
         n_heads=71, n_kv_heads=1, intermediate_size=4 * 4544, max_seq_len=2048,
         pos_embedding="rotary", norm="layernorm", activation="gelu", gated_mlp=False,
         parallel_block=True, shared_block_ln=True, tie_embeddings=True,
+        use_flash_attention=True,
     )
 
 
@@ -172,7 +178,7 @@ def bloom_7b1() -> ModelConfig:
         n_heads=32, intermediate_size=4 * 4096, max_seq_len=2048,
         pos_embedding="alibi", norm="layernorm", activation="gelu_new", gated_mlp=False,
         embedding_norm=True, qkv_bias=True, attn_out_bias=True, mlp_bias=True,
-        tie_embeddings=True,
+        tie_embeddings=True, use_flash_attention=True,
     )
 
 

@@ -38,9 +38,11 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _flash_kernel(start_ref, mask_ref, q_ref, k_ref, v_ref, o_ref, *,
-                  causal: bool, block_q: int, block_k: int, sm_scale: float):
+def _flash_kernel(start_ref, slope_ref, mask_ref, kpos_ref, q_ref, k_ref,
+                  v_ref, o_ref, *, causal: bool, block_q: int, block_k: int,
+                  sm_scale: float, alibi: bool):
     b = pl.program_id(0)
+    h = pl.program_id(1)
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # (bq, hd)
     seq_len = k_ref.shape[2]
@@ -60,6 +62,11 @@ def _flash_kernel(start_ref, mask_ref, q_ref, k_ref, v_ref, o_ref, *,
         v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (bq, bk)
         kmask = mask_ref[0, 0, pl.ds(j * block_k, block_k)] > 0  # (bk,)
+        if alibi:
+            # ALiBi: + slope_h * mask-aware key position (bloom). Matches
+            # decoder._causal_bias exactly — positions come in precomputed.
+            kp = kpos_ref[0, 0, pl.ds(j * block_k, block_k)]      # (bk,)
+            s = s + slope_ref[h, 0] * kp.astype(jnp.float32)[None, :]
         valid = kmask[None, :]
         if causal:
             k_pos = j * block_k + lax.broadcasted_iota(
@@ -96,6 +103,8 @@ def flash_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     causal: bool = True,
     key_mask: jnp.ndarray | None = None,
+    alibi_slopes: jnp.ndarray | None = None,
+    key_positions: jnp.ndarray | None = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
@@ -106,6 +115,9 @@ def flash_attention(
     any pattern (left pad, right pad, holes). Equivalent to the dense
     path's additive key-mask bias for every valid query position; rows of
     fully-masked queries return 0.
+    ``alibi_slopes``: optional (H,) per-head ALiBi slopes (bloom). Adds
+    ``slope_h * key_position`` to the scores; ``key_positions`` (B, S)
+    mask-aware positions must be given with it (decoder.mask_positions).
     S must be divisible by the block sizes (blocks shrink automatically for
     short sequences). ``interpret=True`` runs the kernel in the Pallas
     interpreter (CPU tests).
@@ -117,10 +129,20 @@ def flash_attention(
         raise ValueError(
             f"seq len {S} must be divisible by blocks ({block_q}, {block_k})"
         )
+    alibi = alibi_slopes is not None
+    if alibi and key_positions is None:
+        raise ValueError("alibi_slopes requires key_positions")
     sm_scale = 1.0 / np.sqrt(hd)
     if key_mask is None:
         key_mask = jnp.ones((B, S), jnp.int32)
     key_mask = jnp.asarray(key_mask, jnp.int32)
+    if key_positions is None:
+        key_positions = jnp.zeros((B, S), jnp.int32)
+    key_positions = jnp.asarray(key_positions, jnp.int32)
+    if alibi_slopes is None:
+        slopes = jnp.zeros((H, 1), jnp.float32)
+    else:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(H, 1)
     # First valid key index per row (loop lower bound; 0 when all-masked —
     # such rows are garbage on every path).
     first_valid = jnp.argmax(key_mask, axis=-1).astype(jnp.int32)
@@ -132,7 +154,7 @@ def flash_attention(
 
     kernel = functools.partial(
         _flash_kernel, causal=causal, block_q=block_q, block_k=block_k,
-        sm_scale=sm_scale)
+        sm_scale=sm_scale, alibi=alibi)
 
     out = pl.pallas_call(
         kernel,
@@ -143,7 +165,12 @@ def flash_attention(
             # index it by their batch id.
             pl.BlockSpec(index_map=lambda b, h, i: (0, 0),
                          memory_space=pltpu.SMEM),
+            # Per-head ALiBi slopes, whole (H, 1) array in SMEM.
+            pl.BlockSpec(index_map=lambda b, h, i: (0, 0),
+                         memory_space=pltpu.SMEM),
             # Key mask as (B, 1, S): one (1, 1, S) block per program.
+            pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
+            # Mask-aware key positions, same layout as the mask.
             pl.BlockSpec((1, 1, S), lambda b, h, i: (b, 0, 0)),
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, S, hd), lambda b, h, i: (b, h, 0, 0)),
@@ -153,5 +180,6 @@ def flash_attention(
                                lambda b, h, i: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         interpret=interpret,
-    )(first_valid[:, None], key_mask[:, None, :], qt, kt, vt)
+    )(first_valid[:, None], slopes, key_mask[:, None, :],
+      key_positions[:, None, :], qt, kt, vt)
     return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,150 @@
+"""End-to-end with a REAL tokenizer and a real safetensors checkpoint
+(VERDICT r1 missing #1 / #9).
+
+The zero-egress image ships no pretrained checkpoints, so this builds a
+GENUINE HF checkpoint locally: a byte-level BPE tokenizer trained in-process
+with the `tokenizers` library (real merges, real leading-space " Yes"
+semantics, saved as tokenizer.json) plus a random-weight GPT-2 model saved
+with save_pretrained. `factory.load_engine` then runs UNMOCKED —
+AutoConfig/AutoTokenizer/safetensors from disk — and the scored
+relative_prob is compared against a torch implementation of the reference's
+measurement rule (compare_base_vs_instruct.py:185-305) on the same
+checkpoint.
+
+A second, skip-gated test runs the same comparison against a REAL
+pretrained checkpoint when one is provided via LIR_TPU_CHECKPOINT_DIR
+(see README "Real-checkpoint smoke test" for the fetch-once recipe).
+"""
+
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+import torch
+
+from lir_tpu.config import RuntimeConfig
+from lir_tpu.data.prompts import format_instruct_prompt
+from lir_tpu.models.factory import load_engine
+
+
+@pytest.fixture(scope="module")
+def bpe_checkpoint(tmp_path_factory):
+    """Train a real byte-level BPE tokenizer + save a GPT-2 checkpoint."""
+    import transformers as tf
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+    from lir_tpu.data.prompts import WORD_MEANING_QUESTIONS
+
+    corpus = list(WORD_MEANING_QUESTIONS) + [
+        "Yes", "No", " Yes", " No", "Answer either 'Yes' or 'No'.",
+        "Question: Answer:", "Is a tomato a vegetable?",
+        " ".join(str(i) for i in range(101)),
+    ]
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=1024, special_tokens=["<|endoftext|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(corpus, trainer)
+    fast = tf.PreTrainedTokenizerFast(
+        tokenizer_object=tok, eos_token="<|endoftext|>")
+
+    torch.manual_seed(0)
+    model = tf.GPT2LMHeadModel(tf.GPT2Config(
+        vocab_size=len(fast), n_embd=64, n_layer=2, n_head=4,
+        n_positions=256)).eval()
+    path = tmp_path_factory.mktemp("real_ckpt") / "bpe-gpt2"
+    path.mkdir()
+    model.save_pretrained(path, safe_serialization=True)
+    fast.save_pretrained(path)
+    return path, model, fast
+
+
+def _reference_yes_no(model, tokenizer, prompt: str, yes_id: int, no_id: int,
+                      max_look_ahead: int = 10):
+    """The reference's measurement rule in torch
+    (compare_base_vs_instruct.py:185-305): greedy generate with scores, scan
+    the first 10 generated positions, read P(yes)/P(no) at the first
+    position whose top-2 contains either target id; fallback position 0."""
+    ids = torch.tensor([tokenizer(prompt).input_ids])
+    with torch.no_grad():
+        out = model.generate(
+            ids, max_new_tokens=max_look_ahead + 2, do_sample=False,
+            output_scores=True, return_dict_in_generate=True,
+            pad_token_id=tokenizer.eos_token_id)
+    position = 0
+    for p in range(min(max_look_ahead, len(out.scores))):
+        probs = torch.softmax(out.scores[p][0], dim=-1)
+        top2 = torch.topk(probs, k=2).indices.tolist()
+        if yes_id in top2 or no_id in top2:
+            position = p
+            break
+    probs = torch.softmax(out.scores[position][0], dim=-1)
+    yes_p, no_p = float(probs[yes_id]), float(probs[no_id])
+    return yes_p, no_p, yes_p / (yes_p + no_p)
+
+
+def test_unmocked_load_and_score_matches_torch(bpe_checkpoint):
+    path, torch_model, fast = bpe_checkpoint
+
+    engine = load_engine(path, RuntimeConfig(batch_size=4, max_new_tokens=12,
+                                             max_seq_len=128))
+    # The real tokenizer resolved the LEADING-SPACE ids (hard part #1).
+    assert engine.yes_id == fast(" Yes", add_special_tokens=False).input_ids[0]
+    assert engine.no_id == fast(" No", add_special_tokens=False).input_ids[0]
+    assert engine.yes_id != engine.no_id
+
+    prompt = format_instruct_prompt('Is a "screenshot" a "photograph"?')
+    row = engine.score_prompts([prompt])[0]
+    ref_yes, ref_no, ref_rel = _reference_yes_no(
+        torch_model, fast, prompt, engine.yes_id, engine.no_id)
+
+    assert abs(row.yes_prob - ref_yes) < 2e-3
+    assert abs(row.no_prob - ref_no) < 2e-3
+    # The BASELINE gate: relative_prob within 1%.
+    assert abs(row.relative_prob - ref_rel) <= 0.01 * max(ref_rel, 1e-9)
+
+
+def test_d2_schema_row_from_real_checkpoint(bpe_checkpoint, tmp_path):
+    """Full stage-3 slice (SURVEY.md §7): load -> score -> D2-schema CSV."""
+    import pandas as pd
+    from lir_tpu.data import schemas
+    from lir_tpu.engine.sweep import run_word_meaning_sweep
+
+    path, _, _ = bpe_checkpoint
+    engine = load_engine(path, RuntimeConfig(batch_size=4, max_new_tokens=12,
+                                             max_seq_len=128))
+    rows = run_word_meaning_sweep(
+        engine, "bpe-gpt2", "instruct",
+        ['Is a "screenshot" a "photograph"?', 'Is a "drone" an "aircraft"?'],
+        format_instruct_prompt)
+    out = tmp_path / "instruct_model_comparison_results.csv"
+    schemas.write_instruct_comparison_csv(rows, out)
+    df = pd.read_csv(out)
+    assert list(df.columns) == list(schemas.INSTRUCT_COMPARISON_COLUMNS)
+    assert len(df) == 2
+    assert df["relative_prob"].between(0, 1).all()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("LIR_TPU_CHECKPOINT_DIR"),
+    reason="set LIR_TPU_CHECKPOINT_DIR to a local HF checkpoint "
+           "(README: real-checkpoint smoke test)")
+def test_real_pretrained_checkpoint_smoke():
+    """BASELINE config 3 with actual pretrained weights, when available:
+    load the checkpoint, score one word-meaning prompt, compare
+    relative_prob against the reference rule run in torch."""
+    import transformers as tf
+
+    ckpt = Path(os.environ["LIR_TPU_CHECKPOINT_DIR"])
+    engine = load_engine(ckpt, RuntimeConfig(batch_size=4, max_new_tokens=12))
+    tokenizer = tf.AutoTokenizer.from_pretrained(ckpt, local_files_only=True)
+    torch_model = tf.AutoModelForCausalLM.from_pretrained(
+        ckpt, local_files_only=True, torch_dtype=torch.float32).eval()
+
+    prompt = format_instruct_prompt('Is a "screenshot" a "photograph"?')
+    row = engine.score_prompts([prompt])[0]
+    _, _, ref_rel = _reference_yes_no(
+        torch_model, tokenizer, prompt, engine.yes_id, engine.no_id)
+    assert abs(row.relative_prob - ref_rel) <= 0.01 * max(abs(ref_rel), 1e-9)

@@ -1,0 +1,78 @@
+"""Measure the flash-attention delta on the real chip (VERDICT r1 #4).
+
+Times the fused scoring step of llama2_7b (int8) with
+``use_flash_attention`` on vs off at seq 512 and 1024 — the lengths where
+the dense (B, H, S, S) score tensor starts to dominate HBM — and appends
+the measured delta to SCALE.md. Host-read-synced timing (same discipline as
+bench.py). Run on the TPU:  python tools/flash_delta.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.scale_validation import SCALE_MD, _append, _fused_step  # noqa: E402
+
+
+def main() -> None:
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+    from lir_tpu.models import quant
+    from lir_tpu.models.registry import llama2_7b
+
+    dev = jax.devices()[0]
+    assert dev.platform != "cpu", "run on the TPU (Pallas does not lower on CPU)"
+
+    base = llama2_7b()
+    params = quant.random_quantized_params(base, jax.random.PRNGKey(0),
+                                           dtype=jnp.bfloat16)
+    jax.block_until_ready(params)
+    _ = float(params["layers"]["wq"].scale.reshape(-1)[0])
+
+    lines = [f"\n## flash-attention prefill delta — {dev.device_kind}, "
+             f"{datetime.date.today()}\n\n"
+             "llama-2-7b int8, fused scoring step (prefill + 10 decode), "
+             "batch 8:\n\n"
+             "| seq | dense step s | flash step s | speedup |\n"
+             "|---|---|---|---|\n"]
+    for seq in (512, 1024):
+        results = {}
+        for flash in (False, True):
+            cfg = dataclasses.replace(base, use_flash_attention=flash)
+            try:
+                _, step_s = _fused_step(params, cfg, batch=8, seq=seq,
+                                        new_tokens=10)
+                results[flash] = step_s
+            except Exception as err:  # noqa: BLE001
+                if ("RESOURCE_EXHAUSTED" in str(err)
+                        or "out of memory" in str(err).lower()):
+                    results[flash] = None  # OOM: the delta IS the fit
+                else:
+                    raise
+            gc.collect()
+        dense, flash_t = results[False], results[True]
+        dense_s = f"{dense:.3f}" if dense else "OOM"
+        flash_s = f"{flash_t:.3f}" if flash_t else "OOM"
+        if dense and flash_t:
+            ratio = f"{dense / flash_t:.2f}x"
+        elif flash_t and not dense:
+            ratio = "flash fits, dense OOMs"
+        else:
+            ratio = "n/a"
+        lines.append(f"| {seq} | {dense_s} | {flash_s} | {ratio} |\n")
+    _append("".join(lines))
+    print(f"appended flash delta to {SCALE_MD}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,213 @@
+"""7B-at-real-size validation (VERDICT r1 #3).
+
+Materializes the two extreme 7B-class presets at FULL size with random
+weights and proves the claims the round-1 docstrings only asserted:
+
+  tpu mode (default when a real accelerator is present):
+    - llama2_7b() weight-only int8 on ONE chip: measure init, compile and
+      warm fused-scoring-step time (host-read synced), prompts/s, implied
+      TFLOPS/MFU, and the empirical HBM-fit boundary (which batch OOMs).
+    - falcon_7b() int8 (MQA: 71 q heads / 1 kv head, shared-LN parallel
+      block) — the degenerate-sharding family — one fused scoring step.
+
+  mesh-bf16 mode (--mesh-bf16; any platform, uses 8 virtual CPU devices via
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 when no pod exists):
+    - llama2_7b() bf16 at full size sharded over an 8-device (1, 8, 1) mesh
+      with the production NamedSharding rules: compile + run ONE fused
+      scoring step on tiny batch/seq. This is the "bf16 needs 8-way TP"
+      fit story executed end to end.
+
+Appends measured numbers to SCALE.md. Run:
+    python tools/scale_validation.py            # on the TPU
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/scale_validation.py --mesh-bf16
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+SCALE_MD = REPO / "SCALE.md"
+
+HEADER = """# SCALE.md — 7B-at-real-size validation log
+
+Measured on-device numbers for the real-size model claims (VERDICT r1 #3).
+Each section is appended by `tools/scale_validation.py`; nothing here is
+estimated or asserted without a run behind it.
+"""
+
+
+def _append(text: str) -> None:
+    if not SCALE_MD.exists():
+        SCALE_MD.write_text(HEADER)
+    SCALE_MD.write_text(SCALE_MD.read_text() + text)
+    print(text)
+
+
+def _fused_step(params, cfg, batch, seq, new_tokens):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from lir_tpu.engine import generate, score
+
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(3, cfg.vocab_size, (batch, seq)), jnp.int32)
+    mask = jnp.ones_like(toks)
+    yes = jnp.full((batch,), 1, jnp.int32)
+    no = jnp.full((batch,), 2, jnp.int32)
+
+    def step():
+        fused = generate.greedy_decode_fused(
+            params, cfg, toks, mask, yes, no,
+            jnp.arange(10, 110, dtype=jnp.int32),
+            jnp.arange(0, 100, dtype=jnp.float32),
+            max_new_tokens=new_tokens)
+        res = score.readout_from_fused(fused, yes, no)
+        # Host read = the only trustworthy sync under tunneled dispatch.
+        return float(jnp.sum(res.yes_prob) + jnp.sum(res.no_prob))
+
+    t0 = time.perf_counter()
+    chk = step()
+    compile_s = time.perf_counter() - t0
+    assert np.isfinite(chk), f"non-finite checksum {chk}"
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        chk = step()
+        best = min(best, time.perf_counter() - t0)
+    assert np.isfinite(chk), f"non-finite checksum {chk}"
+    return compile_s, best
+
+
+def run_tpu_int8() -> None:
+    import jax
+    import jax.numpy as jnp
+    from lir_tpu.models import quant
+    from lir_tpu.models.registry import falcon_7b, llama2_7b
+    from lir_tpu.utils import profiling
+
+    import gc
+
+    dev = jax.devices()[0]
+    seq, new_tokens = 256, 10
+    _append(f"\n## int8 single-chip — {dev.device_kind} ({dev.platform}), "
+            f"{datetime.date.today()}\n\n")
+
+    for mk_cfg in (llama2_7b, falcon_7b):
+        cfg = mk_cfg()
+        t0 = time.perf_counter()
+        params = quant.random_quantized_params(cfg, jax.random.PRNGKey(0),
+                                               dtype=jnp.bfloat16)
+        jax.block_until_ready(params)
+        _ = float(params["layers"]["wq"].scale.reshape(-1)[0])  # real sync
+        init_s = time.perf_counter() - t0
+        gib = quant.param_bytes(params) / 2**30
+
+        batch_results = []
+        oom_at = None
+        for batch in (8, 16, 32):
+            try:
+                compile_s, step_s = _fused_step(params, cfg, batch, seq,
+                                                new_tokens)
+            except Exception as err:  # noqa: BLE001
+                if ("RESOURCE_EXHAUSTED" in str(err)
+                        or "out of memory" in str(err).lower()):
+                    oom_at = batch
+                    break
+                raise
+            flops = profiling.scoring_step_flops(cfg, batch, seq, new_tokens)
+            tflops = flops / step_s / 1e12
+            peak = profiling.chip_peak_flops(dev)
+            mfu = f"{tflops * 1e12 / peak:.1%}" if peak else "n/a"
+            batch_results.append(
+                f"| {batch} | {compile_s:.1f} | {step_s:.3f} | "
+                f"{batch / step_s:.2f} | {tflops:.1f} | {mfu} |")
+
+        kv_gib = (cfg.n_layers * (seq + new_tokens) * cfg.n_kv_heads
+                  * cfg.head_dim * 2 * 2) / 2**30
+        _append(
+            f"### {cfg.name} (int8, {gib:.2f} GiB params, "
+            f"KV {kv_gib:.3f} GiB/row @ seq {seq + new_tokens})\n\n"
+            f"- random-init (on device): {init_s:.0f} s\n"
+            f"- fused scoring step (prefill {seq} + {new_tokens} decode):\n\n"
+            "| batch | compile s | step s | prompts/s | impl TFLOPS | MFU |\n"
+            "|---|---|---|---|---|---|\n"
+            + "\n".join(batch_results) + "\n"
+            + (f"\n- HBM-fit boundary: batch {oom_at} OOMs on this chip "
+               f"(largest fitting batch above)\n" if oom_at else
+               "\n- no OOM up to batch 32\n"))
+        # Free this model's HBM before materializing the next 7B tree —
+        # two resident int8 trees (6.3 + 6.9 GiB) plus caches exhaust a
+        # 16 GiB chip.
+        del params
+        gc.collect()
+
+
+def run_mesh_bf16() -> None:
+    import os
+    if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    import jax
+    import jax.numpy as jnp
+    from lir_tpu.config import MeshConfig
+    from lir_tpu.models import decoder, quant
+    from lir_tpu.models.registry import llama2_7b
+    from lir_tpu.parallel import sharding
+
+    n_dev = len(jax.devices())
+    assert n_dev >= 8, f"need 8 devices (virtual ok), have {n_dev}"
+    cfg = llama2_7b()
+    mesh = sharding.build_mesh(MeshConfig(data=1, model=8))
+
+    t0 = time.perf_counter()
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.bfloat16)
+    params = sharding.shard_params(params, cfg, mesh)
+    jax.block_until_ready(params)
+    init_s = time.perf_counter() - t0
+    gib = quant.param_bytes(params) / 2**30
+
+    # Per-device shard of the largest matrix proves 8-way placement.
+    wq = params["layers"]["wq"]
+    shard_gib = (wq.addressable_shards[0].data.size
+                 * wq.dtype.itemsize) / 2**30
+
+    compile_s, step_s = _fused_step(params, cfg, batch=2, seq=16, new_tokens=4)
+    _append(
+        f"\n## bf16 8-way tensor-parallel — {jax.devices()[0].platform} x "
+        f"{n_dev} devices, {datetime.date.today()}\n\n"
+        f"### {cfg.name} (bf16, {gib:.2f} GiB params, mesh (1, 8, 1))\n\n"
+        f"- init + shard (full size): {init_s:.0f} s\n"
+        f"- wq per-device shard: {shard_gib:.3f} GiB "
+        f"(= 1/8 of {shard_gib * 8:.2f} GiB)\n"
+        f"- fused scoring step, batch 2 / seq 16 / 4 decode: "
+        f"compile {compile_s:.0f} s, warm step {step_s:.2f} s\n"
+        f"- bf16/chip at 8-way TP: ~{gib / 8:.2f} GiB params/device -> fits "
+        f"a 16 GiB v5e chip with room for cache+activations\n")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mesh-bf16", action="store_true",
+                    help="run the full-size bf16 8-device-mesh validation")
+    args = ap.parse_args()
+    if args.mesh_bf16:
+        run_mesh_bf16()
+    else:
+        run_tpu_int8()
+
+
+if __name__ == "__main__":
+    main()
