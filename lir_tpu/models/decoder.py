@@ -206,8 +206,14 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
 def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
            bias: jax.Array, cache_kv: Optional[Tuple[jax.Array, jax.Array]],
            cache_index: Optional[jax.Array],
-           key_mask: Optional[jax.Array] = None):
-    """One transformer block. Returns (new_x, (k_full, v_full))."""
+           key_mask: Optional[jax.Array] = None,
+           attn_impl=None):
+    """One transformer block. Returns (new_x, (k_full, v_full)).
+
+    ``attn_impl(q, k, v, key_mask) -> (B, S, H*hd)`` replaces dense
+    attention when given (the sequence-parallel path, parallel/seq_forward);
+    it owns causality/ALiBi itself, so ``bias`` may be None then.
+    """
     B, S, _ = x.shape
     H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -237,7 +243,10 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
         ck, cv = k, v
         k_all, v_all = k, v
 
-    attn = _attention(q, k_all, v_all, bias, cfg, key_mask=key_mask)
+    if attn_impl is not None:
+        attn = attn_impl(q, k_all, v_all, key_mask)
+    else:
+        attn = _attention(q, k_all, v_all, bias, cfg, key_mask=key_mask)
     attn = _mm(attn, lp["wo"])
     if cfg.attn_out_bias:
         attn = attn + lp["bo"]
@@ -321,14 +330,14 @@ def mask_positions(attn_mask: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def _scan_blocks(params: Params, cfg: ModelConfig, x, sin, cos, bias,
-                 cache=None, cache_index=None, key_mask=None):
+                 cache=None, cache_index=None, key_mask=None, attn_impl=None):
     """lax.scan over the stacked layer params."""
     def body(carry, xs):
         h = carry
         if cache is None:
             lp = xs
             h, _ = _block(h, lp, cfg, sin, cos, bias, None, None,
-                          key_mask=key_mask)
+                          key_mask=key_mask, attn_impl=attn_impl)
             return h, None
         lp, (ck, cv) = xs
         h, (nk, nv) = _block(h, lp, cfg, sin, cos, bias, (ck, cv), cache_index)
@@ -340,8 +349,14 @@ def _scan_blocks(params: Params, cfg: ModelConfig, x, sin, cos, bias,
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
-            attn_mask: Optional[jax.Array] = None) -> jax.Array:
-    """Full-sequence causal forward. tokens: (B, S) int32 -> fp32 logits (B,S,V)."""
+            attn_mask: Optional[jax.Array] = None,
+            attn_impl=None) -> jax.Array:
+    """Full-sequence causal forward. tokens: (B, S) int32 -> fp32 logits (B,S,V).
+
+    ``attn_impl`` (see _block) swaps in a sequence-parallel attention; the
+    O(S*T) bias tensor is then never materialized — required for
+    long-context prefill, where (S, T) would not fit.
+    """
     if attn_mask is None:
         attn_mask = jnp.ones_like(tokens)
     positions = mask_positions(attn_mask)
@@ -349,8 +364,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     sin = cos = None
     if cfg.pos_embedding == "rotary":
         sin, cos = _rope_sincos(positions, cfg.rotary_dim, cfg.rope_theta)
-    bias = _causal_bias(attn_mask, positions, cfg)
-    x, _ = _scan_blocks(params, cfg, x, sin, cos, bias, key_mask=attn_mask)
+    bias = None if attn_impl is not None else _causal_bias(attn_mask, positions, cfg)
+    x, _ = _scan_blocks(params, cfg, x, sin, cos, bias, key_mask=attn_mask,
+                        attn_impl=attn_impl)
     return _unembed(params, cfg, x)
 
 
@@ -361,12 +377,16 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.float32):
 
 
 def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
-            attn_mask: jax.Array, max_len: int):
+            attn_mask: jax.Array, max_len: int, attn_impl=None):
     """Run the prompt, fill the KV cache, return last-position logits.
 
     tokens/attn_mask: (B, S) with LEFT padding (so position S-1 is the prompt
     end for every row — mirrors the reference's unpadded single-prompt calls).
     Returns (logits_last (B, V) fp32, cache, next_positions (B,)).
+
+    ``attn_impl`` routes the prompt pass through sequence-parallel attention
+    (parallel/seq_forward): the quadratic phase runs seq-sharded, and the
+    returned cache holds the same per-layer k/v for ordinary decode.
     """
     B, S = tokens.shape
     positions = mask_positions(attn_mask)
@@ -374,13 +394,13 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
     sin = cos = None
     if cfg.pos_embedding == "rotary":
         sin, cos = _rope_sincos(positions, cfg.rotary_dim, cfg.rope_theta)
-    bias = _causal_bias(attn_mask, positions, cfg)
+    bias = None if attn_impl is not None else _causal_bias(attn_mask, positions, cfg)
 
     # Scan layers, capturing each block's (post-rope) k/v — returned by
     # _block itself, no re-projection — into a (L, ...) stack.
     def body(h, lp):
         h_out, (k, v) = _block(h, lp, cfg, sin, cos, bias, None, None,
-                               key_mask=attn_mask)
+                               key_mask=attn_mask, attn_impl=attn_impl)
         return h_out, (k, v)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])

@@ -143,6 +143,38 @@ def _layer_llama(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
     return out
 
 
+def _layer_qwen1(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
+    """Qwen-v1 NATIVE tensor names (model_type "qwen", trust_remote_code
+    family — reference loads it via remote code, compare_base_vs_instruct.py:
+    421; we re-implement the mapping from the public modeling_qwen.py):
+
+    - ``h.{i}.attn.c_attn``: fused qkv, torch Linear (3D, D), q|k|v blocks
+      (NOT head-interleaved), WITH bias even though every other projection
+      is bias-free (``no_bias`` exempts c_attn).
+    - ``h.{i}.mlp.{w1,w2,c_proj}``: Qwen's MLP is ``c_proj(w1(x) *
+      silu(w2(x)))`` — w2 is the GATE, w1 the up-projection; each is
+      config.intermediate_size // 2 wide.
+    - RMSNorm ``ln_1``/``ln_2``/``ln_f`` (scale only).
+
+    Checkpoints already converted to llama-format names keep loading via
+    the _layer_llama fallback in convert_decoder.
+    """
+    p = f"h.{i}."
+    D = cfg.hidden_size
+    ca = sd(p + "attn.c_attn.weight")  # (3D, D)
+    cb = sd(p + "attn.c_attn.bias")
+    return {
+        "ln1.scale": sd(p + "ln_1.weight"),
+        "wq": _lin(ca[:D]), "wk": _lin(ca[D:2 * D]), "wv": _lin(ca[2 * D:]),
+        "bq": cb[:D], "bk": cb[D:2 * D], "bv": cb[2 * D:],
+        "wo": _lin(sd(p + "attn.c_proj.weight")),
+        "ln2.scale": sd(p + "ln_2.weight"),
+        "w_gate": _lin(sd(p + "mlp.w2.weight")),
+        "w_up": _lin(sd(p + "mlp.w1.weight")),
+        "w_down": _lin(sd(p + "mlp.c_proj.weight")),
+    }
+
+
 def _layer_baichuan(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
     """Baichuan2 packs qkv as W_pack (3D, D), q|k|v blocks (not interleaved)."""
     p = f"layers.{i}."
@@ -220,7 +252,8 @@ def _layer_opt(sd: _SD, cfg: ModelConfig, i: int) -> Dict[str, np.ndarray]:
 
 _LAYER_FNS: Dict[str, Callable[[_SD, ModelConfig, int], Dict[str, np.ndarray]]] = {
     "gpt2": _layer_gpt2, "gpt_neox": _layer_gptneox, "llama": _layer_llama,
-    "mistral": _layer_llama, "qwen2": _layer_llama, "qwen": _layer_llama,
+    "mistral": _layer_llama, "qwen2": _layer_llama, "qwen": _layer_qwen1,
+    "qwen_llama": _layer_llama,
     "baichuan": _layer_baichuan, "falcon": _layer_falcon,
     "RefinedWebModel": _layer_falcon, "bloom": _layer_bloom, "opt": _layer_opt,
 }
@@ -228,7 +261,8 @@ _LAYER_FNS: Dict[str, Callable[[_SD, ModelConfig, int], Dict[str, np.ndarray]]] 
 _EMBED_KEYS = {
     "gpt2": "wte.weight", "gpt_neox": "embed_in.weight",
     "llama": "embed_tokens.weight", "mistral": "embed_tokens.weight",
-    "qwen2": "embed_tokens.weight", "qwen": "embed_tokens.weight",
+    "qwen2": "embed_tokens.weight", "qwen": "wte.weight",
+    "qwen_llama": "embed_tokens.weight",
     "baichuan": "embed_tokens.weight",
     "falcon": "word_embeddings.weight", "RefinedWebModel": "word_embeddings.weight",
     "bloom": "word_embeddings.weight", "opt": "decoder.embed_tokens.weight",
@@ -238,7 +272,8 @@ _FINAL_LN = {
     "gpt2": ("ln_f.weight", "ln_f.bias"),
     "gpt_neox": ("final_layer_norm.weight", "final_layer_norm.bias"),
     "llama": ("norm.weight", None), "mistral": ("norm.weight", None),
-    "qwen2": ("norm.weight", None), "qwen": ("norm.weight", None),
+    "qwen2": ("norm.weight", None), "qwen": ("ln_f.weight", None),
+    "qwen_llama": ("norm.weight", None),
     "baichuan": ("norm.weight", None),
     "falcon": ("ln_f.weight", "ln_f.bias"),
     "RefinedWebModel": ("ln_f.weight", "ln_f.bias"),
@@ -251,6 +286,9 @@ def convert_decoder(state_dict: Mapping[str, Any], cfg: ModelConfig,
                     family: str, dtype=jnp.float32) -> Params:
     """Build the stacked-layer pytree `models/decoder.py` expects."""
     sd = _SD(state_dict)
+    if family == "qwen" and not sd.has("h.0.attn.c_attn.weight"):
+        # A Qwen-v1 checkpoint pre-converted to llama-format names.
+        family = "qwen_llama"
     layer_fn = _LAYER_FNS[family]
     rows = [layer_fn(sd, cfg, i) for i in range(cfg.n_layers)]
 
@@ -383,6 +421,24 @@ def config_from_hf(hf_cfg) -> Tuple[ModelConfig, str]:
                                getattr(hf_cfg, "use_bias", False)),
                            tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings",
                                                        False))), mt
+    if mt == "qwen":
+        # Qwen-v1 (trust_remote_code upstream): RMSNorm, rotary, fused-qkv
+        # bias; config.intermediate_size counts BOTH mlp halves — the public
+        # modeling_qwen.py sets ff_dim = intermediate_size // 2 per
+        # projection (see _layer_qwen1). no_bias=False checkpoints would
+        # carry c_proj/mlp biases _layer_qwen1 does not read — refuse them
+        # loudly rather than load silently-wrong weights.
+        if not getattr(hf_cfg, "no_bias", True):
+            raise ValueError(
+                "Qwen-v1 with no_bias=False (biased c_proj/mlp) is not "
+                "supported by the native mapping")
+        return ModelConfig(**common,
+                           intermediate_size=hf_cfg.intermediate_size // 2,
+                           rope_theta=g("rotary_emb_base", d=10000.0),
+                           norm_eps=g("layer_norm_epsilon", d=1e-6),
+                           qkv_bias=True,
+                           tie_embeddings=bool(getattr(
+                               hf_cfg, "tie_word_embeddings", False))), "qwen"
     if mt in ("falcon", "RefinedWebModel"):
         return ModelConfig(**common, intermediate_size=4 * common["hidden_size"],
                            n_kv_heads=1 if g("multi_query", d=True) else common["n_heads"],

@@ -152,7 +152,7 @@ def qwen_7b() -> ModelConfig:
     # upstream; re-implemented here).
     return ModelConfig(name="qwen-7b", vocab_size=151936, hidden_size=4096,
                        n_layers=32, n_heads=32, intermediate_size=11008,
-                       max_seq_len=2048, qkv_bias=True,
+                       max_seq_len=2048, qkv_bias=True, norm_eps=1e-6,
                        use_flash_attention=True)
 
 

@@ -13,6 +13,12 @@ from .ring_attention import (  # noqa: F401
     seq_sharded,
     ulysses_attention,
 )
+from .seq_forward import (  # noqa: F401
+    forward_seq_parallel,
+    make_seq_attn_impl,
+    prefill_seq_parallel,
+    seq_batch_sharding,
+)
 from .multihost import (  # noqa: F401
     barrier,
     gather_rows,

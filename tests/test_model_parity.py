@@ -173,3 +173,91 @@ def test_t5_logit_parity():
                                      jnp.ones_like(jnp.asarray(enc)),
                                      jnp.asarray(dec)))
     np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=2e-3)
+
+
+class TestQwenV1NativeNames:
+    """Qwen-v1 (model_type "qwen") native tensor names (VERDICT r1 missing
+    #6). No transformers class exists offline (trust_remote_code family), so
+    the mapping is pinned two ways: (a) a native-name state dict and its
+    llama-format conversion must produce IDENTICAL pytrees (the llama path
+    is torch-parity-tested above); (b) the HF-config adapter halves
+    intermediate_size per the public modeling_qwen.py ff_dim rule."""
+
+    def _native_sd(self, rng, D=32, F=48, L=2, V=64):
+        sd = {"transformer.wte.weight": rng.normal(size=(V, D)),
+              "transformer.ln_f.weight": rng.normal(size=(D,)),
+              "lm_head.weight": rng.normal(size=(V, D))}
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            sd[p + "ln_1.weight"] = rng.normal(size=(D,))
+            sd[p + "attn.c_attn.weight"] = rng.normal(size=(3 * D, D))
+            sd[p + "attn.c_attn.bias"] = rng.normal(size=(3 * D,))
+            sd[p + "attn.c_proj.weight"] = rng.normal(size=(D, D))
+            sd[p + "ln_2.weight"] = rng.normal(size=(D,))
+            sd[p + "mlp.w1.weight"] = rng.normal(size=(F, D))   # up
+            sd[p + "mlp.w2.weight"] = rng.normal(size=(F, D))   # gate (silu)
+            sd[p + "mlp.c_proj.weight"] = rng.normal(size=(D, F))
+        return sd
+
+    def _llama_equiv(self, sd, L=2):
+        """The llama-format rename of the same weights (what conversion
+        scripts emit: c_attn split to q/k/v, w2 -> gate_proj, w1 -> up)."""
+        out = {"model.embed_tokens.weight": sd["transformer.wte.weight"],
+               "model.norm.weight": sd["transformer.ln_f.weight"],
+               "lm_head.weight": sd["lm_head.weight"]}
+        D = sd["transformer.h.0.ln_1.weight"].shape[0]
+        for i in range(L):
+            p, q = f"transformer.h.{i}.", f"model.layers.{i}."
+            ca, cb = sd[p + "attn.c_attn.weight"], sd[p + "attn.c_attn.bias"]
+            out[q + "input_layernorm.weight"] = sd[p + "ln_1.weight"]
+            out[q + "self_attn.q_proj.weight"] = ca[:D]
+            out[q + "self_attn.k_proj.weight"] = ca[D:2 * D]
+            out[q + "self_attn.v_proj.weight"] = ca[2 * D:]
+            out[q + "self_attn.q_proj.bias"] = cb[:D]
+            out[q + "self_attn.k_proj.bias"] = cb[D:2 * D]
+            out[q + "self_attn.v_proj.bias"] = cb[2 * D:]
+            out[q + "self_attn.o_proj.weight"] = sd[p + "attn.c_proj.weight"]
+            out[q + "post_attention_layernorm.weight"] = sd[p + "ln_2.weight"]
+            out[q + "mlp.gate_proj.weight"] = sd[p + "mlp.w2.weight"]
+            out[q + "mlp.up_proj.weight"] = sd[p + "mlp.w1.weight"]
+            out[q + "mlp.down_proj.weight"] = sd[p + "mlp.c_proj.weight"]
+        return out
+
+    def test_native_matches_llama_format(self):
+        from lir_tpu.models.registry import ModelConfig
+        import jax
+
+        rng = np.random.default_rng(11)
+        cfg = ModelConfig(name="qwen-tiny", vocab_size=64, hidden_size=32,
+                          n_layers=2, n_heads=4, intermediate_size=48,
+                          max_seq_len=64, qkv_bias=True, norm_eps=1e-6)
+        native_sd = self._native_sd(rng)
+        p_native = convert_decoder(native_sd, cfg, "qwen")
+        p_llama = convert_decoder(self._llama_equiv(native_sd), cfg, "qwen")
+
+        flat_n = jax.tree_util.tree_leaves_with_path(p_native)
+        flat_l = dict(jax.tree_util.tree_leaves_with_path(p_llama))
+        assert len(flat_n) == len(flat_l)
+        for path, leaf in flat_n:
+            np.testing.assert_array_equal(
+                np.asarray(leaf), np.asarray(flat_l[path]), err_msg=str(path))
+
+        toks = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(decoder.forward(p_native, cfg, toks)),
+            np.asarray(decoder.forward(p_llama, cfg, toks)), atol=0)
+
+    def test_config_adapter(self):
+        from types import SimpleNamespace
+
+        hf = SimpleNamespace(
+            model_type="qwen", vocab_size=151936, hidden_size=4096,
+            num_hidden_layers=32, num_attention_heads=32, seq_length=2048,
+            intermediate_size=22016, layer_norm_epsilon=1e-6,
+            rotary_emb_base=10000.0, no_bias=True, name_or_path="qwen-7b")
+        cfg, fam = config_from_hf(hf)
+        assert fam == "qwen"
+        assert cfg.intermediate_size == 11008   # ff_dim = 22016 // 2
+        assert cfg.qkv_bias and cfg.norm == "rmsnorm"
+        assert cfg.norm_eps == 1e-6
+        assert cfg.max_seq_len == 2048

@@ -148,3 +148,131 @@ def test_ring_attention_gqa_repeat(seq_mesh):
     out = ring_attention(q, k, v, seq_mesh, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel MODEL forward (parallel/seq_forward): the full decoder
+# with attention routed through the ring / Ulysses kernels must match the
+# dense single-mesh forward exactly — including left-pad masks and ALiBi.
+# ---------------------------------------------------------------------------
+
+from lir_tpu.models import decoder
+from lir_tpu.models.registry import ModelConfig
+from lir_tpu.parallel import (
+    forward_seq_parallel,
+    prefill_seq_parallel,
+    seq_batch_sharding,
+)
+
+
+def _llama_tiny(**kw):
+    base = dict(name="seqfwd-llama", vocab_size=128, hidden_size=32,
+                n_layers=2, n_heads=8, intermediate_size=64, max_seq_len=128)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def _tokens(cfg, B=2, S=32, seed=7, left_pad=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(3, cfg.vocab_size, (B, S))
+    mask = np.ones((B, S), np.int32)
+    if left_pad:
+        for b in range(B):
+            n = (b * left_pad) % S
+            toks[b, :n] = 0
+            mask[b, :n] = 0
+    return jnp.asarray(toks, jnp.int32), jnp.asarray(mask)
+
+
+class TestSeqParallelForward:
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_matches_dense_forward(self, seq_mesh, impl):
+        cfg = _llama_tiny()
+        params = decoder.init_params(cfg, jax.random.PRNGKey(0))
+        toks, mask = _tokens(cfg)
+        expected = decoder.forward(params, cfg, toks, mask)
+        sb = seq_batch_sharding(seq_mesh)
+        out = forward_seq_parallel(
+            params, cfg, jax.device_put(toks, sb), jax.device_put(mask, sb),
+            mesh=seq_mesh, impl=impl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=3e-4, rtol=1e-4)
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_left_padded_parity(self, seq_mesh, impl):
+        """Ragged left-padded batches: mask-aware positions must propagate
+        into the sharded kernels exactly like _causal_bias."""
+        cfg = _llama_tiny()
+        params = decoder.init_params(cfg, jax.random.PRNGKey(1))
+        toks, mask = _tokens(cfg, B=4, left_pad=5)
+        expected = decoder.forward(params, cfg, toks, mask)
+        out = forward_seq_parallel(params, cfg, toks, mask,
+                                   mesh=seq_mesh, impl=impl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=3e-4, rtol=1e-4)
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_alibi_family(self, seq_mesh, impl):
+        """bloom's ALiBi bias is applied inside the seq-parallel kernels."""
+        cfg = _llama_tiny(name="seqfwd-bloom", pos_embedding="alibi",
+                          norm="layernorm", embedding_norm=True,
+                          gated_mlp=False, activation="gelu",
+                          qkv_bias=True, attn_out_bias=True, mlp_bias=True)
+        params = decoder.init_params(cfg, jax.random.PRNGKey(2))
+        toks, mask = _tokens(cfg, left_pad=3)
+        expected = decoder.forward(params, cfg, toks, mask)
+        out = forward_seq_parallel(params, cfg, toks, mask,
+                                   mesh=seq_mesh, impl=impl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=3e-4, rtol=1e-4)
+
+    def test_gqa_family(self, seq_mesh):
+        cfg = _llama_tiny(name="seqfwd-gqa", n_kv_heads=2)
+        params = decoder.init_params(cfg, jax.random.PRNGKey(3))
+        toks, mask = _tokens(cfg)
+        expected = decoder.forward(params, cfg, toks, mask)
+        out = forward_seq_parallel(params, cfg, toks, mask, mesh=seq_mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=3e-4, rtol=1e-4)
+
+    def test_needs_mesh(self):
+        cfg = _llama_tiny()
+        params = decoder.init_params(cfg, jax.random.PRNGKey(0))
+        toks, mask = _tokens(cfg)
+        with pytest.raises(ValueError, match="mesh"):
+            forward_seq_parallel(params, cfg, toks, mask)
+
+
+class TestSeqParallelPrefill:
+    def test_matches_dense_prefill_and_decodes(self, seq_mesh):
+        """Seq-sharded prefill fills the SAME cache as dense prefill, and an
+        ordinary dense decode step continues from it identically — the
+        long-prompt recipe (shard the O(S^2) phase, decode cheap)."""
+        cfg = _llama_tiny()
+        params = decoder.init_params(cfg, jax.random.PRNGKey(4))
+        toks, mask = _tokens(cfg, B=2, S=32, left_pad=4)
+        max_len = 40
+
+        el, (eck, ecv), epos = decoder.prefill(params, cfg, toks, mask, max_len)
+        ol, (ock, ocv), opos = prefill_seq_parallel(
+            params, cfg, toks, mask, max_len, mesh=seq_mesh)
+
+        np.testing.assert_allclose(np.asarray(ol), np.asarray(el),
+                                   atol=3e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(ock), np.asarray(eck),
+                                   atol=3e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(ocv), np.asarray(ecv),
+                                   atol=3e-4, rtol=1e-4)
+        np.testing.assert_array_equal(np.asarray(opos), np.asarray(epos))
+
+        # One dense decode step from each cache must agree.
+        B, S = toks.shape
+        tok_next = jnp.argmax(el, axis=-1).astype(jnp.int32)
+        full_mask = jnp.concatenate(
+            [mask, jnp.zeros((B, max_len - S), mask.dtype)], axis=1)
+        full_mask = full_mask.at[:, S].set(1)
+        args = (tok_next, epos, jnp.int32(S), full_mask)
+        dl, _ = decoder.decode_step(params, cfg, (eck, ecv), *args)
+        sl, _ = decoder.decode_step(params, cfg, (ock, ocv), *args)
+        np.testing.assert_allclose(np.asarray(sl), np.asarray(dl),
+                                   atol=3e-4, rtol=1e-4)
