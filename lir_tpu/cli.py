@@ -44,6 +44,10 @@ def _add_sweep(sub) -> None:
                         "fast afterwards")
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 quantization (7B fits one chip)")
+    p.add_argument("--int8-dynamic", action="store_true",
+                   help="with --int8: quantize activations per token and "
+                        "run s8xs8 MXU matmuls (LLM.int8()-style vector-"
+                        "wise mode, no outlier decomposition)")
 
 
 def _add_perturb(sub) -> None:
@@ -59,6 +63,7 @@ def _add_perturb(sub) -> None:
     p.add_argument("--mesh", type=str, default=None)
     p.add_argument("--param-cache", type=Path, default=None)
     p.add_argument("--int8", action="store_true")
+    p.add_argument("--int8-dynamic", action="store_true")
 
 
 def _add_rephrase(sub) -> None:
@@ -138,7 +143,7 @@ def cmd_sweep(args) -> None:
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(batch_size=args.batch_size),
         _parse_mesh(args.mesh), cache_root=args.param_cache,
-        quantize_int8=args.int8,
+        quantize_int8=args.int8, int8_dynamic=args.int8_dynamic,
     )
     run_model_comparison_sweep(
         _parse_models(args.models), factory, args.out,
@@ -156,7 +161,7 @@ def cmd_perturb(args) -> None:
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(batch_size=args.batch_size),
         _parse_mesh(args.mesh), cache_root=args.param_cache,
-        quantize_int8=args.int8,
+        quantize_int8=args.int8, int8_dynamic=args.int8_dynamic,
     )
     entries = load_or_generate_perturbations(
         args.perturbations, LEGAL_PROMPTS, None
@@ -311,6 +316,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     sub.add_parser("bench", help="prompts/sec/chip benchmark")
 
     args = parser.parse_args(argv)
+    if getattr(args, "int8_dynamic", False) and not getattr(args, "int8", False):
+        parser.error("--int8-dynamic requires --int8 (it selects HOW int8 "
+                     "matmuls run, not whether weights are quantized)")
     {
         "sweep": cmd_sweep,
         "perturb": cmd_perturb,

@@ -397,18 +397,22 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
     bias = None if attn_impl is not None else _causal_bias(attn_mask, positions, cfg)
 
     # Scan layers, capturing each block's (post-rope) k/v — returned by
-    # _block itself, no re-projection — into a (L, ...) stack.
+    # _block itself, no re-projection — into a (L, ...) stack. Each layer's
+    # k/v is padded to max_len INSIDE the body: the scan's output stacking
+    # then allocates the cache at its final (L, B, T, K, hd) size directly.
+    # Padding the stacked (L, ...) tensor afterwards would materialize the
+    # pre-pad stack AND the padded copy — ~2x cache HBM transiently, which
+    # is exactly what used to OOM a 7B at batch 32 / seq 1024 on one chip.
+    pad = max_len - S
+    pad_spec = ((0, 0), (0, pad), (0, 0), (0, 0))
+
     def body(h, lp):
         h_out, (k, v) = _block(h, lp, cfg, sin, cos, bias, None, None,
                                key_mask=attn_mask, attn_impl=attn_impl)
-        return h_out, (k, v)
+        return h_out, (jnp.pad(k, pad_spec), jnp.pad(v, pad_spec))
 
-    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    x, (ck, cv) = lax.scan(body, x, params["layers"])
     logits = _unembed(params, cfg, x[:, -1:, :])[:, 0, :]
-
-    pad = max_len - S
-    ck = jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-    cv = jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
     next_positions = positions[:, -1] + 1
     return logits, (ck, cv), next_positions
 

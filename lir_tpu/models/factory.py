@@ -101,6 +101,7 @@ def load_engine(
     dtype=None,
     cache_root: Optional[Path] = None,
     quantize_int8: bool = False,
+    int8_dynamic: bool = False,
 ) -> ScoringEngine:
     """Build a ready ScoringEngine from a local HF checkpoint directory.
 
@@ -142,7 +143,7 @@ def load_engine(
         from . import quant
 
         before = quant.param_bytes(params)
-        params = quant.quantize_decoder_params(params)
+        params = quant.quantize_decoder_params(params, dynamic=int8_dynamic)
         log.info(
             "int8-quantized %s: %.2f GB -> %.2f GB", model_dir.name,
             before / 2**30, quant.param_bytes(params) / 2**30,
@@ -172,6 +173,7 @@ def engine_factory(
     mesh_cfg: Optional[MeshConfig] = None,
     cache_root: Optional[Path] = None,
     quantize_int8: bool = False,
+    int8_dynamic: bool = False,
 ):
     """EngineFactory for engine.multi: maps an HF repo id to
     ``checkpoint_root/<org>__<name>`` or ``checkpoint_root/<name>``."""
@@ -187,7 +189,8 @@ def engine_factory(
             if cand.is_dir():
                 return load_engine(cand, runtime, mesh_cfg,
                                    cache_root=cache_root,
-                                   quantize_int8=quantize_int8)
+                                   quantize_int8=quantize_int8,
+                                   int8_dynamic=int8_dynamic)
         raise FileNotFoundError(
             f"no local checkpoint for {model_name} under {checkpoint_root} "
             f"(tried {[str(c) for c in candidates]})"

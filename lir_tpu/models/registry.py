@@ -64,8 +64,8 @@ class ModelConfig:
 
     # Attention backend: route full-sequence self-attention through the
     # Pallas flash kernel (O(S*hd) memory) instead of the dense score
-    # matrix. ALiBi models keep the dense path (the kernel has no additive
-    # bias), as do decode steps and non-block-divisible sequences.
+    # matrix. ALiBi (bloom) rides the kernel via per-head slopes; decode
+    # steps and non-block-divisible sequences fall back dense.
     use_flash_attention: bool = False
 
     def __post_init__(self) -> None:
@@ -131,20 +131,24 @@ def gptneox(name: str = "pythia-6.9b", *, hidden: int = 4096, layers: int = 32,
     )
 
 
-# 7B-class presets default to flash-attention prefill (VERDICT r1 #4): at
-# these sizes the dense (B, H, S, S) score tensor is the HBM hot spot the
-# Pallas kernel exists to remove. ALiBi (bloom) is supported in-kernel.
+# 7B-class presets run DENSE prefill attention by default: measured on a
+# v5e chip (SCALE.md "flash vs dense"), dense beats the Pallas flash
+# kernel by ~8% at every batch/seq that fits a single chip (S<=512 —
+# XLA's fused softmax never materializes the full (B, H, S, S) f32
+# tensor), and past that the KV-cache while-loop layout copies OOM first
+# either way. Flip use_flash_attention=True for long-S workloads on
+# larger-HBM chips; ALiBi (bloom) is supported in-kernel.
 
 def llama2_7b() -> ModelConfig:
     return ModelConfig(name="llama-2-7b", vocab_size=32000, hidden_size=4096,
                        n_layers=32, n_heads=32, intermediate_size=11008,
-                       max_seq_len=4096, use_flash_attention=True)
+                       max_seq_len=4096, use_flash_attention=False)
 
 
 def mistral_7b() -> ModelConfig:
     return ModelConfig(name="mistral-7b", vocab_size=32000, hidden_size=4096,
                        n_layers=32, n_heads=32, n_kv_heads=8, intermediate_size=14336,
-                       max_seq_len=4096, use_flash_attention=True)
+                       max_seq_len=4096, use_flash_attention=False)
 
 
 def qwen_7b() -> ModelConfig:
@@ -153,13 +157,13 @@ def qwen_7b() -> ModelConfig:
     return ModelConfig(name="qwen-7b", vocab_size=151936, hidden_size=4096,
                        n_layers=32, n_heads=32, intermediate_size=11008,
                        max_seq_len=2048, qkv_bias=True, norm_eps=1e-6,
-                       use_flash_attention=True)
+                       use_flash_attention=False)
 
 
 def baichuan2_7b() -> ModelConfig:
     return ModelConfig(name="baichuan2-7b", vocab_size=125696, hidden_size=4096,
                        n_layers=32, n_heads=32, intermediate_size=11008,
-                       max_seq_len=4096, use_flash_attention=True)
+                       max_seq_len=4096, use_flash_attention=False)
 
 
 def falcon_7b() -> ModelConfig:
@@ -168,7 +172,7 @@ def falcon_7b() -> ModelConfig:
         n_heads=71, n_kv_heads=1, intermediate_size=4 * 4544, max_seq_len=2048,
         pos_embedding="rotary", norm="layernorm", activation="gelu", gated_mlp=False,
         parallel_block=True, shared_block_ln=True, tie_embeddings=True,
-        use_flash_attention=True,
+        use_flash_attention=False,
     )
 
 
@@ -178,7 +182,7 @@ def bloom_7b1() -> ModelConfig:
         n_heads=32, intermediate_size=4 * 4096, max_seq_len=2048,
         pos_embedding="alibi", norm="layernorm", activation="gelu_new", gated_mlp=False,
         embedding_norm=True, qkv_bias=True, attn_out_bias=True, mlp_bias=True,
-        tie_embeddings=True, use_flash_attention=True,
+        tie_embeddings=True, use_flash_attention=False,
     )
 
 

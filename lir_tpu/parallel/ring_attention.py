@@ -48,7 +48,9 @@ def reference_attention(
     B, S, H, hd = q.shape
     scale = 1.0 / np.sqrt(hd)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if q_positions is not None:
+    if q_positions is not None or key_mask is not None:
+        if q_positions is None:
+            q_positions = jnp.broadcast_to(jnp.arange(S), (B, S))
         if kv_positions is None:
             kv_positions = q_positions
         allowed = jnp.ones((B, S, k.shape[1]), bool)
@@ -144,7 +146,6 @@ def _ring_kernel(q, k, v, q_index, axis_name: str, axis_size: int,
         if masked:
             kp_blk = lax.ppermute(kp_blk, axis_name, perm)
             kv_blk = lax.ppermute(kv_blk, axis_name, perm)
-            return (o, m_new, l, k_blk, v_blk, kp_blk, kv_blk)
         return (o, m_new, l, k_blk, v_blk, kp_blk, kv_blk)
 
     o, m, l, *_ = lax.fori_loop(

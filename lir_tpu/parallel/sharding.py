@@ -132,6 +132,7 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
                 q=jax.device_put(leaf.q, NamedSharding(mesh, spec)),
                 scale=jax.device_put(
                     leaf.scale, NamedSharding(mesh, quant_scale_spec(spec))),
+                dynamic=leaf.dynamic,
             )
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 

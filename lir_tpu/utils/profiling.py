@@ -73,7 +73,8 @@ class ThroughputMeter:
 
 # Published peak dense-matmul throughput per chip (bf16 FLOPS). Weight-only
 # int8 still computes in bf16 on the MXU, so bf16 peak is the MFU denominator
-# for every mode this framework runs. Keys are jax Device.device_kind strings.
+# there; dynamic int8 (s8 x s8 -> s32 dots) gets 2x this on every listed
+# chip. Keys are jax Device.device_kind strings.
 CHIP_PEAK_BF16_FLOPS = {
     "TPU v5 lite": 197e12,      # v5e
     "TPU v5e": 197e12,
@@ -83,12 +84,24 @@ CHIP_PEAK_BF16_FLOPS = {
 }
 
 
-def chip_peak_flops(device=None) -> Optional[float]:
-    """Peak bf16 FLOPS of the given (default: first) device, or None when
-    the chip kind is unknown (e.g. CPU) — callers skip the MFU gate then."""
+# s8-dot speedup over bf16 per chip: v5e/v5p/v6e run int8 at 2x bf16 MXU
+# rate; TPU v4 has NO accelerated int8 path (s8 dots run at the bf16 rate).
+CHIP_INT8_MULTIPLIER = {"TPU v4": 1.0}
+_DEFAULT_INT8_MULTIPLIER = 2.0
+
+
+def chip_peak_flops(device=None, int8: bool = False) -> Optional[float]:
+    """Peak matmul FLOPS of the given (default: first) device, or None when
+    the chip kind is unknown (e.g. CPU) — callers skip the MFU gate then.
+    ``int8=True`` returns the chip's s8-dot peak (2x bf16 on v5e/v5p/v6e,
+    1x on v4)."""
     if device is None:
         device = jax.devices()[0]
-    return CHIP_PEAK_BF16_FLOPS.get(getattr(device, "device_kind", ""))
+    kind = getattr(device, "device_kind", "")
+    peak = CHIP_PEAK_BF16_FLOPS.get(kind)
+    if peak is not None and int8:
+        peak *= CHIP_INT8_MULTIPLIER.get(kind, _DEFAULT_INT8_MULTIPLIER)
+    return peak
 
 
 def decoder_matmul_params(cfg) -> int:
@@ -152,9 +165,11 @@ def ensure_cpu_backend() -> bool:
 
     The analysis/survey layers are host statistics: tiny kernels where an
     accelerator buys nothing, and under a tunneled-TPU environment (axon)
-    every launch round-trips over HTTP — orders of magnitude slower than
-    local CPU. Call before any jax computation; returns False when the
-    backend was already initialized to something else (work proceeds there).
+    every launch round-trips over HTTP — measured 5-75x slower warm and
+    minutes of compile cold at the reference's problem sizes
+    (tools/stats_device_bench.py; table in SCALE.md). Call before any jax
+    computation; returns False when the backend was already initialized to
+    something else (work proceeds there).
     """
     import jax
 

@@ -163,12 +163,20 @@ def test_alibi_requires_positions():
         flash_attention(q, k, v, alibi_slopes=jnp.ones((4,)), interpret=True)
 
 
-def test_7b_presets_default_flash():
+def test_7b_presets_default_dense():
+    """Presets run DENSE prefill by default — a measured decision, not an
+    omission: on v5e, dense beats the flash kernel ~8% at every batch/seq
+    that fits one chip (SCALE.md "flash vs dense", 2026-07-30). The kernel
+    stays available behind the flag for long-S / large-HBM regimes."""
     from lir_tpu.models import registry
 
     for mk in (registry.llama2_7b, registry.mistral_7b, registry.qwen_7b,
                registry.baichuan2_7b, registry.falcon_7b, registry.bloom_7b1):
-        assert mk().use_flash_attention, mk().name
+        assert not mk().use_flash_attention, mk().name
+        # The flag itself must keep working per preset.
+        import dataclasses
+        assert dataclasses.replace(
+            mk(), use_flash_attention=True).use_flash_attention
 
 
 def test_decoder_alibi_flash_routing_matches_dense():
