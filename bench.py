@@ -57,11 +57,11 @@ SEQ = 256
 NEW_TOKENS = 10  # MAX_LOOK_AHEAD: the positions the C13 readout consumes
 
 # (batch, n_iters) candidates, largest batch first; on HBM exhaustion the
-# bench falls back down the list. 7B int8 on v5e-1 (16 GB): params 6.3 GiB +
-# KV cache ~139 MiB/row; batch 32 OOMs on XLA's prefill->decode cache
-# layout copies (2x 2.08 GiB) + 42% temp fragmentation, and measures no
-# faster than 24 anyway — 24 is the throughput knee (measured 2026-07-30).
-TPU_CANDIDATES = ((24, 6), (16, 8), (8, 8))
+# bench falls back down the list. 7B int8 on v5e-1 (16 GB): params 6.3 GiB;
+# the int8 KV cache (~70 MiB/row incl. XLA's while-loop layout copy)
+# admits batch 48, the measured throughput knee; 64 OOMs (SCALE.md,
+# 2026-07-30).
+TPU_CANDIDATES = ((48, 4), (32, 6), (24, 6), (16, 8), (8, 8))
 CPU_CANDIDATES = ((8, 2), (4, 2))
 
 
@@ -80,14 +80,18 @@ def main() -> None:
     on_accel = dev.platform != "cpu"
 
     if on_accel:
+        import dataclasses
+
         from lir_tpu.models.registry import llama2_7b
-        cfg = llama2_7b()
+        # int8 KV cache: half the cache HBM -> batch 48 fits (the knee);
+        # decode attention runs s8 dots like the dynamic weight mode.
+        cfg = dataclasses.replace(llama2_7b(), kv_cache_int8=True)
         params = quant.random_quantized_params(cfg, jax.random.PRNGKey(0),
                                                dtype=jnp.bfloat16,
                                                dynamic=True)
         candidates = TPU_CANDIDATES
         nominal = BENCH_NOMINAL_7B
-        mode = "int8-dyn"
+        mode = "int8-dyn+kvq8"
     else:
         from __graft_entry__ import _flagship_cfg
         cfg = _flagship_cfg()
@@ -141,7 +145,7 @@ def main() -> None:
     batch_used = candidates[-1][0]
     implied_tflops = 0.0
     mfu = None
-    peak = (profiling.chip_peak_flops(dev, int8=(mode == "int8-dyn"))
+    peak = (profiling.chip_peak_flops(dev, int8=mode.startswith("int8-dyn"))
             if on_accel else None)
 
     last_oom = None

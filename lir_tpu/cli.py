@@ -48,6 +48,10 @@ def _add_sweep(sub) -> None:
                    help="with --int8: quantize activations per token and "
                         "run s8xs8 MXU matmuls (LLM.int8()-style vector-"
                         "wise mode, no outlier decomposition)")
+    p.add_argument("--kv-cache-int8", action="store_true",
+                   help="store the KV cache int8 with per-vector scales: "
+                        "half the cache HBM (longer contexts / bigger "
+                        "batches on one chip), s8 decode attention dots")
 
 
 def _add_perturb(sub) -> None:
@@ -64,6 +68,7 @@ def _add_perturb(sub) -> None:
     p.add_argument("--param-cache", type=Path, default=None)
     p.add_argument("--int8", action="store_true")
     p.add_argument("--int8-dynamic", action="store_true")
+    p.add_argument("--kv-cache-int8", action="store_true")
 
 
 def _add_rephrase(sub) -> None:
@@ -144,6 +149,7 @@ def cmd_sweep(args) -> None:
         args.checkpoints, RuntimeConfig(batch_size=args.batch_size),
         _parse_mesh(args.mesh), cache_root=args.param_cache,
         quantize_int8=args.int8, int8_dynamic=args.int8_dynamic,
+        kv_cache_int8=args.kv_cache_int8,
     )
     run_model_comparison_sweep(
         _parse_models(args.models), factory, args.out,
@@ -162,6 +168,7 @@ def cmd_perturb(args) -> None:
         args.checkpoints, RuntimeConfig(batch_size=args.batch_size),
         _parse_mesh(args.mesh), cache_root=args.param_cache,
         quantize_int8=args.int8, int8_dynamic=args.int8_dynamic,
+        kv_cache_int8=args.kv_cache_int8,
     )
     entries = load_or_generate_perturbations(
         args.perturbations, LEGAL_PROMPTS, None

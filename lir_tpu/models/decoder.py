@@ -203,6 +203,75 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
     return out.reshape(B, S, H * hd)
 
 
+def _quant_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization over the trailing axis (amax/127) —
+    delegates to quant.dynamic_quant, the single source of the rule. Used
+    for the int8 KV cache: one scale per (head, position, row) vector."""
+    from .quant import dynamic_quant
+
+    return dynamic_quant(x)
+
+
+def _attention_cached_int8(q: jax.Array, kq, ks, vq, vs,
+                           bias: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Decode-step attention over the int8 cache (payload (K, T, B, hd) +
+    scales (K, T, B)). All dots run s8 x s8 -> s32 on the MXU: the query
+    and the value-scale-folded probabilities are quantized dynamically
+    per vector, so neither a bf16 copy of the cache nor one of the weights
+    ever materializes. Softmax stays fp32.
+
+    GQA/MQA contracts GROUPED query heads against the un-repeated cache
+    (q reshaped to (B, S, K, G, hd)) — repeating the cache K -> H would
+    materialize an H/K-times copy of the whole cache inside the decode
+    loop, giving back the HBM the int8 cache exists to save.
+    """
+    B, S, H, hd = q.shape
+    K = kq.shape[0]
+    G = H // K
+    qq, qs = _quant_kv(q)                                   # (B,S,H,hd),(B,S,H)
+    qq = qq.reshape(B, S, K, G, hd)
+    s32 = jnp.einsum("bskgd,ktbd->bkgst", qq, kq,
+                     preferred_element_type=jnp.int32)
+    scores = (s32.astype(jnp.float32).reshape(B, H, S, -1)
+              * qs.transpose(0, 2, 1)[:, :, :, None]        # (B,H,S,1)
+              * jnp.repeat(ks.transpose(2, 0, 1), G, axis=1)[:, :, None, :])
+    scores = scores / math.sqrt(hd) + bias
+    probs = jax.nn.softmax(scores, axis=-1)                 # fp32 (B,H,S,T)
+    # Fold v scales in, then dynamically quantize the weighted probs.
+    pw = probs * jnp.repeat(vs.transpose(2, 0, 1), G, axis=1)[:, :, None, :]
+    pq, ps = _quant_kv(pw)                                  # (B,H,S,T),(B,H,S)
+    pq = pq.reshape(B, K, G, S, -1)
+    o32 = jnp.einsum("bkgst,ktbd->bskgd", pq, vq,
+                     preferred_element_type=jnp.int32)
+    out = (o32.astype(jnp.float32).reshape(B, S, H, hd)
+           * ps.transpose(0, 2, 1)[..., None])
+    return out.astype(q.dtype).reshape(B, S, H * hd)
+
+
+def _attention_cached(q: jax.Array, k: jax.Array, v: jax.Array,
+                      bias: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Decode-step attention over the CACHE layout (K, T, B, hd).
+
+    The cache is stored head-major/batch-minor on purpose: it is the
+    layout XLA's decode while-loop prefers for these dots, so the loop
+    carry aliases the prefill output instead of inserting two full-cache
+    layout copies (measured 2x 2.08 GiB at 7B batch 32 — the difference
+    between fitting a chip and OOM; see SCALE.md). q: (B, S=1, H, hd).
+    GQA/MQA contracts grouped query heads against the un-repeated cache
+    (see _attention_cached_int8).
+    """
+    B, S, H, hd = q.shape
+    K = k.shape[0]
+    G = H // K
+    qg = q.reshape(B, S, K, G, hd)
+    scores = jnp.einsum("bskgd,ktbd->bkgst", qg, k).astype(jnp.float32)
+    scores = scores.reshape(B, H, S, -1) / math.sqrt(hd) + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    pg = probs.reshape(B, K, G, S, -1)
+    out = jnp.einsum("bkgst,ktbd->bskgd", pg, v)
+    return out.reshape(B, S, H * hd)
+
+
 def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
            bias: jax.Array, cache_kv: Optional[Tuple[jax.Array, jax.Array]],
            cache_index: Optional[jax.Array],
@@ -232,21 +301,35 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
         k = _apply_rope(k, sin, cos, rd)
 
     if cache_kv is not None:
-        # Decode: insert this step's k/v at cache_index, attend over full cache.
+        # Decode: insert this step's k/v at cache_index, attend over the
+        # full cache. Cache layout is (K, T, B, hd) — see _attention_cached.
         ck, cv = cache_kv
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
-        k_all, v_all = ck, cv
-    else:
+        k_t = k.transpose(2, 1, 0, 3)  # (B, 1, K, hd) -> (K, 1, B, hd)
+        v_t = v.transpose(2, 1, 0, 3)
+        if cfg.kv_cache_int8:
+            (ckq, cks), (cvq, cvs) = ck, cv
+            k_q, k_s = _quant_kv(k_t)
+            v_q, v_s = _quant_kv(v_t)
+            ckq = lax.dynamic_update_slice(ckq, k_q, (0, cache_index, 0, 0))
+            cks = lax.dynamic_update_slice(cks, k_s, (0, cache_index, 0))
+            cvq = lax.dynamic_update_slice(cvq, v_q, (0, cache_index, 0, 0))
+            cvs = lax.dynamic_update_slice(cvs, v_s, (0, cache_index, 0))
+            ck, cv = (ckq, cks), (cvq, cvs)
+            attn = _attention_cached_int8(q, ckq, cks, cvq, cvs, bias, cfg)
+        else:
+            ck = lax.dynamic_update_slice(ck, k_t.astype(ck.dtype),
+                                          (0, cache_index, 0, 0))
+            cv = lax.dynamic_update_slice(cv, v_t.astype(cv.dtype),
+                                          (0, cache_index, 0, 0))
+            attn = _attention_cached(q, ck, cv, bias, cfg)
+    elif attn_impl is not None:
         # Prefill/forward: hand back this layer's (post-rope) k/v so prefill
         # can fill the cache without re-projecting them.
         ck, cv = k, v
-        k_all, v_all = k, v
-
-    if attn_impl is not None:
-        attn = attn_impl(q, k_all, v_all, key_mask)
+        attn = attn_impl(q, k, v, key_mask)
     else:
-        attn = _attention(q, k_all, v_all, bias, cfg, key_mask=key_mask)
+        ck, cv = k, v
+        attn = _attention(q, k, v, bias, cfg, key_mask=key_mask)
     attn = _mm(attn, lp["wo"])
     if cfg.attn_out_bias:
         attn = attn + lp["bo"]
@@ -371,8 +454,22 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.float32):
-    """Per-layer KV cache stacked on the layer axis: (L, B, T, K, hd) pair."""
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    """Per-layer KV cache stacked on the layer axis: (L, K, T, B, hd) pair.
+
+    Head-major/batch-minor on purpose: this is the physical order XLA's
+    decode while-loop assigns to the cache anyway; storing it logically
+    row-major in that order lets the loop carry alias the prefill output
+    instead of copying the whole cache (see _attention_cached).
+
+    With ``cfg.kv_cache_int8`` each side becomes a (payload int8
+    (L, K, T, B, hd), scale f32 (L, K, T, B)) pair — half the HBM.
+    """
+    shape = (cfg.n_layers, cfg.n_kv_heads, max_len, batch, cfg.head_dim)
+    if cfg.kv_cache_int8:
+        def side():
+            return (jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:-1], jnp.float32))
+        return (side(), side())
     return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 
@@ -398,17 +495,25 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
     # Scan layers, capturing each block's (post-rope) k/v — returned by
     # _block itself, no re-projection — into a (L, ...) stack. Each layer's
-    # k/v is padded to max_len INSIDE the body: the scan's output stacking
-    # then allocates the cache at its final (L, B, T, K, hd) size directly.
-    # Padding the stacked (L, ...) tensor afterwards would materialize the
-    # pre-pad stack AND the padded copy — ~2x cache HBM transiently, which
-    # is exactly what used to OOM a 7B at batch 32 / seq 1024 on one chip.
+    # k/v is transposed to the cache layout (K, S, B, hd) and padded to
+    # max_len INSIDE the body: the scan's output stacking then allocates
+    # the cache at its final (L, K, T, B, hd) size directly, in the layout
+    # the decode loop consumes. Stacking first and padding/transposing the
+    # (L, ...) tensor afterwards would materialize the whole cache twice —
+    # exactly what used to OOM a 7B at batch 32 / seq 1024 on one chip.
     pad = max_len - S
     pad_spec = ((0, 0), (0, pad), (0, 0), (0, 0))
 
     def body(h, lp):
         h_out, (k, v) = _block(h, lp, cfg, sin, cos, bias, None, None,
                                key_mask=attn_mask, attn_impl=attn_impl)
+        k = k.transpose(2, 1, 0, 3)  # (B, S, K, hd) -> (K, S, B, hd)
+        v = v.transpose(2, 1, 0, 3)
+        if cfg.kv_cache_int8:
+            def side(x):
+                xq, xs = _quant_kv(x)
+                return (jnp.pad(xq, pad_spec), jnp.pad(xs, pad_spec[:-1]))
+            return h_out, (side(k), side(v))
         return h_out, (jnp.pad(k, pad_spec), jnp.pad(v, pad_spec))
 
     x, (ck, cv) = lax.scan(body, x, params["layers"])
@@ -434,7 +539,6 @@ def decode_step(params: Params, cfg: ModelConfig, cache, token: jax.Array,
     if cfg.pos_embedding == "rotary":
         sin, cos = _rope_sincos(position[:, None], cfg.rotary_dim, cfg.rope_theta)
 
-    T = cache[0].shape[2]
     key_positions = mask_positions(prompt_mask)
     bias = _causal_bias(jnp.ones((B, 1), jnp.int32), position[:, None], cfg,
                         key_positions=key_positions, key_mask=prompt_mask)

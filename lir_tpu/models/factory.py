@@ -102,6 +102,7 @@ def load_engine(
     cache_root: Optional[Path] = None,
     quantize_int8: bool = False,
     int8_dynamic: bool = False,
+    kv_cache_int8: bool = False,
 ) -> ScoringEngine:
     """Build a ready ScoringEngine from a local HF checkpoint directory.
 
@@ -139,6 +140,10 @@ def load_engine(
         if cache_root is not None:
             cache_mod.save_params(cache_root, model_dir.name, params, cfg)
 
+    if kv_cache_int8 and not encdec:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, kv_cache_int8=True)
     if quantize_int8 and not encdec:
         from . import quant
 
@@ -174,6 +179,7 @@ def engine_factory(
     cache_root: Optional[Path] = None,
     quantize_int8: bool = False,
     int8_dynamic: bool = False,
+    kv_cache_int8: bool = False,
 ):
     """EngineFactory for engine.multi: maps an HF repo id to
     ``checkpoint_root/<org>__<name>`` or ``checkpoint_root/<name>``."""
@@ -190,7 +196,8 @@ def engine_factory(
                 return load_engine(cand, runtime, mesh_cfg,
                                    cache_root=cache_root,
                                    quantize_int8=quantize_int8,
-                                   int8_dynamic=int8_dynamic)
+                                   int8_dynamic=int8_dynamic,
+                                   kv_cache_int8=kv_cache_int8)
         raise FileNotFoundError(
             f"no local checkpoint for {model_name} under {checkpoint_root} "
             f"(tried {[str(c) for c in candidates]})"

@@ -65,6 +65,19 @@ def quantize(w: jax.Array) -> QuantTensor:
     return QuantTensor(q=q, scale=scale)
 
 
+def dynamic_quant(x: jax.Array):
+    """Symmetric per-vector int8 quantization over the LAST axis:
+    x (..., D) -> (int8 payload (..., D), fp32 scale (...)), amax/127 with
+    a zero-safe floor. The single source of the dynamic rule — used for
+    activations (matmul), the int8 KV cache (models/decoder._quant_kv),
+    and decode attention probabilities."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def matmul(x: jax.Array, w) -> jax.Array:
     """x @ w for dense or QuantTensor weights: (..., D_in) x (D_in, D_out).
 
@@ -79,13 +92,11 @@ def matmul(x: jax.Array, w) -> jax.Array:
     """
     if isinstance(w, QuantTensor):
         if w.dynamic:
-            xf = x.astype(jnp.float32)
-            amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
-            xs = jnp.maximum(amax, 1e-8) / 127.0
-            xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+            xq, xs = dynamic_quant(x)
             y = jnp.einsum("...d,de->...e", xq, w.q,
                            preferred_element_type=jnp.int32)
-            return (y.astype(jnp.float32) * xs * w.scale).astype(x.dtype)
+            return (y.astype(jnp.float32) * xs[..., None]
+                    * w.scale).astype(x.dtype)
         y = jnp.einsum("...d,de->...e", x, w.q.astype(x.dtype))
         return y * w.scale.astype(x.dtype)
     return jnp.einsum("...d,de->...e", x, w)

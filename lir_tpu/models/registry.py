@@ -68,6 +68,15 @@ class ModelConfig:
     # steps and non-block-divisible sequences fall back dense.
     use_flash_attention: bool = False
 
+    # KV-cache storage: int8 with per-(head, position, row) scales halves
+    # cache HBM (the single-chip long-context limiter — a 7B's bf16 cache
+    # plus XLA's while-loop copy OOMs v5e at seq 1024, SCALE.md) and
+    # halves decode-phase cache reads. Decode attention then runs s8 x s8
+    # dots with dynamic query/probability quantization, mirroring the
+    # dynamic int8 weight mode. Prefill attention is unaffected (it reads
+    # the pre-quantization k/v). Opt-in; measured accuracy in tests.
+    kv_cache_int8: bool = False
+
     def __post_init__(self) -> None:
         if self.head_dim is None:
             object.__setattr__(self, "head_dim", self.hidden_size // self.n_heads)

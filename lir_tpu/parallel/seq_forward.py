@@ -105,9 +105,11 @@ def prefill_seq_parallel(params, cfg: ModelConfig, tokens: jax.Array,
     tokens = lax.with_sharding_constraint(tokens, sb)
     attn_mask = lax.with_sharding_constraint(attn_mask, sb)
     attn_impl = make_seq_attn_impl(cfg, mesh, impl, axis_name)
-    logits, (ck, cv), next_pos = decoder.prefill(
+    logits, cache, next_pos = decoder.prefill(
         params, cfg, tokens, attn_mask, max_len, attn_impl=attn_impl)
-    unshard = NamedSharding(mesh, P(None, None, None, None, None))
-    ck = lax.with_sharding_constraint(ck, unshard)
-    cv = lax.with_sharding_constraint(cv, unshard)
-    return logits, (ck, cv), next_pos
+
+    def unshard(x):
+        return lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*([None] * x.ndim))))
+
+    return logits, jax.tree.map(unshard, cache), next_pos

@@ -122,7 +122,9 @@ def test_prefill_matches_forward():
     last, cache, next_pos = decoder.prefill(params, cfg, toks, mask, max_len=16)
     np.testing.assert_allclose(np.asarray(last), np.asarray(full[:, -1]),
                                atol=1e-4, rtol=1e-4)
-    assert cache[0].shape == (cfg.n_layers, 2, 16, cfg.n_kv_heads, cfg.head_dim)
+    # Cache layout is (L, K, T, B, hd) — head-major/batch-minor so the
+    # decode while-loop aliases it instead of copying (decoder.init_cache).
+    assert cache[0].shape == (cfg.n_layers, cfg.n_kv_heads, 16, 2, cfg.head_dim)
     assert np.all(np.asarray(next_pos) == 8)
 
 

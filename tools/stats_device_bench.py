@@ -118,6 +118,18 @@ def main() -> None:
             sys.exit(1)
         results[backend] = json.loads(proc.stdout.strip().splitlines()[-1])
 
+    # Refuse to record a "TPU vs CPU" table measured on two CPU backends
+    # (e.g. no reachable chip and jax silently fell back) — the whole point
+    # of this tool is honest data.
+    if results["tpu"]["platform"] == "cpu":
+        print("ABORT: the 'tpu' child ran on the CPU backend "
+              f"({results['tpu']['device_kind']}); no table written.")
+        sys.exit(1)
+    if results["cpu"]["platform"] != "cpu":
+        print("ABORT: the 'cpu' child did not run on CPU "
+              f"({results['cpu']['platform']}); no table written.")
+        sys.exit(1)
+
     date = datetime.date.today().isoformat()
     kind = results["tpu"]["device_kind"]
     lines = [
