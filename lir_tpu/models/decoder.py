@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import ModelConfig
-from .quant import QuantTensor, matmul as _mm
+from .quant import QuantTensor, dynamic_quant as _quant_kv, matmul as _mm
 
 Params = Dict[str, Any]
 
@@ -201,15 +201,6 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhst,bthd->bshd", probs, v)
     return out.reshape(B, S, H * hd)
-
-
-def _quant_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Symmetric int8 quantization over the trailing axis (amax/127) —
-    delegates to quant.dynamic_quant, the single source of the rule. Used
-    for the int8 KV cache: one scale per (head, position, row) vector."""
-    from .quant import dynamic_quant
-
-    return dynamic_quant(x)
 
 
 def _attention_cached_int8(q: jax.Array, kq, ks, vq, vs,

@@ -1,0 +1,142 @@
+"""End-to-end PERTURBATION-SWEEP throughput at 7B scale — the literal
+BASELINE.json metric ("prompts/sec/chip on the perturbation sweep").
+
+bench.py measures the fused scoring step in isolation (in-scan, checksum-
+synced). This tool measures the whole production loop around it:
+grid build -> manifest resume filter -> length bucketing/padding ->
+tokenization -> fused binary + confidence decodes -> top-20 logprob map ->
+D6 Excel append + manifest write-ahead — `engine.sweep.run_perturbation_
+sweep` exactly as the CLI runs it, on a full-size llama-2-7b (random
+weights, dynamic int8 + int8 KV cache) with long rephrasings that
+land in the 256-token bucket at the default N_WORDS, as the real legal
+prompts do (SURVEY.md §6:
+prompt + format <= ~700 tokens).
+
+A warmup sweep (separate results dir) triggers the two jit compiles; the
+timed sweep then runs all-warm, matching steady-state operation where one
+compile serves ~20k grid cells. Appends measured numbers to SCALE.md.
+
+Run on the TPU:  python tools/sweep_bench.py [--cells 192] [--batch 48]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+SCALE_MD = REPO / "SCALE.md"
+
+WORDS = ("coverage policy flood water damage claim insurer holder premium "
+         "exclusion endorsement rider peril deductible adjuster settle "
+         "liability clause binding interpret statute ordinary meaning").split()
+
+
+N_WORDS = 170  # + format lines -> the 256-token bucket for FakeTokenizer
+
+
+def _long_text(rng, n_words: int = N_WORDS) -> str:
+    return " ".join(rng.choice(WORDS) for _ in range(n_words)) + " ?"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cells", type=int, default=192)
+    ap.add_argument("--batch", type=int, default=48)
+    ap.add_argument("--no-record", action="store_true",
+                    help="print only; do not append to SCALE.md")
+    args = ap.parse_args()
+
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.models import quant
+    from lir_tpu.models.registry import llama2_7b
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    if not on_accel:
+        print("# no accelerator: running the tiny CPU smoke variant")
+
+    if on_accel:
+        cfg = dataclasses.replace(llama2_7b(), kv_cache_int8=True)
+        params = quant.random_quantized_params(
+            cfg, jax.random.PRNGKey(0), dtype=jax.numpy.bfloat16,
+            dynamic=True)
+        mode = "llama-2-7b int8-dyn+kvq8"
+    else:
+        from lir_tpu.models import decoder
+        from lir_tpu.models.registry import ModelConfig
+        cfg = ModelConfig(name="sweep-smoke", vocab_size=1024, hidden_size=64,
+                          n_layers=2, n_heads=4, intermediate_size=128,
+                          max_seq_len=512)
+        params = decoder.init_params(cfg, jax.random.PRNGKey(0))
+        mode = "136M-smoke fp32"
+
+    rt = RuntimeConfig(batch_size=args.batch, max_seq_len=512)
+    engine = ScoringEngine(params, cfg, FakeTokenizer(), rt)
+
+    rng = np.random.default_rng(7)
+    lp = (LegalPrompt(
+        main=_long_text(rng),
+        response_format="Respond with either ' Yes' or ' No' only .",
+        target_tokens=("Yes", "No"),
+        confidence_format="Give a confidence number from 0 to 100 ."),)
+
+    def run(n_cells: int, tag: str) -> float:
+        perts = ([_long_text(rng) for _ in range(n_cells - 1)],)
+        with tempfile.TemporaryDirectory() as td:
+            t0 = time.perf_counter()
+            rows = run_perturbation_sweep(
+                engine, f"sweep-bench-{tag}", lp, perts,
+                Path(td) / "results.xlsx", checkpoint_every=100)
+            dt = time.perf_counter() - t0
+        assert len(rows) == n_cells, (len(rows), n_cells)
+        assert all(np.isfinite(r.token_1_prob) for r in rows)
+        return dt
+
+    warm_cells = args.batch  # one full bucket: triggers both compiles
+    t_warm = run(warm_cells, "warmup")
+    print(f"# warmup ({warm_cells} cells incl. compiles): {t_warm:.1f}s")
+    t = run(args.cells, "timed")
+    rate = args.cells / t
+    print(f"sweep_bench: {args.cells} grid cells in {t:.1f}s -> "
+          f"{rate:.2f} prompts/s/chip end-to-end ({mode}, batch "
+          f"{args.batch}, ~{N_WORDS}-word rephrasings, "
+          f"binary+confidence per cell)")
+
+    if args.no_record or not on_accel:
+        return
+    date = datetime.date.today().isoformat()
+    SCALE_MD.write_text(SCALE_MD.read_text() + f"""
+## end-to-end sweep throughput — {dev.device_kind}, {date}
+
+`run_perturbation_sweep` exactly as the CLI runs it (grid + manifest +
+bucketing + tokenize + binary & confidence fused decodes + top-20 logprob
+maps + D6 Excel/manifest writes), {mode}, batch {args.batch},
+~{N_WORDS}-word rephrasings:
+
+- {args.cells} grid cells in {t:.1f}s = **{rate:.2f} prompts/s/chip
+  end-to-end** (warm; compile-inclusive warmup bucket took {t_warm:.1f}s)
+- vs bench.py's isolated scoring step at the same batch: the gap is the
+  real orchestration overhead (host readback, Excel append, manifest).
+""")
+    print("recorded to SCALE.md")
+
+
+if __name__ == "__main__":
+    main()
