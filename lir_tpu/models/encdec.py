@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import T5Config
+from .quant import QuantTensor, matmul as _mm
 
 Params = Dict[str, Any]
 
@@ -67,7 +68,9 @@ def _attn(q, k, v, bias):
 
 
 def _proj(x, w):
-    return jnp.einsum("bsd,de->bse", x, w)
+    """Dense or int8 (QuantTensor) projection — quant.matmul handles both,
+    including the dynamic s8 x s8 activation-quantization mode."""
+    return _mm(x, w)
 
 
 def _mlp(x, lp, cfg: T5Config):
@@ -135,7 +138,7 @@ def encode(params: Params, cfg: T5Config, tokens: jax.Array,
         q = _proj(a_in, lp["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
         kk = _proj(a_in, lp["wk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
         vv = _proj(a_in, lp["wv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
-        h = h + jnp.einsum("bse,ed->bsd", _attn(q, kk, vv, bias), lp["wo"])
+        h = h + _proj(_attn(q, kk, vv, bias), lp["wo"])
         m_in = _rmsnorm(h, lp["ln_mlp"], cfg.norm_eps)
         h = h + _mlp(m_in, lp, cfg)
         return h, None
@@ -168,14 +171,14 @@ def decode(params: Params, cfg: T5Config, enc_out: jax.Array,
         q = _proj(a_in, lp["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
         kk = _proj(a_in, lp["wk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
         vv = _proj(a_in, lp["wv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
-        h = h + jnp.einsum("bse,ed->bsd", _attn(q, kk, vv, self_bias), lp["wo"])
+        h = h + _proj(_attn(q, kk, vv, self_bias), lp["wo"])
 
         c_in = _rmsnorm(h, lp["ln_cross"], cfg.norm_eps)
         Te = enc_out.shape[1]
         cq = _proj(c_in, lp["cq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
         ck = _proj(enc_out, lp["ck"]).reshape(B, Te, cfg.n_heads, cfg.head_dim)
         cv = _proj(enc_out, lp["cv"]).reshape(B, Te, cfg.n_heads, cfg.head_dim)
-        h = h + jnp.einsum("bse,ed->bsd", _attn(cq, ck, cv, cross_bias), lp["co"])
+        h = h + _proj(_attn(cq, ck, cv, cross_bias), lp["co"])
 
         m_in = _rmsnorm(h, lp["ln_mlp"], cfg.norm_eps)
         h = h + _mlp(m_in, lp, cfg)
@@ -189,6 +192,8 @@ def decode(params: Params, cfg: T5Config, enc_out: jax.Array,
         x = x * (cfg.hidden_size ** -0.5)
     else:
         head = params["lm_head"]
+    if isinstance(head, QuantTensor):
+        return _mm(x.astype(jnp.float32), head)
     return jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32), head.astype(jnp.float32))
 
 

@@ -144,11 +144,13 @@ def load_engine(
         import dataclasses
 
         cfg = dataclasses.replace(cfg, kv_cache_int8=True)
-    if quantize_int8 and not encdec:
+    if quantize_int8:
         from . import quant
 
         before = quant.param_bytes(params)
-        params = quant.quantize_decoder_params(params, dynamic=int8_dynamic)
+        qfn = (quant.quantize_encdec_params if encdec
+               else quant.quantize_decoder_params)
+        params = qfn(params, dynamic=int8_dynamic)
         log.info(
             "int8-quantized %s: %.2f GB -> %.2f GB", model_dir.name,
             before / 2**30, quant.param_bytes(params) / 2**30,

@@ -127,6 +127,32 @@ def quantize_decoder_params(params: Params, dynamic: bool = False) -> Params:
     return out
 
 
+# T5-family per-layer matrices (models/encdec.py stacks; biases/norms and
+# the relative-position embeddings stay dense).
+_ENCDEC_MATRICES = ("wq", "wk", "wv", "wo", "wi", "wi_0", "wi_1", "wo_mlp",
+                    "cq", "ck", "cv", "co")
+
+
+def quantize_encdec_params(params: Params, dynamic: bool = False) -> Params:
+    """int8-quantize a converted T5 param tree (models/encdec.py layout) —
+    the reference loads its t5/T0/tk-instruct models through the same 8-bit
+    config as the decoders (compare_base_vs_instruct.py:431-435 via
+    AutoModelForSeq2SeqLM :444-455). Same rules as the decoder path:
+    per-output-channel scales, optional dynamic activation mode, lm_head
+    weight-only (tied v1.0 embeddings stay dense entirely)."""
+    out = dict(params)
+    for side in ("encoder", "decoder"):
+        blk = dict(params[side])
+        for name in _ENCDEC_MATRICES:
+            if name in blk:
+                blk[name] = dataclasses.replace(quantize(blk[name]),
+                                                dynamic=dynamic)
+        out[side] = blk
+    if "lm_head" in params:
+        out["lm_head"] = quantize(params["lm_head"])
+    return out
+
+
 def random_quantized_params(cfg, key: jax.Array, dtype=jnp.bfloat16,
                             dynamic: bool = False) -> Params:
     """Random param tree at FULL size with the big matrices born int8.

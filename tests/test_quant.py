@@ -252,3 +252,56 @@ class TestInt8KVCache:
         ga, sa = generate.greedy_decode(params, cfg, toks, mask, max_new_tokens=4)
         gb, sb = generate.greedy_decode(params, cfg_q, toks, mask, max_new_tokens=4)
         np.testing.assert_array_equal(np.asarray(ga), np.asarray(gb))
+
+
+class TestEncDecInt8:
+    """T5-family int8 (quantize_encdec_params): the reference loads its
+    t5/T0/tk-instruct models through the SAME 8-bit config as the decoders
+    (compare_base_vs_instruct.py:431-435, routing :444-455)."""
+
+    @pytest.fixture(scope="class")
+    def t5(self):
+        import transformers as tf
+        from lir_tpu.models.loader import convert_t5, t5_config_from_hf
+
+        torch.manual_seed(1)
+        hf_cfg = tf.T5Config(
+            vocab_size=256, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+            num_heads=4, feed_forward_proj="gated-gelu",
+            tie_word_embeddings=False, decoder_start_token_id=0)
+        hf = tf.T5ForConditionalGeneration(hf_cfg).eval()
+        cfg = t5_config_from_hf(hf.config)
+        return convert_t5(hf.state_dict(), cfg), cfg
+
+    @pytest.mark.parametrize("dynamic", [False, True])
+    def test_forward_close_to_dense(self, t5, dynamic):
+        from lir_tpu.models import encdec
+
+        params, cfg = t5
+        qp = quant.quantize_encdec_params(params, dynamic=dynamic)
+        assert qp["encoder"]["wq"].q.dtype == jnp.int8
+        assert qp["decoder"]["co"].dynamic == dynamic
+        assert not qp["lm_head"].dynamic  # logit head stays weight-only
+
+        rng = np.random.default_rng(5)
+        enc = jnp.asarray(rng.integers(0, 256, (2, 10)), jnp.int32)
+        dec = jnp.asarray([[0, 5, 9], [0, 7, 3]], jnp.int32)
+        mask = jnp.ones((2, 10), jnp.int32)
+        dense = encdec.forward(params, cfg, enc, mask, dec)
+        q = encdec.forward(qp, cfg, enc, mask, dec)
+        pd = np.asarray(jax.nn.softmax(dense, axis=-1))
+        pq = np.asarray(jax.nn.softmax(q, axis=-1))
+        # Random-init T5 logits are sharp (untrained torch init), so int8
+        # noise lands on near-argmax classes; 8e-2 bounds the dynamic mode
+        # on this synthetic worst case (weight-only measures ~2e-2).
+        np.testing.assert_allclose(pq, pd, atol=8e-2)
+        # The scored quantity is the two-token relative prob — pin it tight.
+        rel_d = pd[..., 5] / (pd[..., 5] + pd[..., 9] + 1e-12)
+        rel_q = pq[..., 5] / (pq[..., 5] + pq[..., 9] + 1e-12)
+        np.testing.assert_allclose(rel_q, rel_d, atol=5e-2)
+
+    def test_memory_halves(self, t5):
+        params, _ = t5
+        before = quant.param_bytes(params)
+        after = quant.param_bytes(quant.quantize_encdec_params(params))
+        assert after < 0.55 * before  # fp32 matrices -> int8 (+small scales)

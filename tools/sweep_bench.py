@@ -85,7 +85,7 @@ def main() -> None:
                           n_layers=2, n_heads=4, intermediate_size=128,
                           max_seq_len=512)
         params = decoder.init_params(cfg, jax.random.PRNGKey(0))
-        mode = "136M-smoke fp32"
+        mode = "0.2M-smoke fp32"
 
     rt = RuntimeConfig(batch_size=args.batch, max_seq_len=512)
     engine = ScoringEngine(params, cfg, FakeTokenizer(), rt)
