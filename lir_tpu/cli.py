@@ -27,6 +27,21 @@ from .utils.logging import get_logger
 log = get_logger(__name__)
 
 
+def _add_multihost_flag(p) -> None:
+    p.add_argument("--multihost", action="store_true",
+                   help="bring up jax.distributed for a multi-host pod "
+                        "before loading models (each host sweeps its grid "
+                        "shard; rows all-gather over ICI/DCN); errors if "
+                        "bring-up fails rather than silently degrading")
+
+
+def _maybe_init_multihost(args) -> None:
+    if getattr(args, "multihost", False):
+        from .parallel import multihost
+
+        multihost.initialize(required=True)
+
+
 def _add_sweep(sub) -> None:
     p = sub.add_parser("sweep", help="word-meaning model comparison (D1/D2)")
     p.add_argument("--checkpoints", type=Path, required=True)
@@ -52,6 +67,7 @@ def _add_sweep(sub) -> None:
                    help="store the KV cache int8 with per-vector scales: "
                         "half the cache HBM (longer contexts / bigger "
                         "batches on one chip), s8 decode attention dots")
+    _add_multihost_flag(p)
 
 
 def _add_perturb(sub) -> None:
@@ -69,6 +85,7 @@ def _add_perturb(sub) -> None:
     p.add_argument("--int8", action="store_true")
     p.add_argument("--int8-dynamic", action="store_true")
     p.add_argument("--kv-cache-int8", action="store_true")
+    _add_multihost_flag(p)
 
 
 def _add_rephrase(sub) -> None:
@@ -141,6 +158,7 @@ def _parse_models(items: List[str]):
 
 
 def cmd_sweep(args) -> None:
+    _maybe_init_multihost(args)
     from .config import RuntimeConfig
     from .engine.multi import run_model_comparison_sweep
     from .models.factory import engine_factory
@@ -158,6 +176,7 @@ def cmd_sweep(args) -> None:
 
 
 def cmd_perturb(args) -> None:
+    _maybe_init_multihost(args)
     from .config import RuntimeConfig
     from .data.prompts import LEGAL_PROMPTS
     from .engine.rephrase import load_or_generate_perturbations

@@ -106,6 +106,18 @@ def matmul(x: jax.Array, w) -> jax.Array:
 _LAYER_MATRICES = ("wq", "wk", "wv", "wo", "w_up", "w_gate", "w_down")
 
 
+def _quantize_block(blk: Params, names, dynamic: bool) -> Params:
+    """Shallow-copy a param subtree, int8-quantizing the named matrices
+    (optionally tagged dynamic). The single quantize-a-stack rule shared by
+    the decoder and T5 paths."""
+    out = dict(blk)
+    for name in names:
+        if name in out:
+            out[name] = dataclasses.replace(quantize(out[name]),
+                                            dynamic=dynamic)
+    return out
+
+
 def quantize_decoder_params(params: Params, dynamic: bool = False) -> Params:
     """Quantize the big linear weights of a converted decoder param tree
     (stacked layer matrices + lm_head); everything else passes through.
@@ -116,12 +128,8 @@ def quantize_decoder_params(params: Params, dynamic: bool = False) -> Params:
     where activation-quantization noise would land on the measured
     probabilities."""
     out = dict(params)
-    layers = dict(params["layers"])
-    for name in _LAYER_MATRICES:
-        if name in layers:
-            qt = quantize(layers[name])
-            layers[name] = dataclasses.replace(qt, dynamic=dynamic)
-    out["layers"] = layers
+    out["layers"] = _quantize_block(params["layers"], _LAYER_MATRICES,
+                                    dynamic)
     if "lm_head" in params:
         out["lm_head"] = quantize(params["lm_head"])
     return out
@@ -142,12 +150,7 @@ def quantize_encdec_params(params: Params, dynamic: bool = False) -> Params:
     weight-only (tied v1.0 embeddings stay dense entirely)."""
     out = dict(params)
     for side in ("encoder", "decoder"):
-        blk = dict(params[side])
-        for name in _ENCDEC_MATRICES:
-            if name in blk:
-                blk[name] = dataclasses.replace(quantize(blk[name]),
-                                                dynamic=dynamic)
-        out[side] = blk
+        out[side] = _quantize_block(params[side], _ENCDEC_MATRICES, dynamic)
     if "lm_head" in params:
         out["lm_head"] = quantize(params["lm_head"])
     return out

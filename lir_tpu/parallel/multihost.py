@@ -18,6 +18,45 @@ from ..utils.logging import get_logger
 log = get_logger(__name__)
 
 
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None,
+               required: bool = False) -> bool:
+    """Bring up the JAX distributed runtime for a multi-host pod.
+
+    On a real TPU pod slice ``jax.distributed.initialize()`` auto-detects
+    the coordinator and process topology from the TPU metadata; the three
+    arguments exist for manual bring-up (CPU/GPU clusters, DCN-connected
+    multislice). Collectives then ride ICI within a slice and DCN across
+    slices — the jobs themselves never change, because every helper in
+    this module (and ``host_shard``/``gather_rows`` in the sweep drivers)
+    keys off ``jax.process_count()``.
+
+    Returns True when the distributed runtime came up, False when running
+    single-process (no cluster detected / already initialized) — callers
+    proceed either way. ``required=True`` (what the CLI's explicit
+    ``--multihost`` passes) turns a failed bring-up into a hard error
+    instead: a host that silently fell back to process_count()==1 would
+    take the ENTIRE grid via host_shard while its peers sweep shards —
+    duplicate scoring and conflicting manifest writes.
+    """
+    try:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+        log.info("jax.distributed up: process %d of %d, %d local devices",
+                 jax.process_index(), jax.process_count(),
+                 jax.local_device_count())
+        return True
+    except Exception as err:  # noqa: BLE001 — single-host is a normal path
+        if required:
+            raise RuntimeError(
+                f"--multihost requested but distributed bring-up failed: "
+                f"{err}") from err
+        log.info("single-process mode (distributed init unavailable: %s)",
+                 err)
+        return False
+
+
 def is_multiprocess() -> bool:
     return jax.process_count() > 1
 

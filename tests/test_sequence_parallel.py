@@ -276,3 +276,43 @@ class TestSeqParallelPrefill:
         sl, _ = decoder.decode_step(params, cfg, (ock, ocv), *args)
         np.testing.assert_allclose(np.asarray(sl), np.asarray(dl),
                                    atol=3e-4, rtol=1e-4)
+
+
+def test_multihost_initialize_single_process_degrade():
+    """multihost.initialize(): no cluster -> False, never raises (pod
+    bring-up is opt-in; single-host jobs proceed unchanged); required=True
+    escalates the same condition to a hard error (the CLI's --multihost).
+
+    Runs in a subprocess with cluster env vars scrubbed: jax's cluster
+    auto-detection must see a clean environment (the axon plugin exports
+    TPU_WORKER_HOSTNAMES in-process), and a successful bring-up would
+    leave a distributed service running for the rest of the session."""
+    import subprocess
+    import sys
+    import textwrap
+
+    script = textwrap.dedent("""
+        import os
+        for v in ('SLURM_JOB_ID', 'OMPI_COMM_WORLD_SIZE',
+                  'COORDINATOR_ADDRESS', 'TPU_WORKER_HOSTNAMES',
+                  'CLOUD_TPU_TASK_ID', 'TPU_SKIP_MDS_QUERY'):
+            os.environ.pop(v, None)
+        os.environ['JAX_PLATFORMS'] = 'cpu'
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+        from lir_tpu.parallel import multihost
+        assert multihost.initialize() is False
+        assert not multihost.is_multiprocess()
+        try:
+            multihost.initialize(required=True)
+        except RuntimeError as e:
+            assert 'multihost' in str(e)
+        else:
+            raise AssertionError('required=True did not escalate')
+        print('DEGRADE-OK')
+    """)
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=300,
+                          cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "DEGRADE-OK" in proc.stdout
