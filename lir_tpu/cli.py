@@ -30,9 +30,11 @@ log = get_logger(__name__)
 def _add_multihost_flag(p) -> None:
     p.add_argument("--multihost", action="store_true",
                    help="bring up jax.distributed for a multi-host pod "
-                        "before loading models (each host sweeps its grid "
-                        "shard; rows all-gather over ICI/DCN); errors if "
-                        "bring-up fails rather than silently degrading")
+                        "before loading models; each host then sweeps its "
+                        "shard (perturb: grid cells, sweep: models) into "
+                        "per-host .hostN artifacts that concatenate "
+                        "row-wise; errors if bring-up fails rather than "
+                        "silently degrading")
 
 
 def _maybe_init_multihost(args) -> None:
@@ -53,7 +55,9 @@ def _add_sweep(sub) -> None:
                    default="base_vs_instruct")
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--mesh", type=str, default=None,
-                   help="dataxmodel, e.g. 1x8 for 8-way tensor parallel")
+                   help="dataxmodel[xseq], e.g. 1x8 for 8-way tensor "
+                        "parallel, 1x1x8 for sequence-parallel prefill "
+                        "(long prompts)")
     p.add_argument("--param-cache", type=Path, default=None,
                    help="orbax cache root: convert HF weights once, restore "
                         "fast afterwards")
@@ -143,8 +147,14 @@ def _parse_mesh(spec: Optional[str]):
         return None
     from .config import MeshConfig
 
-    data, model = (int(x) for x in spec.lower().split("x"))
-    return MeshConfig(data=data, model=model)
+    dims = [int(x) for x in spec.lower().split("x")]
+    if len(dims) == 2:
+        dims.append(1)
+    if len(dims) != 3:
+        raise SystemExit(
+            f"--mesh must be DATAxMODEL or DATAxMODELxSEQ, got {spec!r}")
+    data, model, seq = dims
+    return MeshConfig(data=data, model=model, seq=seq)
 
 
 def _parse_models(items: List[str]):

@@ -58,12 +58,14 @@ def _small_readout(logits: jax.Array, yes_ids: jax.Array, no_ids: jax.Array):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("cfg", "max_new_tokens", "topk"))
+                   static_argnames=("cfg", "max_new_tokens", "topk",
+                                    "prefill_fn"))
 def greedy_decode_fused(params, cfg: ModelConfig, tokens: jax.Array,
                         attn_mask: jax.Array, yes_ids: jax.Array,
                         no_ids: jax.Array, digit_ids: jax.Array,
                         digit_vals: jax.Array, max_new_tokens: int = 50,
-                        topk: int = 20) -> FusedDecodeOut:
+                        topk: int = 20,
+                        prefill_fn=None) -> FusedDecodeOut:
     """Greedy decode with the C13/D6 readouts fused into the scan.
 
     yes_ids/no_ids: (B,) per-row target token ids (rows of one batch may
@@ -73,7 +75,8 @@ def greedy_decode_fused(params, cfg: ModelConfig, tokens: jax.Array,
     """
     B, S = tokens.shape
     T = S + max_new_tokens
-    logits0, cache, pos0 = decoder.prefill(params, cfg, tokens, attn_mask, T)
+    pf = prefill_fn or decoder.prefill
+    logits0, cache, pos0 = pf(params, cfg, tokens, attn_mask, T)
     cache_mask0 = jnp.pad(attn_mask, ((0, 0), (0, max_new_tokens)))
 
     # Position-0 extras from the prefill logits (the first generated
@@ -108,17 +111,19 @@ def greedy_decode_fused(params, cfg: ModelConfig, tokens: jax.Array,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_tokens", "prefill_fn"))
 def greedy_decode(params, cfg: ModelConfig, tokens: jax.Array,
-                  attn_mask: jax.Array, max_new_tokens: int = 50
-                  ) -> Tuple[jax.Array, jax.Array]:
+                  attn_mask: jax.Array, max_new_tokens: int = 50,
+                  prefill_fn=None) -> Tuple[jax.Array, jax.Array]:
     """tokens/attn_mask: (B, S) LEFT-padded int32.
 
     Returns (generated (B, max_new_tokens) int32,
              step_logits (B, max_new_tokens, V) fp32)."""
     B, S = tokens.shape
     T = S + max_new_tokens
-    logits0, cache, pos0 = decoder.prefill(params, cfg, tokens, attn_mask, T)
+    pf = prefill_fn or decoder.prefill
+    logits0, cache, pos0 = pf(params, cfg, tokens, attn_mask, T)
 
     cache_mask0 = jnp.pad(attn_mask, ((0, 0), (0, max_new_tokens)))
 
@@ -136,11 +141,12 @@ def greedy_decode(params, cfg: ModelConfig, tokens: jax.Array,
     return jnp.swapaxes(gen, 0, 1), jnp.swapaxes(step_logits, 0, 1)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens"))
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_tokens", "prefill_fn"))
 def sample_decode(params, cfg: ModelConfig, tokens: jax.Array,
                   attn_mask: jax.Array, key: jax.Array,
-                  temperature: float = 0.9, max_new_tokens: int = 50
-                  ) -> jax.Array:
+                  temperature: float = 0.9, max_new_tokens: int = 50,
+                  prefill_fn=None) -> jax.Array:
     """Temperature sampling with the same prefill + lax.scan structure as
     greedy_decode, for the on-pod perturbation generator (the reference
     rephrases with temperature 0.9 via the Anthropic API,
@@ -158,7 +164,8 @@ def sample_decode(params, cfg: ModelConfig, tokens: jax.Array,
     B, S = tokens.shape
     T = S + max_new_tokens
     per_row = key.ndim == 2
-    logits0, cache, pos0 = decoder.prefill(params, cfg, tokens, attn_mask, T)
+    pf = prefill_fn or decoder.prefill
+    logits0, cache, pos0 = pf(params, cfg, tokens, attn_mask, T)
     cache_mask0 = jnp.pad(attn_mask, ((0, 0), (0, max_new_tokens)))
 
     def step(carry, xs):

@@ -90,6 +90,18 @@ def format_for(spec: ModelSpec, sweep_kind: str = "base_vs_instruct"
     return format_instruct_prompt
 
 
+def _host_path(path: Path) -> Path:
+    """Per-host artifact suffix on pods (.hostN); identity single-process."""
+    from ..parallel import multihost
+
+    if not multihost.is_multiprocess():
+        return path
+    import jax
+
+    return path.with_name(
+        f"{path.stem}.host{jax.process_index()}{path.suffix}")
+
+
 def run_model_comparison_sweep(
     specs: Sequence[ModelSpec],
     engine_factory: EngineFactory,
@@ -104,6 +116,15 @@ def run_model_comparison_sweep(
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     capture = start_capture()
+    from ..parallel import multihost
+
+    if multihost.is_multiprocess():
+        # Pods parallelize across MODELS (the reference's ThreadPoolExecutor
+        # axis, perturb_prompts.py:917-946): host i loads specs[i::N]; CSVs
+        # get a .hostN suffix and concatenate row-wise.
+        specs = multihost.host_shard(list(specs))
+        log.info("multihost: process %d sweeps %d model(s)",
+                 __import__("jax").process_index(), len(specs))
     meter = ThroughputMeter()
     all_rows: List[schemas.ScoreRow] = []
     per_model: Dict[str, Dict[str, object]] = {}
@@ -123,8 +144,28 @@ def run_model_comparison_sweep(
             tokens_in = sum(
                 len(engine.tokenizer(fmt(q)).input_ids) for q in questions
             )
+            # Implied-TFLOPS/MFU sanity figure: per-MODEL matmul FLOPs at
+            # this model's mean prompt length (mixed-size sweeps stay
+            # correctly weighted; enc-dec models contribute no flops and
+            # only dilute MFU downward — never a false "impossible" alarm).
+            flops = 0.0
+            if not engine.encoder_decoder:
+                import jax
+
+                from ..models.quant import QuantTensor
+                from ..utils.profiling import scoring_step_flops
+
+                flops = len(rows) * scoring_step_flops(
+                    engine.cfg, 1, max(tokens_in // max(len(rows), 1), 1),
+                    engine.rt.max_new_tokens)
+                meter.int8_dots = meter.int8_dots or any(
+                    getattr(l, "dynamic", False)
+                    for l in jax.tree.leaves(
+                        engine.params,
+                        is_leaf=lambda x: isinstance(x, QuantTensor)))
             meter.add(len(rows), tokens_in=tokens_in,
-                      tokens_out=len(rows) * engine.rt.max_new_tokens)
+                      tokens_out=len(rows) * engine.rt.max_new_tokens,
+                      flops=flops)
             n_found = sum(r.yes_no_found for r in rows)
             per_model[spec.name] = {
                 "rows": len(rows),
@@ -152,19 +193,20 @@ def run_model_comparison_sweep(
     if write_base_csv:
         # D1 holds every swept model, base and instruct alike.
         df = schemas.write_model_comparison_csv(
-            all_rows, out_dir / "model_comparison_results.csv"
+            all_rows, _host_path(out_dir / "model_comparison_results.csv")
         )
         artifacts["model_comparison_csv"] = df
     if write_instruct_csv:
         instruct_rows = [r for r in all_rows if r.base_or_instruct == "instruct"]
         if instruct_rows:
             df = schemas.write_instruct_comparison_csv(
-                instruct_rows, out_dir / "instruct_model_comparison_results.csv"
+                instruct_rows,
+                _host_path(out_dir / "instruct_model_comparison_results.csv")
             )
             artifacts["instruct_comparison_csv"] = df
 
     log.info("Sweep throughput: %s", meter.summary())
-    save_captured_output(capture, out_dir / "sweep_session_log.txt")
+    save_captured_output(capture, _host_path(out_dir / "sweep_session_log.txt"))
     return artifacts
 
 

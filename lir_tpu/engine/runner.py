@@ -74,12 +74,28 @@ class ScoringEngine:
     def __init__(self, params: Any, cfg: Any, tokenizer: Any,
                  runtime: Optional[RuntimeConfig] = None,
                  encoder_decoder: bool = False,
-                 yes_text: str = "Yes", no_text: str = "No"):
+                 yes_text: str = "Yes", no_text: str = "No",
+                 seq_mesh: Any = None, seq_impl: str = "ring"):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.rt = runtime or RuntimeConfig()
         self.encoder_decoder = encoder_decoder
+        # Sequence-parallel prefill (long-context path): with a mesh whose
+        # `seq` axis > 1, the quadratic prompt phase runs seq-sharded
+        # through ring/Ulysses attention (parallel/seq_forward) and hands
+        # the KV cache back unsharded for ordinary dense decode. Built ONCE
+        # here so the jitted decode fns cache on a stable static callable.
+        self._prefill_fn = None
+        if seq_mesh is not None and not encoder_decoder:
+            from ..parallel.seq_forward import prefill_seq_parallel
+
+            def _seq_prefill(p, c, t, m, T, *, _mesh=seq_mesh,
+                             _impl=seq_impl):
+                return prefill_seq_parallel(p, c, t, m, T, mesh=_mesh,
+                                            impl=_impl)
+
+            self._prefill_fn = _seq_prefill
         self.yes_id, self.no_id = tok.yes_no_ids(
             tokenizer, encoder_decoder=encoder_decoder,
             yes_text=yes_text, no_text=no_text)
@@ -111,7 +127,8 @@ class ScoringEngine:
                 max_new_tokens=self.rt.max_new_tokens)
         return generate.greedy_decode(
             self.params, self.cfg, toks, mask,
-            max_new_tokens=self.rt.max_new_tokens)
+            max_new_tokens=self.rt.max_new_tokens,
+            prefill_fn=self._prefill_fn)
 
     def decode_fused(self, prompts: Sequence[str], yes_ids: np.ndarray,
                      no_ids: np.ndarray, with_digits: bool = False,
@@ -134,7 +151,8 @@ class ScoringEngine:
             jnp.asarray(yes_ids, jnp.int32), jnp.asarray(no_ids, jnp.int32),
             jnp.asarray(digit_ids), jnp.asarray(digit_vals),
             max_new_tokens=(self.rt.max_new_tokens if max_new_tokens is None
-                            else max_new_tokens))
+                            else max_new_tokens),
+            prefill_fn=self._prefill_fn)
 
     def decode_completion(self, generated_ids: np.ndarray) -> str:
         """Token ids -> text, stopping at the first EOS (HF generate parity —
@@ -167,7 +185,8 @@ class ScoringEngine:
         gen = generate.sample_decode(
             self.params, self.cfg, toks, mask, key, temperature=temperature,
             max_new_tokens=(self.rt.max_new_tokens if max_new_tokens is None
-                            else max_new_tokens))
+                            else max_new_tokens),
+            prefill_fn=self._prefill_fn)
         gen = np.asarray(jax.device_get(gen))
         return ([self.decode_completion(gen[j])
                  for j in range(gen.shape[0])], gen)

@@ -110,11 +110,25 @@ def run_perturbation_sweep(
     parsed confidence integer (:459-464) and no logprob map is stored.
     """
     results_path = schemas.resolve_results_path(results_path)
+    # Multi-host pods: each host owns a deterministic shard of the grid and
+    # its OWN results/manifest files (suffix .hostN) — disjoint writes, and
+    # a preempted host resumes exactly its shard. Single-process runs leave
+    # paths untouched.
+    from ..parallel import multihost
+
+    shard_grid = manifest is None and multihost.is_multiprocess()
+    if shard_grid:
+        i = __import__("jax").process_index()
+        results_path = results_path.with_name(
+            f"{results_path.stem}.host{i}{results_path.suffix}")
+        log.info("multihost: process %d writes %s", i, results_path)
     manifest = manifest or SweepManifest(
         results_path.with_suffix(".manifest.jsonl"),
         grid_mod.RESUME_KEY_FIELDS)
     cells = grid_mod.build_grid(model_name, prompts, perturbations)
     cells = grid_mod.random_subset(cells, subset_size, seed)
+    if shard_grid:
+        cells = multihost.host_shard(cells)
     todo = grid_mod.pending_cells(cells, manifest)
     log.info("%s: %d/%d grid cells pending", model_name, len(todo), len(cells))
 
@@ -215,6 +229,10 @@ def run_perturbation_sweep(
 
     if pending_rows:
         _flush(pending_rows, results_path, manifest)
+    if shard_grid:
+        # Fence so no host's caller reads partial peers; per-host workbooks
+        # concatenate row-wise (the D6 schema has no cross-row state).
+        multihost.barrier("perturbation-sweep-done")
     return rows
 
 

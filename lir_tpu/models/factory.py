@@ -156,11 +156,16 @@ def load_engine(
             before / 2**30, quant.param_bytes(params) / 2**30,
         )
 
+    seq_mesh = None
     if not encdec and mesh_cfg is not None and mesh_cfg.n_devices > 1:
         from ..parallel import sharding
 
         mesh = sharding.build_mesh(mesh_cfg)
         params = sharding.shard_params(params, cfg, mesh)
+        if mesh_cfg.seq > 1:
+            # Long-context: engine prefills seq-sharded (ring attention)
+            # and decodes dense from the gathered cache.
+            seq_mesh = mesh
         log.info(
             "sharded %s over mesh %s", model_dir.name,
             dict(zip(mesh.axis_names, mesh.devices.shape)),
@@ -170,7 +175,7 @@ def load_engine(
              "enc-dec" if encdec else "decoder", np.dtype(dtype).name)
     return ScoringEngine(
         params, cfg, tokenizer, runtime or RuntimeConfig(),
-        encoder_decoder=encdec,
+        encoder_decoder=encdec, seq_mesh=seq_mesh,
     )
 
 

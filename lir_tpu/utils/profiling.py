@@ -22,16 +22,31 @@ from typing import Dict, Iterator, Optional
 
 import jax
 
+from .logging import get_logger
+
+log = get_logger(__name__)
+
 
 @dataclasses.dataclass
 class ThroughputMeter:
-    """Counts scored prompts and wall time; reports prompts/sec/chip."""
+    """Counts scored prompts and wall time; reports prompts/sec/chip.
+
+    Pass per-batch matmul FLOPs to ``add(..., flops=...)`` (via
+    ``scoring_step_flops``) to get implied TFLOPS and MFU against the
+    chip's published peak in the summary — the sanity figure that would
+    have caught round 1's physically impossible benchmark number at sweep
+    time. FLOPs accumulate per call, so mixed-size model sweeps weight
+    each model correctly. Set ``int8_dots=True`` for dynamic-int8 sweeps
+    so the MFU denominator is the chip's s8 peak, not bf16's.
+    """
 
     n_devices: int = 0
     prompts: int = 0
     tokens_in: int = 0
     tokens_out: int = 0
     elapsed: float = 0.0
+    flops: float = 0.0
+    int8_dots: bool = False
     _start: Optional[float] = None
 
     def __post_init__(self) -> None:
@@ -46,10 +61,12 @@ class ThroughputMeter:
         finally:
             self.elapsed += time.perf_counter() - start
 
-    def add(self, prompts: int, tokens_in: int = 0, tokens_out: int = 0) -> None:
+    def add(self, prompts: int, tokens_in: int = 0, tokens_out: int = 0,
+            flops: float = 0.0) -> None:
         self.prompts += prompts
         self.tokens_in += tokens_in
         self.tokens_out += tokens_out
+        self.flops += flops
 
     @property
     def prompts_per_sec(self) -> float:
@@ -60,7 +77,7 @@ class ThroughputMeter:
         return self.prompts_per_sec / max(self.n_devices, 1)
 
     def summary(self) -> Dict[str, float]:
-        return {
+        out = {
             "prompts": self.prompts,
             "tokens_in": self.tokens_in,
             "tokens_out": self.tokens_out,
@@ -69,6 +86,19 @@ class ThroughputMeter:
             "prompts_per_sec": round(self.prompts_per_sec, 4),
             "prompts_per_sec_per_chip": round(self.prompts_per_sec_per_chip, 4),
         }
+        if self.flops > 0 and self.elapsed > 0:
+            implied = self.flops / self.elapsed / max(self.n_devices, 1)
+            out["implied_tflops_per_chip"] = round(implied / 1e12, 2)
+            peak = chip_peak_flops(int8=self.int8_dots)
+            if peak is not None:
+                out["mfu"] = round(implied / peak, 4)
+                if implied > peak:
+                    log.warning(
+                        "implied %.1f TFLOPS exceeds the %s peak (%.0f) — "
+                        "timing is not syncing with the device",
+                        implied / 1e12, jax.devices()[0].device_kind,
+                        peak / 1e12)
+        return out
 
 
 # Published peak dense-matmul throughput per chip (bf16 FLOPS). Weight-only

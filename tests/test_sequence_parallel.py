@@ -311,8 +311,42 @@ def test_multihost_initialize_single_process_degrade():
             raise AssertionError('required=True did not escalate')
         print('DEGRADE-OK')
     """)
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
     proc = subprocess.run([sys.executable, "-c", script],
                           capture_output=True, text=True, timeout=300,
-                          cwd="/root/repo")
+                          cwd=repo)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "DEGRADE-OK" in proc.stdout
+
+
+def test_engine_seq_parallel_prefill_matches_plain(seq_mesh):
+    """ScoringEngine(seq_mesh=...): the engine's production scoring path
+    (fused decode) prefills seq-sharded and must score identically to the
+    plain engine — the long-context path wired end to end (CLI --mesh
+    1x1x8 -> factory -> engine -> generate -> decoder prefill)."""
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="eng-sp", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=2, n_heads=8,
+                      intermediate_size=64, max_seq_len=128)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0))
+    rt = RuntimeConfig(batch_size=4, max_new_tokens=5, max_seq_len=128)
+    prompts = ["Is a tomato a vegetable ?",
+               "Is a whale considered a fish in law ?"]
+
+    plain = ScoringEngine(params, cfg, FakeTokenizer(), rt)
+    sp = ScoringEngine(params, cfg, FakeTokenizer(), rt, seq_mesh=seq_mesh)
+    assert sp._prefill_fn is not None
+
+    r_plain = plain.score_prompts(prompts)
+    r_sp = sp.score_prompts(prompts)
+    for a, b in zip(r_plain, r_sp):
+        np.testing.assert_allclose(b.relative_prob, a.relative_prob,
+                                   atol=1e-4)
+        assert b.completion == a.completion

@@ -211,3 +211,48 @@ def test_parse_confidence_truncation_guard():
     assert _parse_confidence("confidence: 8", complete=False) is None
     assert _parse_confidence("confidence: 85 .", complete=False) == 85
     assert _parse_confidence("no number here", complete=False) is None
+
+
+def test_perturbation_sweep_multihost_shards(tmp_path, monkeypatch):
+    """Under a (simulated) 2-process pod, each host sweeps HALF the grid
+    into its own .hostN results + manifest (disjoint writes), and the two
+    shards partition the cells exactly."""
+    import jax
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.parallel import multihost
+
+    cfg = ModelConfig(name="mh", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=2, n_heads=4,
+                      intermediate_size=64, max_seq_len=128)
+    eng = ScoringEngine(decoder.init_params(cfg, jax.random.PRNGKey(0)),
+                        cfg, FakeTokenizer(),
+                        RuntimeConfig(batch_size=4, max_new_tokens=4))
+    lp = (LegalPrompt(main="Is a levee failure a flood ?",
+                      response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Number 0 to 100 ."),)
+    perts = ([f"variant {i} of the levee question ?" for i in range(5)],)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # A real barrier would block: this simulation has one actual process.
+    monkeypatch.setattr(multihost, "barrier", lambda name: None)
+    seen = []
+    for proc in (0, 1):
+        monkeypatch.setattr(jax, "process_index", lambda p=proc: p)
+        assert multihost.is_multiprocess()
+        rows = run_perturbation_sweep(
+            eng, "mh-model", lp, perts, tmp_path / "results.xlsx",
+            checkpoint_every=3)
+        out = tmp_path / f"results.host{proc}.csv"
+        assert out.exists(), list(tmp_path.iterdir())
+        assert (tmp_path / f"results.host{proc}.manifest.jsonl").exists()
+        seen.extend((r.original_main, r.rephrased_main) for r in rows)
+    # 6 cells total (original + 5 rephrasings), split 3/3, no overlap.
+    assert len(seen) == 6 and len(set(seen)) == 6
