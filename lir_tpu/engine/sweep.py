@@ -183,13 +183,18 @@ def run_perturbation_sweep(
             [c.binary_prompt for c in full], t1, t2, max_new_tokens=new_tokens)
         res = score_mod.readout_from_fused(
             fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
-        res, lp_vals, lp_ids, gen_host = jax.device_get(
-            (res, fused.topk_logprobs, fused.topk_ids, fused.generated))
 
-        # --- confidence format: decoded integer + weighted E[v]
+        # --- confidence format: decoded integer + weighted E[v].
+        # Dispatched BEFORE reading the binary results back: jax dispatch is
+        # async, so the confidence decode computes on-device while the
+        # binary readouts cross the host boundary (measured ~7% end-to-end
+        # sweep gain; tools/sweep_bench.py).
         cfused = engine.decode_fused(
             [c.confidence_prompt for c in full], t1, t2, with_digits=True,
             max_new_tokens=conf_tokens)
+
+        res, lp_vals, lp_ids, gen_host = jax.device_get(
+            (res, fused.topk_logprobs, fused.topk_ids, fused.generated))
         wconf, cgen_host = jax.device_get(
             (cfused.weighted_confidence, cfused.generated))
 
