@@ -24,16 +24,30 @@ BASELINE.json's metric, measured honestly:
   flops) divided by the chip's published peak for the mode's dot dtype
   (int8 peak = 2x bf16 for the dynamic mode) must be <= 100%; the bench
   ABORTS (exit 1) on a physically impossible number instead of reporting
-  it.
+  it. The gate is ARMED on unknown chips too: a device kind missing from
+  the profiling table aborts (exit 1) unless ``--allow-ungated`` is passed
+  explicitly — an un-gated number can never be recorded silently
+  (VERDICT r2 weak #6).
+
+- **The headline is the SWEEP PATH.** BASELINE.json's metric is
+  "prompts/sec/chip on the perturbation sweep", so the primary JSON value
+  is a real `run_perturbation_sweep` (grid -> manifest -> shared-prefix
+  fused scoring -> D6 writes), not the isolated scoring step; the isolated
+  in-scan step (which the MFU gate checks) is printed as a secondary
+  comment line. vs_baseline compares against the first honest recording
+  of the SWEEP-path definition (18.47 p/s, round 2, SCALE.md).
 
 Prints ONE JSON line.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
+import tempfile
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -47,11 +61,27 @@ import numpy as np
 # improvement since this first honest recording (dynamic int8 + batch 24
 # later raised the measured value ~1.2x). Update deliberately, never
 # silently.
-BENCH_NOMINAL_7B = 26.247  # prompts/sec/chip
+BENCH_NOMINAL_7B = 26.247  # prompts/sec/chip (isolated scoring step)
 
-# CPU smoke nominal (flagship 136M config, fp32, batch 8) — only used when
-# no accelerator is present so the JSON stays comparable run-to-run.
+# First honest recording of the SWEEP-PATH definition (round 2,
+# tools/sweep_bench.py: full run_perturbation_sweep at 7B int8-dyn+kvq8,
+# batch 48, 256-token bucket — SCALE.md "end-to-end sweep throughput").
+# This is the primary metric's baseline; update deliberately, never
+# silently.
+BENCH_NOMINAL_7B_SWEEP = 18.47  # prompts/sec/chip (end-to-end sweep)
+
+# CPU smoke nominals (flagship 136M config, fp32) — only used when no
+# accelerator is present so the JSON stays comparable run-to-run.
 BENCH_NOMINAL_CPU = 2.0
+BENCH_NOMINAL_CPU_SWEEP = 1.0
+
+# Sweep-path measurement shape: batch 40 is the measured sweet spot for
+# the shared-prefix scoring path on a 16 GiB v5e (48 OOMs — the shared
+# cache carries suffix + generation slack slots; SCALE.md r3).
+SWEEP_BATCH_TPU = 40
+SWEEP_CELLS_TPU = 160
+SWEEP_BATCH_CPU = 4
+SWEEP_CELLS_CPU = 8
 
 SEQ = 256
 NEW_TOKENS = 10  # MAX_LOOK_AHEAD: the positions the C13 readout consumes
@@ -72,12 +102,33 @@ def _is_oom(err: Exception) -> bool:
 
 
 def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--allow-ungated", action="store_true",
+                    help="report numbers even when the chip kind is missing "
+                         "from the MFU peak table (default: abort)")
+    args = ap.parse_args()
+
     from lir_tpu.engine import generate, score
     from lir_tpu.models import decoder, quant
     from lir_tpu.utils import profiling
 
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
+
+    # Gate arming check FIRST — before any multi-minute 7B param init. A
+    # device kind missing from the peak table means the MFU sanity gate
+    # cannot run; a new TPU generation hitting this path is exactly where
+    # unsynced timing (the round-1 failure mode) would otherwise sail
+    # through un-gated.
+    if (on_accel and profiling.chip_peak_flops(dev) is None
+            and not args.allow_ungated):
+        print(
+            f"BENCH ABORT: device kind {getattr(dev, 'device_kind', '?')!r} "
+            "is not in profiling.CHIP_PEAK_BF16_FLOPS, so the MFU sanity "
+            "gate cannot run. Add the chip's peak to the table, or rerun "
+            "with --allow-ungated to record an UNGATED number on purpose.",
+            file=sys.stderr)
+        sys.exit(1)
 
     if on_accel:
         import dataclasses
@@ -193,18 +244,81 @@ def main() -> None:
     if mfu is not None:
         mfu_str = f"{mfu:.1%} MFU"
     elif on_accel:
-        mfu_str = "MFU n/a (unknown chip)"   # gate could not run; say so
+        mfu_str = "MFU UNGATED (unknown chip, --allow-ungated)"
     else:
         mfu_str = "MFU n/a (cpu)"
+    print(f"# isolated scoring step: {value:.3f} prompts/s "
+          f"(batch={batch_used}, {implied_tflops:.1f} TFLOPS impl, "
+          f"{mfu_str}, vs r1-nominal {value / nominal:.3f}x)",
+          file=sys.stderr)
+
+    # ---- primary: the end-to-end perturbation sweep (BASELINE's metric).
+    sweep_value, sweep_batch, sweep_cells = _sweep_path(
+        params, cfg, on_accel)
+    sweep_nominal = (BENCH_NOMINAL_7B_SWEEP if on_accel
+                     else BENCH_NOMINAL_CPU_SWEEP)
     print(json.dumps({
-        "metric": "prompts_per_sec_per_chip",
-        "value": round(value, 3),
-        "unit": (f"prompts/s ({cfg.name} {n_params / 1e9:.2f}B {mode}, "
-                 f"seq={SEQ}, {NEW_TOKENS} gen, batch={batch_used}, "
-                 f"{implied_tflops:.1f} TFLOPS impl, {mfu_str}, "
-                 f"{dev.platform})"),
-        "vs_baseline": round(value / nominal, 3),
+        "metric": "sweep_prompts_per_sec_per_chip",
+        "value": round(sweep_value, 3),
+        "unit": (f"prompts/s end-to-end perturbation sweep ({cfg.name} "
+                 f"{n_params / 1e9:.2f}B {mode}, shared-prefix scoring, "
+                 f"batch={sweep_batch}, {sweep_cells} cells, "
+                 f"binary+confidence per cell; isolated step "
+                 f"{value:.1f} p/s at {mfu_str}, {dev.platform})"),
+        "vs_baseline": round(sweep_value / sweep_nominal, 3),
     }))
+
+
+def _sweep_path(params, cfg, on_accel: bool):
+    """Measure `run_perturbation_sweep` end-to-end: grid build, manifest,
+    shared-prefix fused scoring, top-20 logprob maps, D6 + manifest writes.
+    A warmup sweep (one full bucket, separate results dir) absorbs the two
+    jit compiles; the timed sweep runs all-warm, matching steady state
+    where one compile serves ~20k grid cells."""
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+
+    batch = SWEEP_BATCH_TPU if on_accel else SWEEP_BATCH_CPU
+    cells = SWEEP_CELLS_TPU if on_accel else SWEEP_CELLS_CPU
+    rt = RuntimeConfig(batch_size=batch, max_seq_len=512)
+    engine = ScoringEngine(params, cfg, FakeTokenizer(), rt)
+    rng = np.random.default_rng(7)
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible adjuster settle "
+             "liability clause binding interpret statute meaning").split()
+    n_words = 170 if on_accel else 12   # 256-token bucket on the chip
+
+    def long_text():
+        return " ".join(rng.choice(words) for _ in range(n_words)) + " ?"
+
+    lp = (LegalPrompt(
+        main=long_text(),
+        response_format="Respond with either ' Yes' or ' No' only .",
+        target_tokens=("Yes", "No"),
+        confidence_format="Give a confidence number from 0 to 100 ."),)
+
+    def run(n_cells, tag):
+        perts = ([long_text() for _ in range(n_cells - 1)],)
+        with tempfile.TemporaryDirectory() as td:
+            t0 = time.perf_counter()
+            rows = run_perturbation_sweep(
+                engine, f"bench-{tag}", lp, perts,
+                Path(td) / "results.xlsx", checkpoint_every=100)
+            dt = time.perf_counter() - t0
+        assert len(rows) == n_cells, (len(rows), n_cells)
+        assert all(np.isfinite(r.token_1_prob) for r in rows)
+        return dt
+
+    t_warm = run(batch, "warmup")
+    print(f"# sweep warmup ({batch} cells incl. compiles): {t_warm:.1f}s",
+          file=sys.stderr)
+    dt = run(cells, "timed")
+    return cells / dt, batch, cells
 
 
 if __name__ == "__main__":

@@ -154,6 +154,62 @@ class ScoringEngine:
                             else max_new_tokens),
             prefill_fn=self._prefill_fn)
 
+    def decode_fused_shared(self, binary_prompts: Sequence[str],
+                            confidence_prompts: Sequence[str],
+                            yes_ids: np.ndarray, no_ids: np.ndarray,
+                            new_tokens: int, conf_tokens: int):
+        """Score BOTH sweep formats with ONE shared-prefix prefill.
+
+        Each grid cell's binary and confidence prompts share the long
+        rephrased legal text and differ only in the short trailing format
+        instruction. Tokenize both, split every row at the longest common
+        TOKEN prefix (tokenizer-agnostic — see tokens.shared_prefix_len),
+        left-pad the prefixes into the standard bucket and right-pad each
+        format's suffix into a small power-of-two bucket, then run
+        generate.greedy_decode_fused_shared: one prefill + two chunked
+        suffix extensions instead of two full prefills. Returns
+        (binary FusedDecodeOut, confidence FusedDecodeOut).
+        """
+        assert not self.encoder_decoder
+        bin_ids = [self.tokenizer(p).input_ids for p in binary_prompts]
+        conf_ids = [self.tokenizer(p).input_ids for p in confidence_prompts]
+        lcp = [tok.shared_prefix_len(a, b)
+               for a, b in zip(bin_ids, conf_ids)]
+        pad_id = tok.pad_token_id(self.tokenizer)
+        sfx_buckets = (8, 16, 32, 64, 128, 256)
+        sfx_a_ids = [a[n:] for a, n in zip(bin_ids, lcp)]
+        sfx_b_ids = [b[n:] for b, n in zip(conf_ids, lcp)]
+        max_sfx = max(len(s) for s in sfx_a_ids + sfx_b_ids)
+        if max_sfx > max(sfx_buckets):
+            # A suffix longer than the largest bucket would be silently
+            # right-truncated — dropping the very instruction the readout
+            # depends on. Prompt pairs that diverge this early share too
+            # little to be worth a shared prefill anyway: score them on the
+            # plain (two full prefills) path instead.
+            fused = self.decode_fused(binary_prompts, yes_ids, no_ids,
+                                      max_new_tokens=new_tokens)
+            cfused = self.decode_fused(confidence_prompts, yes_ids, no_ids,
+                                       with_digits=True,
+                                       max_new_tokens=conf_tokens)
+            return fused, cfused
+        bucket = tok.pick_bucket([max(n, 1) for n in lcp], self.buckets)
+        prefix, prefix_mask = tok.left_pad_ids(
+            [a[:n] for a, n in zip(bin_ids, lcp)], bucket, pad_id)
+        ba = tok.pick_bucket([len(s) for s in sfx_a_ids], sfx_buckets)
+        bb = tok.pick_bucket([len(s) for s in sfx_b_ids], sfx_buckets)
+        sfx_a, sfx_a_mask = tok.right_pad_ids(sfx_a_ids, ba, pad_id)
+        sfx_b, sfx_b_mask = tok.right_pad_ids(sfx_b_ids, bb, pad_id)
+        digit_ids, digit_vals = self.digit_table
+        return generate.greedy_decode_fused_shared(
+            self.params, self.cfg, jnp.asarray(prefix),
+            jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
+            jnp.asarray(sfx_a_mask), jnp.asarray(sfx_b),
+            jnp.asarray(sfx_b_mask),
+            jnp.asarray(yes_ids, jnp.int32), jnp.asarray(no_ids, jnp.int32),
+            jnp.asarray(digit_ids), jnp.asarray(digit_vals),
+            max_new_a=new_tokens, max_new_b=conf_tokens,
+            prefill_fn=self._prefill_fn)
+
     def decode_completion(self, generated_ids: np.ndarray) -> str:
         """Token ids -> text, stopping at the first EOS (HF generate parity —
         the fixed-length jitted decode keeps emitting after EOS; those tokens

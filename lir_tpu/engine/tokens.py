@@ -120,6 +120,40 @@ def trim_at_eos(ids: Sequence[int], eos_id: Optional[int]) -> List[int]:
         return ids
 
 
+def right_pad_ids(ids_list: Sequence[Sequence[int]], max_len: int,
+                  pad_id: int) -> Tuple[np.ndarray, np.ndarray]:
+    """RIGHT-pad pre-tokenized suffixes to (B, max_len) int32 (tokens, mask).
+
+    Format suffixes in the shared-prefix sweep path sit AFTER a left-padded
+    prefix in the KV cache, so their real tokens must start at the first
+    suffix slot; the decoder reads per-row validity from the mask
+    (decoder.extend). Truncates from the right if a suffix exceeds max_len.
+    """
+    B = len(ids_list)
+    tokens = np.full((B, max_len), pad_id, np.int32)
+    mask = np.zeros((B, max_len), np.int32)
+    for i, ids in enumerate(ids_list):
+        ids = list(ids)[:max_len]
+        tokens[i, :len(ids)] = ids
+        mask[i, :len(ids)] = 1
+    return tokens, mask
+
+
+def shared_prefix_len(a: Sequence[int], b: Sequence[int]) -> int:
+    """Longest common token prefix of two prompts, capped so BOTH suffixes
+    keep at least one real token (decoder.extend reads its branch logits
+    from the last real suffix position — an empty suffix has none).
+
+    Splitting at the common-token boundary (instead of at a string
+    boundary) is tokenizer-agnostic: BPE merges that cross the text split
+    point simply shorten the shared prefix by a token or two."""
+    cap = min(len(a), len(b)) - 1
+    n = 0
+    while n < cap and a[n] == b[n]:
+        n += 1
+    return max(n, 0)
+
+
 def pick_bucket(lengths: Sequence[int], buckets: Sequence[int]) -> int:
     """Smallest bucket that fits the longest prompt (static-shape discipline:
     one compile per bucket instead of one per length)."""

@@ -513,6 +513,43 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
     return logits, (ck, cv), next_positions
 
 
+def extend(params: Params, cfg: ModelConfig, cache, suffix_tokens: jax.Array,
+           suffix_mask: jax.Array, cache_mask: jax.Array, start_index: int):
+    """Teacher-forced multi-token cache extension (chunked prefill).
+
+    Runs ``suffix_tokens`` (B, S2), RIGHT-padded, through the layers in ONE
+    forward pass, attending over the already-filled cache plus the suffix
+    itself, and inserts the suffix k/v at cache slots
+    [start_index, start_index + S2). This is how the perturbation sweep
+    shares one prefill between the binary and confidence formats: the long
+    rephrased text is prefilled once, then each short format suffix is
+    extended here at ~S2/S of the prefill cost (the reference pays two full
+    forward passes per cell, perturb_prompts.py:551-726).
+
+    cache_mask: (B, T) validity over the FULL cache, already including the
+    suffix slots (pads 0). Pad-slot k/v values are garbage but carry mask 0,
+    so attention never sees them. Returns (last-valid-position logits
+    (B, V) fp32, new_cache, next_positions (B,)).
+    """
+    B, S2 = suffix_tokens.shape
+    key_positions = mask_positions(cache_mask)
+    qpos = lax.dynamic_slice_in_dim(key_positions, start_index, S2, axis=1)
+    x = _embed(params, cfg, suffix_tokens, qpos)
+    sin = cos = None
+    if cfg.pos_embedding == "rotary":
+        sin, cos = _rope_sincos(qpos, cfg.rotary_dim, cfg.rope_theta)
+    bias = _causal_bias(suffix_mask, qpos, cfg,
+                        key_positions=key_positions, key_mask=cache_mask)
+    x, new_cache = _scan_blocks(params, cfg, x, sin, cos, bias,
+                                cache=cache, cache_index=start_index)
+    # Per-row last REAL suffix position (right padding varies by row).
+    last = jnp.maximum(jnp.sum(suffix_mask, axis=-1) - 1, 0)      # (B,)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # (B, 1, D)
+    logits = _unembed(params, cfg, x_last)[:, 0, :]
+    next_positions = jnp.take_along_axis(qpos, last[:, None], axis=1)[:, 0] + 1
+    return logits, new_cache, next_positions
+
+
 def decode_step(params: Params, cfg: ModelConfig, cache, token: jax.Array,
                 position: jax.Array, step_index: jax.Array,
                 prompt_mask: jax.Array):

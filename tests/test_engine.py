@@ -222,3 +222,157 @@ def test_fused_decode_matches_capture_path():
                                np.asarray(ref_topk_vals), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(fused.weighted_confidence),
                                np.asarray(ref_wconf), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Shared-prefix fused decode (one prefill serves both sweep formats)
+# ---------------------------------------------------------------------------
+
+import dataclasses as _dc
+
+from lir_tpu.models.registry import ModelConfig as _MC
+
+
+@pytest.mark.parametrize("family,int8kv", [
+    ("llama", False),   # rotary + RMSNorm + gated MLP
+    ("llama", True),    # + int8 KV cache (extend quantizes suffix k/v)
+    ("bloom", False),   # ALiBi + embedding LayerNorm
+    ("gpt2", False),    # learned positions + tied embeddings
+])
+def test_shared_prefix_decode_matches_full_prompts(family, int8kv):
+    """greedy_decode_fused_shared == two greedy_decode_fused calls on the
+    concatenated prompts, for every position-dependent readout. Rows have
+    DIFFERENT prefix and suffix lengths, so per-row position bookkeeping
+    (left-padded prefix + right-padded suffix) is exercised."""
+    from lir_tpu.models.registry import tiny as tiny_cfg
+
+    cfg = tiny_cfg(family)
+    if int8kv:
+        cfg = _dc.replace(cfg, kv_cache_int8=True)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(3)
+    V = cfg.vocab_size
+    prefix_lens = [10, 17, 5, 23]
+    sa_lens = [3, 5, 2, 4]
+    sb_lens = [6, 2, 7, 3]
+    prefix_ids = [rng.integers(3, V, n).tolist() for n in prefix_lens]
+    sa_ids = [rng.integers(3, V, n).tolist() for n in sa_lens]
+    sb_ids = [rng.integers(3, V, n).tolist() for n in sb_lens]
+    yes_ids = rng.integers(3, V, 4).astype(np.int32)
+    no_ids = rng.integers(3, V, 4).astype(np.int32)
+    digit_ids = np.asarray([5, 6, 7], np.int32)
+    digit_vals = np.asarray([10.0, 50.0, 90.0], np.float32)
+    NEW_A, NEW_B = 4, 6
+
+    def ref(full_ids, n_new, d_ids, d_vals):
+        toks, mask = tok.left_pad_ids(full_ids, 32, 0)
+        return generate.greedy_decode_fused(
+            params, cfg, jnp.asarray(toks), jnp.asarray(mask),
+            jnp.asarray(yes_ids), jnp.asarray(no_ids),
+            jnp.asarray(d_ids), jnp.asarray(d_vals), max_new_tokens=n_new)
+
+    ref_a = ref([p + s for p, s in zip(prefix_ids, sa_ids)], NEW_A,
+                np.zeros((0,), np.int32), np.zeros((0,), np.float32))
+    ref_b = ref([p + s for p, s in zip(prefix_ids, sb_ids)], NEW_B,
+                digit_ids, digit_vals)
+
+    pre, pre_mask = tok.left_pad_ids(prefix_ids, 32, 0)
+    sa, sa_mask = tok.right_pad_ids(sa_ids, 8, 0)
+    sb, sb_mask = tok.right_pad_ids(sb_ids, 8, 0)
+    out_a, out_b = generate.greedy_decode_fused_shared(
+        params, cfg, jnp.asarray(pre), jnp.asarray(pre_mask),
+        jnp.asarray(sa), jnp.asarray(sa_mask), jnp.asarray(sb),
+        jnp.asarray(sb_mask), jnp.asarray(yes_ids), jnp.asarray(no_ids),
+        jnp.asarray(digit_ids), jnp.asarray(digit_vals),
+        max_new_a=NEW_A, max_new_b=NEW_B)
+
+    # int8 KV: the reference path's FIRST position comes from the dense
+    # (unquantized) prefill, while the shared path reads it through the
+    # quantized cache — a real ~0.5% numeric difference, same one every
+    # decode step already carries. fp32 paths agree to float tolerance.
+    tol = dict(rtol=2e-2, atol=2e-2) if int8kv else dict(rtol=1e-4, atol=1e-5)
+    for out, refd in ((out_a, ref_a), (out_b, ref_b)):
+        if not int8kv:
+            np.testing.assert_array_equal(np.asarray(out.generated),
+                                          np.asarray(refd.generated))
+            np.testing.assert_array_equal(np.asarray(out.top2_ids),
+                                          np.asarray(refd.top2_ids))
+        np.testing.assert_allclose(np.asarray(out.p_yes),
+                                   np.asarray(refd.p_yes), **tol)
+        np.testing.assert_allclose(np.asarray(out.p_no),
+                                   np.asarray(refd.p_no), **tol)
+    if not int8kv:
+        np.testing.assert_array_equal(np.asarray(out_a.topk_ids),
+                                      np.asarray(ref_a.topk_ids))
+        np.testing.assert_allclose(np.asarray(out_a.topk_logprobs),
+                                   np.asarray(ref_a.topk_logprobs),
+                                   rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_b.weighted_confidence),
+                               np.asarray(ref_b.weighted_confidence), **tol)
+
+
+def test_engine_decode_fused_shared_matches_decode_fused():
+    """Runner-level: tokenize/LCP-split/pad host prep reproduces the plain
+    decode_fused readouts on real prompt strings (FakeTokenizer)."""
+    cfg = _MC(name="shared-smoke", vocab_size=FakeTokenizer.VOCAB,
+              hidden_size=64, n_layers=2, n_heads=4, intermediate_size=128,
+              max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(2))
+    engine = ScoringEngine(params, cfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=4, max_seq_len=256))
+    mains = [f"the quick brown fox {i} jumps over the lazy dog "
+             f"word {i * 7} more filler text here" for i in range(4)]
+    bins = [m + " Respond with either Yes or No only" for m in mains]
+    confs = [m + " Give a confidence number from 0 to 100" for m in mains]
+    t1 = np.full((4,), FakeTokenizer.YES, np.int32)
+    t2 = np.full((4,), FakeTokenizer.NO, np.int32)
+
+    fused_a = engine.decode_fused(bins, t1, t2, max_new_tokens=4)
+    fused_b = engine.decode_fused(confs, t1, t2, with_digits=True,
+                                  max_new_tokens=6)
+    out_a, out_b = engine.decode_fused_shared(bins, confs, t1, t2,
+                                              new_tokens=4, conf_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out_a.generated),
+                                  np.asarray(fused_a.generated))
+    np.testing.assert_allclose(np.asarray(out_a.p_yes),
+                               np.asarray(fused_a.p_yes),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out_a.topk_ids),
+                                  np.asarray(fused_a.topk_ids))
+    np.testing.assert_array_equal(np.asarray(out_b.generated),
+                                  np.asarray(fused_b.generated))
+    np.testing.assert_allclose(np.asarray(out_b.weighted_confidence),
+                               np.asarray(fused_b.weighted_confidence),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_shared_prefix_len_caps_for_nonempty_suffix():
+    a = [1, 2, 3, 4]
+    assert tok.shared_prefix_len(a, a) == 3          # strict-prefix guard
+    assert tok.shared_prefix_len(a, [1, 2, 9]) == 2
+    assert tok.shared_prefix_len([7], [8]) == 0
+    assert tok.shared_prefix_len(a, [1, 2, 3, 4, 5]) == 3
+
+
+def test_decode_fused_shared_falls_back_on_long_suffix():
+    """Prompt pairs that diverge early (suffix > largest suffix bucket) must
+    take the plain two-prefill path, not silently truncate the instruction
+    the readout depends on."""
+    cfg = _MC(name="fallback-smoke", vocab_size=FakeTokenizer.VOCAB,
+              hidden_size=64, n_layers=2, n_heads=4, intermediate_size=128,
+              max_seq_len=1024)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(4))
+    engine = ScoringEngine(params, cfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=2, max_seq_len=1024))
+    # Shared prefix of 2 words; suffixes of ~300 words each (> 256 bucket).
+    long_a = "start shared " + " ".join(f"alpha{i}" for i in range(300))
+    long_b = "start shared " + " ".join(f"beta{i}" for i in range(300))
+    t1 = np.full((2,), FakeTokenizer.YES, np.int32)
+    t2 = np.full((2,), FakeTokenizer.NO, np.int32)
+    out_a, out_b = engine.decode_fused_shared(
+        [long_a] * 2, [long_b] * 2, t1, t2, new_tokens=2, conf_tokens=2)
+    ref_a = engine.decode_fused([long_a] * 2, t1, t2, max_new_tokens=2)
+    np.testing.assert_array_equal(np.asarray(out_a.generated),
+                                  np.asarray(ref_a.generated))
+    np.testing.assert_allclose(np.asarray(out_a.p_yes),
+                               np.asarray(ref_a.p_yes), rtol=1e-6)

@@ -148,3 +148,130 @@ def test_real_pretrained_checkpoint_smoke():
     _, _, ref_rel = _reference_yes_no(
         torch_model, tokenizer, prompt, engine.yes_id, engine.no_id)
     assert abs(row.relative_prob - ref_rel) <= 0.01 * max(abs(ref_rel), 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# Sentencepiece-style (Metaspace/Unigram) family — llama/mistral/t5/baichuan
+# resolve "▁Yes", not " Yes"-as-bytelevel (VERDICT r2 missing #1;
+# compare_base_vs_instruct.py:244-247 vs :208-209)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def sp_checkpoint(tmp_path_factory):
+    """Build a GENUINE sentencepiece-style tokenizer (Unigram model +
+    Metaspace pre-tokenizer, the llama/t5 scheme) + a random-weight Llama
+    checkpoint saved with save_pretrained. The Unigram vocab is constructed
+    explicitly — word pieces ("▁Yes", "▁No", "▁85", ...) scored above a
+    full char-fallback alphabet — so the metaspace resolution under test is
+    deterministic, exactly like a trained sentencepiece model's."""
+    import transformers as tf
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+    from lir_tpu.data.prompts import WORD_MEANING_QUESTIONS
+
+    corpus = list(WORD_MEANING_QUESTIONS) + [
+        "Yes", "No", "Answer either 'Yes' or 'No'.",
+        "Question: Answer:", "Is a tomato a vegetable?",
+        "Give a confidence number from 0 to 100",
+    ]
+    words = sorted({w for line in corpus for w in line.split()})
+    chars = sorted({c for line in corpus for c in line} | {"▁"})
+    pieces = {"<unk>": 0.0, "<s>": 0.0, "</s>": 0.0}
+    for w in words:
+        pieces.setdefault("▁" + w, -8.0)
+    for v in range(101):
+        pieces.setdefault("▁" + str(v), -8.0)
+        pieces.setdefault(str(v), -9.0)
+    for c in chars:
+        pieces.setdefault(c, -12.0)
+    tok = Tokenizer(models.Unigram(list(pieces.items()), unk_id=0))
+    tok.pre_tokenizer = pre_tokenizers.Metaspace()
+    tok.decoder = decoders.Metaspace()
+    fast = tf.PreTrainedTokenizerFast(
+        tokenizer_object=tok, bos_token="<s>", eos_token="</s>",
+        unk_token="<unk>")
+
+    torch.manual_seed(1)
+    model = tf.LlamaForCausalLM(tf.LlamaConfig(
+        vocab_size=len(fast), hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        max_position_embeddings=256, tie_word_embeddings=False)).eval()
+    path = tmp_path_factory.mktemp("real_ckpt_sp") / "sp-llama"
+    path.mkdir()
+    model.save_pretrained(path, safe_serialization=True)
+    fast.save_pretrained(path)
+    return path, model, fast
+
+
+def test_sentencepiece_metaspace_yes_no_resolution(sp_checkpoint):
+    """tokens.yes_no_ids must land on the METASPACE pieces ("▁Yes"/"▁No")
+    for a sentencepiece-family tokenizer — the exact mis-resolution SURVEY
+    §7 hard part 1 warns silently corrupts every downstream number."""
+    path, _, fast = sp_checkpoint
+    engine = load_engine(path, RuntimeConfig(batch_size=4, max_new_tokens=12,
+                                             max_seq_len=128))
+    assert fast.convert_ids_to_tokens(engine.yes_id) == "▁Yes"
+    assert fast.convert_ids_to_tokens(engine.no_id) == "▁No"
+    assert engine.yes_id != engine.no_id
+    # The leading-space and bare forms both resolve to the metaspace piece
+    # (real llama behavior: sentencepiece prepends ▁ at word starts).
+    assert engine.yes_id == fast(" Yes", add_special_tokens=False).input_ids[0]
+    assert engine.yes_id == fast("Yes", add_special_tokens=False).input_ids[0]
+    # Integer-token table picked up the metaspace digit pieces (confidence
+    # readout path).
+    ids, vals = engine.digit_table
+    sp85 = fast(" 85", add_special_tokens=False).input_ids
+    assert len(sp85) == 1 and sp85[0] in set(int(i) for i in ids)
+    assert vals[list(ids).index(sp85[0])] == 85.0
+
+
+def test_sentencepiece_unmocked_score_matches_torch(sp_checkpoint):
+    """Same differential as the byte-BPE test, through the metaspace ids:
+    UNMOCKED factory.load_engine vs the reference rule run in torch on the
+    identical checkpoint."""
+    path, torch_model, fast = sp_checkpoint
+    engine = load_engine(path, RuntimeConfig(batch_size=4, max_new_tokens=12,
+                                             max_seq_len=128))
+    prompt = format_instruct_prompt('Is a "tomato" a "vegetable"?')
+    row = engine.score_prompts([prompt])[0]
+    ref_yes, ref_no, ref_rel = _reference_yes_no(
+        torch_model, fast, prompt, engine.yes_id, engine.no_id)
+    assert abs(row.yes_prob - ref_yes) < 2e-3
+    assert abs(row.no_prob - ref_no) < 2e-3
+    assert abs(row.relative_prob - ref_rel) <= 0.01 * max(ref_rel, 1e-9)
+
+
+def test_sentencepiece_perturbation_sweep_shared_prefix(sp_checkpoint,
+                                                       tmp_path):
+    """The shared-prefix sweep path (LCP token split + suffix extension)
+    with a REAL metaspace tokenizer: D6 rows come out finite and the
+    binary probs equal the plain (non-shared) fused scoring path."""
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+
+    path, _, _ = sp_checkpoint
+    engine = load_engine(path, RuntimeConfig(batch_size=2, max_new_tokens=8,
+                                             max_seq_len=128))
+    lp = (LegalPrompt(
+        main="Is a tomato a vegetable?",
+        response_format="Answer either 'Yes' or 'No'.",
+        target_tokens=("Yes", "No"),
+        confidence_format="Give a confidence number from 0 to 100"),)
+    perts = (["Is a tomato really a vegetable?",
+              "Would a tomato count as a vegetable?",
+              "Is a tomato considered a vegetable?"],)
+    rows = run_perturbation_sweep(engine, "sp-llama", lp, perts,
+                                  tmp_path / "d6.xlsx")
+    assert len(rows) == 4
+    assert all(np.isfinite(r.token_1_prob) for r in rows)
+    assert all(np.isfinite(r.weighted_confidence) for r in rows)
+    # Cross-check one cell against the non-shared scoring path.
+    import jax.numpy as jnp
+    from lir_tpu.engine import score as score_mod
+    t1 = np.full((2,), engine.yes_id, np.int32)
+    t2 = np.full((2,), engine.no_id, np.int32)
+    fused = engine.decode_fused([rows[0].full_rephrased_prompt] * 2, t1, t2,
+                                max_new_tokens=4)
+    ref = score_mod.readout_from_fused(fused, jnp.asarray(t1),
+                                       jnp.asarray(t2), scan_positions=1)
+    np.testing.assert_allclose(rows[0].token_1_prob, float(ref.yes_prob[0]),
+                               rtol=1e-4, atol=1e-6)

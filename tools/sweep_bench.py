@@ -49,7 +49,9 @@ def _long_text(rng, n_words: int = N_WORDS) -> str:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cells", type=int, default=192)
-    ap.add_argument("--batch", type=int, default=48)
+    # batch 40 is the measured sweet spot for the shared-prefix path (48
+    # OOMs: the shared cache carries suffix+gen slack slots; SCALE.md r3).
+    ap.add_argument("--batch", type=int, default=40)
     ap.add_argument("--no-record", action="store_true",
                     help="print only; do not append to SCALE.md")
     args = ap.parse_args()
