@@ -77,10 +77,11 @@ BENCH_NOMINAL_CPU_SWEEP = 1.0
 
 # Sweep-path measurement shape: batch 40 is the measured sweet spot for
 # the shared-prefix scoring path on a 16 GiB v5e (48 OOMs — the shared
-# cache carries suffix + generation slack slots; SCALE.md r3).
-SWEEP_BATCH_TPU = 40
+# cache carries suffix + generation slack slots; SCALE.md r3). Like the
+# isolated step, the sweep falls down the ladder on HBM exhaustion.
+SWEEP_BATCHES_TPU = (40, 32, 24, 16, 8)
 SWEEP_CELLS_TPU = 160
-SWEEP_BATCH_CPU = 4
+SWEEP_BATCHES_CPU = (4,)
 SWEEP_CELLS_CPU = 8
 
 SEQ = 256
@@ -283,10 +284,8 @@ def _sweep_path(params, cfg, on_accel: bool):
     from lir_tpu.engine.runner import ScoringEngine
     from lir_tpu.engine.sweep import run_perturbation_sweep
 
-    batch = SWEEP_BATCH_TPU if on_accel else SWEEP_BATCH_CPU
+    batches = SWEEP_BATCHES_TPU if on_accel else SWEEP_BATCHES_CPU
     cells = SWEEP_CELLS_TPU if on_accel else SWEEP_CELLS_CPU
-    rt = RuntimeConfig(batch_size=batch, max_seq_len=512)
-    engine = ScoringEngine(params, cfg, FakeTokenizer(), rt)
     rng = np.random.default_rng(7)
     words = ("coverage policy flood water damage claim insurer premium "
              "exclusion endorsement peril deductible adjuster settle "
@@ -302,7 +301,7 @@ def _sweep_path(params, cfg, on_accel: bool):
         target_tokens=("Yes", "No"),
         confidence_format="Give a confidence number from 0 to 100 ."),)
 
-    def run(n_cells, tag):
+    def run(engine, n_cells, tag):
         perts = ([long_text() for _ in range(n_cells - 1)],)
         with tempfile.TemporaryDirectory() as td:
             t0 = time.perf_counter()
@@ -314,11 +313,25 @@ def _sweep_path(params, cfg, on_accel: bool):
         assert all(np.isfinite(r.token_1_prob) for r in rows)
         return dt
 
-    t_warm = run(batch, "warmup")
-    print(f"# sweep warmup ({batch} cells incl. compiles): {t_warm:.1f}s",
+    last_oom = None
+    for batch in batches:
+        engine = ScoringEngine(params, cfg, FakeTokenizer(),
+                               RuntimeConfig(batch_size=batch,
+                                             max_seq_len=512))
+        try:
+            t_warm = run(engine, batch, "warmup")
+            print(f"# sweep warmup (batch {batch}, incl. compiles): "
+                  f"{t_warm:.1f}s", file=sys.stderr)
+            dt = run(engine, cells, "timed")
+        except Exception as err:  # noqa: BLE001 — OOM falls back, rest raises
+            if _is_oom(err):
+                last_oom = err
+                continue
+            raise
+        return cells / dt, batch, cells
+    print(f"BENCH ABORT: every sweep batch candidate OOMed; last: {last_oom}",
           file=sys.stderr)
-    dt = run(cells, "timed")
-    return cells / dt, batch, cells
+    sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -272,6 +272,66 @@ def resolve_results_path(path: Path) -> Path:
     return path
 
 
+def concat_host_shards(path: Path,
+                       n_hosts: Optional[int] = None) -> Optional[pd.DataFrame]:
+    """Merge per-host ``.hostN`` result shards + manifests into the final
+    artifact at ``path`` — the TPU-pod replacement for the reference's
+    "download each batch output file and append" gather step
+    (perturb_prompts.py:161-188,975-984).
+
+    ``n_hosts`` is the EXPECTED shard count (the sweep passes
+    ``jax.process_count()``): exactly hosts ``0..n_hosts-1`` are merged,
+    so stale ``.hostN`` files from an earlier, larger-pod run at the same
+    path are never silently included, and if ANY expected shard is
+    missing (a pod without a shared filesystem — each host sees only its
+    own shard) the merge returns None instead of writing a
+    complete-looking final artifact that holds 1/N of the rows; gather
+    rows over the network instead (parallel.multihost.gather_rows).
+    ``n_hosts=None`` discovers shards by walking host0, host1, ... until
+    the first gap (single-process tooling/cleanup use).
+
+    Shards are concatenated ROW-WISE in host order (the D6 schema has no
+    cross-row state) after a column-schema check; the per-host manifests
+    are unioned into ``{stem}.manifest.jsonl`` so a later single-process
+    resume sees every completed cell. Per-host shard files and manifests
+    are left in place — the per-HOST resume story keeps working.
+    """
+    path = resolve_results_path(Path(path))
+    frames = []
+    i = 0
+    while n_hosts is None or i < n_hosts:
+        shard = path.with_name(f"{path.stem}.host{i}{path.suffix}")
+        if not shard.exists():
+            if n_hosts is not None:
+                return None     # expected shard invisible: no shared fs
+            break
+        df = read_results_frame(shard)
+        if frames and list(df.columns) != list(frames[0].columns):
+            raise ValueError(
+                f"host shard {shard} column schema differs from host0 — "
+                f"refusing to merge mismatched artifacts")
+        frames.append(df)
+        i += 1
+    n_hosts = i
+    if not frames:
+        return None
+    merged = pd.concat(frames, ignore_index=True)
+    _write_frame(merged, path)
+    # Union the per-host manifests (write-ahead order preserved: the merged
+    # manifest only ever contains keys whose rows are already in a shard).
+    man_path = path.with_suffix(".manifest.jsonl")
+    lines = []
+    for i in range(n_hosts):
+        m = path.with_name(
+            f"{path.stem}.host{i}{path.suffix}").with_suffix(
+            ".manifest.jsonl")
+        if m.exists():
+            lines.append(m.read_text().rstrip("\n"))
+    if lines:
+        man_path.write_text("\n".join(l for l in lines if l) + "\n")
+    return merged
+
+
 def read_results_frame(path: Path) -> pd.DataFrame:
     """Read a results artifact written by _write_frame (xlsx or CSV fallback)."""
     path = Path(path)

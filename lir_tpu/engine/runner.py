@@ -132,15 +132,18 @@ class ScoringEngine:
 
     def decode_fused(self, prompts: Sequence[str], yes_ids: np.ndarray,
                      no_ids: np.ndarray, with_digits: bool = False,
-                     max_new_tokens: Optional[int] = None):
+                     max_new_tokens: Optional[int] = None,
+                     pretokenized: Optional[Sequence[Sequence[int]]] = None):
         """The production scoring path: one jitted decode with the C13/D6
         readouts fused into the scan (no (B, T, V) logit stack). Decoder-only
         models only; T5 keeps the capture path (tiny vocab stacks).
 
         ``max_new_tokens`` overrides the runtime default (the perturbation
-        sweep passes its short per-cell budget, config.RuntimeConfig)."""
+        sweep passes its short per-cell budget, config.RuntimeConfig).
+        ``pretokenized`` skips tokenization when the caller already holds
+        the token ids (the shared-prefix fallback path)."""
         assert not self.encoder_decoder
-        toks, mask = self._pad_batch(prompts)
+        toks, mask = self._pad_batch(prompts, pretokenized)
         if with_digits:
             digit_ids, digit_vals = self.digit_table
         else:
@@ -187,10 +190,12 @@ class ScoringEngine:
             # little to be worth a shared prefill anyway: score them on the
             # plain (two full prefills) path instead.
             fused = self.decode_fused(binary_prompts, yes_ids, no_ids,
-                                      max_new_tokens=new_tokens)
+                                      max_new_tokens=new_tokens,
+                                      pretokenized=bin_ids)
             cfused = self.decode_fused(confidence_prompts, yes_ids, no_ids,
                                        with_digits=True,
-                                       max_new_tokens=conf_tokens)
+                                       max_new_tokens=conf_tokens,
+                                       pretokenized=conf_ids)
             return fused, cfused
         bucket = tok.pick_bucket([max(n, 1) for n in lcp], self.buckets)
         prefix, prefix_mask = tok.left_pad_ids(
@@ -217,9 +222,12 @@ class ScoringEngine:
         trimmed = tok.trim_at_eos(np.asarray(generated_ids).tolist(), self.eos_id)
         return self.tokenizer.decode(trimmed, skip_special_tokens=True).strip()
 
-    def _pad_batch(self, prompts: Sequence[str]) -> Tuple[jax.Array, jax.Array]:
+    def _pad_batch(self, prompts: Sequence[str],
+                   pretokenized: Optional[Sequence[Sequence[int]]] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
         """Tokenize + left-pad into the smallest fitting bucket."""
-        ids_list = [self.tokenizer(p).input_ids for p in prompts]
+        ids_list = (list(pretokenized) if pretokenized is not None
+                    else [self.tokenizer(p).input_ids for p in prompts])
         bucket = tok.pick_bucket([len(i) for i in ids_list], self.buckets)
         toks_arr, mask = tok.left_pad_ids(ids_list, bucket,
                                           tok.pad_token_id(self.tokenizer))

@@ -127,6 +127,7 @@ def run_perturbation_sweep(
             "each host must own its .hostN results/manifest shard — pass "
             "manifest=None and let the sweep derive per-host paths")
     shard_grid = manifest is None and multihost.is_multiprocess()
+    base_results_path = results_path
     if shard_grid:
         i = __import__("jax").process_index()
         results_path = results_path.with_name(
@@ -190,6 +191,19 @@ def run_perturbation_sweep(
         # Fence so no host's caller reads partial peers; per-host workbooks
         # concatenate row-wise (the D6 schema has no cross-row state).
         multihost.barrier("perturbation-sweep-done")
+        if __import__("jax").process_index() == 0:
+            # Gather step on a shared filesystem: merge every visible
+            # .hostN shard (+ manifests) into the final artifact — the
+            # reference's "download each batch output and append"
+            # (perturb_prompts.py:161-188). Hosts without a shared fs see
+            # only their own shard; gather_rows covers that topology.
+            merged = schemas.concat_host_shards(
+                base_results_path,
+                n_hosts=__import__("jax").process_count())
+            if merged is not None:
+                log.info("multihost: merged host shards -> %s (%d rows)",
+                         schemas.resolve_results_path(base_results_path),
+                         len(merged))
     return rows
 
 

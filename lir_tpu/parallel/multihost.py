@@ -48,6 +48,17 @@ def initialize(coordinator_address: str | None = None,
                  jax.local_device_count())
         return True
     except Exception as err:  # noqa: BLE001 — single-host is a normal path
+        # A launcher (or an earlier call) may have brought the runtime up
+        # already; that is a SUCCESSFUL multi-host state, not a bring-up
+        # failure (ADVICE r2 #2). jax raises a RuntimeError whose text
+        # varies by version, so probe the outcome instead of the message.
+        try:
+            if jax.process_count() > 1:
+                log.info("jax.distributed already up: process %d of %d",
+                         jax.process_index(), jax.process_count())
+                return True
+        except Exception:  # noqa: BLE001 — no runtime at all
+            pass
         if required:
             raise RuntimeError(
                 f"--multihost requested but distributed bring-up failed: "

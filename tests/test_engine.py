@@ -376,3 +376,29 @@ def test_decode_fused_shared_falls_back_on_long_suffix():
                                   np.asarray(ref_a.generated))
     np.testing.assert_allclose(np.asarray(out_a.p_yes),
                                np.asarray(ref_a.p_yes), rtol=1e-6)
+
+
+def test_data_parallel_mesh_8x1_replicated_params():
+    """Pure data-parallel serving (mesh 8x1): params replicate, the batch
+    shards on `data`, and scores equal the single-device run — the int8-7B
+    v5e-8 deployment mode (DEPLOY.md §2; perturb_prompts.py:294-330)."""
+    params, cfg, _ = _tiny_llama_params()
+    mesh = sharding.build_mesh(MeshConfig(data=8, model=1))
+    sharded = sharding.shard_params(params, cfg, mesh)
+    rng = np.random.default_rng(3)
+    toks = rng.integers(3, 1000, size=(8, 6)).astype(np.int32)
+    mask = np.ones_like(toks)
+
+    ref_gen, ref_logits = generate.greedy_decode(
+        params, cfg, jnp.asarray(toks), jnp.asarray(mask), max_new_tokens=4)
+    bs = sharding.batch_sharding(mesh)
+    gen, logits = generate.greedy_decode(
+        sharded, cfg, jax.device_put(jnp.asarray(toks), bs),
+        jax.device_put(jnp.asarray(mask), bs), max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(gen), np.asarray(ref_gen))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               atol=1e-4, rtol=1e-4)
+    # Params really are replicated: with model=1 every device holds the
+    # FULL weight (the named model axis has size 1 -> no actual split).
+    wq = sharded["layers"]["wq"]
+    assert wq.sharding.shard_shape(wq.shape) == wq.shape

@@ -350,3 +350,21 @@ def test_engine_seq_parallel_prefill_matches_plain(seq_mesh):
         np.testing.assert_allclose(b.relative_prob, a.relative_prob,
                                    atol=1e-4)
         assert b.completion == a.completion
+
+
+def test_multihost_initialize_already_up_is_success(monkeypatch):
+    """A launcher that already brought jax.distributed up must not turn
+    --multihost into a hard error: initialize(required=True) probes
+    process_count() and returns True (ADVICE r2 #2)."""
+    import jax
+
+    from lir_tpu.parallel import multihost
+
+    def _raise(*a, **k):
+        raise RuntimeError("jax.distributed.initialize was already called")
+
+    monkeypatch.setattr(jax.distributed, "initialize", _raise)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    assert multihost.initialize(required=True) is True
+    assert multihost.initialize() is True

@@ -256,3 +256,58 @@ def test_perturbation_sweep_multihost_shards(tmp_path, monkeypatch):
         seen.extend((r.original_main, r.rephrased_main) for r in rows)
     # 6 cells total (original + 5 rephrasings), split 3/3, no overlap.
     assert len(seen) == 6 and len(set(seen)) == 6
+
+
+def test_multihost_shard_concat_and_merged_resume(tmp_path, monkeypatch):
+    """The gather step: after both hosts sweep their shards, host 0 merges
+    the .hostN workbooks + manifests into the FINAL artifact
+    (perturb_prompts.py:161-188,975-984 semantics), and a later
+    single-process resume against the merged manifest scores nothing."""
+    import jax
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data import schemas
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine import grid as grid_mod
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.parallel import multihost
+    from lir_tpu.utils.manifest import SweepManifest
+
+    cfg = ModelConfig(name="mhc", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=2, n_heads=4,
+                      intermediate_size=64, max_seq_len=128)
+    eng = ScoringEngine(decoder.init_params(cfg, jax.random.PRNGKey(0)),
+                        cfg, FakeTokenizer(),
+                        RuntimeConfig(batch_size=4, max_new_tokens=4))
+    lp = (LegalPrompt(main="Is a levee failure a flood ?",
+                      response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Number 0 to 100 ."),)
+    perts = ([f"variant {i} of the levee question ?" for i in range(5)],)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost, "barrier", lambda name: None)
+    # Host 1 first, then host 0 (whose tail runs the merge).
+    for proc in (1, 0):
+        monkeypatch.setattr(jax, "process_index", lambda p=proc: p)
+        run_perturbation_sweep(eng, "mhc-model", lp, perts,
+                               tmp_path / "results.xlsx", checkpoint_every=3)
+
+    final = schemas.resolve_results_path(tmp_path / "results.xlsx")
+    assert final.exists()
+    df = schemas.read_results_frame(final)
+    assert len(df) == 6
+    assert list(df.columns) == list(schemas.PERTURBATION_COLUMNS)
+    assert len(set(df["Rephrased Main Part"])) == 6
+    # Per-host shards/manifests survive (per-host resume keeps working).
+    assert (tmp_path / "results.host0.csv").exists()
+    assert (tmp_path / "results.host1.manifest.jsonl").exists()
+    # Merged manifest covers ALL cells: a single-process resume runs dry.
+    merged_manifest = SweepManifest(final.with_suffix(".manifest.jsonl"),
+                                    grid_mod.RESUME_KEY_FIELDS)
+    cells = grid_mod.build_grid("mhc-model", lp, perts)
+    assert grid_mod.pending_cells(cells, merged_manifest) == []
