@@ -188,6 +188,12 @@ def run_perturbation_sweep(
     if pending_rows:
         _flush(pending_rows, results_path, manifest)
     if shard_grid:
+        # A host whose shard had zero pending cells (grid smaller than the
+        # pod, or a fully-resumed shard) still writes a header-only shard
+        # file: the post-barrier merge distinguishes "host had nothing to
+        # do" from "shard invisible — no shared filesystem" by existence.
+        if not results_path.exists():
+            schemas.write_perturbation_results([], results_path)
         # Fence so no host's caller reads partial peers; per-host workbooks
         # concatenate row-wise (the D6 schema has no cross-row state).
         multihost.barrier("perturbation-sweep-done")
@@ -204,6 +210,13 @@ def run_perturbation_sweep(
                 log.info("multihost: merged host shards -> %s (%d rows)",
                          schemas.resolve_results_path(base_results_path),
                          len(merged))
+            else:
+                log.warning(
+                    "multihost: peer shards not visible from host 0 (no "
+                    "shared filesystem?) — final artifact NOT merged; "
+                    "gather rows over the network (multihost.gather_rows) "
+                    "or concatenate the per-host %s.hostN files manually",
+                    base_results_path.stem)
     return rows
 
 

@@ -82,7 +82,8 @@ def _mlp(x, lp, cfg: T5Config):
 
 
 def init_params(cfg: T5Config, key: jax.Array, dtype=jnp.float32) -> Params:
-    k = iter(jax.random.split(key, 16))
+    # 22 draws for a gated (wi_0/wi_1) untied config; headroom is free.
+    k = iter(jax.random.split(key, 32))
     D, H, hd, F, L = (cfg.hidden_size, cfg.n_heads, cfg.head_dim,
                       cfg.intermediate_size, cfg.n_layers)
 

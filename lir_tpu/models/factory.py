@@ -140,10 +140,20 @@ def load_engine(
         if cache_root is not None:
             cache_mod.save_params(cache_root, model_dir.name, params, cfg)
 
-    if kv_cache_int8 and not encdec:
-        import dataclasses
+    if kv_cache_int8:
+        if encdec:
+            # ≤50-token decodes re-run the tiny decoder stack instead of
+            # keeping a cache (generate.t5_greedy_decode), so there is no
+            # cache to quantize — say so instead of silently ignoring the
+            # flag (ADVICE r2 #4).
+            log.warning(
+                "%s: --kv-cache-int8 has no effect on encoder-decoder "
+                "models (no KV cache in the seq2seq decode path); "
+                "proceeding without it", model_dir.name)
+        else:
+            import dataclasses
 
-        cfg = dataclasses.replace(cfg, kv_cache_int8=True)
+            cfg = dataclasses.replace(cfg, kv_cache_int8=True)
     if quantize_int8:
         from . import quant
 
@@ -157,9 +167,18 @@ def load_engine(
         )
 
     seq_mesh = None
-    if not encdec and mesh_cfg is not None and mesh_cfg.n_devices > 1:
+    if mesh_cfg is not None and mesh_cfg.n_devices > 1:
         from ..parallel import sharding
 
+        if encdec and mesh_cfg.seq > 1:
+            # Ring/Ulysses prefill is a decoder-path feature; refuse the
+            # seq axis loudly rather than silently serving a different
+            # sharding than the user asked for (ADVICE r2 #4).
+            raise ValueError(
+                f"--mesh with seq={mesh_cfg.seq} > 1 is not supported for "
+                f"encoder-decoder checkpoints ({model_dir.name}); use a "
+                f"DATAxMODEL mesh (e.g. "
+                f"{mesh_cfg.data}x{mesh_cfg.model * mesh_cfg.seq})")
         mesh = sharding.build_mesh(mesh_cfg)
         params = sharding.shard_params(params, cfg, mesh)
         if mesh_cfg.seq > 1:

@@ -106,6 +106,59 @@ def decoder_param_specs(cfg: ModelConfig, mesh: Mesh) -> Params:
     return specs
 
 
+def encdec_param_specs(cfg, mesh: Mesh) -> Params:
+    """PartitionSpec tree matching models/encdec.py's T5 param layout —
+    Megatron-style: attention head projections column-parallel (output
+    axis on 'model'), their output projections row-parallel, MLP columns
+    on 'model'; relative-attention bucket embeddings shard on the HEAD
+    axis so the per-head bias lives with its heads. Same divisibility
+    degradations as decoder_param_specs (non-dividing axes replicate).
+
+    Closes the round-2 gap where `--mesh` was silently ignored for
+    encoder-decoder checkpoints (models/factory.py; the reference runs
+    T0-3B/tk-instruct-3b 8-bit on one GPU,
+    compare_instruct_models.py:145-166,471-475 — at bf16 they need the
+    slice)."""
+    m = mesh.shape["model"]
+    shard_attn = cfg.n_heads % m == 0
+    A = "model" if shard_attn else None
+    F = "model" if cfg.intermediate_size % m == 0 else None
+
+    def stack(cross: bool) -> Params:
+        p: Params = {
+            "ln_attn": P(None, None),
+            "wq": P(None, None, A), "wk": P(None, None, A),
+            "wv": P(None, None, A), "wo": P(None, A, None),
+            "ln_mlp": P(None, None),
+            "wo_mlp": P(None, F, None),
+        }
+        if cfg.gated_mlp:
+            p.update({"wi_0": P(None, None, F), "wi_1": P(None, None, F)})
+        else:
+            p["wi"] = P(None, None, F)
+        if cross:
+            p.update({
+                "ln_cross": P(None, None),
+                "cq": P(None, None, A), "ck": P(None, None, A),
+                "cv": P(None, None, A), "co": P(None, A, None),
+            })
+        return p
+
+    specs: Params = {
+        "shared_embed": P(None, "model" if cfg.hidden_size % m == 0 else None),
+        "enc_rel_embed": P(None, A),
+        "dec_rel_embed": P(None, A),
+        "encoder": stack(cross=False),
+        "enc_final_ln": P(None),
+        "decoder": stack(cross=True),
+        "dec_final_ln": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(
+            None, "model" if cfg.vocab_size % m == 0 else None)
+    return specs
+
+
 def quant_scale_spec(spec: P) -> P:
     """Spec for a QuantTensor's per-output-channel scale, derived from the
     dense weight's spec: keep the leading (layer-stack) axes, keep the OUTPUT
@@ -121,10 +174,13 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
     """device_put every param with its NamedSharding (single host).
 
     int8 trees compose: a QuantTensor's payload takes the dense weight's
-    spec, its scale the derived output-axis spec (quant_scale_spec)."""
+    spec, its scale the derived output-axis spec (quant_scale_spec).
+    Dispatches on the config type: T5Config trees get the enc-dec specs."""
     from ..models.quant import QuantTensor
+    from ..models.registry import T5Config
 
-    specs = decoder_param_specs(cfg, mesh)
+    specs = (encdec_param_specs(cfg, mesh) if isinstance(cfg, T5Config)
+             else decoder_param_specs(cfg, mesh))
 
     def place(leaf, spec):
         if isinstance(leaf, QuantTensor):

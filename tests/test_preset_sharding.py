@@ -187,3 +187,64 @@ def test_full_feature_matrix_on_mesh(mesh):
     # reductions) against weight-only int8: readout agreement within 5e-2.
     np.testing.assert_allclose(np.asarray(r_fast.yes_prob),
                                np.asarray(r_ref.yes_prob), atol=5e-2)
+
+
+# ---------------------------------------------------------------------------
+# Encoder-decoder (T5) sharding — closes the r2 "--mesh silently ignored
+# for enc-dec" gap (compare_instruct_models.py:145-166,471-475 parity)
+# ---------------------------------------------------------------------------
+
+def _tiny_t5():
+    from lir_tpu.models import encdec
+    cfg = registry.t5_v1_1("small")
+    cfg = dataclasses.replace(cfg, name="t5-shard-test", vocab_size=256,
+                              hidden_size=64, n_layers=2, n_heads=4,
+                              head_dim=16, intermediate_size=128)
+    params = encdec.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_t5_sharded_forward_matches_single_device():
+    from lir_tpu.models import encdec
+    cfg, params = _tiny_t5()
+    mesh = sharding.build_mesh(MeshConfig(data=2, model=4))
+    sharded = sharding.shard_params(params, cfg, mesh)
+    # Attention + MLP really shard (4 divides 4 heads / 128 ff).
+    wq = sharded["encoder"]["wq"]
+    assert wq.sharding.shard_shape(wq.shape)[-1] == wq.shape[-1] // 4
+    co = sharded["decoder"]["co"]
+    assert co.sharding.shard_shape(co.shape)[1] == co.shape[1] // 4
+
+    rng = np.random.default_rng(3)
+    enc = jnp.asarray(rng.integers(0, 256, (4, 10)), jnp.int32)
+    dec = jnp.asarray(rng.integers(0, 256, (4, 3)), jnp.int32)
+    ref = encdec.forward(params, cfg, enc, jnp.ones_like(enc), dec)
+    bs = sharding.batch_sharding(mesh)
+    out = encdec.forward(sharded, cfg, jax.device_put(enc, bs),
+                         jax.device_put(jnp.ones_like(enc), bs),
+                         jax.device_put(dec, bs))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_t5_int8_sharded_greedy_decode_matches():
+    """int8 QuantTensor trees compose with the enc-dec specs; the full T5
+    scoring decode path agrees with the unsharded int8 run."""
+    from lir_tpu.engine import generate
+    from lir_tpu.models import quant
+    cfg, params = _tiny_t5()
+    qparams = quant.quantize_encdec_params(params)
+    mesh = sharding.build_mesh(MeshConfig(data=2, model=4))
+    sharded = sharding.shard_params(qparams, cfg, mesh)
+    rng = np.random.default_rng(4)
+    enc = jnp.asarray(rng.integers(0, 256, (4, 8)), jnp.int32)
+    mask = jnp.ones_like(enc)
+    ref_gen, ref_logits = generate.t5_greedy_decode(qparams, cfg, enc, mask,
+                                                    max_new_tokens=4)
+    bs = sharding.batch_sharding(mesh)
+    gen, logits = generate.t5_greedy_decode(
+        sharded, cfg, jax.device_put(enc, bs), jax.device_put(mask, bs),
+        max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(gen), np.asarray(ref_gen))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               atol=1e-4, rtol=1e-4)

@@ -311,3 +311,46 @@ def test_multihost_shard_concat_and_merged_resume(tmp_path, monkeypatch):
                                     grid_mod.RESUME_KEY_FIELDS)
     cells = grid_mod.build_grid("mhc-model", lp, perts)
     assert grid_mod.pending_cells(cells, merged_manifest) == []
+
+
+def test_multihost_empty_host_still_merges(tmp_path, monkeypatch):
+    """A pod larger than the grid: hosts with zero assigned cells write a
+    header-only shard, so host 0's merge still produces the final artifact
+    instead of mistaking the empty host for a missing filesystem."""
+    import jax
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data import schemas
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.parallel import multihost
+
+    cfg = ModelConfig(name="mhe", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=2, n_heads=4,
+                      intermediate_size=64, max_seq_len=128)
+    eng = ScoringEngine(decoder.init_params(cfg, jax.random.PRNGKey(0)),
+                        cfg, FakeTokenizer(),
+                        RuntimeConfig(batch_size=4, max_new_tokens=4))
+    lp = (LegalPrompt(main="Is a levee failure a flood ?",
+                      response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Number 0 to 100 ."),)
+    # 2 cells total on a 3-host pod: host 2 gets nothing.
+    perts = (["variant zero of the levee question ?"],)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    monkeypatch.setattr(multihost, "barrier", lambda name: None)
+    for proc in (2, 1, 0):
+        monkeypatch.setattr(jax, "process_index", lambda p=proc: p)
+        run_perturbation_sweep(eng, "mhe-model", lp, perts,
+                               tmp_path / "results.xlsx", checkpoint_every=3)
+
+    assert (tmp_path / "results.host2.csv").exists()   # header-only shard
+    final = schemas.resolve_results_path(tmp_path / "results.xlsx")
+    df = schemas.read_results_frame(final)
+    assert len(df) == 2
+    assert list(df.columns) == list(schemas.PERTURBATION_COLUMNS)

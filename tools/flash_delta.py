@@ -23,6 +23,7 @@ from tools.scale_validation import SCALE_MD, _append, _fused_step  # noqa: E402
 
 
 def main() -> None:
+    import argparse
     import gc
 
     import jax
@@ -30,27 +31,41 @@ def main() -> None:
     from lir_tpu.models import quant
     from lir_tpu.models.registry import llama2_7b
 
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--long", action="store_true",
+                    help="long-context points the int8 KV cache unlocked "
+                         "(seq 1024 batch 8 / seq 2048 batch 4, int8-dyn + "
+                         "kvq8) — VERDICT r2 weak #4")
+    args = ap.parse_args()
+
     dev = jax.devices()[0]
     assert dev.platform != "cpu", "run on the TPU (Pallas does not lower on CPU)"
 
     base = llama2_7b()
+    if args.long:
+        base = dataclasses.replace(base, kv_cache_int8=True)
     params = quant.random_quantized_params(base, jax.random.PRNGKey(0),
-                                           dtype=jnp.bfloat16)
+                                           dtype=jnp.bfloat16,
+                                           dynamic=args.long)
     jax.block_until_ready(params)
     _ = float(params["layers"]["wq"].scale.reshape(-1)[0])
 
+    mode = ("int8-dyn + int8 KV cache" if args.long else "int8")
+    points = ([(1024, 8), (2048, 4)] if args.long
+              else [(512, 8), (1024, 8)])
     lines = [f"\n## flash-attention prefill delta — {dev.device_kind}, "
-             f"{datetime.date.today()}\n\n"
-             "llama-2-7b int8, fused scoring step (prefill + 10 decode), "
-             "batch 8:\n\n"
-             "| seq | dense step s | flash step s | speedup |\n"
-             "|---|---|---|---|\n"]
-    for seq in (512, 1024):
+             f"{datetime.date.today()}"
+             f"{' (long-context, int8 KV)' if args.long else ''}\n\n"
+             f"llama-2-7b {mode}, fused scoring step (prefill + 10 "
+             "decode):\n\n"
+             "| seq | batch | dense step s | flash step s | speedup |\n"
+             "|---|---|---|---|---|\n"]
+    for seq, batch in points:
         results = {}
         for flash in (False, True):
             cfg = dataclasses.replace(base, use_flash_attention=flash)
             try:
-                _, step_s = _fused_step(params, cfg, batch=8, seq=seq,
+                _, step_s = _fused_step(params, cfg, batch=batch, seq=seq,
                                         new_tokens=10)
                 results[flash] = step_s
             except Exception as err:  # noqa: BLE001
@@ -69,7 +84,7 @@ def main() -> None:
             ratio = "flash fits, dense OOMs"
         else:
             ratio = "n/a"
-        lines.append(f"| {seq} | {dense_s} | {flash_s} | {ratio} |\n")
+        lines.append(f"| {seq} | {batch} | {dense_s} | {flash_s} | {ratio} |\n")
     _append("".join(lines))
     print(f"appended flash delta to {SCALE_MD}")
 

@@ -1,0 +1,171 @@
+"""Attack the batch-64 OOM wall with AUTO input layouts (VERDICT r2 #6).
+
+The round-2/3 OOM dumps blame HLO-temp layout copies: XLA materializes
+relaid-out copies of the int8 weight stacks (3-4 x 512 MiB) and of the KV
+cache when the layout a producer (prefill scan) prefers differs from what
+the decode while-loop wants. Chasing the preferred layout by hand failed in
+round 2 (the preference MOVES). This probe lets XLA pick the INPUT layouts
+itself: compile the fused scoring step with `Format(Layout.AUTO)` on the
+params, then device_put the params into the compiled executable's chosen
+formats — if the copies were input-layout-induced, they disappear and the
+fit boundary moves.
+
+Measures, on the real chip (llama-2-7b int8-dyn + int8 KV, seq 256):
+  A. plain fused step, default layouts:  batch 48 (r2 knee), batch 64 (OOM?)
+  B. plain fused step, AUTO layouts:     batch 48, batch 64
+Appends results to SCALE.md.  Run:  python tools/layout_probe.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import gc
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.scale_validation import SCALE_MD, _append  # noqa: E402
+
+
+def run_one(mode: str, batch: int) -> str:
+    """One (layout-mode, batch) measurement in THIS process — modes run in
+    separate processes so the default-layout tree and the relaid-out copy
+    never co-reside in HBM (6.4 GiB each; both at once OOMs the probe
+    itself)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.layout import Format, Layout
+
+    from lir_tpu.engine import generate, score
+    from lir_tpu.models import quant
+    from lir_tpu.models.registry import llama2_7b
+
+    dev = jax.devices()[0]
+    assert dev.platform != "cpu", "run on the TPU"
+
+    cfg = dataclasses.replace(llama2_7b(), kv_cache_int8=True)
+    params = quant.random_quantized_params(cfg, jax.random.PRNGKey(0),
+                                           dtype=jnp.bfloat16, dynamic=True)
+    jax.block_until_ready(params)
+    seq, new_tokens = 256, 10
+
+    def build(batch):
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(rng.integers(3, cfg.vocab_size, (batch, seq)),
+                           jnp.int32)
+        mask = jnp.ones_like(toks)
+        yes = jnp.full((batch,), 1, jnp.int32)
+        no = jnp.full((batch,), 2, jnp.int32)
+
+        def f(params, toks, mask, yes, no):
+            fused = generate.greedy_decode_fused.__wrapped__(
+                params, cfg, toks, mask, yes, no,
+                jnp.arange(10, 110, dtype=jnp.int32),
+                jnp.arange(0, 100, dtype=jnp.float32),
+                max_new_tokens=new_tokens)
+            res = score.readout_from_fused(fused, yes, no)
+            return jnp.sum(res.yes_prob) + jnp.sum(res.no_prob)
+
+        return f, (toks, mask, yes, no)
+
+    def timed(run, *args):
+        t0 = time.perf_counter()
+        chk = float(run(*args))
+        compile_s = time.perf_counter() - t0
+        assert np.isfinite(chk)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            chk = float(run(*args))
+            best = min(best, time.perf_counter() - t0)
+        assert np.isfinite(chk)
+        return compile_s, best
+
+    def is_oom(err):
+        return ("RESOURCE_EXHAUSTED" in str(err)
+                or "out of memory" in str(err).lower()
+                or "Ran out of memory" in str(err))
+
+    f, args = build(batch)
+    try:
+        if mode == "default":
+            _, step_s = timed(jax.jit(f), params, *args)
+        else:
+            auto = Format(Layout.AUTO)
+            jf = jax.jit(f, in_shardings=(auto,) + (None,) * 4)
+            compiled = jf.lower(params, *args).compile()
+            fmts = compiled.input_formats[0][0]
+            # Relayout IN PLACE leaf-by-leaf: drop each default-layout leaf
+            # as soon as its AUTO-format copy lands, so peak extra HBM is
+            # one weight stack, not a whole second tree.
+            leaves, treedef = jax.tree.flatten(params)
+            fmt_leaves = jax.tree.flatten(fmts)[0]
+            for i in range(len(leaves)):
+                leaves[i] = jax.device_put(leaves[i], fmt_leaves[i])
+            p_opt = jax.tree.unflatten(treedef, leaves)
+            del leaves
+            gc.collect()
+            jax.block_until_ready(p_opt)
+            _, step_s = timed(compiled, p_opt, *args)
+        return f"{step_s:.3f}s ({batch / step_s:.1f} p/s)"
+    except Exception as err:  # noqa: BLE001
+        if not is_oom(err):
+            raise
+        return "OOM"
+
+
+def main() -> None:
+    import argparse
+    import datetime as _dt
+    import subprocess
+    import sys as _sys
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--one", nargs=2, metavar=("MODE", "BATCH"),
+                    help="internal: run a single (mode, batch) measurement")
+    args = ap.parse_args()
+    if args.one:
+        print("RESULT::" + run_one(args.one[0], int(args.one[1])), flush=True)
+        return
+
+    results = {}
+    for batch in (48, 64):
+        for mode in ("default", "auto"):
+            proc = subprocess.run(
+                [_sys.executable, __file__, "--one", mode, str(batch)],
+                capture_output=True, text=True, timeout=560)
+            out = [l for l in proc.stdout.splitlines()
+                   if l.startswith("RESULT::")]
+            results[(mode, batch)] = (out[0][8:] if out
+                                      else f"FAILED rc={proc.returncode}")
+            print(mode, batch, results[(mode, batch)], flush=True)
+            if not out and proc.returncode != 0:
+                tail = (proc.stderr or "")[-1500:]
+                if not ("RESOURCE_EXHAUSTED" in tail
+                        or "out of memory" in tail.lower()
+                        or "Ran out of memory" in tail):
+                    print(tail, flush=True)
+                else:
+                    results[(mode, batch)] = "OOM"
+    rows = [f"| {b} | {results[('default', b)]} | {results[('auto', b)]} |"
+            for b in (48, 64)]
+
+    _append(
+        f"\n## AUTO-layout probe (batch-64 wall) — "
+        f"{_dt.date.today()}\n\n"
+        "llama-2-7b int8-dyn + int8 KV, fused scoring step (prefill 256 + "
+        "10 decode), params device_put into the executable's "
+        "Layout.AUTO-chosen input formats vs default layouts:\n\n"
+        "| batch | default layouts | AUTO input layouts |\n"
+        "|---|---|---|\n" + "\n".join(rows) + "\n")
+    print(f"appended to {SCALE_MD}")
+
+
+if __name__ == "__main__":
+    main()

@@ -151,6 +151,90 @@ def run_tpu_int8() -> None:
         gc.collect()
 
 
+def run_tpu_t5() -> None:
+    """T0-3B (the reference's largest enc-dec,
+    compare_instruct_models.py:145-166,471-475) at FULL size on the chip:
+    bf16 and int8, batch ladder over the seq2seq scoring step
+    (t5_greedy_decode: encode once + 10 teacher-forced decoder re-runs).
+    VERDICT r2 missing #4: no T5 had ever been materialized at real size.
+    """
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from lir_tpu.engine import generate
+    from lir_tpu.models import encdec, quant
+    from lir_tpu.models.registry import t0_3b
+
+    dev = jax.devices()[0]
+    seq, new_tokens = 256, 10
+    cfg = t0_3b()
+    _append(f"\n## T5 at real size — {dev.device_kind} ({dev.platform}), "
+            f"{datetime.date.today()}\n\n")
+
+    def step_fn(params, batch):
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(rng.integers(3, cfg.vocab_size, (batch, seq)),
+                           jnp.int32)
+        mask = jnp.ones_like(toks)
+        t0 = time.perf_counter()
+        gen, logits = generate.t5_greedy_decode(params, cfg, toks, mask,
+                                                max_new_tokens=new_tokens)
+        chk = float(jnp.sum(logits[:, 0, :2]))  # host read = real sync
+        compile_s = time.perf_counter() - t0
+        assert np.isfinite(chk)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            gen, logits = generate.t5_greedy_decode(
+                params, cfg, toks, mask, max_new_tokens=new_tokens)
+            chk = float(jnp.sum(logits[:, 0, :2]))
+            best = min(best, time.perf_counter() - t0)
+        assert np.isfinite(chk)
+        return compile_s, best
+
+    import os
+    modes = tuple(os.environ.get("T5_MODES", "bf16,int8").split(","))
+    for mode in modes:
+        t0 = time.perf_counter()
+        params = encdec.init_params(cfg, jax.random.PRNGKey(0),
+                                    dtype=jnp.bfloat16)
+        if mode == "int8":
+            params = quant.quantize_encdec_params(params)
+        jax.block_until_ready(params)
+        # Host read of one leaf = the only trustworthy sync (tunneled axon).
+        leaf = jax.tree.leaves(params)[0]
+        _ = float(jnp.asarray(leaf).reshape(-1)[0].astype(jnp.float32))
+        init_s = time.perf_counter() - t0
+        gib = quant.param_bytes(params) / 2**30
+
+        rows, oom_at = [], None
+        for batch in (8, 16, 32):
+            try:
+                compile_s, step_s = step_fn(params, batch)
+            except Exception as err:  # noqa: BLE001
+                if ("RESOURCE_EXHAUSTED" in str(err)
+                        or "out of memory" in str(err).lower()):
+                    oom_at = batch
+                    break
+                raise
+            rows.append(f"| {batch} | {compile_s:.1f} | {step_s:.3f} | "
+                        f"{batch / step_s:.2f} |")
+        _append(
+            f"### {cfg.name} ({mode}, {gib:.2f} GiB params)\n\n"
+            f"- random-init + {'quantize ' if mode == 'int8' else ''}"
+            f"(on device): {init_s:.0f} s\n"
+            f"- seq2seq scoring step (encode {seq} + {new_tokens} "
+            f"teacher-forced decoder passes):\n\n"
+            "| batch | compile s | step s | prompts/s |\n"
+            "|---|---|---|---|\n" + "\n".join(rows) + "\n"
+            + (f"\n- HBM-fit boundary: batch {oom_at} OOMs\n" if oom_at
+               else "\n- no OOM up to batch 32\n"))
+        del params
+        gc.collect()
+
+
 def run_mesh_bf16() -> None:
     import os
     if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
@@ -202,9 +286,14 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mesh-bf16", action="store_true",
                     help="run the full-size bf16 8-device-mesh validation")
+    ap.add_argument("--t5", action="store_true",
+                    help="materialize T0-3B at full size (bf16 + int8) on "
+                         "the chip and measure the seq2seq scoring step")
     args = ap.parse_args()
     if args.mesh_bf16:
         run_mesh_bf16()
+    elif args.t5:
+        run_tpu_t5()
     else:
         run_tpu_int8()
 
