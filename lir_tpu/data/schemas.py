@@ -208,11 +208,54 @@ def write_perturbation_results(
 ) -> pd.DataFrame:
     """D6 writer with the reference's append-with-schema-check semantics
     (perturb_prompts.py:987-1016): if an existing file's columns mismatch, the
-    old file is backed up and a fresh one written, never silently merged."""
+    old file is backed up and a fresh one written, never silently merged.
+
+    Returns the frame of the rows written by THIS call (read the file via
+    read_results_frame for the accumulated artifact — the CSV checkpoint
+    path appends without re-reading the whole file, so the combined frame
+    is deliberately never materialized here)."""
     df = perturbation_dataframe(rows)
     path.parent.mkdir(parents=True, exist_ok=True)
     if path.suffix == ".xlsx" and not _xlsx_available():
         path = path.with_suffix(".csv")
+    if append and path.exists() and path.suffix == ".csv":
+        # CSV fast-append: O(new rows) per checkpoint instead of
+        # read-whole + concat + rewrite (O(total) per flush, O(total^2)
+        # over a sweep — at 20k grid cells the final flushes would cost
+        # seconds each and throttle the writer thread). The schema check
+        # reads only the HEADER line; a mismatch keeps the reference's
+        # backup-and-fresh semantics. A torn last line from a killed
+        # write is TRUNCATED before appending — a partial row may end
+        # inside a quoted field (D6 prompt fields carry commas/quotes),
+        # where merely closing the line would swallow the next appended
+        # row into the open quote. Dropping the fragment loses nothing:
+        # the write-ahead flush order marks rows done only AFTER they are
+        # written, so a row torn mid-write was never marked done and a
+        # resumed sweep re-scores it.
+        try:
+            existing_cols = list(pd.read_csv(path, nrows=0).columns)
+            torn = False
+            with path.open("rb") as f:
+                size = f.seek(0, 2)
+                if size > 0:
+                    f.seek(size - 1)
+                    torn = f.read(1) != b"\n"
+        except Exception:
+            existing_cols = None
+        if existing_cols == list(df.columns):
+            if torn:
+                _truncate_torn_tail(path)
+            with path.open("a", newline="") as f:
+                df.to_csv(f, index=False, header=False)
+            return df
+        if existing_cols is not None:
+            backup = path.with_name(path.stem + "_backup" + path.suffix)
+            path.rename(backup)
+            _write_frame(df, path)
+            return df
+        # Unreadable header: fall through to the read-based path, whose
+        # corrupt-file fallback writes the _new side file.
+    new_df = df
     if append and path.exists():
         read = pd.read_excel if path.suffix == ".xlsx" else pd.read_csv
         try:
@@ -233,14 +276,35 @@ def write_perturbation_results(
                 except Exception:
                     pass
             _write_frame(df, new_path)
-            return df
+            return new_df
         if list(existing.columns) == list(df.columns):
             df = pd.concat([existing, df], ignore_index=True)
         else:
             backup = path.with_name(path.stem + "_backup" + path.suffix)
             path.rename(backup)
     _write_frame(df, path)
-    return df
+    return new_df
+
+
+def _truncate_torn_tail(path: Path) -> None:
+    """Drop a partial last line (no trailing newline) left by a killed
+    write: scan backward in blocks for the last newline and truncate the
+    file just after it. See write_perturbation_results for why dropping
+    the fragment is lossless."""
+    with path.open("rb+") as f:
+        size = f.seek(0, 2)
+        pos = size
+        block = 4096
+        while pos > 0:
+            start = max(0, pos - block)
+            f.seek(start)
+            chunk = f.read(pos - start)
+            nl = chunk.rfind(b"\n")
+            if nl >= 0:
+                f.truncate(start + nl + 1)
+                return
+            pos = start
+        f.truncate(0)
 
 
 def _xlsx_available() -> bool:
