@@ -333,11 +333,19 @@ def cmd_survey(args) -> None:
                         **kwargs)
 
 
-def cmd_bench(_args) -> None:
+def cmd_bench(args) -> None:
     import runpy
 
-    runpy.run_path(str(Path(__file__).resolve().parent.parent / "bench.py"),
-                   run_name="__main__")
+    # bench.py parses sys.argv itself (--allow-ungated); hand it a clean
+    # argv so the CLI's own subcommand tokens don't reach its parser.
+    bench_path = Path(__file__).resolve().parent.parent / "bench.py"
+    old_argv = sys.argv
+    sys.argv = [str(bench_path)] + (
+        ["--allow-ungated"] if getattr(args, "allow_ungated", False) else [])
+    try:
+        runpy.run_path(str(bench_path), run_name="__main__")
+    finally:
+        sys.argv = old_argv
 
 
 def main(argv: Optional[List[str]] = None) -> None:
@@ -349,7 +357,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     _add_analyze(sub)
     _add_repro(sub)
     _add_survey(sub)
-    sub.add_parser("bench", help="prompts/sec/chip benchmark")
+    bench_p = sub.add_parser(
+        "bench", help="prompts/sec/chip benchmark (end-to-end sweep path)")
+    bench_p.add_argument("--allow-ungated", action="store_true",
+                         help="report even when the chip kind has no MFU "
+                              "peak-table entry (default: abort)")
 
     args = parser.parse_args(argv)
     if getattr(args, "int8_dynamic", False) and not getattr(args, "int8", False):

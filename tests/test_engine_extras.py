@@ -533,3 +533,28 @@ sys.exit(0)
     assert r.returncode == 1, (r.returncode, r.stdout, r.stderr)
     assert "MFU sanity gate" in r.stderr
     assert "REACHED-REPORT" not in r.stdout
+
+
+def test_cli_bench_passes_clean_argv(monkeypatch):
+    """`lir_tpu bench` must not leak the CLI's own argv into bench.py's
+    argparse (bench.py now parses --allow-ungated itself)."""
+    import sys
+
+    import lir_tpu.cli as cli
+
+    seen = {}
+
+    def fake_run_path(path, run_name):
+        seen["argv"] = list(sys.argv)
+        seen["run_name"] = run_name
+
+    monkeypatch.setattr("runpy.run_path", fake_run_path)
+    before = list(sys.argv)
+    cli.main(["bench", "--allow-ungated"])
+    assert seen["run_name"] == "__main__"
+    assert seen["argv"][0].endswith("bench.py")
+    assert seen["argv"][1:] == ["--allow-ungated"]
+    assert sys.argv == before          # restored
+
+    cli.main(["bench"])
+    assert seen["argv"][1:] == []

@@ -87,22 +87,34 @@ def _fused_step(params, cfg, batch, seq, new_tokens):
     return compile_s, best
 
 
-def run_tpu_int8() -> None:
+def run_tpu_int8(models: str | None = None) -> None:
     import jax
     import jax.numpy as jnp
-    from lir_tpu.models import quant
-    from lir_tpu.models.registry import falcon_7b, llama2_7b
+    from lir_tpu.models import registry, quant
     from lir_tpu.utils import profiling
 
     import gc
 
     dev = jax.devices()[0]
     seq, new_tokens = 256, 10
+    names = [n.strip() for n in (models or "llama2_7b,falcon_7b").split(",")
+             if n.strip()]
+    # Resolve every preset BEFORE the first _append: a typo'd name must
+    # fail fast, not leave an orphaned section header in SCALE.md.
+    cfgs = []
+    for name in names:
+        mk = getattr(registry, name, None)
+        if mk is None:
+            raise SystemExit(f"--models: no registry preset {name!r}")
+        cfg = mk()
+        if isinstance(cfg, registry.T5Config):
+            raise SystemExit(
+                f"--models: {name} is an encoder-decoder preset; use --t5")
+        cfgs.append(cfg)
     _append(f"\n## int8 single-chip — {dev.device_kind} ({dev.platform}), "
             f"{datetime.date.today()}\n\n")
 
-    for mk_cfg in (llama2_7b, falcon_7b):
-        cfg = mk_cfg()
+    for cfg in cfgs:
         t0 = time.perf_counter()
         params = quant.random_quantized_params(cfg, jax.random.PRNGKey(0),
                                                dtype=jnp.bfloat16)
@@ -286,16 +298,22 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mesh-bf16", action="store_true",
                     help="run the full-size bf16 8-device-mesh validation")
+    ap.add_argument("--models", default=None,
+                    help="comma-separated registry preset names for the "
+                         "int8 single-chip run (default: llama2_7b,"
+                         "falcon_7b)")
     ap.add_argument("--t5", action="store_true",
                     help="materialize T0-3B at full size (bf16 + int8) on "
                          "the chip and measure the seq2seq scoring step")
     args = ap.parse_args()
+    if args.models and (args.mesh_bf16 or args.t5):
+        ap.error("--models only applies to the int8 single-chip run")
     if args.mesh_bf16:
         run_mesh_bf16()
     elif args.t5:
         run_tpu_t5()
     else:
-        run_tpu_int8()
+        run_tpu_int8(args.models)
 
 
 if __name__ == "__main__":
