@@ -87,7 +87,8 @@ def _fused_step(params, cfg, batch, seq, new_tokens):
     return compile_s, best
 
 
-def run_tpu_int8(models: str | None = None) -> None:
+def run_tpu_int8(models: str | None = None,
+                 fast_path: bool = False) -> None:
     import jax
     import jax.numpy as jnp
     from lir_tpu.models import registry, quant
@@ -101,11 +102,27 @@ def run_tpu_int8(models: str | None = None) -> None:
              if n.strip()]
     # Resolve every preset BEFORE the first _append: a typo'd name must
     # fail fast, not leave an orphaned section header in SCALE.md.
+    import inspect
+    import types as _types
+
+    # Only the registry's zero-arg preset FACTORIES qualify — classes
+    # (ModelConfig() constructs a default config!) and helpers like tiny()
+    # must not resolve.
+    presets = {
+        n: v for n, v in vars(registry).items()
+        if isinstance(v, _types.FunctionType)
+        and v.__module__ == registry.__name__
+        and not n.startswith("_")
+        and all(p.default is not inspect.Parameter.empty
+                for p in inspect.signature(v).parameters.values())
+    }
     cfgs = []
     for name in names:
-        mk = getattr(registry, name, None)
+        mk = presets.get(name)
         if mk is None:
-            raise SystemExit(f"--models: no registry preset {name!r}")
+            raise SystemExit(
+                f"--models: no registry preset {name!r} "
+                f"(try one of: {', '.join(sorted(presets))})")
         cfg = mk()
         if isinstance(cfg, registry.T5Config):
             raise SystemExit(
@@ -114,10 +131,15 @@ def run_tpu_int8(models: str | None = None) -> None:
     _append(f"\n## int8 single-chip — {dev.device_kind} ({dev.platform}), "
             f"{datetime.date.today()}\n\n")
 
+    import dataclasses as _dc
+
     for cfg in cfgs:
+        if fast_path:
+            cfg = _dc.replace(cfg, kv_cache_int8=True)
         t0 = time.perf_counter()
         params = quant.random_quantized_params(cfg, jax.random.PRNGKey(0),
-                                               dtype=jnp.bfloat16)
+                                               dtype=jnp.bfloat16,
+                                               dynamic=fast_path)
         jax.block_until_ready(params)
         _ = float(params["layers"]["wq"].scale.reshape(-1)[0])  # real sync
         init_s = time.perf_counter() - t0
@@ -125,7 +147,7 @@ def run_tpu_int8(models: str | None = None) -> None:
 
         batch_results = []
         oom_at = None
-        for batch in (8, 16, 32):
+        for batch in ((16, 32, 48) if fast_path else (8, 16, 32)):
             try:
                 compile_s, step_s = _fused_step(params, cfg, batch, seq,
                                                 new_tokens)
@@ -137,16 +159,18 @@ def run_tpu_int8(models: str | None = None) -> None:
                 raise
             flops = profiling.scoring_step_flops(cfg, batch, seq, new_tokens)
             tflops = flops / step_s / 1e12
-            peak = profiling.chip_peak_flops(dev)
+            peak = profiling.chip_peak_flops(dev, int8=fast_path)
             mfu = f"{tflops * 1e12 / peak:.1%}" if peak else "n/a"
             batch_results.append(
                 f"| {batch} | {compile_s:.1f} | {step_s:.3f} | "
                 f"{batch / step_s:.2f} | {tflops:.1f} | {mfu} |")
 
+        kv_bytes = 1 if fast_path else 2     # int8 cache vs bf16
         kv_gib = (cfg.n_layers * (seq + new_tokens) * cfg.n_kv_heads
-                  * cfg.head_dim * 2 * 2) / 2**30
+                  * cfg.head_dim * 2 * kv_bytes) / 2**30
         _append(
-            f"### {cfg.name} (int8, {gib:.2f} GiB params, "
+            f"### {cfg.name} ({'int8-dyn+kvq8' if fast_path else 'int8'}, "
+            f"{gib:.2f} GiB params, "
             f"KV {kv_gib:.3f} GiB/row @ seq {seq + new_tokens})\n\n"
             f"- random-init (on device): {init_s:.0f} s\n"
             f"- fused scoring step (prefill {seq} + {new_tokens} decode):\n\n"
@@ -155,7 +179,7 @@ def run_tpu_int8(models: str | None = None) -> None:
             + "\n".join(batch_results) + "\n"
             + (f"\n- HBM-fit boundary: batch {oom_at} OOMs on this chip "
                f"(largest fitting batch above)\n" if oom_at else
-               "\n- no OOM up to batch 32\n"))
+               f"\n- no OOM up to batch {48 if fast_path else 32}\n"))
         # Free this model's HBM before materializing the next 7B tree —
         # two resident int8 trees (6.3 + 6.9 GiB) plus caches exhaust a
         # 16 GiB chip.
@@ -298,6 +322,10 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mesh-bf16", action="store_true",
                     help="run the full-size bf16 8-device-mesh validation")
+    ap.add_argument("--fast-path", action="store_true",
+                    help="int8 single-chip run with the FULL fast path "
+                         "(dynamic activations + int8 KV cache), batch "
+                         "ladder 16/32/48")
     ap.add_argument("--models", default=None,
                     help="comma-separated registry preset names for the "
                          "int8 single-chip run (default: llama2_7b,"
@@ -306,14 +334,15 @@ def main() -> None:
                     help="materialize T0-3B at full size (bf16 + int8) on "
                          "the chip and measure the seq2seq scoring step")
     args = ap.parse_args()
-    if args.models and (args.mesh_bf16 or args.t5):
-        ap.error("--models only applies to the int8 single-chip run")
+    if (args.models or args.fast_path) and (args.mesh_bf16 or args.t5):
+        ap.error("--models/--fast-path only apply to the int8 "
+                 "single-chip run")
     if args.mesh_bf16:
         run_mesh_bf16()
     elif args.t5:
         run_tpu_t5()
     else:
-        run_tpu_int8(args.models)
+        run_tpu_int8(args.models, fast_path=args.fast_path)
 
 
 if __name__ == "__main__":
