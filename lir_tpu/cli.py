@@ -333,6 +333,44 @@ def cmd_survey(args) -> None:
                         **kwargs)
 
 
+def cmd_concat_shards(args) -> None:
+    """Merge per-host .hostN result shards into the final artifact — the
+    manual gather for pods WITHOUT a shared filesystem (copy every host's
+    shard + manifest next to --results first; with a shared filesystem the
+    sweep's host 0 runs this merge automatically after its barrier)."""
+    from .data import schemas
+
+    # Pod hosts and the merge machine may disagree on openpyxl (shards are
+    # written in the POD's resolved container) — probe the requested
+    # suffix, then the alternate, before declaring the shards missing.
+    candidates = [args.results]
+    if args.results.suffix in (".xlsx", ".csv"):
+        candidates.append(args.results.with_suffix(
+            ".csv" if args.results.suffix == ".xlsx" else ".xlsx"))
+    merged = out = None
+    for cand in candidates:
+        merged = schemas.concat_host_shards(cand, n_hosts=args.hosts)
+        if merged is not None:
+            out = schemas.resolve_results_path(cand)
+            break
+    if merged is None:
+        probed = ", ".join(
+            str(schemas.resolve_results_path(c).with_name(
+                f"{schemas.resolve_results_path(c).stem}.host0"
+                f"{schemas.resolve_results_path(c).suffix}"))
+            for c in candidates)
+        raise SystemExit(
+            f"no mergeable shards for {args.results} — expected "
+            f"{args.hosts or 'host0..hostN'} consecutive shard files "
+            f"(probed: {probed}, ...)")
+    manifest = out.with_suffix(".manifest.jsonl")
+    manifest_note = (
+        f"(+ union manifest {manifest.name})" if manifest.exists() else
+        "(WARNING: no shard manifests found next to the shards — resume "
+        "state NOT merged; copy the .hostN.manifest.jsonl files too)")
+    print(f"merged {len(merged)} rows -> {out} {manifest_note}")
+
+
 def cmd_bench(args) -> None:
     import runpy
 
@@ -363,6 +401,18 @@ def main(argv: Optional[List[str]] = None) -> None:
                          help="report even when the chip kind has no MFU "
                               "peak-table entry (default: abort)")
 
+    cs = sub.add_parser(
+        "concat-shards",
+        help="merge per-host .hostN sweep shards + manifests into the "
+             "final results artifact (manual gather for pods without a "
+             "shared filesystem)")
+    cs.add_argument("--results", type=Path, required=True,
+                    help="the FINAL results path the sweep was given "
+                         "(shards live next to it as <stem>.hostN.<ext>)")
+    cs.add_argument("--hosts", type=int, default=None,
+                    help="expected shard count (default: walk host0, "
+                         "host1, ... until the first gap)")
+
     args = parser.parse_args(argv)
     if getattr(args, "int8_dynamic", False) and not getattr(args, "int8", False):
         parser.error("--int8-dynamic requires --int8 (it selects HOW int8 "
@@ -375,6 +425,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         "repro": cmd_repro,
         "survey": cmd_survey,
         "bench": cmd_bench,
+        "concat-shards": cmd_concat_shards,
     }[args.command](args)
 
 

@@ -9,6 +9,7 @@ from pathlib import Path
 
 import numpy as np
 import pandas as pd
+import pytest
 import torch
 
 from lir_tpu.backends.fake import FakeTokenizer
@@ -354,3 +355,62 @@ def test_multihost_empty_host_still_merges(tmp_path, monkeypatch):
     df = schemas.read_results_frame(final)
     assert len(df) == 2
     assert list(df.columns) == list(schemas.PERTURBATION_COLUMNS)
+
+
+def test_cli_concat_shards(tmp_path, capsys):
+    """`lir_tpu concat-shards` merges .hostN shards from the command line
+    (the manual gather for pods without a shared filesystem)."""
+    from lir_tpu import cli
+    from lir_tpu.data import schemas
+    from lir_tpu.data.schemas import PerturbationRow
+
+    def rows(tag):
+        return [PerturbationRow(
+            model="m", original_main="q", response_format="rf",
+            confidence_format="cf", rephrased_main=f"{tag}-{i}",
+            full_rephrased_prompt="p", full_confidence_prompt="c",
+            model_response="Yes", model_confidence_response="85",
+            log_probabilities="{}", token_1_prob=0.6, token_2_prob=0.3,
+            confidence_value=85, weighted_confidence=80.0) for i in range(2)]
+
+    for h in (0, 1):
+        schemas.write_perturbation_results(
+            rows(f"h{h}"), tmp_path / f"results.host{h}.csv")
+        (tmp_path / f"results.host{h}.manifest.jsonl").write_text(
+            "\n".join('{"model": "m", "original_main": "q", '
+                      f'"rephrased_main": "h{h}-{i}"}}' for i in range(2))
+            + "\n")
+    cli.main(["concat-shards", "--results", str(tmp_path / "results.csv"),
+              "--hosts", "2"])
+    assert "merged 4 rows" in capsys.readouterr().out
+    df = schemas.read_results_frame(tmp_path / "results.csv")
+    assert len(df) == 4
+
+    with pytest.raises(SystemExit, match="no mergeable shards"):
+        cli.main(["concat-shards", "--results",
+                  str(tmp_path / "missing.csv"), "--hosts", "2"])
+
+
+def test_cli_concat_shards_xlsx_request_finds_csv_shards(tmp_path, capsys):
+    """Pod hosts without openpyxl write .csv shards; an operator following
+    DEPLOY.md with --results results.xlsx must still find them, and a
+    merge without shard manifests warns instead of claiming one."""
+    from lir_tpu import cli
+    from lir_tpu.data import schemas
+    from lir_tpu.data.schemas import PerturbationRow
+
+    row = PerturbationRow(
+        model="m", original_main="q", response_format="rf",
+        confidence_format="cf", rephrased_main="r",
+        full_rephrased_prompt="p", full_confidence_prompt="c",
+        model_response="Yes", model_confidence_response="85",
+        log_probabilities="{}", token_1_prob=0.6, token_2_prob=0.3,
+        confidence_value=85, weighted_confidence=80.0)
+    for h in (0, 1):
+        schemas.write_perturbation_results(
+            [row], tmp_path / f"results.host{h}.csv")
+    cli.main(["concat-shards", "--results", str(tmp_path / "results.xlsx"),
+              "--hosts", "2"])
+    out = capsys.readouterr().out
+    assert "merged 2 rows" in out
+    assert "WARNING: no shard manifests" in out
