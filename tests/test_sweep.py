@@ -414,3 +414,62 @@ def test_cli_concat_shards_xlsx_request_finds_csv_shards(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "merged 2 rows" in out
     assert "WARNING: no shard manifests" in out
+
+
+def test_pipelined_writer_failure_preserves_resume(tmp_path, monkeypatch):
+    """A flush failure inside the writer thread must re-raise on the
+    caller's thread, and the write-ahead guarantee must hold: only rows
+    from SUCCESSFUL flushes are marked done, so a resumed sweep re-scores
+    exactly the unflushed cells and the final artifact is complete."""
+    import jax
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data import schemas
+    from lir_tpu.engine import sweep as sweep_mod
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="wf", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=2, n_heads=4,
+                      intermediate_size=64, max_seq_len=128)
+    eng = ScoringEngine(decoder.init_params(cfg, jax.random.PRNGKey(0)),
+                        cfg, FakeTokenizer(),
+                        RuntimeConfig(batch_size=2, max_new_tokens=4))
+    lp = (LegalPrompt(main="Is a levee failure a flood ?",
+                      response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Number 0 to 100 ."),)
+    perts = ([f"variant {i} ?" for i in range(5)],)  # 6 cells, batches of 2
+
+    real_write = schemas.write_perturbation_results
+    calls = {"n": 0}
+
+    def failing_write(rows, path, append=True):
+        calls["n"] += 1
+        if calls["n"] == 2:          # second flush dies (disk full, etc.)
+            raise OSError("disk full")
+        return real_write(rows, path, append=append)
+
+    monkeypatch.setattr(sweep_mod.schemas, "write_perturbation_results",
+                        failing_write)
+    out = tmp_path / "results.csv"
+    with pytest.raises(OSError, match="disk full"):
+        run_perturbation_sweep(eng, "wf-model", lp, perts, out,
+                               checkpoint_every=2)
+    # First flush landed; its rows (and ONLY its rows) are marked done.
+    manifest_lines = [
+        l for l in (out.with_suffix(".manifest.jsonl")
+                    .read_text().splitlines()) if l]
+    assert len(manifest_lines) == 2
+    assert len(schemas.read_results_frame(out)) == 2
+
+    monkeypatch.setattr(sweep_mod.schemas, "write_perturbation_results",
+                        real_write)
+    resumed = run_perturbation_sweep(eng, "wf-model", lp, perts, out,
+                                     checkpoint_every=2)
+    assert len(resumed) == 4         # exactly the unflushed cells
+    df = schemas.read_results_frame(out)
+    assert len(df) == 6
+    assert len(set(df["Rephrased Main Part"])) == 6
