@@ -97,9 +97,9 @@ CPU_CANDIDATES = ((8, 2), (4, 2))
 
 
 def _is_oom(err: Exception) -> bool:
-    msg = str(err)
-    return ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-            or "out of memory" in msg.lower())
+    from lir_tpu.utils.profiling import is_oom_error
+
+    return is_oom_error(err)
 
 
 def main() -> None:

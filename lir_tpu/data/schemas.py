@@ -224,34 +224,35 @@ def write_perturbation_results(
         # over a sweep — at 20k grid cells the final flushes would cost
         # seconds each and throttle the writer thread). The schema check
         # reads only the HEADER line; a mismatch keeps the reference's
-        # backup-and-fresh semantics. A torn last line from a killed
-        # write is TRUNCATED before appending — a partial row may end
-        # inside a quoted field (D6 prompt fields carry commas/quotes),
-        # where merely closing the line would swallow the next appended
-        # row into the open quote. Dropping the fragment loses nothing:
-        # the write-ahead flush order marks rows done only AFTER they are
-        # written, so a row torn mid-write was never marked done and a
-        # resumed sweep re-scores it.
+        # backup-and-fresh semantics.
+        #
+        # Torn-write recovery uses a KNOWN-GOOD-OFFSET sidecar, not a
+        # newline heuristic: D6 fields legitimately contain newlines and
+        # quotes, so a kill mid-write can leave the file ending in a
+        # dangling open-quoted field whose last byte IS a newline —
+        # undetectable from the bytes alone, and appending after it would
+        # swallow the next rows into the open quote. Instead, every
+        # successful write records the file size; on append, anything
+        # past the recorded offset is a torn tail and is truncated away.
+        # Dropping the fragment loses nothing: the write-ahead flush
+        # order marks rows done only AFTER they are written, so torn rows
+        # were never marked done and a resumed sweep re-scores them.
         try:
             existing_cols = list(pd.read_csv(path, nrows=0).columns)
-            torn = False
-            with path.open("rb") as f:
-                size = f.seek(0, 2)
-                if size > 0:
-                    f.seek(size - 1)
-                    torn = f.read(1) != b"\n"
         except Exception:
             existing_cols = None
-        if existing_cols == list(df.columns):
-            if torn:
-                _truncate_torn_tail(path)
+        if existing_cols == list(df.columns) and _recover_known_good(path):
             with path.open("a", newline="") as f:
                 df.to_csv(f, index=False, header=False)
+                f.flush()
+            _record_known_good(path)
             return df
         if existing_cols is not None:
             backup = path.with_name(path.stem + "_backup" + path.suffix)
             path.rename(backup)
+            _offset_sidecar(path).unlink(missing_ok=True)
             _write_frame(df, path)
+            _record_known_good(path)
             return df
         # Unreadable header: fall through to the read-based path, whose
         # corrupt-file fallback writes the _new side file.
@@ -283,28 +284,52 @@ def write_perturbation_results(
             backup = path.with_name(path.stem + "_backup" + path.suffix)
             path.rename(backup)
     _write_frame(df, path)
+    if path.suffix == ".csv":
+        _record_known_good(path)
     return new_df
 
 
-def _truncate_torn_tail(path: Path) -> None:
-    """Drop a partial last line (no trailing newline) left by a killed
-    write: scan backward in blocks for the last newline and truncate the
-    file just after it. See write_perturbation_results for why dropping
-    the fragment is lossless."""
-    with path.open("rb+") as f:
-        size = f.seek(0, 2)
-        pos = size
-        block = 4096
-        while pos > 0:
-            start = max(0, pos - block)
-            f.seek(start)
-            chunk = f.read(pos - start)
-            nl = chunk.rfind(b"\n")
-            if nl >= 0:
-                f.truncate(start + nl + 1)
-                return
-            pos = start
-        f.truncate(0)
+def _offset_sidecar(path: Path) -> Path:
+    return path.with_name(path.name + ".offset")
+
+
+def _record_known_good(path: Path) -> None:
+    """Atomically record the artifact's current size as known-good (every
+    byte up to it was written by a completed flush)."""
+    import os
+
+    side = _offset_sidecar(path)
+    tmp = side.with_name(side.name + ".tmp")
+    tmp.write_text(str(path.stat().st_size))
+    os.replace(tmp, side)
+
+
+def _recover_known_good(path: Path) -> bool:
+    """Prepare ``path`` for a fast append: truncate any torn tail past the
+    recorded known-good offset. Returns False when the artifact cannot be
+    trusted for appending (no/invalid sidecar and the file does not parse
+    cleanly) — the caller then uses the read-based legacy path.
+
+    A legacy file without a sidecar is validated ONCE by a full parse
+    (O(total), paid only on the first resume of a pre-sidecar artifact);
+    every later flush is O(new rows)."""
+    side = _offset_sidecar(path)
+    try:
+        known = int(side.read_text())
+    except (OSError, ValueError):
+        known = None
+    size = path.stat().st_size
+    if known is not None and 0 < known <= size:
+        if size > known:
+            with path.open("rb+") as f:
+                f.truncate(known)
+        return True
+    try:
+        pd.read_csv(path)          # full one-time validation
+    except Exception:
+        return False
+    _record_known_good(path)
+    return True
 
 
 def _xlsx_available() -> bool:
@@ -381,6 +406,11 @@ def concat_host_shards(path: Path,
         return None
     merged = pd.concat(frames, ignore_index=True)
     _write_frame(merged, path)
+    if path.suffix == ".csv":
+        # The merged artifact supersedes any earlier flush history; the
+        # known-good offset must follow it or a later append would
+        # truncate the merge away.
+        _record_known_good(path)
     # Union the per-host manifests (write-ahead order preserved: the merged
     # manifest only ever contains keys whose rows are already in a shard).
     man_path = path.with_suffix(".manifest.jsonl")

@@ -15,6 +15,7 @@ pair and every subsequent batch reuses the cache.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -100,6 +101,14 @@ class ScoringEngine:
             tokenizer, encoder_decoder=encoder_decoder,
             yes_text=yes_text, no_text=no_text)
         self.eos_id = getattr(tokenizer, "eos_token_id", None)
+        # The pipelined sweep tokenizes bucket N+1 on the main thread while
+        # its writer thread decodes bucket N's completions. HF fast (Rust)
+        # tokenizers are NOT safe under concurrent encode/decode (encode
+        # takes a write borrow for truncation/padding state -> intermittent
+        # "Already borrowed" RuntimeError), so every tokenizer touch goes
+        # through this lock. Contention is negligible: encode/decode are
+        # each ~ms per bucket vs ~1.5 s of device work.
+        self._tok_lock = threading.Lock()
         # Length buckets: powers of two up to max_seq_len (≲700-token prompts).
         self.buckets = [b for b in (64, 128, 256, 512, 1024)
                         if b <= self.rt.max_seq_len] or [self.rt.max_seq_len]
@@ -110,7 +119,9 @@ class ScoringEngine:
         """(token ids, values) of single-token integers 0..100, resolved
         once per tokenizer (feeds the weighted-confidence readout)."""
         if self._digit_table is None:
-            self._digit_table = tok.integer_token_table(self.tokenizer)
+            with self._tok_lock:
+                if self._digit_table is None:
+                    self._digit_table = tok.integer_token_table(self.tokenizer)
         return self._digit_table
 
     # -- building blocks ----------------------------------------------------
@@ -174,8 +185,10 @@ class ScoringEngine:
         (binary FusedDecodeOut, confidence FusedDecodeOut).
         """
         assert not self.encoder_decoder
-        bin_ids = [self.tokenizer(p).input_ids for p in binary_prompts]
-        conf_ids = [self.tokenizer(p).input_ids for p in confidence_prompts]
+        with self._tok_lock:
+            bin_ids = [self.tokenizer(p).input_ids for p in binary_prompts]
+            conf_ids = [self.tokenizer(p).input_ids
+                        for p in confidence_prompts]
         lcp = [tok.shared_prefix_len(a, b)
                for a, b in zip(bin_ids, conf_ids)]
         pad_id = tok.pad_token_id(self.tokenizer)
@@ -189,6 +202,12 @@ class ScoringEngine:
             # depends on. Prompt pairs that diverge this early share too
             # little to be worth a shared prefill anyway: score them on the
             # plain (two full prefills) path instead.
+            from ..utils.logging import get_logger
+
+            get_logger(__name__).info(
+                "shared-prefix fallback: a prompt pair diverges %d tokens "
+                "before its end (> %d suffix bucket) — scoring this whole "
+                "bucket with two full prefills", max_sfx, max(sfx_buckets))
             fused = self.decode_fused(binary_prompts, yes_ids, no_ids,
                                       max_new_tokens=new_tokens,
                                       pretokenized=bin_ids)
@@ -220,14 +239,19 @@ class ScoringEngine:
         the fixed-length jitted decode keeps emitting after EOS; those tokens
         must not leak into response text or the confidence-integer parse)."""
         trimmed = tok.trim_at_eos(np.asarray(generated_ids).tolist(), self.eos_id)
-        return self.tokenizer.decode(trimmed, skip_special_tokens=True).strip()
+        with self._tok_lock:
+            return self.tokenizer.decode(
+                trimmed, skip_special_tokens=True).strip()
 
     def _pad_batch(self, prompts: Sequence[str],
                    pretokenized: Optional[Sequence[Sequence[int]]] = None
                    ) -> Tuple[jax.Array, jax.Array]:
         """Tokenize + left-pad into the smallest fitting bucket."""
-        ids_list = (list(pretokenized) if pretokenized is not None
-                    else [self.tokenizer(p).input_ids for p in prompts])
+        if pretokenized is not None:
+            ids_list = list(pretokenized)
+        else:
+            with self._tok_lock:
+                ids_list = [self.tokenizer(p).input_ids for p in prompts]
         bucket = tok.pick_bucket([len(i) for i in ids_list], self.buckets)
         toks_arr, mask = tok.left_pad_ids(ids_list, bucket,
                                           tok.pad_token_id(self.tokenizer))

@@ -217,6 +217,10 @@ def run_perturbation_sweep(
                     "gather rows over the network (multihost.gather_rows) "
                     "or concatenate the per-host %s.hostN files manually",
                     base_results_path.stem)
+        # Second fence: peers must not return (and possibly let their
+        # launcher read the final artifact) while host 0 is still
+        # mid-merge.
+        multihost.barrier("perturbation-merge-done")
     return rows
 
 

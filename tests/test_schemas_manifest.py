@@ -258,7 +258,7 @@ class TestCsvFastAppend:
         schemas.write_perturbation_results(self._rows("b"), out)
         assert out.read_bytes()[:len(first)] == first  # pure append
 
-    def test_torn_last_line_is_closed(self, tmp_path):
+    def test_torn_last_line_is_truncated(self, tmp_path):
         out = tmp_path / "r.csv"
         schemas.write_perturbation_results(self._rows("a"), out)
         with out.open("ab") as f:          # simulate a kill mid-write
@@ -269,6 +269,44 @@ class TestCsvFastAppend:
         # manifest, so resume re-scores it): 3 original + 3 new rows.
         assert len(df) == 6
         assert df["Rephrased Main Part"].tolist()[-3:] == ["b-0", "b-1", "b-2"]
+
+    def test_torn_quoted_field_with_embedded_newline(self, tmp_path):
+        """The nasty kill artifact: the file dies INSIDE a quoted field
+        whose content contains a newline, so the file's last byte IS a
+        newline and the tail parses as an open quote. The known-good
+        offset protocol must truncate it anyway; appended rows must not
+        be swallowed into the dangling quote."""
+        out = tmp_path / "r.csv"
+        schemas.write_perturbation_results(self._rows("a"), out)
+        with out.open("ab") as f:
+            f.write(b'm,q,rf,cf,torn,"line one\nline two\n')
+        schemas.write_perturbation_results(self._rows("b"), out)
+        df = schemas.read_results_frame(out)
+        assert len(df) == 6
+        assert df["Rephrased Main Part"].tolist() == [
+            "a-0", "a-1", "a-2", "b-0", "b-1", "b-2"]
+
+    def test_legacy_file_without_sidecar_validates_once(self, tmp_path):
+        out = tmp_path / "r.csv"
+        schemas.write_perturbation_results(self._rows("a"), out)
+        schemas._offset_sidecar(out).unlink()       # pre-sidecar artifact
+        schemas.write_perturbation_results(self._rows("b"), out)
+        assert schemas._offset_sidecar(out).exists()
+        assert len(schemas.read_results_frame(out)) == 6
+
+    def test_merged_artifact_refreshes_offset(self, tmp_path):
+        """concat_host_shards rewrites the final file; a later append must
+        NOT truncate the merge back to a stale pre-merge offset."""
+        schemas.write_perturbation_results(
+            self._rows("x"), tmp_path / "r.csv")     # records offset for r.csv
+        for h in (0, 1):
+            schemas.write_perturbation_results(
+                self._rows(f"h{h}"), tmp_path / f"r.host{h}.csv")
+        merged = schemas.concat_host_shards(tmp_path / "r.csv", n_hosts=2)
+        assert len(merged) == 6
+        schemas.write_perturbation_results(self._rows("z"),
+                                           tmp_path / "r.csv")
+        assert len(schemas.read_results_frame(tmp_path / "r.csv")) == 9
 
     def test_torn_quoted_field_does_not_swallow_rows(self, tmp_path):
         out = tmp_path / "r.csv"

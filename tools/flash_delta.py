@@ -69,8 +69,9 @@ def main() -> None:
                                         new_tokens=10)
                 results[flash] = step_s
             except Exception as err:  # noqa: BLE001
-                if ("RESOURCE_EXHAUSTED" in str(err)
-                        or "out of memory" in str(err).lower()):
+                from lir_tpu.utils.profiling import is_oom_error
+
+                if is_oom_error(err):
                     results[flash] = None  # OOM: the delta IS the fit
                 else:
                     raise

@@ -87,10 +87,7 @@ def run_one(mode: str, batch: int) -> str:
         assert np.isfinite(chk)
         return compile_s, best
 
-    def is_oom(err):
-        return ("RESOURCE_EXHAUSTED" in str(err)
-                or "out of memory" in str(err).lower()
-                or "Ran out of memory" in str(err))
+    from lir_tpu.utils.profiling import is_oom_error as is_oom
 
     f, args = build(batch)
     try:
@@ -146,13 +143,13 @@ def main() -> None:
                                       else f"FAILED rc={proc.returncode}")
             print(mode, batch, results[(mode, batch)], flush=True)
             if not out and proc.returncode != 0:
+                from lir_tpu.utils.profiling import is_oom_error
+
                 tail = (proc.stderr or "")[-1500:]
-                if not ("RESOURCE_EXHAUSTED" in tail
-                        or "out of memory" in tail.lower()
-                        or "Ran out of memory" in tail):
-                    print(tail, flush=True)
-                else:
+                if is_oom_error(tail):
                     results[(mode, batch)] = "OOM"
+                else:
+                    print(tail, flush=True)
     rows = [f"| {b} | {results[('default', b)]} | {results[('auto', b)]} |"
             for b in (48, 64)]
 

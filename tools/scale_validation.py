@@ -152,8 +152,9 @@ def run_tpu_int8(models: str | None = None,
                 compile_s, step_s = _fused_step(params, cfg, batch, seq,
                                                 new_tokens)
             except Exception as err:  # noqa: BLE001
-                if ("RESOURCE_EXHAUSTED" in str(err)
-                        or "out of memory" in str(err).lower()):
+                from lir_tpu.utils.profiling import is_oom_error
+
+                if is_oom_error(err):
                     oom_at = batch
                     break
                 raise
@@ -250,8 +251,9 @@ def run_tpu_t5() -> None:
             try:
                 compile_s, step_s = step_fn(params, batch)
             except Exception as err:  # noqa: BLE001
-                if ("RESOURCE_EXHAUSTED" in str(err)
-                        or "out of memory" in str(err).lower()):
+                from lir_tpu.utils.profiling import is_oom_error
+
+                if is_oom_error(err):
                     oom_at = batch
                     break
                 raise
