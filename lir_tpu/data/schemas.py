@@ -241,21 +241,29 @@ def write_perturbation_results(
             existing_cols = list(pd.read_csv(path, nrows=0).columns)
         except Exception:
             existing_cols = None
-        if existing_cols == list(df.columns) and _recover_known_good(path):
-            with path.open("a", newline="") as f:
-                df.to_csv(f, index=False, header=False)
-                f.flush()
-            _record_known_good(path)
-            return df
-        if existing_cols is not None:
+        if existing_cols == list(df.columns):
+            if _recover_known_good(path):
+                with path.open("a", newline="") as f:
+                    df.to_csv(f, index=False, header=False)
+                    f.flush()
+                _record_known_good(path)
+                return df
+            # Schema matches but the file cannot be certified for
+            # appending (no sidecar and it does not parse — e.g. a
+            # pre-sidecar artifact torn inside a quoted field). Fall
+            # through to the read-based path: its corrupt-file fallback
+            # PRESERVES the damaged main file and writes new rows to the
+            # _new sidecar — never backup-and-fresh, which would drop
+            # rows the manifest already marks done from the artifact.
+        elif existing_cols is not None:
             backup = path.with_name(path.stem + "_backup" + path.suffix)
             path.rename(backup)
             _offset_sidecar(path).unlink(missing_ok=True)
             _write_frame(df, path)
             _record_known_good(path)
             return df
-        # Unreadable header: fall through to the read-based path, whose
-        # corrupt-file fallback writes the _new side file.
+        # Unreadable header (or uncertifiable matching file): fall through
+        # to the read-based path below.
     new_df = df
     if append and path.exists():
         read = pd.read_excel if path.suffix == ".xlsx" else pd.read_csv
@@ -324,12 +332,43 @@ def _recover_known_good(path: Path) -> bool:
             with path.open("rb+") as f:
                 f.truncate(known)
         return True
+    # Legacy file: a torn PLAIN tail (no trailing newline) would survive a
+    # pandas parse (short rows NaN-pad silently) and then poison the next
+    # append — drop it before validating. A tail torn inside a QUOTED
+    # field fails the parse below instead, and the caller routes to the
+    # corrupt-file sidecar path.
+    with path.open("rb") as f:
+        end = f.seek(0, 2)
+        last = b"\n"
+        if end > 0:
+            f.seek(end - 1)
+            last = f.read(1)
+    if last != b"\n":
+        _truncate_after_last_newline(path)
     try:
         pd.read_csv(path)          # full one-time validation
     except Exception:
         return False
     _record_known_good(path)
     return True
+
+
+def _truncate_after_last_newline(path: Path) -> None:
+    """Drop a partial last line: scan backward in blocks for the final
+    newline and truncate just after it (empty file if none)."""
+    with path.open("rb+") as f:
+        pos = f.seek(0, 2)
+        block = 4096
+        while pos > 0:
+            start = max(0, pos - block)
+            f.seek(start)
+            chunk = f.read(pos - start)
+            nl = chunk.rfind(b"\n")
+            if nl >= 0:
+                f.truncate(start + nl + 1)
+                return
+            pos = start
+        f.truncate(0)
 
 
 def _xlsx_available() -> bool:

@@ -25,3 +25,8 @@ from .multihost import (  # noqa: F401
     host_shard,
     is_multiprocess,
 )
+from .pipeline import (  # noqa: F401
+    build_pipe_mesh,
+    forward_pipelined,
+    shard_params_pipelined,
+)

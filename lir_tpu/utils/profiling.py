@@ -213,10 +213,9 @@ def ensure_cpu_backend() -> bool:
 def is_oom_error(err: BaseException | str) -> bool:
     """True when an exception (or its text) is a device out-of-memory —
     the ONE place the TPU runtime's OOM message heuristics live
-    (RESOURCE_EXHAUSTED / "out of memory" / "Ran out of memory"); bench
-    and the measurement tools use it to fall down batch ladders instead
-    of aborting."""
+    (RESOURCE_EXHAUSTED / "out of memory", case-insensitive); bench and
+    the measurement tools use it to fall down batch ladders instead of
+    aborting."""
     msg = str(err)
     return ("RESOURCE_EXHAUSTED" in msg
-            or "out of memory" in msg.lower()
-            or "Ran out of memory" in msg)
+            or "out of memory" in msg.lower())

@@ -326,3 +326,45 @@ class TestCsvFastAppend:
         assert (tmp_path / "r_backup.csv").exists()
         df = schemas.read_results_frame(out)
         assert len(df) == 3
+
+
+class TestLegacyTornArtifacts:
+    """Pre-sidecar artifacts (no .offset file) with kill damage: a torn
+    plain tail is truncated before certification; a torn quoted tail
+    routes to the corrupt-file sidecar path, never backup-and-fresh
+    (which would drop manifest-marked rows from the artifact)."""
+
+    def _rows(self, tag, n=3):
+        return [schemas.PerturbationRow(
+            model="m", original_main="q", response_format="rf",
+            confidence_format="cf", rephrased_main=f"{tag}-{i}",
+            full_rephrased_prompt="p", full_confidence_prompt="c",
+            model_response="Yes", model_confidence_response="85",
+            log_probabilities="{}", token_1_prob=0.6, token_2_prob=0.3,
+            confidence_value=85, weighted_confidence=80.0) for i in range(n)]
+
+    def test_legacy_torn_plain_tail_truncated(self, tmp_path):
+        out = tmp_path / "r.csv"
+        schemas.write_perturbation_results(self._rows("a"), out)
+        schemas._offset_sidecar(out).unlink()
+        with out.open("ab") as f:
+            f.write(b"m,q,rf,cf,torn-fragment")     # pre-sidecar kill
+        schemas.write_perturbation_results(self._rows("b"), out)
+        df = schemas.read_results_frame(out)
+        assert len(df) == 6
+        assert df["Rephrased Main Part"].tolist() == [
+            "a-0", "a-1", "a-2", "b-0", "b-1", "b-2"]
+
+    def test_legacy_torn_quoted_tail_goes_to_sidecar(self, tmp_path):
+        out = tmp_path / "r.csv"
+        schemas.write_perturbation_results(self._rows("a"), out)
+        schemas._offset_sidecar(out).unlink()
+        with out.open("ab") as f:
+            f.write(b'm,q,rf,cf,torn,"open quote never closed\n')
+        schemas.write_perturbation_results(self._rows("b"), out)
+        # Damaged main file PRESERVED (its 3 good rows are manifest-marked
+        # and must not vanish); new rows land in the _new sidecar.
+        assert not (tmp_path / "r_backup.csv").exists()
+        sidecar = tmp_path / "r_new.csv"
+        assert sidecar.exists()
+        assert len(pd.read_csv(sidecar)) == 3

@@ -55,11 +55,16 @@ def main() -> None:
     ap.add_argument("--no-record", action="store_true",
                     help="print only; do not append to SCALE.md")
     args = ap.parse_args()
+    if args.cells < args.batch:
+        raise SystemExit(
+            f"--cells {args.cells} < --batch {args.batch}: the timed run "
+            f"would measure a tail-bucket jit compile, not throughput — "
+            f"pass --cells >= --batch (a multiple of it)")
     if args.cells % args.batch:
         # A ragged cell count leaves a tail bucket whose power-of-two
         # batch compiles INSIDE the timed run (~17 s at 7B) — measuring
         # compile, not steady state. Snap down to full buckets.
-        snapped = max(args.batch, args.cells - args.cells % args.batch)
+        snapped = args.cells - args.cells % args.batch
         print(f"# snapping --cells {args.cells} -> {snapped} "
               f"(multiple of batch {args.batch}; a tail bucket would time "
               f"an extra jit compile)")
