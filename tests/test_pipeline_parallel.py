@@ -86,3 +86,29 @@ def test_pipelined_scoring_readout_matches():
     p_dn = jax.nn.softmax(logits_dense[:, -1], axis=-1)
     np.testing.assert_allclose(np.asarray(p_pp), np.asarray(p_dn),
                                atol=1e-5)
+
+
+def test_pipelined_forward_int8_quant_tree():
+    """QuantTensor layer stacks shard their leading (layer) axis across
+    stages like dense ones (payload + per-channel scales both lead with
+    L); pipelined int8 forward equals the unsharded int8 forward."""
+    import dataclasses
+
+    from lir_tpu.models import quant
+
+    cfg = dataclasses.replace(tiny("llama"), n_layers=4)
+    params = quant.quantize_decoder_params(
+        decoder.init_params(cfg, jax.random.PRNGKey(2)))
+    rng = np.random.default_rng(9)
+    toks = jnp.asarray(rng.integers(3, cfg.vocab_size, (4, 8)), jnp.int32)
+    mask = jnp.ones_like(toks)
+    dense = decoder.forward(params, cfg, toks, mask)
+
+    mesh = pipeline.build_pipe_mesh(2)
+    placed = pipeline.shard_params_pipelined(params, cfg, mesh)
+    wq = placed["layers"]["wq"]
+    assert wq.q.sharding.shard_shape(wq.q.shape)[0] == cfg.n_layers // 2
+    out = pipeline.forward_pipelined(placed, cfg, toks, mask, mesh=mesh,
+                                     n_micro=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               atol=1e-4, rtol=1e-4)
