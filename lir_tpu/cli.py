@@ -9,7 +9,8 @@ experiment and analysis is one subcommand of ``python -m lir_tpu``:
   rephrase     generate/refresh perturbations.json with a local model
   analyze      all statistical analyses over existing artifacts
   survey       human-survey pipeline -> every survey JSON artifact
-  bench        the prompts/sec/chip benchmark
+  bench        the prompts/sec/chip benchmark (end-to-end sweep path)
+  concat-shards  merge per-host .hostN sweep shards into the final artifact
 
 Model weights must be local checkpoint directories (zero egress); pass
 --checkpoints pointing at a root containing ``<org>__<name>`` dirs.

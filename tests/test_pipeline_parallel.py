@@ -112,3 +112,25 @@ def test_pipelined_forward_int8_quant_tree():
                                      n_micro=2)
     np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_pipelined_forward_jits_and_single_row_microbatches():
+    """forward_pipelined composes with an outer jax.jit (the engine would
+    call it from jitted scoring code) and survives Bm=1 microbatches."""
+    import dataclasses
+    import functools
+
+    cfg = dataclasses.replace(tiny("llama"), n_layers=4)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(3))
+    rng = np.random.default_rng(11)
+    toks = jnp.asarray(rng.integers(3, cfg.vocab_size, (4, 6)), jnp.int32)
+    mask = jnp.ones_like(toks)
+    mesh = pipeline.build_pipe_mesh(2)
+    placed = pipeline.shard_params_pipelined(params, cfg, mesh)
+
+    f = jax.jit(functools.partial(pipeline.forward_pipelined, cfg=cfg,
+                                  mesh=mesh, n_micro=4))   # Bm = 1
+    out = f(placed, tokens=toks, attn_mask=mask)
+    dense = decoder.forward(params, cfg, toks, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               atol=1e-4, rtol=1e-4)
