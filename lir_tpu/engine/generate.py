@@ -45,6 +45,19 @@ class FusedDecodeOut:
     weighted_confidence: jax.Array  # (B,) fp32 E[v] over digit ids at pos 0
 
 
+def is_per_row_keys(key: jax.Array) -> bool:
+    """True when ``key`` is a BATCH of PRNG keys (one stream per prompt
+    row), under either key flavor: typed keys (jax.random.key — a key
+    batch is shape (B,), scalar key shape ()) or legacy uint32 keys (a
+    batch is (B, 2), a single key (2,))."""
+    try:
+        if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+            return key.ndim >= 1
+    except TypeError:
+        pass
+    return getattr(key, "ndim", 1) == 2
+
+
 def _small_readout(logits: jax.Array, yes_ids: jax.Array, no_ids: jax.Array):
     """(B, V) fp32 logits -> (p_yes, p_no, top2_ids): O(B*V) compute, O(B)
     output."""
@@ -234,7 +247,7 @@ def sample_decode(params, cfg: ModelConfig, tokens: jax.Array,
     keeps HBM free for long sample runs."""
     B, S = tokens.shape
     T = S + max_new_tokens
-    per_row = key.ndim == 2
+    per_row = is_per_row_keys(key)
     pf = prefill_fn or decoder.prefill
     logits0, cache, pos0 = pf(params, cfg, tokens, attn_mask, T)
     cache_mask0 = jnp.pad(attn_mask, ((0, 0), (0, max_new_tokens)))

@@ -112,6 +112,21 @@ class ScoringEngine:
         # Length buckets: powers of two up to max_seq_len (≲700-token prompts).
         self.buckets = [b for b in (64, 128, 256, 512, 1024)
                         if b <= self.rt.max_seq_len] or [self.rt.max_seq_len]
+        if getattr(cfg, "pos_embedding", None) == "learned":
+            # A bucket + generation budget past the learned-position table
+            # would read beyond pos_embed (gpt2/opt tables are exactly
+            # max_seq_len rows): trim such buckets so a ~1000-token prompt
+            # fails loudly into a smaller bucket's truncation semantics
+            # instead of decoding at clipped positions.
+            limit = cfg.max_seq_len - self.rt.max_new_tokens
+            fitting = [b for b in self.buckets if b <= limit]
+            if not fitting:
+                raise ValueError(
+                    f"{cfg.name}: no length bucket fits the learned-"
+                    f"position table ({cfg.max_seq_len} rows) minus the "
+                    f"generation budget ({self.rt.max_new_tokens}) — "
+                    f"reduce max_new_tokens or max_seq_len")
+            self.buckets = fitting
         self._digit_table: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
     @property
@@ -325,7 +340,7 @@ class ScoringEngine:
         """
         if key is None:
             key = jax.random.PRNGKey(0)
-        per_row = getattr(key, "ndim", 1) == 2   # (B, 2): per-prompt streams
+        per_row = generate.is_per_row_keys(key)  # per-prompt streams
         all_runs: List[List[str]] = [[] for _ in prompts]
         # Tokenize/pad ONCE; only the PRNG key varies across runs.
         toks, mask = self._pad_batch(prompts)

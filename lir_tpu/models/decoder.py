@@ -162,9 +162,7 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
     overhead per step. Non-block-divisible lengths also fall back dense."""
     B, S, H, hd = q.shape
     K = k.shape[2]
-    if K != H:  # GQA/MQA: repeat kv heads
-        k = jnp.repeat(k, H // K, axis=2)
-        v = jnp.repeat(v, H // K, axis=2)
+    G = H // K
 
     from ..ops.flash_attention import (
         DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_attention,
@@ -183,6 +181,9 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
         and (jax.default_backend() == "tpu" or FLASH_INTERPRET_ON_CPU)
     )
     if flash_ok:
+        if K != H:  # the Pallas kernel wants per-query-head k/v
+            k = jnp.repeat(k, G, axis=2)
+            v = jnp.repeat(v, G, axis=2)
         interpret = (FLASH_INTERPRET_ON_CPU
                      and jax.default_backend() != "tpu")
         if cfg.pos_embedding == "alibi":
@@ -196,10 +197,18 @@ def _attention(q: jax.Array, k: jax.Array, v: jax.Array, bias: jax.Array,
                                   interpret=interpret)
         return out.reshape(B, S, H * hd)
 
-    scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
-    scores = scores / math.sqrt(hd) + bias
+    # GQA/MQA contracts GROUPED query heads against the UN-REPEATED k/v
+    # (same h = k*G + g convention as _attention_cached): repeating k/v to
+    # H heads would materialize an H/K-times copy inside every layer of
+    # the prefill scan — ~600 MB transient per layer for falcon's 71:1
+    # MQA at batch 32 / seq 1024.
+    T = k.shape[1]
+    qg = q.reshape(B, S, K, G, hd)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
+    scores = scores.reshape(B, H, S, T) / math.sqrt(hd) + bias
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhst,bthd->bshd", probs, v)
+    pg = probs.reshape(B, K, G, S, T)
+    out = jnp.einsum("bkgst,btkd->bskgd", pg, v)
     return out.reshape(B, S, H * hd)
 
 
@@ -351,7 +360,13 @@ def _embed(params: Params, cfg: ModelConfig, tokens: jax.Array,
            positions: jax.Array) -> jax.Array:
     x = jnp.take(params["tok_embed"], tokens, axis=0)
     if cfg.pos_embedding == "learned":
-        x = x + jnp.take(params["pos_embed"], positions + cfg.learned_pos_offset, axis=0)
+        # mode="clip": an out-of-table position reuses the last row instead
+        # of jnp.take's default NaN fill silently poisoning every logit.
+        # The engine additionally refuses buckets that could overflow the
+        # table (runner.ScoringEngine), so this is defense in depth.
+        x = x + jnp.take(params["pos_embed"],
+                         positions + cfg.learned_pos_offset, axis=0,
+                         mode="clip")
     if cfg.embedding_norm:
         ln = {"scale": params["embed_ln"]["scale"], "bias": params["embed_ln"]["bias"]}
         x = _norm(x, ln, dataclasses.replace(cfg, norm="layernorm"))

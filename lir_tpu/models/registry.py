@@ -85,6 +85,12 @@ class ModelConfig:
         assert self.pos_embedding in ("rotary", "learned", "alibi"), self.pos_embedding
         assert self.norm in ("rmsnorm", "layernorm"), self.norm
         assert self.activation in ("silu", "gelu", "gelu_new", "relu"), self.activation
+        # shared_block_ln reuses the attention LN for the MLP, which only
+        # exists in falcon-style PARALLEL blocks; a sequential block with
+        # it set would KeyError('ln2') deep inside the first forward trace.
+        assert not (self.shared_block_ln and not self.parallel_block), (
+            "shared_block_ln=True requires parallel_block=True "
+            f"({self.name})")
 
     @property
     def rotary_dim(self) -> int:

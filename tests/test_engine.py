@@ -402,3 +402,31 @@ def test_data_parallel_mesh_8x1_replicated_params():
     # FULL weight (the named model axis has size 1 -> no actual split).
     wq = sharded["layers"]["wq"]
     assert wq.sharding.shard_shape(wq.shape) == wq.shape
+
+
+def test_sample_decode_typed_prng_key_batch():
+    """Per-row PRNG streams must work with BOTH key flavors: legacy
+    uint32 (B, 2) arrays and modern typed keys (shape (B,)). The typed
+    batch previously misrouted into the single-key path and crashed."""
+    cfg = _MC(name="key-smoke", vocab_size=64, hidden_size=32, n_layers=2,
+              n_heads=4, intermediate_size=64, max_seq_len=64)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(rng.integers(3, 64, (3, 5)), jnp.int32)
+    mask = jnp.ones_like(toks)
+
+    legacy = jnp.stack([jax.random.PRNGKey(i) for i in range(3)])
+    assert generate.is_per_row_keys(legacy)
+    g1 = generate.sample_decode(params, cfg, toks, mask, legacy,
+                                max_new_tokens=4)
+    typed = jax.vmap(jax.random.key)(jnp.arange(3, dtype=jnp.uint32))
+    assert generate.is_per_row_keys(typed)
+    g2 = generate.sample_decode(params, cfg, toks, mask, typed,
+                                max_new_tokens=4)
+    assert g1.shape == g2.shape == (3, 4)
+    # Scalar keys of both flavors route to the single-stream path.
+    assert not generate.is_per_row_keys(jax.random.PRNGKey(0))
+    assert not generate.is_per_row_keys(jax.random.key(0))
+    g3 = generate.sample_decode(params, cfg, toks, mask, jax.random.key(7),
+                                max_new_tokens=4)
+    assert g3.shape == (3, 4)
