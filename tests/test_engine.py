@@ -430,3 +430,32 @@ def test_sample_decode_typed_prng_key_batch():
     g3 = generate.sample_decode(params, cfg, toks, mask, jax.random.key(7),
                                 max_new_tokens=4)
     assert g3.shape == (3, 4)
+
+
+def test_shared_prefix_scorer_on_dp_mesh():
+    """The sweep's shared-prefix scorer on a pure data-parallel (8x1)
+    engine — the recommended int8-7B serving mode — equals the
+    single-device run."""
+    params, cfg, _ = _tiny_llama_params()
+    mesh = sharding.build_mesh(MeshConfig(data=8, model=1))
+    sharded = sharding.shard_params(params, cfg, mesh)
+    tok_f = FakeTokenizer()
+    rt = RuntimeConfig(batch_size=8, max_seq_len=64)
+    plain = ScoringEngine(params, cfg, tok_f, rt)
+    dp = ScoringEngine(sharded, cfg, tok_f, rt)
+    mains = [f"levee failure case number {i} in the policy ?"
+             for i in range(8)]
+    bins = [m + " Answer Yes or No ." for m in mains]
+    confs = [m + " Give a number 0 to 100 ." for m in mains]
+    t1 = np.full((8,), FakeTokenizer.YES, np.int32)
+    t2 = np.full((8,), FakeTokenizer.NO, np.int32)
+    pa, pb = plain.decode_fused_shared(bins, confs, t1, t2,
+                                       new_tokens=3, conf_tokens=4)
+    da, db = dp.decode_fused_shared(bins, confs, t1, t2,
+                                    new_tokens=3, conf_tokens=4)
+    np.testing.assert_array_equal(np.asarray(da.generated),
+                                  np.asarray(pa.generated))
+    np.testing.assert_allclose(np.asarray(da.p_yes), np.asarray(pa.p_yes),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(db.weighted_confidence),
+                               np.asarray(pb.weighted_confidence), atol=1e-3)

@@ -368,3 +368,40 @@ def test_multihost_initialize_already_up_is_success(monkeypatch):
     monkeypatch.setattr(jax, "process_index", lambda: 0)
     assert multihost.initialize(required=True) is True
     assert multihost.initialize() is True
+
+
+def test_engine_shared_prefix_on_seq_mesh(seq_mesh):
+    """The SWEEP's shared-prefix scorer composes with the seq-parallel
+    prefill: the shared prefix prefills seq-sharded (ring attention), the
+    suffix extensions and fused scans run dense, and the readouts equal
+    the plain engine's."""
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="eng-sp-shared", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=2, n_heads=8,
+                      intermediate_size=64, max_seq_len=128)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(1))
+    rt = RuntimeConfig(batch_size=2, max_new_tokens=5, max_seq_len=128)
+    mains = ["Is a levee failure considered a flood event under the policy ?",
+             "Would a burst dam count as a flood for coverage purposes ?"]
+    bins = [m + " Answer Yes or No ." for m in mains]
+    confs = [m + " Give a number 0 to 100 ." for m in mains]
+    t1 = np.full((2,), FakeTokenizer.YES, np.int32)
+    t2 = np.full((2,), FakeTokenizer.NO, np.int32)
+
+    plain = ScoringEngine(params, cfg, FakeTokenizer(), rt)
+    sp = ScoringEngine(params, cfg, FakeTokenizer(), rt, seq_mesh=seq_mesh)
+    pa, pb = plain.decode_fused_shared(bins, confs, t1, t2,
+                                       new_tokens=3, conf_tokens=4)
+    sa, sb = sp.decode_fused_shared(bins, confs, t1, t2,
+                                    new_tokens=3, conf_tokens=4)
+    np.testing.assert_array_equal(np.asarray(sa.generated),
+                                  np.asarray(pa.generated))
+    np.testing.assert_allclose(np.asarray(sa.p_yes), np.asarray(pa.p_yes),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(sb.weighted_confidence),
+                               np.asarray(pb.weighted_confidence), atol=1e-3)
