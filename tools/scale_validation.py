@@ -87,6 +87,36 @@ def _fused_step(params, cfg, batch, seq, new_tokens):
     return compile_s, best
 
 
+def resolve_preset(name: str, *, allow_t5: bool = False):
+    """Resolve a registry PRESET name to a config, restricted to the
+    zero-arg preset factories (class names like ModelConfig would
+    construct a default config; tiny() needs an argument; modules are
+    not callable). SystemExit with the valid names on any miss."""
+    import inspect
+    import types as _types
+
+    from lir_tpu.models import registry
+
+    presets = {
+        n: v for n, v in vars(registry).items()
+        if isinstance(v, _types.FunctionType)
+        and v.__module__ == registry.__name__
+        and not n.startswith("_")
+        and all(p.default is not inspect.Parameter.empty
+                for p in inspect.signature(v).parameters.values())
+    }
+    mk = presets.get(name)
+    if mk is None:
+        raise SystemExit(f"no registry preset {name!r} "
+                         f"(try one of: {', '.join(sorted(presets))})")
+    cfg = mk()
+    if isinstance(cfg, registry.T5Config) and not allow_t5:
+        raise SystemExit(
+            f"{name} is an encoder-decoder preset; this tool runs "
+            f"decoder-only models (use scale_validation.py --t5)")
+    return cfg
+
+
 def run_tpu_int8(models: str | None = None,
                  fast_path: bool = False) -> None:
     import jax
@@ -102,32 +132,7 @@ def run_tpu_int8(models: str | None = None,
              if n.strip()]
     # Resolve every preset BEFORE the first _append: a typo'd name must
     # fail fast, not leave an orphaned section header in SCALE.md.
-    import inspect
-    import types as _types
-
-    # Only the registry's zero-arg preset FACTORIES qualify — classes
-    # (ModelConfig() constructs a default config!) and helpers like tiny()
-    # must not resolve.
-    presets = {
-        n: v for n, v in vars(registry).items()
-        if isinstance(v, _types.FunctionType)
-        and v.__module__ == registry.__name__
-        and not n.startswith("_")
-        and all(p.default is not inspect.Parameter.empty
-                for p in inspect.signature(v).parameters.values())
-    }
-    cfgs = []
-    for name in names:
-        mk = presets.get(name)
-        if mk is None:
-            raise SystemExit(
-                f"--models: no registry preset {name!r} "
-                f"(try one of: {', '.join(sorted(presets))})")
-        cfg = mk()
-        if isinstance(cfg, registry.T5Config):
-            raise SystemExit(
-                f"--models: {name} is an encoder-decoder preset; use --t5")
-        cfgs.append(cfg)
+    cfgs = [resolve_preset(n) for n in names]
     _append(f"\n## int8 single-chip — {dev.device_kind} ({dev.platform}), "
             f"{datetime.date.today()}\n\n")
 

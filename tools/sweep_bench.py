@@ -6,8 +6,9 @@ synced). This tool measures the whole production loop around it:
 grid build -> manifest resume filter -> length bucketing/padding ->
 tokenization -> fused binary + confidence decodes -> top-20 logprob map ->
 D6 Excel append + manifest write-ahead — `engine.sweep.run_perturbation_
-sweep` exactly as the CLI runs it, on a full-size llama-2-7b (random
-weights, dynamic int8 + int8 KV cache) with long rephrasings that
+sweep` exactly as the CLI runs it, on a full-size registry preset
+(--model, default llama-2-7b; random weights, dynamic int8 + int8 KV
+cache) with long rephrasings that
 land in the 256-token bucket at the default N_WORDS, as the real legal
 prompts do (SURVEY.md §6:
 prompt + format <= ~700 tokens).
@@ -52,6 +53,9 @@ def main() -> None:
     # batch 40 is the measured sweet spot for the shared-prefix path (48
     # OOMs: the shared cache carries suffix+gen slack slots; SCALE.md r3).
     ap.add_argument("--batch", type=int, default=40)
+    ap.add_argument("--model", default="llama2_7b",
+                    help="registry preset for the full-size run "
+                         "(default llama2_7b)")
     ap.add_argument("--no-record", action="store_true",
                     help="print only; do not append to SCALE.md")
     args = ap.parse_args()
@@ -81,7 +85,6 @@ def main() -> None:
     from lir_tpu.engine.runner import ScoringEngine
     from lir_tpu.engine.sweep import run_perturbation_sweep
     from lir_tpu.models import quant
-    from lir_tpu.models.registry import llama2_7b
 
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
@@ -89,11 +92,13 @@ def main() -> None:
         print("# no accelerator: running the tiny CPU smoke variant")
 
     if on_accel:
-        cfg = dataclasses.replace(llama2_7b(), kv_cache_int8=True)
+        from tools.scale_validation import resolve_preset
+        cfg = dataclasses.replace(resolve_preset(args.model),
+                                  kv_cache_int8=True)
         params = quant.random_quantized_params(
             cfg, jax.random.PRNGKey(0), dtype=jax.numpy.bfloat16,
             dynamic=True)
-        mode = "llama-2-7b int8-dyn+kvq8"
+        mode = f"{cfg.name} int8-dyn+kvq8"
     else:
         from lir_tpu.models import decoder
         from lir_tpu.models.registry import ModelConfig
