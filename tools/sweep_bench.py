@@ -43,7 +43,7 @@ WORDS = ("coverage policy flood water damage claim insurer holder premium "
 N_WORDS = 170  # + format lines -> the 256-token bucket for FakeTokenizer
 
 
-def _long_text(rng, n_words: int = N_WORDS) -> str:
+def _long_text(rng, n_words: int) -> str:
     return " ".join(rng.choice(WORDS) for _ in range(n_words)) + " ?"
 
 
@@ -53,6 +53,10 @@ def main() -> None:
     # batch 40 is the measured sweet spot for the shared-prefix path (48
     # OOMs: the shared cache carries suffix+gen slack slots; SCALE.md r3).
     ap.add_argument("--batch", type=int, default=40)
+    ap.add_argument("--words", type=int, default=N_WORDS,
+                    help="rephrasing length in words (~tokens for the fake "
+                         "tokenizer): 170 -> 256-token bucket, 700 -> 1024 "
+                         "(long-context sweep)")
     ap.add_argument("--model", default="llama2_7b",
                     help="registry preset for the full-size run "
                          "(default llama2_7b)")
@@ -108,18 +112,20 @@ def main() -> None:
         params = decoder.init_params(cfg, jax.random.PRNGKey(0))
         mode = "0.2M-smoke fp32"
 
-    rt = RuntimeConfig(batch_size=args.batch, max_seq_len=512)
+    rt = RuntimeConfig(batch_size=args.batch,
+                       max_seq_len=max(512, 2 * args.words))
     engine = ScoringEngine(params, cfg, FakeTokenizer(), rt)
 
     rng = np.random.default_rng(7)
     lp = (LegalPrompt(
-        main=_long_text(rng),
+        main=_long_text(rng, args.words),
         response_format="Respond with either ' Yes' or ' No' only .",
         target_tokens=("Yes", "No"),
         confidence_format="Give a confidence number from 0 to 100 ."),)
 
     def run(n_cells: int, tag: str) -> float:
-        perts = ([_long_text(rng) for _ in range(n_cells - 1)],)
+        perts = ([_long_text(rng, args.words)
+                  for _ in range(n_cells - 1)],)
         with tempfile.TemporaryDirectory() as td:
             t0 = time.perf_counter()
             rows = run_perturbation_sweep(
@@ -137,7 +143,7 @@ def main() -> None:
     rate = args.cells / t
     print(f"sweep_bench: {args.cells} grid cells in {t:.1f}s -> "
           f"{rate:.2f} prompts/s/chip end-to-end ({mode}, batch "
-          f"{args.batch}, ~{N_WORDS}-word rephrasings, "
+          f"{args.batch}, ~{args.words}-word rephrasings, "
           f"binary+confidence per cell)")
 
     if args.no_record or not on_accel:
@@ -149,7 +155,7 @@ def main() -> None:
 `run_perturbation_sweep` exactly as the CLI runs it (grid + manifest +
 bucketing + tokenize + binary & confidence fused decodes + top-20 logprob
 maps + D6 Excel/manifest writes), {mode}, batch {args.batch},
-~{N_WORDS}-word rephrasings:
+~{args.words}-word rephrasings:
 
 - {args.cells} grid cells in {t:.1f}s = **{rate:.2f} prompts/s/chip
   end-to-end** (warm; compile-inclusive warmup bucket took {t_warm:.1f}s)
