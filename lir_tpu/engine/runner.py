@@ -211,18 +211,47 @@ class ScoringEngine:
         sfx_a_ids = [a[n:] for a, n in zip(bin_ids, lcp)]
         sfx_b_ids = [b[n:] for b, n in zip(conf_ids, lcp)]
         max_sfx = max(len(s) for s in sfx_a_ids + sfx_b_ids)
+        max_total = max(len(r) for r in bin_ids + conf_ids)
+        bucket = tok.pick_bucket([max(n, 1) for n in lcp], self.buckets)
+        ba = tok.pick_bucket([len(s) for s in sfx_a_ids], sfx_buckets)
+        bb = tok.pick_bucket([len(s) for s in sfx_b_ids], sfx_buckets)
+        fallback_reason = None
         if max_sfx > max(sfx_buckets):
             # A suffix longer than the largest bucket would be silently
             # right-truncated — dropping the very instruction the readout
             # depends on. Prompt pairs that diverge this early share too
-            # little to be worth a shared prefill anyway: score them on the
-            # plain (two full prefills) path instead.
+            # little to be worth a shared prefill anyway.
+            fallback_reason = (
+                f"a prompt pair diverges {max_sfx} tokens before its end "
+                f"(> {max(sfx_buckets)} suffix bucket)")
+        elif max_total > max(self.buckets):
+            # An over-long TOTAL prompt: the plain path left-truncates the
+            # whole prompt into the largest bucket, while the shared path
+            # would retain prefix-bucket + suffix-bucket tokens — more
+            # context, an unpinned scoring divergence between the two paths
+            # (ADVICE r3 #2). The plain path owns over-long semantics.
+            fallback_reason = (
+                f"a prompt ({max_total} tokens) exceeds the largest "
+                f"bucket ({max(self.buckets)})")
+        elif (getattr(self.cfg, "pos_embedding", None) == "learned"
+              and bucket + max(ba + new_tokens, bb + conf_tokens)
+              > self.cfg.max_seq_len):
+            # The suffix extension appends past the prefix bucket, so decode
+            # positions can reach the shared-decode cache length
+            # bucket + max(ba+new, bb+conf) (generate.py T0) — beyond the
+            # plain-path limit the constructor's bucket trim enforces. A
+            # learned-position table would be read out of range (ADVICE r3
+            # #1); the plain path's trimmed buckets stay in range.
+            fallback_reason = (
+                f"prefix bucket {bucket} + suffix/new-token budget "
+                f"{max(ba + new_tokens, bb + conf_tokens)} would overrun "
+                f"the {self.cfg.max_seq_len}-row learned-position table")
+        if fallback_reason is not None:
             from ..utils.logging import get_logger
 
             get_logger(__name__).info(
-                "shared-prefix fallback: a prompt pair diverges %d tokens "
-                "before its end (> %d suffix bucket) — scoring this whole "
-                "bucket with two full prefills", max_sfx, max(sfx_buckets))
+                "shared-prefix fallback: %s — scoring this whole bucket "
+                "with two full prefills", fallback_reason)
             fused = self.decode_fused(binary_prompts, yes_ids, no_ids,
                                       max_new_tokens=new_tokens,
                                       pretokenized=bin_ids)
@@ -231,11 +260,8 @@ class ScoringEngine:
                                        max_new_tokens=conf_tokens,
                                        pretokenized=conf_ids)
             return fused, cfused
-        bucket = tok.pick_bucket([max(n, 1) for n in lcp], self.buckets)
         prefix, prefix_mask = tok.left_pad_ids(
             [a[:n] for a, n in zip(bin_ids, lcp)], bucket, pad_id)
-        ba = tok.pick_bucket([len(s) for s in sfx_a_ids], sfx_buckets)
-        bb = tok.pick_bucket([len(s) for s in sfx_b_ids], sfx_buckets)
         sfx_a, sfx_a_mask = tok.right_pad_ids(sfx_a_ids, ba, pad_id)
         sfx_b, sfx_b_mask = tok.right_pad_ids(sfx_b_ids, bb, pad_id)
         digit_ids, digit_vals = self.digit_table

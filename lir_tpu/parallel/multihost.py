@@ -43,10 +43,6 @@ def initialize(coordinator_address: str | None = None,
     try:
         jax.distributed.initialize(coordinator_address, num_processes,
                                    process_id)
-        log.info("jax.distributed up: process %d of %d, %d local devices",
-                 jax.process_index(), jax.process_count(),
-                 jax.local_device_count())
-        return True
     except Exception as err:  # noqa: BLE001 — single-host is a normal path
         # A launcher (or an earlier call) may have brought the runtime up
         # already; that is a SUCCESSFUL multi-host state, not a bring-up
@@ -60,12 +56,45 @@ def initialize(coordinator_address: str | None = None,
         except Exception:  # noqa: BLE001 — no runtime at all
             pass
         if required:
+            # Distinguish "runtime is up but reports one process" (a
+            # launcher pre-initialized a single-process topology — the
+            # bring-up itself SUCCEEDED; the topology is what's wrong) from
+            # a genuine bring-up failure, so --multihost users see the real
+            # state instead of a misattributed error (ADVICE r3 #3).
+            already_up = False
+            try:
+                already_up = jax.distributed.is_initialized()
+            except Exception:  # noqa: BLE001 — probe only
+                pass
+            if already_up:
+                raise RuntimeError(
+                    "--multihost requested but the distributed runtime was "
+                    "already initialized with a SINGLE-process topology "
+                    "(process_count()==1) — this host would take the entire "
+                    "grid while any peers sweep shards. Fix the launcher's "
+                    "coordinator/num_processes settings rather than the "
+                    f"bring-up call. (initialize() said: {err})") from err
             raise RuntimeError(
                 f"--multihost requested but distributed bring-up failed: "
                 f"{err}") from err
         log.info("single-process mode (distributed init unavailable: %s)",
                  err)
         return False
+    if required and jax.process_count() == 1:
+        # Bring-up "succeeded" but found no peers (e.g. a lone TPU VM whose
+        # coordinator config is missing): under --multihost this host would
+        # take the ENTIRE grid via host_shard while any correctly-configured
+        # peers sweep shards — the same duplicate-scoring hazard as the
+        # pre-initialized single-process case above, so it must be as loud.
+        raise RuntimeError(
+            "--multihost requested but jax.distributed came up with a "
+            "SINGLE-process topology (process_count()==1) — no peers were "
+            "found. Check the coordinator address / pod slice "
+            "configuration.")
+    log.info("jax.distributed up: process %d of %d, %d local devices",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count())
+    return True
 
 
 def is_multiprocess() -> bool:

@@ -378,6 +378,71 @@ def test_decode_fused_shared_falls_back_on_long_suffix():
                                np.asarray(ref_a.p_yes), rtol=1e-6)
 
 
+def test_decode_fused_shared_falls_back_on_overlong_prefix(caplog):
+    """When the common token prefix exceeds the largest prefix bucket, the
+    shared path must NOT keep more context than the plain path (which
+    left-truncates the whole prompt): it falls back to two full prefills so
+    over-long semantics stay pinned across paths (ADVICE r3 #2)."""
+    cfg = _MC(name="overlong-smoke", vocab_size=FakeTokenizer.VOCAB,
+              hidden_size=64, n_layers=2, n_heads=4, intermediate_size=128,
+              max_seq_len=1024)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(5))
+    # rt.max_seq_len=128 -> prefix buckets [64, 128].
+    engine = ScoringEngine(params, cfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=2, max_seq_len=128))
+    shared = " ".join(f"common{i}" for i in range(200))   # lcp >> 128
+    bins = [shared + " answer yes or no"] * 2
+    confs = [shared + " give a number"] * 2
+    t1 = np.full((2,), FakeTokenizer.YES, np.int32)
+    t2 = np.full((2,), FakeTokenizer.NO, np.int32)
+    with caplog.at_level("INFO", logger="lir_tpu"):
+        out_a, out_b = engine.decode_fused_shared(
+            bins, confs, t1, t2, new_tokens=2, conf_tokens=2)
+    assert any("shared-prefix fallback" in r.message
+               and "exceeds the largest bucket" in r.message
+               for r in caplog.records)
+    ref_a = engine.decode_fused(bins, t1, t2, max_new_tokens=2)
+    ref_b = engine.decode_fused(confs, t1, t2, with_digits=True,
+                                max_new_tokens=2)
+    np.testing.assert_array_equal(np.asarray(out_a.generated),
+                                  np.asarray(ref_a.generated))
+    np.testing.assert_allclose(np.asarray(out_a.p_yes),
+                               np.asarray(ref_a.p_yes), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out_b.generated),
+                                  np.asarray(ref_b.generated))
+
+
+def test_decode_fused_shared_falls_back_on_learned_pos_overflow(caplog):
+    """Learned-position models: prefix bucket + suffix bucket + new tokens
+    can overrun the position table even when each bucket individually fits
+    (the constructor only trims for the plain path) — the shared path must
+    detect this and take the trimmed plain path (ADVICE r3 #1)."""
+    cfg = _MC(name="learnedpos-smoke", vocab_size=FakeTokenizer.VOCAB,
+              hidden_size=64, n_layers=2, n_heads=4, intermediate_size=128,
+              max_seq_len=160, pos_embedding="learned")
+    params = decoder.init_params(cfg, jax.random.PRNGKey(6))
+    engine = ScoringEngine(params, cfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=2, max_seq_len=256,
+                                         max_new_tokens=4))
+    # Constructor trim: buckets <= 160-4 -> [64, 128]. Total prompt ~120
+    # tokens fits the 128 bucket (so the over-long-total branch stays
+    # quiet), but prefix bucket 128 + suffix bucket 32 + 2 new tokens =
+    # 162 > the 160-row position table -> must fall back.
+    shared = " ".join(f"body{i}" for i in range(100))
+    bins = [shared + " " + " ".join(f"ba{i}" for i in range(18))] * 2
+    confs = [shared + " " + " ".join(f"bc{i}" for i in range(18))] * 2
+    t1 = np.full((2,), FakeTokenizer.YES, np.int32)
+    t2 = np.full((2,), FakeTokenizer.NO, np.int32)
+    with caplog.at_level("INFO", logger="lir_tpu"):
+        out_a, _ = engine.decode_fused_shared(
+            bins, confs, t1, t2, new_tokens=2, conf_tokens=2)
+    assert any("shared-prefix fallback" in r.message
+               and "learned-position" in r.message for r in caplog.records)
+    ref_a = engine.decode_fused(bins, t1, t2, max_new_tokens=2)
+    np.testing.assert_array_equal(np.asarray(out_a.generated),
+                                  np.asarray(ref_a.generated))
+
+
 def test_data_parallel_mesh_8x1_replicated_params():
     """Pure data-parallel serving (mesh 8x1): params replicate, the batch
     shards on `data`, and scores equal the single-device run — the int8-7B

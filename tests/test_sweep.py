@@ -259,6 +259,34 @@ def test_perturbation_sweep_multihost_shards(tmp_path, monkeypatch):
     assert len(seen) == 6 and len(set(seen)) == 6
 
 
+def test_multihost_required_single_process_runtime_error_attribution(
+        monkeypatch):
+    """A launcher that pre-initialized jax.distributed with a SINGLE-process
+    topology must get an error naming that state — not a misattributed
+    'bring-up failed' (ADVICE r3 #3)."""
+    import jax
+    import pytest
+
+    from lir_tpu.parallel import multihost
+
+    def boom(*a, **k):
+        raise RuntimeError("distributed runtime already initialized")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    with pytest.raises(RuntimeError, match="SINGLE-process topology"):
+        multihost.initialize(required=True)
+    # With no runtime at all, the plain bring-up-failed error stands.
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    with pytest.raises(RuntimeError, match="bring-up failed"):
+        multihost.initialize(required=True)
+    # initialize() "succeeding" but finding no peers is the same hazard.
+    monkeypatch.setattr(jax.distributed, "initialize", lambda *a, **k: None)
+    with pytest.raises(RuntimeError, match="no peers were found"):
+        multihost.initialize(required=True)
+
+
 def test_multihost_shard_concat_and_merged_resume(tmp_path, monkeypatch):
     """The gather step: after both hosts sweep their shards, host 0 merges
     the .hostN workbooks + manifests into the FINAL artifact
