@@ -63,11 +63,25 @@ class RuntimeConfig:
     # of the full `max_new_tokens`=50 — a ~10x cut in decode-step compute.
     # The confidence call keeps a larger budget: its *parsed* integer may sit
     # several tokens into a verbose reply ("I am about 85% sure"), and a
-    # truncated decode would silently null 'Confidence Value'.
+    # truncated decode would silently null 'Confidence Value'. The 8-token
+    # default is measured, not guessed: across the reference's committed
+    # real-model outputs (18 base/instruct + 10 instruct models,
+    # data/*_comparison_results.csv), the answer token sits at word 0-1 for
+    # every perturbation-zoo family (96.4% of base rows and 100% of
+    # instruct rows inside 8 words — SCALE.md "confidence decode budget").
+    # A truncated integer is never recorded wrong (the parse rejects
+    # budget-edge integers), and the C26 confidence-compliance gate flags a
+    # model that needs a bigger budget; with `sweep_early_stop` a generous
+    # re-run budget costs only actual response length.
     # `sweep_full_completions=True` restores 50-token 'Model Response' /
     # 'Model Confidence Response' text parity with the reference.
     sweep_decode_tokens: int = 4
-    sweep_confidence_tokens: int = 16
+    sweep_confidence_tokens: int = 8
+    # Stop the confidence decode scan once every row has emitted EOS or a
+    # complete first integer (a digit token followed by a digit-free one) —
+    # the only thing the confidence parse reads. Needs per-token strings
+    # (HF tokenizers) + an EOS id; silently off otherwise.
+    sweep_early_stop: bool = True
     sweep_full_completions: bool = False
 
 

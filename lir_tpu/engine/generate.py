@@ -73,12 +73,27 @@ def _small_readout(logits: jax.Array, yes_ids: jax.Array, no_ids: jax.Array):
 def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
                 cache_mask0: jax.Array, pos0: jax.Array, slot0: int,
                 yes_ids: jax.Array, no_ids: jax.Array, digit_ids: jax.Array,
-                digit_vals: jax.Array, max_new_tokens: int, topk: int
+                digit_vals: jax.Array, max_new_tokens: int, topk: int,
+                stop_mask: jax.Array = None, eos_id: jax.Array = None,
                 ) -> Tuple[FusedDecodeOut, Tuple]:
     """The fused greedy scan shared by the full-prompt and shared-prefix
     paths: start from ``logits0`` (the first generated position), write
     generated k/v at cache slots ``slot0 + t``, capture the C13/D6 readouts
-    in-scan. Returns (FusedDecodeOut, final cache)."""
+    in-scan. Returns (FusedDecodeOut, final cache).
+
+    ``stop_mask`` ((V,) bool: token string contains a digit) + ``eos_id``
+    enable the confidence early stop: a row is DONE once it emits EOS or a
+    digit-free token after a digit-bearing one (its first integer —
+    the only thing the confidence parse reads — is then complete). Done
+    rows emit EOS from the next step (so host-side EOS trimming ends their
+    text at the stop point), and once EVERY row is done the remaining scan
+    steps skip the model forward via a scalar ``lax.cond`` — a generous
+    token budget then costs actual-response-length decode steps, not the
+    worst case. Per-step p_yes/p_no/top2 after a row's stop point reflect
+    the EOS-fed model and must not be consumed (the sweep's confidence
+    readout uses position 0 only).
+    """
+    early_stop = stop_mask is not None and eos_id is not None
     # Position-0 extras (first generated position): top-k logprob map +
     # weighted confidence.
     logp0 = logits0 - jax.scipy.special.logsumexp(
@@ -88,17 +103,37 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
     mass = jnp.maximum(p_digits.sum(axis=-1), 1e-10)
     wconf = (p_digits * digit_vals[None, :]).sum(axis=-1) / mass
 
+    B = logits0.shape[0]
+
     def step(carry, t):
-        logits, cache, cache_mask = carry
+        logits, cache, cache_mask, done, digit_seen = carry
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         p_yes, p_no, top2 = _small_readout(logits, yes_ids, no_ids)
         cache_mask = cache_mask.at[:, slot0 + t].set(1)
-        new_logits, cache = decoder.decode_step(
-            params, cfg, cache, nxt, pos0 + t, slot0 + t, cache_mask)
-        return (new_logits, cache, cache_mask), (nxt, p_yes, p_no, top2)
+        if early_stop:
+            emit = jnp.where(done, eos_id, nxt)
+            is_digit = stop_mask[emit]
+            done = done | (emit == eos_id) | (digit_seen & ~is_digit)
+            digit_seen = digit_seen | is_digit
 
-    (_, cache_f, _), (gen, p_yes, p_no, top2) = lax.scan(
-        step, (logits0, cache, cache_mask0), jnp.arange(max_new_tokens))
+            def run(args):
+                lg, c = args
+                return decoder.decode_step(
+                    params, cfg, c, emit, pos0 + t, slot0 + t, cache_mask)
+
+            new_logits, cache = lax.cond(
+                jnp.all(done), lambda args: args, run, (logits, cache))
+        else:
+            emit = nxt
+            new_logits, cache = decoder.decode_step(
+                params, cfg, cache, emit, pos0 + t, slot0 + t, cache_mask)
+        return ((new_logits, cache, cache_mask, done, digit_seen),
+                (emit, p_yes, p_no, top2))
+
+    done0 = jnp.zeros((B,), bool)
+    (_, cache_f, _, _, _), (gen, p_yes, p_no, top2) = lax.scan(
+        step, (logits0, cache, cache_mask0, done0, jnp.zeros((B,), bool)),
+        jnp.arange(max_new_tokens))
 
     return FusedDecodeOut(
         generated=jnp.swapaxes(gen, 0, 1),
@@ -119,13 +154,15 @@ def greedy_decode_fused(params, cfg: ModelConfig, tokens: jax.Array,
                         no_ids: jax.Array, digit_ids: jax.Array,
                         digit_vals: jax.Array, max_new_tokens: int = 50,
                         topk: int = 20,
-                        prefill_fn=None) -> FusedDecodeOut:
+                        prefill_fn=None, stop_mask: jax.Array = None,
+                        eos_id: jax.Array = None) -> FusedDecodeOut:
     """Greedy decode with the C13/D6 readouts fused into the scan.
 
     yes_ids/no_ids: (B,) per-row target token ids (rows of one batch may
     score different prompts with different target tokens). digit_ids/vals:
     the integer-token table for the weighted-confidence readout (pass empty
-    arrays to skip: the gather on an empty axis is free).
+    arrays to skip: the gather on an empty axis is free). stop_mask/eos_id
+    enable the confidence early stop (see _fused_tail).
     """
     B, S = tokens.shape
     T = S + max_new_tokens
@@ -134,7 +171,8 @@ def greedy_decode_fused(params, cfg: ModelConfig, tokens: jax.Array,
     cache_mask0 = jnp.pad(attn_mask, ((0, 0), (0, max_new_tokens)))
     out, _ = _fused_tail(params, cfg, logits0, cache, cache_mask0, pos0, S,
                          yes_ids, no_ids, digit_ids, digit_vals,
-                         max_new_tokens, topk)
+                         max_new_tokens, topk, stop_mask=stop_mask,
+                         eos_id=eos_id)
     return out
 
 
@@ -148,7 +186,8 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
                                no_ids: jax.Array, digit_ids: jax.Array,
                                digit_vals: jax.Array, max_new_a: int,
                                max_new_b: int, topk: int = 20,
-                               prefill_fn=None
+                               prefill_fn=None, stop_mask_b: jax.Array = None,
+                               eos_id: jax.Array = None
                                ) -> Tuple[FusedDecodeOut, FusedDecodeOut]:
     """TWO fused greedy decodes sharing ONE prefill over a common prefix.
 
@@ -178,7 +217,8 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
     empty_ids = jnp.zeros((0,), jnp.int32)
     empty_vals = jnp.zeros((0,), jnp.float32)
 
-    def branch(cache_in, sfx, sfx_mask, new_tokens, d_ids, d_vals):
+    def branch(cache_in, sfx, sfx_mask, new_tokens, d_ids, d_vals,
+               stop_mask=None):
         S2 = sfx.shape[1]
         cm = jnp.concatenate(
             [prefix_mask, sfx_mask,
@@ -186,12 +226,15 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
         logits_l, cache2, pos = decoder.extend(
             params, cfg, cache_in, sfx, sfx_mask, cm, S)
         return _fused_tail(params, cfg, logits_l, cache2, cm, pos, S + S2,
-                           yes_ids, no_ids, d_ids, d_vals, new_tokens, topk)
+                           yes_ids, no_ids, d_ids, d_vals, new_tokens, topk,
+                           stop_mask=stop_mask, eos_id=eos_id)
 
     out_a, cache_a = branch(cache, sfx_a, sfx_a_mask, max_new_a,
                             empty_ids, empty_vals)
+    # The confidence branch (B) takes the digit table and, when provided,
+    # the digit early stop — only its first complete integer is read.
     out_b, _ = branch(cache_a, sfx_b, sfx_b_mask, max_new_b,
-                      digit_ids, digit_vals)
+                      digit_ids, digit_vals, stop_mask=stop_mask_b)
     return out_a, out_b
 
 

@@ -128,6 +128,23 @@ class ScoringEngine:
                     f"reduce max_new_tokens or max_seq_len")
             self.buckets = fitting
         self._digit_table: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._digit_stop_mask: Any = False  # False = not resolved yet
+
+    @property
+    def digit_stop_mask(self) -> Optional[jax.Array]:
+        """(V,) bool device array for the confidence early stop, or None
+        when this tokenizer can't provide per-token strings (or has no EOS
+        to signal the stop with) — callers then decode the full budget."""
+        if self._digit_stop_mask is False:
+            mask = None
+            if self.eos_id is not None:
+                with self._tok_lock:
+                    m = tok.digit_token_mask(self.tokenizer,
+                                             self.cfg.vocab_size)
+                if m is not None:
+                    mask = jnp.asarray(m)
+            self._digit_stop_mask = mask
+        return self._digit_stop_mask
 
     @property
     def digit_table(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -159,7 +176,8 @@ class ScoringEngine:
     def decode_fused(self, prompts: Sequence[str], yes_ids: np.ndarray,
                      no_ids: np.ndarray, with_digits: bool = False,
                      max_new_tokens: Optional[int] = None,
-                     pretokenized: Optional[Sequence[Sequence[int]]] = None):
+                     pretokenized: Optional[Sequence[Sequence[int]]] = None,
+                     early_stop: bool = False):
         """The production scoring path: one jitted decode with the C13/D6
         readouts fused into the scan (no (B, T, V) logit stack). Decoder-only
         models only; T5 keeps the capture path (tiny vocab stacks).
@@ -167,7 +185,10 @@ class ScoringEngine:
         ``max_new_tokens`` overrides the runtime default (the perturbation
         sweep passes its short per-cell budget, config.RuntimeConfig).
         ``pretokenized`` skips tokenization when the caller already holds
-        the token ids (the shared-prefix fallback path)."""
+        the token ids (the shared-prefix fallback path). ``early_stop``
+        enables the confidence digit early stop (generate._fused_tail) when
+        the tokenizer supports it — only valid for calls whose downstream
+        readout is position-0 + first-integer parse."""
         assert not self.encoder_decoder
         toks, mask = self._pad_batch(prompts, pretokenized)
         if with_digits:
@@ -175,18 +196,22 @@ class ScoringEngine:
         else:
             digit_ids = np.zeros((0,), np.int32)
             digit_vals = np.zeros((0,), np.float32)
+        stop_mask = self.digit_stop_mask if early_stop else None
         return generate.greedy_decode_fused(
             self.params, self.cfg, toks, mask,
             jnp.asarray(yes_ids, jnp.int32), jnp.asarray(no_ids, jnp.int32),
             jnp.asarray(digit_ids), jnp.asarray(digit_vals),
             max_new_tokens=(self.rt.max_new_tokens if max_new_tokens is None
                             else max_new_tokens),
-            prefill_fn=self._prefill_fn)
+            prefill_fn=self._prefill_fn, stop_mask=stop_mask,
+            eos_id=(None if stop_mask is None
+                    else jnp.int32(self.eos_id)))
 
     def decode_fused_shared(self, binary_prompts: Sequence[str],
                             confidence_prompts: Sequence[str],
                             yes_ids: np.ndarray, no_ids: np.ndarray,
-                            new_tokens: int, conf_tokens: int):
+                            new_tokens: int, conf_tokens: int,
+                            early_stop: bool = False):
         """Score BOTH sweep formats with ONE shared-prefix prefill.
 
         Each grid cell's binary and confidence prompts share the long
@@ -258,13 +283,15 @@ class ScoringEngine:
             cfused = self.decode_fused(confidence_prompts, yes_ids, no_ids,
                                        with_digits=True,
                                        max_new_tokens=conf_tokens,
-                                       pretokenized=conf_ids)
+                                       pretokenized=conf_ids,
+                                       early_stop=early_stop)
             return fused, cfused
         prefix, prefix_mask = tok.left_pad_ids(
             [a[:n] for a, n in zip(bin_ids, lcp)], bucket, pad_id)
         sfx_a, sfx_a_mask = tok.right_pad_ids(sfx_a_ids, ba, pad_id)
         sfx_b, sfx_b_mask = tok.right_pad_ids(sfx_b_ids, bb, pad_id)
         digit_ids, digit_vals = self.digit_table
+        stop_mask = self.digit_stop_mask if early_stop else None
         return generate.greedy_decode_fused_shared(
             self.params, self.cfg, jnp.asarray(prefix),
             jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
@@ -273,7 +300,9 @@ class ScoringEngine:
             jnp.asarray(yes_ids, jnp.int32), jnp.asarray(no_ids, jnp.int32),
             jnp.asarray(digit_ids), jnp.asarray(digit_vals),
             max_new_a=new_tokens, max_new_b=conf_tokens,
-            prefill_fn=self._prefill_fn)
+            prefill_fn=self._prefill_fn, stop_mask_b=stop_mask,
+            eos_id=(None if stop_mask is None
+                    else jnp.int32(self.eos_id)))
 
     def decode_completion(self, generated_ids: np.ndarray) -> str:
         """Token ids -> text, stopping at the first EOS (HF generate parity —

@@ -334,7 +334,9 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
             fused, cfused = engine.decode_fused_shared(
                 [c.binary_prompt for c in full],
                 [c.confidence_prompt for c in full],
-                t1, t2, new_tokens=new_tokens, conf_tokens=conf_tokens)
+                t1, t2, new_tokens=new_tokens, conf_tokens=conf_tokens,
+                early_stop=(engine.rt.sweep_early_stop
+                            and not engine.rt.sweep_full_completions))
             res = score_mod.readout_from_fused(
                 fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
             work_q.put((batch, fused, res, cfused))

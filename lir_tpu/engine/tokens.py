@@ -12,6 +12,7 @@ rule lives, and tests pin it per family.
 
 from __future__ import annotations
 
+import re
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -69,6 +70,58 @@ def integer_token_table(tokenizer, lo: int = 0, hi: int = 100
                 ids.append(int(toks[0]))
                 vals.append(float(v))
     return np.asarray(ids, np.int32), np.asarray(vals, np.float32)
+
+
+def digit_token_mask(tokenizer, vocab_size: int) -> Optional[np.ndarray]:
+    """(vocab_size,) bool — True where the token's surface string contains a
+    decimal digit. Feeds the confidence decode's early stop: a row whose
+    text has shown a digit-containing token followed by a digit-free one has
+    a COMPLETE first integer, which is all ``_parse_confidence`` reads
+    (perturb_prompts.py:500-502).
+
+    Needs real per-token strings, so it requires ``convert_ids_to_tokens``
+    (HF tokenizers). Returns None when the tokenizer can't provide them
+    (e.g. the test FakeTokenizer renders every id as '<123>' — treating
+    those as digits would stop after two tokens); callers disable the early
+    stop then.
+    """
+    convert = getattr(tokenizer, "convert_ids_to_tokens", None)
+    if convert is None:
+        return None
+    # Model vocab may be padded past the tokenizer's (e.g. multiple-of-128
+    # embedding tables): only real ids get strings; padding rows are never
+    # digits (and never argmax winners in a trained model anyway).
+    try:
+        n = min(vocab_size, len(tokenizer))
+    except TypeError:
+        n = vocab_size
+    try:
+        toks = convert(list(range(n)))
+    except Exception:  # noqa: BLE001 — added-token gaps
+        return None
+    digits = set("0123456789")
+    byte_form = re.compile(r"<0[xX]([0-9A-Fa-f]{2})>")
+    special_form = re.compile(r"<[^<>]*>")
+
+    def _has_digit(t) -> bool:
+        if t is None:
+            return False
+        # Surface forms are NOT always text: sentencepiece byte-fallback
+        # tokens render as '<0xNN>' (digits in the surface, one raw byte in
+        # the decode — only 0x30-0x39 are digit bytes), and bracketed
+        # specials ('</s>', '<|reserved_special_token_0|>') decode to
+        # nothing. Treating those surface digits as response digits would
+        # stop a reply at e.g. a leading newline (<0x0A>) byte.
+        m = byte_form.fullmatch(t)
+        if m:
+            return chr(int(m.group(1), 16)) in digits
+        if special_form.fullmatch(t):
+            return False
+        return any(c in digits for c in t)
+
+    mask = np.zeros((vocab_size,), dtype=bool)
+    mask[:n] = [_has_digit(t) for t in toks]
+    return mask
 
 
 def pad_token_id(tokenizer) -> int:

@@ -346,6 +346,95 @@ def test_engine_decode_fused_shared_matches_decode_fused():
                                rtol=1e-4, atol=1e-6)
 
 
+def test_fused_decode_digit_early_stop_mechanics():
+    """Early-stopped fused decode vs the plain run: each row's tokens match
+    the full decode until its stop point (EOS, or a digit-free token after a
+    digit-bearing one), then the row emits EOS fill; position-0 readouts are
+    bitwise identical. Replayed host-side from the full run's tokens."""
+    cfg = _MC(name="earlystop-smoke", vocab_size=256, hidden_size=32,
+              n_layers=2, n_heads=4, intermediate_size=64, max_seq_len=128)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(7))
+    rng = np.random.default_rng(11)
+    toks = rng.integers(3, 256, size=(4, 8)).astype(np.int32)
+    mask = np.ones_like(toks)
+    t1 = np.full((4,), 10, np.int32)
+    t2 = np.full((4,), 11, np.int32)
+    eos = 5
+    stop = (np.arange(256) % 2 == 0)   # even ids read as digit-bearing
+    stop[eos] = False
+    T = 12
+    kw = dict(max_new_tokens=T)
+    full = generate.greedy_decode_fused(
+        params, cfg, jnp.asarray(toks), jnp.asarray(mask),
+        jnp.asarray(t1), jnp.asarray(t2), jnp.zeros((0,), jnp.int32),
+        jnp.zeros((0,), jnp.float32), **kw)
+    early = generate.greedy_decode_fused(
+        params, cfg, jnp.asarray(toks), jnp.asarray(mask),
+        jnp.asarray(t1), jnp.asarray(t2), jnp.zeros((0,), jnp.int32),
+        jnp.zeros((0,), jnp.float32), stop_mask=jnp.asarray(stop),
+        eos_id=jnp.int32(eos), **kw)
+    g_full = np.asarray(full.generated)
+    g_early = np.asarray(early.generated)
+    stopped = 0
+    for j in range(4):
+        expect, done, digit_seen = [], False, False
+        for t in range(T):
+            emit = eos if done else int(g_full[j, t])
+            expect.append(emit)
+            is_digit = bool(stop[emit])
+            done = done or emit == eos or (digit_seen and not is_digit)
+            digit_seen = digit_seen or is_digit
+        stopped += done
+        np.testing.assert_array_equal(g_early[j], expect)
+    assert stopped == 4, "seeded run should stop every row inside the budget"
+    # Position-0 readouts are computed before any step runs — identical.
+    np.testing.assert_array_equal(np.asarray(early.topk_ids),
+                                  np.asarray(full.topk_ids))
+    np.testing.assert_allclose(np.asarray(early.p_yes[:, 0]),
+                               np.asarray(full.p_yes[:, 0]), rtol=1e-6)
+
+
+def test_digit_token_mask_byte_fallback_and_specials():
+    """Surface forms are not text: '<0x0A>' (newline byte) and bracketed
+    specials contain digit CHARACTERS but decode to no digits — marking
+    them digit-bearing would stop a confidence reply at a leading newline.
+    Only true digit bytes (0x30-0x39) and real digit text count."""
+    class Stub:
+        def convert_ids_to_tokens(self, ids):
+            table = ["▁Yes", "▁85", "<0x0A>", "<0x30>", "</s>",
+                     "<|reserved_special_token_0|>", "a1b", "100"]
+            return [table[i] for i in ids]
+
+        def __len__(self):
+            return 8
+
+    mask = tok.digit_token_mask(Stub(), 8)
+    np.testing.assert_array_equal(
+        mask, [False, True, False, True, False, False, True, True])
+
+
+def test_engine_early_stop_disabled_without_token_strings():
+    """FakeTokenizer renders ids as '<123>' and exposes no per-token
+    strings: the engine must resolve digit_stop_mask to None and score
+    identically with early_stop on/off (the bench stays budget-honest)."""
+    cfg = _MC(name="nostop-smoke", vocab_size=FakeTokenizer.VOCAB,
+              hidden_size=64, n_layers=2, n_heads=4, intermediate_size=128,
+              max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(8))
+    engine = ScoringEngine(params, cfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=2, max_seq_len=256))
+    assert engine.digit_stop_mask is None
+    prompts = ["is a levee failure a flood", "is rust damage covered"]
+    t1 = np.full((2,), FakeTokenizer.YES, np.int32)
+    t2 = np.full((2,), FakeTokenizer.NO, np.int32)
+    on = engine.decode_fused(prompts, t1, t2, with_digits=True,
+                             max_new_tokens=6, early_stop=True)
+    off = engine.decode_fused(prompts, t1, t2, with_digits=True,
+                              max_new_tokens=6, early_stop=False)
+    np.testing.assert_array_equal(np.asarray(on.generated),
+                                  np.asarray(off.generated))
+
+
 def test_shared_prefix_len_caps_for_nonempty_suffix():
     a = [1, 2, 3, 4]
     assert tok.shared_prefix_len(a, a) == 3          # strict-prefix guard

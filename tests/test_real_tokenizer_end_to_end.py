@@ -240,6 +240,56 @@ def test_sentencepiece_unmocked_score_matches_torch(sp_checkpoint):
     assert abs(row.relative_prob - ref_rel) <= 0.01 * max(ref_rel, 1e-9)
 
 
+def test_digit_stop_mask_and_early_stop_sweep_equivalence(sp_checkpoint,
+                                                          tmp_path):
+    """The confidence early stop on a REAL metaspace tokenizer: the digit
+    mask marks exactly the digit-bearing pieces, and a sweep with the early
+    stop records the SAME Confidence Value / Weighted Confidence / binary
+    probs as one without it — only decode steps are saved, never answers."""
+    import dataclasses
+
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+
+    path, _, fast = sp_checkpoint
+    rt = RuntimeConfig(batch_size=2, max_new_tokens=8, max_seq_len=128)
+    engine = load_engine(path, rt)
+    assert engine.digit_stop_mask is not None
+    mask = np.asarray(engine.digit_stop_mask)
+    assert mask[fast(" 85", add_special_tokens=False).input_ids[0]]
+    assert mask[fast("100", add_special_tokens=False).input_ids[0]]
+    assert not mask[engine.yes_id] and not mask[engine.no_id]
+    assert not mask[fast.eos_token_id]
+
+    lp = (LegalPrompt(
+        main="Is a tomato a vegetable?",
+        response_format="Answer either 'Yes' or 'No'.",
+        target_tokens=("Yes", "No"),
+        confidence_format="Give a confidence number from 0 to 100"),)
+    perts = (["Is a tomato really a vegetable?",
+              "Would a tomato count as a vegetable?",
+              "Is a tomato considered a vegetable?"],)
+
+    def sweep(early, sub):
+        eng = load_engine(path, dataclasses.replace(rt, sweep_early_stop=early))
+        d = tmp_path / sub
+        d.mkdir()
+        return run_perturbation_sweep(eng, "sp-llama", lp, perts,
+                                      d / "d6.xlsx")
+
+    rows_es, rows_no = sweep(True, "es"), sweep(False, "no")
+    assert len(rows_es) == len(rows_no) == 4
+    for a, b in zip(rows_es, rows_no):
+        assert a.confidence_value == b.confidence_value
+        np.testing.assert_allclose(a.weighted_confidence,
+                                   b.weighted_confidence, rtol=1e-5)
+        np.testing.assert_allclose(a.token_1_prob, b.token_1_prob, rtol=1e-5)
+        # The early-stopped text is the full text truncated at the row's
+        # stop point (EOS fill decodes away) — never different content.
+        assert b.model_confidence_response.startswith(
+            a.model_confidence_response)
+
+
 def test_sentencepiece_perturbation_sweep_shared_prefix(sp_checkpoint,
                                                        tmp_path):
     """The shared-prefix sweep path (LCP token split + suffix extension)

@@ -60,6 +60,11 @@ def main() -> None:
     ap.add_argument("--model", default="llama2_7b",
                     help="registry preset for the full-size run "
                          "(default llama2_7b)")
+    ap.add_argument("--conf-tokens", type=int, default=None,
+                    help="override RuntimeConfig.sweep_confidence_tokens "
+                         "(budget x throughput table for SCALE.md)")
+    ap.add_argument("--decode-tokens", type=int, default=None,
+                    help="override RuntimeConfig.sweep_decode_tokens")
     ap.add_argument("--no-record", action="store_true",
                     help="print only; do not append to SCALE.md")
     args = ap.parse_args()
@@ -114,7 +119,13 @@ def main() -> None:
 
     rt = RuntimeConfig(batch_size=args.batch,
                        max_seq_len=max(512, 2 * args.words))
+    if args.conf_tokens is not None:
+        rt = dataclasses.replace(rt, sweep_confidence_tokens=args.conf_tokens)
+    if args.decode_tokens is not None:
+        rt = dataclasses.replace(rt, sweep_decode_tokens=args.decode_tokens)
     engine = ScoringEngine(params, cfg, FakeTokenizer(), rt)
+    mode += (f", budgets bin={rt.sweep_decode_tokens}"
+             f"/conf={rt.sweep_confidence_tokens}")
 
     rng = np.random.default_rng(7)
     lp = (LegalPrompt(
