@@ -167,6 +167,15 @@ def expected_compliance_tokens(
     prompt: LegalPrompt, prompt_idx: Optional[int] = None
 ) -> Dict[str, object]:
     t1, t2 = prompt.target_tokens
+    # The reference's expected-token table (:1207-1248) lists first tokens
+    # in the RESPONSE FORMAT's presentation order ('First, Ultimate'), not
+    # the readout's token_1/token_2 order ('Ultimate, First') — order the
+    # report identically (membership semantics are unaffected).
+    order = (t1, t2)
+    fmt = prompt.response_format
+    pos = {t: fmt.find(f"'{t}") for t in order}
+    if all(p >= 0 for p in pos.values()):
+        order = tuple(sorted(order, key=lambda t: pos[t]))
     full: Dict[str, List[str]] = {}
     for token in (t1, t2):
         # Reconstruct the allowed answer phrases from the response format:
@@ -183,7 +192,7 @@ def expected_compliance_tokens(
         if prompt_idx is not None:
             phrases.extend(EXTRA_FULL_RESPONSES.get(prompt_idx, {}).get(token, []))
         full[token] = phrases or [token]
-    return {"first_tokens": [t1, t2], "full_responses": full}
+    return {"first_tokens": list(order), "full_responses": full}
 
 
 def parse_logprob_content(raw) -> Optional[Tuple[str, str]]:

@@ -81,10 +81,15 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
     generated k/v at cache slots ``slot0 + t``, capture the C13/D6 readouts
     in-scan. Returns (FusedDecodeOut, final cache).
 
-    ``stop_mask`` ((V,) bool: token string contains a digit) + ``eos_id``
-    enable the confidence early stop: a row is DONE once it emits EOS or a
-    digit-free token after a digit-bearing one (its first integer —
-    the only thing the confidence parse reads — is then complete). Done
+    ``stop_mask`` ((V,) int32 surface-class bitmask from
+    tokens.digit_stop_classes) + ``eos_id`` enable the confidence early
+    stop: a row is DONE once it emits EOS, or once a standalone digit run
+    (pure digit tokens opened at a word boundary) is followed by a
+    non-gluing token — at that point the decoded text provably contains a
+    complete ``\\b\\d+\\b`` integer, the only thing the confidence parse
+    reads. Letter-glued digits ('1'+'st') neither open nor terminate a
+    run, and transparent specials (empty decode) change nothing, so the
+    stop NEVER nulls an answer the full budget would have parsed. Done
     rows emit EOS from the next step (so host-side EOS trimming ends their
     text at the stop point), and once EVERY row is done the remaining scan
     steps skip the model forward via a scalar ``lax.cond`` — a generous
@@ -106,15 +111,28 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
     B = logits0.shape[0]
 
     def step(carry, t):
-        logits, cache, cache_mask, done, digit_seen = carry
+        logits, cache, cache_mask, done, digit_run, prev_ew = carry
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         p_yes, p_no, top2 = _small_readout(logits, yes_ids, no_ids)
         cache_mask = cache_mask.at[:, slot0 + t].set(1)
         if early_stop:
             emit = jnp.where(done, eos_id, nxt)
-            is_digit = stop_mask[emit]
-            done = done | (emit == eos_id) | (digit_seen & ~is_digit)
-            digit_seen = digit_seen | is_digit
+            cls = stop_mask[emit]
+            pure = (cls & 1) != 0          # tokens.STOP_PURE
+            prefix = (cls & 2) != 0        # tokens.STOP_PREFIX
+            glue = (cls & 4) != 0          # tokens.STOP_STARTS_WORD
+            ends_w = (cls & 8) != 0        # tokens.STOP_ENDS_WORD
+            transp = (cls & 16) != 0       # tokens.STOP_TRANSPARENT
+            done = done | (emit == eos_id) | (digit_run & ~glue & ~transp)
+            # A standalone digit run opens on a pure-digit token at a word
+            # boundary (space prefix, or previous token ended non-word —
+            # position 0 starts at a boundary: prev_ew init False), extends
+            # through unprefixed pure-digit tokens, and is spoiled by
+            # anything else. Transparent tokens freeze all text state.
+            digit_run = jnp.where(
+                transp, digit_run,
+                (pure & (prefix | ~prev_ew)) | (digit_run & pure & ~prefix))
+            prev_ew = jnp.where(transp, prev_ew, ends_w)
 
             def run(args):
                 lg, c = args
@@ -127,12 +145,12 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
             emit = nxt
             new_logits, cache = decoder.decode_step(
                 params, cfg, cache, emit, pos0 + t, slot0 + t, cache_mask)
-        return ((new_logits, cache, cache_mask, done, digit_seen),
+        return ((new_logits, cache, cache_mask, done, digit_run, prev_ew),
                 (emit, p_yes, p_no, top2))
 
-    done0 = jnp.zeros((B,), bool)
-    (_, cache_f, _, _, _), (gen, p_yes, p_no, top2) = lax.scan(
-        step, (logits0, cache, cache_mask0, done0, jnp.zeros((B,), bool)),
+    zeros_b = jnp.zeros((B,), bool)
+    (_, cache_f, _, _, _, _), (gen, p_yes, p_no, top2) = lax.scan(
+        step, (logits0, cache, cache_mask0, zeros_b, zeros_b, zeros_b),
         jnp.arange(max_new_tokens))
 
     return FusedDecodeOut(

@@ -132,15 +132,16 @@ class ScoringEngine:
 
     @property
     def digit_stop_mask(self) -> Optional[jax.Array]:
-        """(V,) bool device array for the confidence early stop, or None
-        when this tokenizer can't provide per-token strings (or has no EOS
-        to signal the stop with) — callers then decode the full budget."""
+        """(V,) int32 surface-class device array for the confidence early
+        stop (tokens.digit_stop_classes), or None when this tokenizer can't
+        provide per-token strings (or has no EOS to signal the stop with) —
+        callers then decode the full budget."""
         if self._digit_stop_mask is False:
             mask = None
             if self.eos_id is not None:
                 with self._tok_lock:
-                    m = tok.digit_token_mask(self.tokenizer,
-                                             self.cfg.vocab_size)
+                    m = tok.digit_stop_classes(self.tokenizer,
+                                               self.cfg.vocab_size)
                 if m is not None:
                     mask = jnp.asarray(m)
             self._digit_stop_mask = mask

@@ -72,25 +72,47 @@ def integer_token_table(tokenizer, lo: int = 0, hi: int = 100
     return np.asarray(ids, np.int32), np.asarray(vals, np.float32)
 
 
-def digit_token_mask(tokenizer, vocab_size: int) -> Optional[np.ndarray]:
-    """(vocab_size,) bool — True where the token's surface string contains a
-    decimal digit. Feeds the confidence decode's early stop: a row whose
-    text has shown a digit-containing token followed by a digit-free one has
-    a COMPLETE first integer, which is all ``_parse_confidence`` reads
-    (perturb_prompts.py:500-502).
+# Bit flags of digit_stop_classes (the confidence early stop's per-token
+# surface classification; consumed by generate._fused_tail).
+STOP_PURE = 1         # surface (after any space prefix) is digits only
+STOP_PREFIX = 2       # surface begins with a word-boundary prefix (▁/Ġ/ws)
+STOP_STARTS_WORD = 4  # glues onto the previous token (first char is a word
+                      # char with NO space prefix — "st" after "1" = "1st")
+STOP_ENDS_WORD = 8    # last decoded char is a word char
+STOP_TRANSPARENT = 16  # decodes to nothing (bracketed specials): invisible
+                       # to the text, so it must not start/stop anything
 
-    Needs real per-token strings, so it requires ``convert_ids_to_tokens``
-    (HF tokenizers). Returns None when the tokenizer can't provide them
-    (e.g. the test FakeTokenizer renders every id as '<123>' — treating
-    those as digits would stop after two tokens); callers disable the early
-    stop then.
+_SPACE_PREFIX = ("▁", "Ġ", "Ċ", " ", "\t", "\n", "\r")
+_BYTE_FORM = re.compile(r"<0[xX]([0-9A-Fa-f]{2})>")
+_SPECIAL_FORM = re.compile(r"<[^<>]*>")
+
+
+def _is_word(c: str) -> bool:
+    """Unicode word character, matching the ``\\b`` semantics of the
+    confidence parse's ``\\b\\d+\\b`` ('è' is a word char: '2ème' has no
+    boundary after the 2, so it must read as glue here too)."""
+    return c.isalnum() or c == "_"
+
+
+def digit_stop_classes(tokenizer, vocab_size: int) -> Optional[np.ndarray]:
+    """(vocab_size,) int32 bitmask classifying every token's DECODED
+    surface for the confidence early stop (generate._fused_tail): the scan
+    may halt a row only once its text provably contains a complete
+    standalone integer — the exact ``\\b(\\d+)\\b`` ``_parse_confidence``
+    reads (perturb_prompts.py:500-502). "contains a digit" alone is wrong
+    both ways: '<0x0A>' has a surface digit but decodes to a newline, and
+    '1'+'st' shows a digit the parse can never match ("1st" has no word
+    boundary after the 1).
+
+    Needs real per-token strings (``convert_ids_to_tokens``); returns None
+    otherwise (e.g. the test FakeTokenizer) and callers disable the stop.
     """
     convert = getattr(tokenizer, "convert_ids_to_tokens", None)
     if convert is None:
         return None
-    # Model vocab may be padded past the tokenizer's (e.g. multiple-of-128
-    # embedding tables): only real ids get strings; padding rows are never
-    # digits (and never argmax winners in a trained model anyway).
+    # Model vocab may be padded past the tokenizer's (multiple-of-128
+    # embedding tables): padding rows class 0 (never argmax in a trained
+    # model anyway).
     try:
         n = min(vocab_size, len(tokenizer))
     except TypeError:
@@ -99,28 +121,30 @@ def digit_token_mask(tokenizer, vocab_size: int) -> Optional[np.ndarray]:
         toks = convert(list(range(n)))
     except Exception:  # noqa: BLE001 — added-token gaps
         return None
-    digits = set("0123456789")
-    byte_form = re.compile(r"<0[xX]([0-9A-Fa-f]{2})>")
-    special_form = re.compile(r"<[^<>]*>")
 
-    def _has_digit(t) -> bool:
+    def _classify(t) -> int:
         if t is None:
-            return False
-        # Surface forms are NOT always text: sentencepiece byte-fallback
-        # tokens render as '<0xNN>' (digits in the surface, one raw byte in
-        # the decode — only 0x30-0x39 are digit bytes), and bracketed
-        # specials ('</s>', '<|reserved_special_token_0|>') decode to
-        # nothing. Treating those surface digits as response digits would
-        # stop a reply at e.g. a leading newline (<0x0A>) byte.
-        m = byte_form.fullmatch(t)
+            return 0
+        m = _BYTE_FORM.fullmatch(t)
         if m:
-            return chr(int(m.group(1), 16)) in digits
-        if special_form.fullmatch(t):
-            return False
-        return any(c in digits for c in t)
+            t = chr(int(m.group(1), 16))   # the byte's actual character
+        elif _SPECIAL_FORM.fullmatch(t):
+            return STOP_TRANSPARENT
+        stripped = t.lstrip("".join(_SPACE_PREFIX))
+        prefix = len(stripped) < len(t)
+        cls = STOP_PREFIX if prefix else 0
+        if stripped and all(c in "0123456789" for c in stripped):
+            cls |= STOP_PURE
+        if stripped and not prefix and _is_word(stripped[0]):
+            cls |= STOP_STARTS_WORD
+        # ENDS_WORD reads the DECODED tail: a prefix-only token ('Ġ' is a
+        # letter codepoint but decodes to a space) ends at a boundary.
+        if stripped and _is_word(stripped[-1]):
+            cls |= STOP_ENDS_WORD
+        return cls
 
-    mask = np.zeros((vocab_size,), dtype=bool)
-    mask[:n] = [_has_digit(t) for t in toks]
+    mask = np.zeros((vocab_size,), dtype=np.int32)
+    mask[:n] = [_classify(t) for t in toks]
     return mask
 
 

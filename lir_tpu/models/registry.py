@@ -175,6 +175,21 @@ def qwen_7b() -> ModelConfig:
                        use_flash_attention=False)
 
 
+def pythia_69b() -> ModelConfig:
+    """EleutherAI/pythia-6.9b at real size (gptneox: partial rotary 0.25,
+    parallel block, LayerNorm) — the base half of the dolly-v2 pair
+    (compare_base_vs_instruct.py:136-180)."""
+    return gptneox(name="pythia-6.9b")
+
+
+def h2ogpt_12b() -> ModelConfig:
+    """h2oai/h2ogpt-oasst1-512-12b — the reference zoo's largest model
+    (compare_instruct_models.py:145-166). Pythia-12b architecture:
+    gptneox with hidden 5120 / 36 layers / 40 heads / vocab 50688."""
+    return gptneox(name="h2ogpt-oasst1-512-12b", hidden=5120, layers=36,
+                   heads=40, vocab=50688)
+
+
 def baichuan2_7b() -> ModelConfig:
     return ModelConfig(name="baichuan2-7b", vocab_size=125696, hidden_size=4096,
                        n_layers=32, n_heads=32, intermediate_size=11008,

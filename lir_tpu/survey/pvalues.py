@@ -85,15 +85,21 @@ def llm_correlations_with_pvalues(
     for i in range(len(models)):
         for j in range(i + 1, len(models)):
             n = int(counts[i, j])
-            if n > min_questions and np.isfinite(corr[i, j]):
+            if n > min_questions:
+                # Constant-input pairs keep their row with a NaN
+                # correlation, exactly as the reference records them
+                # (:83-92 appends pearsonr's NaN); every consumer filters
+                # non-finite values (compare_correlation_distributions).
+                finite = bool(np.isfinite(corr[i, j]))
                 out.append(
                     {
                         "model1": models[i],
                         "model2": models[j],
                         "correlation": float(corr[i, j]),
-                        "p_value": float(pvals[i, j]),
+                        "p_value": float(pvals[i, j]) if finite
+                        else float("nan"),
                         "n_questions": n,
-                        "significant": bool(pvals[i, j] < 0.05),
+                        "significant": bool(finite and pvals[i, j] < 0.05),
                     }
                 )
     return out
@@ -170,6 +176,8 @@ def compare_correlation_distributions(
     cohens_d = float((llm_vals.mean() - human_vals.mean()) / pooled_std)
 
     def _stats_block(vals, rows):
+        # Rates are over VALID (finite-correlation) rows, matching the
+        # reference's valid_*_correlations denominators (:162-176).
         sig = sum(1 for c in rows if c["significant"])
         return {
             "mean": float(vals.mean()),
@@ -177,7 +185,7 @@ def compare_correlation_distributions(
             "median": float(np.median(vals)),
             "n_pairs": int(vals.size),
             "significant_pairs": sig,
-            "proportion_significant": sig / len(rows) if rows else 0,
+            "proportion_significant": sig / int(vals.size) if vals.size else 0,
         }
 
     return {

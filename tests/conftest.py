@@ -32,6 +32,22 @@ import pytest  # noqa: E402
 REFERENCE_DATA = "/root/reference/data"
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavy tests (virtual-mesh TP/PP/seq-parallel, "
+        "executed-reference differentials, torch differentials at size) — "
+        "excluded from the fast inner loop")
+    config.addinivalue_line(
+        "markers", "fast: auto-applied complement of slow; "
+        "`pytest -m fast` is the ~90s inner loop")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.get_closest_marker("slow") is None:
+            item.add_marker(pytest.mark.fast)
+
+
 @pytest.fixture(scope="session")
 def reference_data_dir():
     """Golden reference CSVs; skip golden-parity tests when not mounted."""

@@ -92,6 +92,7 @@ def base_df(reference_data_dir):
     return pd.read_csv(f"{reference_data_dir}/model_comparison_results.csv")
 
 
+@pytest.mark.slow
 class TestPerturbationAnalysis:
     def test_relative_prob(self, synthetic_df):
         df = add_relative_prob(synthetic_df)
@@ -216,6 +217,7 @@ class TestPerturbationAnalysis:
         assert (tmp_path / "tiny" / "summary_statistics.csv").exists()
 
 
+@pytest.mark.slow
 class TestBaseVsInstruct:
     def test_family_stats_match_direct(self, base_df):
         res = family_differences(base_df)
@@ -258,6 +260,7 @@ class TestBaseVsInstruct:
         assert len(res["statistics"]) > 0
 
 
+@pytest.mark.slow
 class TestKappaCombined:
     def test_prepare_model_data(self, instruct_df):
         prepared = prepare_model_data(instruct_df)
@@ -296,6 +299,7 @@ class TestKappaCombined:
         assert isinstance(res["combined"], dict)
 
 
+@pytest.mark.slow
 class TestModelGraph:
     def test_correlation_matrix_matches_pandas(self, instruct_df, tmp_path):
         res = run_model_graph_analysis(

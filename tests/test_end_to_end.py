@@ -23,6 +23,8 @@ from lir_tpu.engine.sweep import run_perturbation_sweep
 from lir_tpu.models.loader import config_from_hf, convert_decoder
 from lir_tpu.utils.manifest import SweepManifest
 
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
 
 @pytest.fixture(scope="module")
 def engine():

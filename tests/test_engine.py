@@ -80,6 +80,7 @@ def test_weighted_confidence():
 # Greedy decode vs repeated full forward
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_greedy_decode_matches_full_forward():
     params, cfg, hf = _tiny_llama_params()
     rng = np.random.default_rng(0)
@@ -106,6 +107,7 @@ def test_greedy_decode_matches_full_forward():
 # End-to-end batched scorer with the fake tokenizer
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_scoring_engine_end_to_end():
     tokenizer = FakeTokenizer()
     params, cfg, _ = _tiny_llama_params(vocab=FakeTokenizer.VOCAB)
@@ -137,6 +139,7 @@ def test_fake_tokenizer_yes_no_ids():
 # Sharded forward on the 8-virtual-device mesh
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_sharded_forward_matches_single_device():
     params, cfg, _ = _tiny_llama_params()
     mesh = sharding.build_mesh(MeshConfig(data=2, model=4))
@@ -152,6 +155,7 @@ def test_sharded_forward_matches_single_device():
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_sharded_greedy_decode():
     params, cfg, _ = _tiny_llama_params()
     mesh = sharding.build_mesh(MeshConfig(data=2, model=4))
@@ -171,6 +175,7 @@ def test_sharded_greedy_decode():
                                atol=1e-3, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_fused_decode_matches_capture_path():
     """The fused in-scan readout must equal the full-logit-capture path
     bit-for-bit on every field the sweeps consume."""
@@ -239,6 +244,7 @@ from lir_tpu.models.registry import ModelConfig as _MC
     ("bloom", False),   # ALiBi + embedding LayerNorm
     ("gpt2", False),    # learned positions + tied embeddings
 ])
+@pytest.mark.slow
 def test_shared_prefix_decode_matches_full_prompts(family, int8kv):
     """greedy_decode_fused_shared == two greedy_decode_fused calls on the
     concatenated prompts, for every position-dependent readout. Rows have
@@ -311,6 +317,7 @@ def test_shared_prefix_decode_matches_full_prompts(family, int8kv):
                                np.asarray(ref_b.weighted_confidence), **tol)
 
 
+@pytest.mark.slow
 def test_engine_decode_fused_shared_matches_decode_fused():
     """Runner-level: tokenize/LCP-split/pad host prep reproduces the plain
     decode_fused readouts on real prompt strings (FakeTokenizer)."""
@@ -346,11 +353,13 @@ def test_engine_decode_fused_shared_matches_decode_fused():
                                rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_fused_decode_digit_early_stop_mechanics():
     """Early-stopped fused decode vs the plain run: each row's tokens match
-    the full decode until its stop point (EOS, or a digit-free token after a
-    digit-bearing one), then the row emits EOS fill; position-0 readouts are
-    bitwise identical. Replayed host-side from the full run's tokens."""
+    the full decode until its stop point (EOS, or a standalone digit run
+    followed by a non-gluing token), then the row emits EOS fill;
+    position-0 readouts are bitwise identical. Replayed host-side from the
+    full run's tokens with the same class machine."""
     cfg = _MC(name="earlystop-smoke", vocab_size=256, hidden_size=32,
               n_layers=2, n_heads=4, intermediate_size=64, max_seq_len=128)
     params = decoder.init_params(cfg, jax.random.PRNGKey(7))
@@ -360,9 +369,14 @@ def test_fused_decode_digit_early_stop_mechanics():
     t1 = np.full((4,), 10, np.int32)
     t2 = np.full((4,), 11, np.int32)
     eos = 5
-    stop = (np.arange(256) % 2 == 0)   # even ids read as digit-bearing
-    stop[eos] = False
-    T = 12
+    # Synthetic vocab surface classes: ids 0-2 mod 4 cycle through
+    # "▁85"-like (PURE|PREFIX|ENDS_WORD), ","-like (0), "st"-like
+    # (STARTS_WORD|ENDS_WORD); eos id is TRANSPARENT.
+    cls = np.zeros((256,), np.int32)
+    cls[np.arange(256) % 4 == 0] = tok.STOP_PURE | tok.STOP_PREFIX | tok.STOP_ENDS_WORD
+    cls[np.arange(256) % 4 == 2] = tok.STOP_STARTS_WORD | tok.STOP_ENDS_WORD
+    cls[eos] = tok.STOP_TRANSPARENT
+    T = 20
     kw = dict(max_new_tokens=T)
     full = generate.greedy_decode_fused(
         params, cfg, jnp.asarray(toks), jnp.asarray(mask),
@@ -371,19 +385,24 @@ def test_fused_decode_digit_early_stop_mechanics():
     early = generate.greedy_decode_fused(
         params, cfg, jnp.asarray(toks), jnp.asarray(mask),
         jnp.asarray(t1), jnp.asarray(t2), jnp.zeros((0,), jnp.int32),
-        jnp.zeros((0,), jnp.float32), stop_mask=jnp.asarray(stop),
+        jnp.zeros((0,), jnp.float32), stop_mask=jnp.asarray(cls),
         eos_id=jnp.int32(eos), **kw)
     g_full = np.asarray(full.generated)
     g_early = np.asarray(early.generated)
     stopped = 0
     for j in range(4):
-        expect, done, digit_seen = [], False, False
+        expect, done, run, prev_ew = [], False, False, False
         for t in range(T):
             emit = eos if done else int(g_full[j, t])
             expect.append(emit)
-            is_digit = bool(stop[emit])
-            done = done or emit == eos or (digit_seen and not is_digit)
-            digit_seen = digit_seen or is_digit
+            c = int(cls[emit])
+            pure, prefix = bool(c & 1), bool(c & 2)
+            glue, ends_w, transp = bool(c & 4), bool(c & 8), bool(c & 16)
+            done = done or emit == eos or (run and not glue and not transp)
+            if not transp:
+                run = (pure and (prefix or not prev_ew)) or (
+                    run and pure and not prefix)
+                prev_ew = ends_w
         stopped += done
         np.testing.assert_array_equal(g_early[j], expect)
     assert stopped == 4, "seeded run should stop every row inside the budget"
@@ -394,25 +413,42 @@ def test_fused_decode_digit_early_stop_mechanics():
                                np.asarray(full.p_yes[:, 0]), rtol=1e-6)
 
 
-def test_digit_token_mask_byte_fallback_and_specials():
-    """Surface forms are not text: '<0x0A>' (newline byte) and bracketed
-    specials contain digit CHARACTERS but decode to no digits — marking
-    them digit-bearing would stop a confidence reply at a leading newline.
-    Only true digit bytes (0x30-0x39) and real digit text count."""
+def test_digit_stop_classes_surface_semantics():
+    """The early-stop class table must read DECODED surfaces, not raw
+    strings: byte tokens map to their byte ('<0x0A>' is a newline, '<0x30>'
+    is the digit 0), bracketed specials are transparent, space-prefixed
+    digits are standalone-integer openers, and letter-glued pieces ('st',
+    'a1b') glue — so '1st' never reads as a parseable integer."""
     class Stub:
         def convert_ids_to_tokens(self, ids):
             table = ["▁Yes", "▁85", "<0x0A>", "<0x30>", "</s>",
-                     "<|reserved_special_token_0|>", "a1b", "100"]
+                     "<|reserved_special_token_0|>", "a1b", "100",
+                     "st", ",", "Ġ42", "Ġ"]
             return [table[i] for i in ids]
 
         def __len__(self):
-            return 8
+            return 12
 
-    mask = tok.digit_token_mask(Stub(), 8)
-    np.testing.assert_array_equal(
-        mask, [False, True, False, True, False, False, True, True])
+    cls = tok.digit_stop_classes(Stub(), 12)
+    P, X, W, E, T = (tok.STOP_PURE, tok.STOP_PREFIX, tok.STOP_STARTS_WORD,
+                     tok.STOP_ENDS_WORD, tok.STOP_TRANSPARENT)
+    assert cls[0] == X | E                 # ▁Yes: fresh word, not digits
+    assert cls[1] == P | X | E             # ▁85: standalone integer opener
+    assert cls[2] == X                     # newline byte = space prefix only
+    assert cls[3] == P | W | E             # '0' byte: digit, glues
+    assert cls[4] == T                     # </s>
+    assert cls[5] == T                     # reserved special
+    assert cls[6] == W | E                 # a1b: glues, not pure
+    assert cls[7] == P | W | E             # bare 100: pure but gluing
+    assert cls[8] == W | E                 # st: the '1st' glue piece
+    assert cls[9] == 0                     # ',' terminator
+    assert cls[10] == P | X | E            # Ġ42 (byte-BPE space prefix)
+    # 'Ġ' alone is a letter CODEPOINT but decodes to a bare space: prefix
+    # only, NOT word-ending ('\n' + '85' must still open a digit run).
+    assert cls[11] == X
 
 
+@pytest.mark.slow
 def test_engine_early_stop_disabled_without_token_strings():
     """FakeTokenizer renders ids as '<123>' and exposes no per-token
     strings: the engine must resolve digit_stop_mask to None and score
@@ -443,6 +479,7 @@ def test_shared_prefix_len_caps_for_nonempty_suffix():
     assert tok.shared_prefix_len(a, [1, 2, 3, 4, 5]) == 3
 
 
+@pytest.mark.slow
 def test_decode_fused_shared_falls_back_on_long_suffix():
     """Prompt pairs that diverge early (suffix > largest suffix bucket) must
     take the plain two-prefill path, not silently truncate the instruction
@@ -467,6 +504,7 @@ def test_decode_fused_shared_falls_back_on_long_suffix():
                                np.asarray(ref_a.p_yes), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_decode_fused_shared_falls_back_on_overlong_prefix(caplog):
     """When the common token prefix exceeds the largest prefix bucket, the
     shared path must NOT keep more context than the plain path (which
@@ -501,6 +539,7 @@ def test_decode_fused_shared_falls_back_on_overlong_prefix(caplog):
                                   np.asarray(ref_b.generated))
 
 
+@pytest.mark.slow
 def test_decode_fused_shared_falls_back_on_learned_pos_overflow(caplog):
     """Learned-position models: prefix bucket + suffix bucket + new tokens
     can overrun the position table even when each bucket individually fits
@@ -532,6 +571,7 @@ def test_decode_fused_shared_falls_back_on_learned_pos_overflow(caplog):
                                   np.asarray(ref_a.generated))
 
 
+@pytest.mark.slow
 def test_data_parallel_mesh_8x1_replicated_params():
     """Pure data-parallel serving (mesh 8x1): params replicate, the batch
     shards on `data`, and scores equal the single-device run — the int8-7B
@@ -558,6 +598,7 @@ def test_data_parallel_mesh_8x1_replicated_params():
     assert wq.sharding.shard_shape(wq.shape) == wq.shape
 
 
+@pytest.mark.slow
 def test_sample_decode_typed_prng_key_batch():
     """Per-row PRNG streams must work with BOTH key flavors: legacy
     uint32 (B, 2) arrays and modern typed keys (shape (B,)). The typed
@@ -586,6 +627,7 @@ def test_sample_decode_typed_prng_key_batch():
     assert g3.shape == (3, 4)
 
 
+@pytest.mark.slow
 def test_shared_prefix_scorer_on_dp_mesh():
     """The sweep's shared-prefix scorer on a pure data-parallel (8x1)
     engine — the recommended int8-7B serving mode — equals the

@@ -118,6 +118,7 @@ class TestRephraseCache:
             )
 
 
+@pytest.mark.slow
 class TestSampleDecode:
     def test_shapes_and_determinism(self):
         params, cfg, _ = _tiny_llama_params()
@@ -151,6 +152,7 @@ class TestSampleDecode:
         np.testing.assert_array_equal(np.asarray(sampled), np.asarray(greedy))
 
 
+@pytest.mark.slow
 class TestMultiModelSweep:
     def _engine_factory(self):
         params, cfg, _ = _tiny_llama_params(vocab=FakeTokenizer.VOCAB)
@@ -368,6 +370,7 @@ class TestThroughputMeter:
         assert summary["prompts_per_sec_per_chip"] == pytest.approx(10.0)
 
 
+@pytest.mark.slow
 class TestReasoningRuns:
     def test_run_requests_and_averaging(self):
         cells = grid_mod.build_grid("o3", LEGAL_PROMPTS[:1], [[]])
@@ -406,6 +409,7 @@ class TestReasoningRuns:
         assert s.weighted_confidence == 73
 
 
+@pytest.mark.slow
 class TestEncDecEngine:
     """End-to-end ScoringEngine on the T5 branch (the reference's Seq2Seq
     routing, compare_base_vs_instruct.py:203-241): greedy decode + C13
@@ -503,6 +507,7 @@ def test_chip_peak_table_covers_tpu_generations():
     assert prof.chip_peak_flops(_FakeDev("")) is None
 
 
+@pytest.mark.slow
 def test_bench_aborts_on_unknown_chip(monkeypatch, tmp_path):
     """bench.py must exit non-zero when the chip kind has no peak entry and
     --allow-ungated was not passed (the gate can't arm -> refuse to report).

@@ -34,6 +34,7 @@ def test_encdec_routing_rule():
     assert not is_encoder_decoder("tiiuae/falcon-7b")
 
 
+@pytest.mark.slow
 def test_state_dict_lazy_loading(tiny_checkpoint):
     from lir_tpu.models.factory import load_state_dict
 
@@ -47,6 +48,7 @@ def test_state_dict_lazy_loading(tiny_checkpoint):
     )
 
 
+@pytest.mark.slow
 def test_load_engine_forward_parity(tiny_checkpoint, monkeypatch):
     """Engine built from the on-disk checkpoint produces the same logits as
     the torch model (the stage-3 validation gate, SURVEY.md §7 build order)."""
@@ -76,6 +78,7 @@ def test_load_engine_forward_parity(tiny_checkpoint, monkeypatch):
     np.testing.assert_allclose(ours, ref_logits, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_engine_factory_resolution(tiny_checkpoint, monkeypatch):
     import transformers as tf
 
@@ -93,6 +96,7 @@ def test_engine_factory_resolution(tiny_checkpoint, monkeypatch):
         factory("org/absent-model")
 
 
+@pytest.mark.slow
 def test_params_cache_roundtrip(tiny_checkpoint, tmp_path, monkeypatch):
     """Convert-once semantics: second load restores from the orbax cache
     without touching the safetensors state dict."""
@@ -142,6 +146,7 @@ def tiny_t5_checkpoint(tmp_path_factory):
     return path, model
 
 
+@pytest.mark.slow
 def test_load_engine_t5_mesh_shards_params(tiny_t5_checkpoint, monkeypatch):
     """--mesh is honored for encoder-decoder checkpoints: params shard with
     the enc-dec specs instead of being silently ignored (VERDICT r2 missing

@@ -9,6 +9,8 @@ import pytest
 from lir_tpu.ops import flash_attention
 from lir_tpu.parallel import reference_attention
 
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
 
 def _qkv(B=2, S=256, H=4, hd=64, seed=0, dtype=jnp.float32):
     rng = np.random.default_rng(seed)

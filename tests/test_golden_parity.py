@@ -20,6 +20,8 @@ from lir_tpu.stats import (
     within_group_kappa,
 )
 
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
 KEY = jax.random.PRNGKey(42)
 
 

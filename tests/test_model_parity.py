@@ -17,6 +17,8 @@ import jax.numpy as jnp
 from lir_tpu.models import decoder, encdec, loader
 from lir_tpu.models.loader import config_from_hf, convert_decoder, convert_t5, t5_config_from_hf
 
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
 torch.manual_seed(0)
 
 TINY = dict(vocab=256, hidden=64, layers=2, heads=4)

@@ -12,6 +12,8 @@ from lir_tpu.models import decoder
 from lir_tpu.models.registry import tiny
 from lir_tpu.parallel import pipeline
 
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
 
 @pytest.mark.parametrize("family,n_stages,n_micro", [
     ("llama", 2, 4),    # rotary + RMSNorm + gated MLP

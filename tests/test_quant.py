@@ -12,6 +12,8 @@ from lir_tpu.engine.runner import ScoringEngine
 from lir_tpu.models import decoder, quant
 from lir_tpu.models.loader import config_from_hf, convert_decoder
 
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
 
 @pytest.fixture(scope="module")
 def tiny_model():

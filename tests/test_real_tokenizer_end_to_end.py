@@ -27,6 +27,8 @@ from lir_tpu.config import RuntimeConfig
 from lir_tpu.data.prompts import format_instruct_prompt
 from lir_tpu.models.factory import load_engine
 
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
 
 @pytest.fixture(scope="module")
 def bpe_checkpoint(tmp_path_factory):
@@ -254,12 +256,16 @@ def test_digit_stop_mask_and_early_stop_sweep_equivalence(sp_checkpoint,
     path, _, fast = sp_checkpoint
     rt = RuntimeConfig(batch_size=2, max_new_tokens=8, max_seq_len=128)
     engine = load_engine(path, rt)
+    from lir_tpu.engine import tokens as tok
+
     assert engine.digit_stop_mask is not None
     mask = np.asarray(engine.digit_stop_mask)
-    assert mask[fast(" 85", add_special_tokens=False).input_ids[0]]
-    assert mask[fast("100", add_special_tokens=False).input_ids[0]]
-    assert not mask[engine.yes_id] and not mask[engine.no_id]
-    assert not mask[fast.eos_token_id]
+    sp85 = fast(" 85", add_special_tokens=False).input_ids[0]
+    assert mask[sp85] & tok.STOP_PURE and mask[sp85] & tok.STOP_PREFIX
+    assert mask[fast("100", add_special_tokens=False).input_ids[0]] & tok.STOP_PURE
+    assert not (mask[engine.yes_id] & tok.STOP_PURE)
+    assert not (mask[engine.no_id] & tok.STOP_PURE)
+    assert mask[fast.eos_token_id] & tok.STOP_TRANSPARENT
 
     lp = (LegalPrompt(
         main="Is a tomato a vegetable?",

@@ -19,6 +19,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
 GOLDEN_PATH = Path(__file__).parent / "golden" / "reference_executed.json"
 KEY = jax.random.PRNGKey(7)
 
@@ -280,3 +282,440 @@ def test_overall_comparison_vs_executed_reference(golden, survey_run):
         assert _close(o["base_mean"], r["base_mean"], abs_tol=BOOT_ABS)
         assert _close(o["instruct_mean"], r["instruct_mean"], abs_tol=BOOT_ABS)
         assert _close(o["difference"], r["difference"], abs_tol=2 * BOOT_ABS)
+
+
+# ---------------------------------------------------------------------------
+# analyze_perturbation_results.py — the 2,025-line per-model analyzer
+# (VERDICT r3 #1 lead item). Identical input: the synthetic D6 (edge model
+# included) after the same CSV round trip the sandbox staged.
+# ---------------------------------------------------------------------------
+
+PERT_MODELS = ["synthetic-scorer-v1", "synthetic-edge-v1"]
+
+
+@pytest.fixture(scope="module")
+def pert_analyzer_run(tmp_path_factory):
+    from lir_tpu.analysis.perturbation import analyze_model
+    from lir_tpu.data import synthetic
+
+    out = tmp_path_factory.mktemp("pert")
+    csv = out / "combined_results.csv"
+    synthetic.synthetic_perturbation_frame().to_csv(csv, index=False)
+    df = pd.read_csv(csv)
+    return {
+        model: analyze_model(
+            df[df["Model"] == model].copy(), model,
+            out / model.replace("-", "_"), make_figures=False)
+        for model in PERT_MODELS
+    }
+
+
+def _golden_pert(golden, model, stem):
+    if "analyze_perturbation_results" not in golden:
+        pytest.skip("golden predates the perturbation-analyzer capture")
+    return pd.DataFrame(golden["analyze_perturbation_results"][model][stem])
+
+
+def _diff_frames(ours: pd.DataFrame, ref: pd.DataFrame, *, tight=(),
+                 loose=(), loose_abs=0.0, exact=(), skip=()):
+    """Column-wise diff of two artifact frames with per-column tolerance."""
+    assert len(ours) == len(ref), (len(ours), len(ref))
+    for col in ref.columns:
+        if col in skip:
+            continue
+        r = ref[col].to_numpy()
+        assert col in ours.columns, f"missing column {col!r}"
+        o = ours[col].to_numpy()
+        if col in exact:
+            assert list(o) == list(r), col
+        elif col in loose:
+            np.testing.assert_allclose(
+                o.astype(float), r.astype(float), atol=loose_abs,
+                rtol=0.05, equal_nan=True, err_msg=col)
+        elif col in tight or np.issubdtype(r.dtype, np.number):
+            np.testing.assert_allclose(
+                o.astype(float), r.astype(float), rtol=1e-6, atol=1e-9,
+                equal_nan=True, err_msg=col)
+        else:
+            assert list(o) == list(r), col
+
+
+@pytest.mark.parametrize("model", PERT_MODELS)
+def test_perturbation_summary_stats_vs_executed_reference(
+        golden, pert_analyzer_run, model):
+    ref = _golden_pert(golden, model, "summary_statistics")
+    _diff_frames(pert_analyzer_run[model]["summary"], ref)
+
+
+@pytest.mark.parametrize("model", PERT_MODELS)
+def test_perturbation_normality_vs_executed_reference(
+        golden, pert_analyzer_run, model):
+    ref = _golden_pert(golden, model, "normality_test_results")
+    _diff_frames(pert_analyzer_run[model]["normality"], ref,
+                 exact=("Column", "KS Normal (p>0.05)",
+                        "AD Normal (stat<crit)"))
+
+
+@pytest.mark.parametrize("model", PERT_MODELS)
+def test_perturbation_truncated_fit_vs_executed_reference(
+        golden, pert_analyzer_run, model):
+    """The zero/one-inflated truncated-normal MC fit. Deterministic columns
+    (observed moments, inflation proportions) hold the 1% gate; the fitted/
+    simulated moments carry two independent 100k-sample MC runs -> abs
+    tolerance scaled by the column's units (confidence rows are 0-100)."""
+    ref = _golden_pert(golden, model, "truncated_normal_test_results")
+    ours = pert_analyzer_run[model]["truncated"]
+    assert len(ours) == len(ref)
+    key = ["Prompt", "Column"]
+    ref = ref.sort_values(key).reset_index(drop=True)
+    ours = ours.sort_values(key).reset_index(drop=True)
+    assert list(ours["Prompt"]) == list(ref["Prompt"])
+    assert list(ours["Column"]) == list(ref["Column"])
+    for i in range(len(ref)):
+        scale = 100.0 if float(ref.loc[i, "Observed Mean"]) > 1.5 else 1.0
+        for col in ("Observed Mean", "Observed Std Dev", "Interior Mean",
+                    "Interior Std Dev"):
+            assert _close(ours.loc[i, col], ref.loc[i, col],
+                          rel=1e-6, abs_tol=1e-9 * scale), (i, col)
+        for col in ("Zero Proportion", "One Proportion"):
+            assert _close(ours.loc[i, col], ref.loc[i, col],
+                          rel=0, abs_tol=1e-12), (i, col)
+        for col in ("Underlying Normal Mean", "Underlying Normal Std Dev",
+                    "Simulated Mean", "Simulated Std Dev"):
+            assert _close(ours.loc[i, col], ref.loc[i, col],
+                          rel=0.05, abs_tol=0.05 * scale), (i, col)
+        assert _close(ours.loc[i, "KS Statistic"], ref.loc[i, "KS Statistic"],
+                      rel=0, abs_tol=0.08), i
+
+
+@pytest.mark.parametrize("model", PERT_MODELS)
+def test_perturbation_kappa_vs_executed_reference(
+        golden, pert_analyzer_run, model):
+    ref = _golden_pert(golden, model, "cohens_kappa_results")
+    ours = pert_analyzer_run[model]["kappa"]
+    for theirs, mine in (("Cohen's Kappa", "Cohen's Kappa"),
+                         ("Observed Agreement", "Observed Agreement"),
+                         ("Expected Agreement", "Expected Agreement")):
+        assert _close(ours[mine].iloc[0], ref[theirs].iloc[0],
+                      rel=1e-9, abs_tol=1e-9), theirs
+
+
+@pytest.mark.parametrize("model", PERT_MODELS)
+def test_perturbation_output_compliance_vs_executed_reference(
+        golden, pert_analyzer_run, model):
+    """Integer counts per compliance category must match EXACTLY — the edge
+    model plants non-compliant first tokens, non-compliant full responses,
+    unparseable payloads, and ast-literal payloads in known proportions."""
+    ref = _golden_pert(golden, model, "output_compliance_results")
+    _diff_frames(pert_analyzer_run[model]["compliance"], ref,
+                 exact=("Prompt", "Expected_First_Tokens", "Total_Samples",
+                        "First_Token_Compliant", "First_Token_Non_Compliant",
+                        "Conditional_Subsequent_Compliant",
+                        "Conditional_Subsequent_Non_Compliant"))
+
+
+@pytest.mark.parametrize("model", PERT_MODELS)
+def test_perturbation_confidence_compliance_vs_executed_reference(
+        golden, pert_analyzer_run, model):
+    """Every confidence error category (float / text / out-of-range /
+    other) counted exactly as the executed reference counts them."""
+    ref = _golden_pert(golden, model, "confidence_compliance_results")
+    _diff_frames(pert_analyzer_run[model]["confidence_compliance"], ref,
+                 exact=("Prompt", "Total_Confidence_Samples",
+                        "Confidence_Compliant", "Confidence_Non_Compliant",
+                        "Float_Errors", "Text_Errors", "Out_Of_Range_Errors",
+                        "Other_Errors"))
+
+
+# ---------------------------------------------------------------------------
+# analyze_results_base_versus_instruct.py — C28 on the committed D2
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def bvi_run(reference_data_dir):
+    from lir_tpu.analysis.base_vs_instruct import family_differences
+
+    df = pd.read_csv(f"{reference_data_dir}/model_comparison_results.csv")
+    return family_differences(df)
+
+
+def test_base_versus_instruct_stats_vs_executed_reference(golden, bvi_run):
+    if "base_versus_instruct" not in golden:
+        pytest.skip("golden predates the base-versus-instruct capture")
+    ref = pd.DataFrame(
+        golden["base_versus_instruct"]["model_rel_prob_statistics"])
+    ours = bvi_run["statistics"]
+    assert set(ours["Model_Family"]) == set(ref["Model_Family"])
+    ref = ref.set_index("Model_Family")
+    ours = ours.set_index("Model_Family")
+    for fam in ref.index:
+        for col in ("Mean", "Std_Dev", "Lower_CI_95", "Upper_CI_95",
+                    "CI_Width"):
+            assert _close(ours.loc[fam, col], ref.loc[fam, col],
+                          rel=1e-6, abs_tol=1e-9), (fam, col)
+        assert int(ours.loc[fam, "Num_Samples"]) == int(
+            ref.loc[fam, "Num_Samples"])
+
+
+def test_base_versus_instruct_heatmap_vs_executed_reference(golden, bvi_run):
+    if "base_versus_instruct" not in golden:
+        pytest.skip("golden predates the base-versus-instruct capture")
+    ref = pd.DataFrame(
+        golden["base_versus_instruct"]["prompt_rel_prob_heatmap_data"]
+    ).set_index("Prompt")
+    pivot = bvi_run["prompt_differences"].pivot_table(
+        index="Prompt", columns="Model Family", values="Difference",
+        aggfunc="mean")
+    assert set(pivot.columns) == set(ref.columns)
+    assert set(pivot.index) == set(ref.index)
+    for fam in ref.columns:
+        np.testing.assert_allclose(
+            pivot.loc[ref.index, fam].to_numpy(dtype=float),
+            ref[fam].to_numpy(dtype=float), rtol=1e-6, atol=1e-9,
+            equal_nan=True, err_msg=fam)
+
+
+# ---------------------------------------------------------------------------
+# analyze_llm_human_agreement.py / analyze_base_vs_instruct_vs_human.py /
+# analyze_model_family_differences.py / calculate_correlation_pvalues.py
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def detailed_and_mapping(golden, reference_data_dir, tmp_path_factory):
+    """The exact D7 + question mapping the sandbox staged: detailed survey
+    stats from OUR loader, mapping from the executed consolidated run."""
+    from lir_tpu.survey import loader
+
+    out = tmp_path_factory.mktemp("detailed")
+    sdf, qcols = loader.load_survey(
+        Path(reference_data_dir) / "word_meaning_survey_results.csv")
+    clean, _ = loader.apply_exclusions(sdf, qcols)
+    path = out / "survey_analysis_detailed.json"
+    loader.write_survey_detailed(clean, qcols, path)
+    detailed = json.loads(path.read_text())
+    mapping = golden["survey_consolidated"]["matching_stats"]["matches"]
+    return detailed, mapping
+
+
+def test_llm_human_agreement_vs_executed_reference(
+        golden, reference_data_dir, detailed_and_mapping):
+    """C39 point metrics per model: deterministic on identical inputs."""
+    if "llm_human_agreement" not in golden:
+        pytest.skip("golden predates the llm-human-agreement capture")
+    from lir_tpu.survey.human_llm import (analyze_all_models,
+                                          human_averages_from_detailed)
+
+    detailed, mapping = detailed_and_mapping
+    ha = human_averages_from_detailed(detailed, mapping)
+    instruct = pd.read_csv(
+        f"{reference_data_dir}/instruct_model_comparison_results.csv")
+    base = pd.read_csv(f"{reference_data_dir}/model_comparison_results.csv")
+    ours = {r["model"]: r for r in analyze_all_models(ha, instruct, base)}
+    ref = {r["model"]: r for r in golden["llm_human_agreement"]["model_results"]}
+    assert set(ours) == set(ref)
+    for name, r in ref.items():
+        o = ours[name]
+        assert o["n_questions"] == r["n_questions"], name
+        for metric in ("mae", "rmse", "mape", "pearson_r"):
+            assert _close(o[metric], r[metric], rel=1e-6, abs_tol=1e-9), (
+                name, metric)
+
+
+def test_base_vs_instruct_vs_human_vs_executed_reference(
+        golden, reference_data_dir, detailed_and_mapping):
+    """The proportion-based correlation table (model_human_correlations.csv)."""
+    if "base_vs_instruct_vs_human" not in golden:
+        pytest.skip("golden predates this capture")
+    from lir_tpu.survey.proportions import (
+        human_proportions_from_detailed, model_vs_proportion_correlations)
+
+    detailed, mapping = detailed_and_mapping
+    props = human_proportions_from_detailed(detailed, mapping)
+    instruct = pd.read_csv(
+        f"{reference_data_dir}/instruct_model_comparison_results.csv")
+    ours = {r["model"]: r
+            for r in model_vs_proportion_correlations(instruct, props)}
+    ref = pd.DataFrame(golden["base_vs_instruct_vs_human"])
+    assert set(ours) == set(ref["model"])
+    for _, r in ref.iterrows():
+        o = ours[r["model"]]
+        if np.isnan(r["pearson_r"]):
+            # The executed reference keeps NaN-probability rows (Qwen: 20)
+            # and constant inputs, so pearsonr returns NaN for 3 models.
+            # Ours drops NaN rows first (documented fix): Qwen gets a
+            # defined r on its 30 valid questions; the two constant-input
+            # models stay NaN on both sides.
+            assert (np.isnan(o["pearson_r"])
+                    or o["n_questions"] < int(r["n_questions"]))
+            continue
+        assert o["n_questions"] == int(r["n_questions"])
+        for col in ("pearson_r", "pearson_p", "spearman_r", "mae"):
+            assert _close(o[col], r[col], rel=1e-6, abs_tol=1e-9), (
+                r["model"], col)
+
+
+def test_family_differences_vs_executed_reference(golden):
+    """C42 on the SAME bootstrap payload the reference script consumed. The
+    summary table (CI-combination arithmetic) is deterministic up to the
+    report's printed rounding; the seed-42 MC section uses independent RNGs
+    on each side -> moment-level tolerances."""
+    if "family_differences" not in golden:
+        pytest.skip("golden predates the family-differences capture")
+    from lir_tpu.survey.family_differences import analyze_family_differences
+
+    res = analyze_family_differences(
+        golden["llm_human_agreement_bootstrap"], KEY)
+    by_upper = {fam.upper(): v for fam, v in res.items()
+                if not isinstance(v, dict) or not v.get("missing")}
+
+    table = golden["family_differences"]["summary_table"]
+    assert table, "summary table parsed empty"
+    for fam, metrics in table.items():
+        ours_fam = by_upper[fam.upper()]
+        for metric, r in metrics.items():
+            o = ours_fam[metric.lower()]
+            # printed at 4dp (1dp for MAPE): tolerance = print rounding.
+            tol = 0.06 if metric == "MAPE" else 6e-4
+            assert _close(o["difference"], r["diff"], rel=0, abs_tol=tol)
+            assert _close(o["ci_combined_range"][0], r["ci"][0], rel=0,
+                          abs_tol=tol)
+            assert _close(o["ci_combined_range"][1], r["ci"][1], rel=0,
+                          abs_tol=tol)
+            assert o["significant_combined_range"] == r["significant"], (
+                fam, metric)
+
+    mc = golden["family_differences"]["mc_differences"]
+    assert mc, "MC section parsed empty"
+    for fam, metrics in mc.items():
+        ours_fam = by_upper[fam.upper()]
+        for metric, r in metrics.items():
+            o = ours_fam[metric.lower()]["mc_difference"]
+            tol = 1.0 if metric == "MAPE" else 0.01
+            assert _close(o["difference_mean"], r["diff"], rel=0, abs_tol=tol)
+            assert _close(o["ci_lower"], r["ci"][0], rel=0, abs_tol=2 * tol)
+            assert _close(o["ci_upper"], r["ci"][1], rel=0, abs_tol=2 * tol)
+            assert _close(o["p_value"], r["p"], rel=0, abs_tol=0.03)
+
+
+def test_correlation_pvalues_vs_executed_reference(golden, reference_data_dir):
+    """C43: pairwise r/p for every LLM pair plus the distribution-level
+    comparison, deterministic on identical inputs."""
+    if "correlation_pvalues" not in golden:
+        pytest.skip("golden predates the correlation-pvalues capture")
+    from lir_tpu.survey.pvalues import run_pvalue_analysis
+
+    instruct = pd.read_csv(
+        f"{reference_data_dir}/instruct_model_comparison_results.csv")
+    base = pd.read_csv(f"{reference_data_dir}/model_comparison_results.csv")
+    from lir_tpu.survey.loader import load_survey
+
+    survey_df, _ = load_survey(
+        Path(reference_data_dir) / "word_meaning_survey_results.csv")
+    res = run_pvalue_analysis(instruct, base, survey_df)
+
+    ref_pairs = {frozenset((c["model1"], c["model2"])): c
+                 for c in golden["correlation_pvalues"]["llm_correlations"]}
+    our_pairs = {frozenset((c["model1"], c["model2"])): c
+                 for c in res["llm_correlations"]}
+    # The executed reference silently DROPS every base model: its concat
+    # materializes a relative_prob column that is NaN for all D1 rows, and
+    # the row reader prefers it (:42,57-58) — only the 45 instruct pairs
+    # survive. Ours fixes that defect (pvalues.py docstring), so our pair
+    # set is a strict superset; every surviving reference pair must match
+    # exactly, and every extra pair must involve a base-CSV model.
+    assert set(ref_pairs) <= set(our_pairs)
+    base_models = set(
+        pd.read_csv(f"{reference_data_dir}/model_comparison_results.csv")
+        ["model"].unique())
+    for k in set(our_pairs) - set(ref_pairs):
+        assert k & base_models, k
+    for k, r in ref_pairs.items():
+        o = our_pairs[k]
+        assert o["n_questions"] == r["n_questions"], k
+        if r["correlation"] is None:
+            assert not np.isfinite(o["correlation"])
+            continue
+        # Our masked-Pearson kernel runs in float32 (jax default): agree to
+        # ~1e-5 absolute — three orders below the 1% BASELINE gate.
+        assert _close(o["correlation"], r["correlation"], rel=1e-5,
+                      abs_tol=1e-5), k
+        assert _close(o["p_value"], r["p_value"], rel=1e-3,
+                      abs_tol=1e-6), k
+
+    assert len(res["human_correlations"]) == (
+        golden["correlation_pvalues"]["n_human_correlations"])
+    cmp_ref = golden["correlation_pvalues"]["comparison"]
+    cmp_ours = res["comparison"]
+    # Human stats: identical inputs on both sides -> the tight gate.
+    for k in ("mean", "std", "median"):
+        assert _close(cmp_ours["human_stats"][k], cmp_ref["human_stats"][k],
+                      rel=1e-5, abs_tol=1e-9), k
+    assert (cmp_ours["human_stats"]["n_pairs"]
+            == cmp_ref["human_stats"]["n_pairs"])
+    assert (cmp_ours["human_stats"]["significant_pairs"]
+            == cmp_ref["human_stats"]["significant_pairs"])
+    # LLM-side stats + distribution tests: the reference's are computed on
+    # its defect-truncated 45-pair list. Recompute the same statistics over
+    # exactly those pairs using OUR correlation values — deterministic, so
+    # the tight gate applies.
+    import scipy.stats as sps
+
+    llm_vals = [our_pairs[k]["correlation"] for k in ref_pairs
+                if np.isfinite(our_pairs[k]["correlation"])]
+    human_vals = [c["correlation"] for c in res["human_correlations"]
+                  if np.isfinite(c["correlation"])]
+    assert len(llm_vals) == cmp_ref["llm_stats"]["n_pairs"]
+    assert _close(np.mean(llm_vals), cmp_ref["llm_stats"]["mean"],
+                  rel=1e-6, abs_tol=1e-9)
+    assert _close(np.std(llm_vals), cmp_ref["llm_stats"]["std"],
+                  rel=1e-6, abs_tol=1e-9)
+    assert _close(np.median(llm_vals), cmp_ref["llm_stats"]["median"],
+                  rel=1e-6, abs_tol=1e-9)
+    mw = sps.mannwhitneyu(llm_vals, human_vals, alternative="two-sided")
+    ks = sps.ks_2samp(llm_vals, human_vals)
+    tt = sps.ttest_ind(llm_vals, human_vals)
+    for name, stat in (("mann_whitney", mw.statistic),
+                       ("kolmogorov_smirnov", ks.statistic),
+                       ("t_test", tt.statistic)):
+        assert _close(stat, cmp_ref["comparison_tests"][name]["statistic"],
+                      rel=1e-5, abs_tol=1e-9), name
+    pooled = np.sqrt((np.std(llm_vals) ** 2 + np.std(human_vals) ** 2) / 2)
+    d = (np.mean(llm_vals) - np.mean(human_vals)) / pooled
+    assert _close(d, cmp_ref["comparison_tests"]["effect_size"]["cohens_d"],
+                  rel=1e-5, abs_tol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# bootstrap_confidence_intervals.py — C38 (captured only by the full, slow
+# run of tools/reference_differential.py; skipped against older goldens)
+# ---------------------------------------------------------------------------
+
+def test_simulated_bootstrap_vs_executed_reference(
+        golden, reference_data_dir, detailed_and_mapping):
+    if "bootstrap_confidence_intervals" not in golden:
+        pytest.skip("golden captured with LIR_SKIP_SLOW_BOOTSTRAP=1")
+    from lir_tpu.survey.simulated import run_simulated_bootstrap
+
+    detailed, mapping = detailed_and_mapping
+    base = pd.read_csv(f"{reference_data_dir}/model_comparison_results.csv")
+    res = run_simulated_bootstrap(
+        base, mapping, detailed, KEY, n_bootstrap=2000)
+    ref = golden["bootstrap_confidence_intervals"]
+
+    for side in ("base", "instruct"):
+        r, o = ref["overall_results"][side], res["overall_results"][side]
+        assert _close(o["mean"], r["mean"], rel=0, abs_tol=BOOT_ABS), side
+        assert _close(o["ci_lower"], r["ci_lower"], rel=0,
+                      abs_tol=CI_ABS), side
+        assert _close(o["ci_upper"], r["ci_upper"], rel=0,
+                      abs_tol=CI_ABS), side
+    r, o = ref["overall_results"]["difference"], res["overall_results"]["difference"]
+    assert _close(o["mean"], r["mean"], rel=0, abs_tol=BOOT_ABS)
+
+    ref_models = ref["per_model_results"]
+    our_models = res["per_model_results"]
+    assert set(our_models) == set(ref_models)
+    for name, r in ref_models.items():
+        o = our_models[name]
+        assert o["type"] == r["type"], name
+        assert _close(o["mean"], r["mean"], rel=0, abs_tol=0.05), name

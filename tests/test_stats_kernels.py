@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from scipy import stats as scipy_stats
 from sklearn.metrics import cohen_kappa_score
 
@@ -53,6 +54,7 @@ class TestCore:
         np.testing.assert_allclose(got, expected)
 
 
+@pytest.mark.slow
 class TestBootstrap:
     def test_bootstrap_correlation_brackets_estimate(self, rng):
         x = rng.normal(size=100)
@@ -95,6 +97,7 @@ class TestBootstrap:
         assert res["ci_lower"] > 0
 
 
+@pytest.mark.slow
 class TestKappa:
     def test_cohen_kappa_matches_sklearn(self, rng):
         for _ in range(5):
@@ -162,6 +165,7 @@ class TestKappa:
         assert "perfect" in interpret_kappa(0.9)
 
 
+@pytest.mark.slow
 class TestAgreement:
     def test_pairwise_agreement_matches_loop(self, rng):
         vals = rng.uniform(0, 100, size=50)
@@ -176,6 +180,7 @@ class TestAgreement:
         assert got["n_pairs"] == len(pair_vals)
 
 
+@pytest.mark.slow
 class TestCorrelationMatrix:
     def test_masked_pearson_matches_pandas(self, rng):
         import pandas as pd
@@ -212,6 +217,7 @@ class TestCorrelationMatrix:
         assert res["correlation_matrix"].shape == (6, 6)
 
 
+@pytest.mark.slow
 class TestFitsAndNormality:
     def test_truncnorm_fit_recovers_moments(self):
         rng = np.random.default_rng(0)

@@ -33,6 +33,8 @@ from lir_tpu.survey import (
 )
 from lir_tpu.survey.loader import group_question_ids
 
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
 KEY = jax.random.PRNGKey(42)
 
 

@@ -68,6 +68,7 @@ def test_grid_expansion_and_subset():
     assert grid_mod.random_subset(cells, 3, seed=42) == sub  # deterministic
 
 
+@pytest.mark.slow
 def test_perturbation_sweep_writes_d6_and_resumes(tmp_path):
     eng = _engine()
     out = tmp_path / "results.xlsx"
@@ -99,6 +100,7 @@ def test_perturbation_sweep_writes_d6_and_resumes(tmp_path):
     assert len(read_results_frame(out)) == 10
 
 
+@pytest.mark.slow
 def test_word_meaning_sweep_rows():
     eng = _engine(batch_size=8)
     questions = list(WORD_MEANING_QUESTIONS[:6])
@@ -111,6 +113,7 @@ def test_word_meaning_sweep_rows():
         assert 0 <= r.yes_prob <= 1 and 0 <= r.no_prob <= 1
 
 
+@pytest.mark.slow
 def test_reasoning_count_averaging_matches_api_decoder():
     """VERDICT r1 #7: the local n-run averaging must binarize with the same
     if/elif order as the API decoder (perturb_prompts.py:423-426) — a text
@@ -149,6 +152,7 @@ def test_reasoning_count_averaging_matches_api_decoder():
     assert res.response == "Covered"  # most common (2x exact)
 
 
+@pytest.mark.slow
 def test_reasoning_sweep_writes_count_fraction_rows(tmp_path):
     """End-to-end reasoning mode on the tiny model: D6 rows carry count
     fractions (multiples of 1/n_runs) and Weighted Confidence equals the
@@ -172,6 +176,7 @@ def test_reasoning_sweep_writes_count_fraction_rows(tmp_path):
     assert len(df) == 5
 
 
+@pytest.mark.slow
 def test_reasoning_resume_is_cell_deterministic(tmp_path):
     """PRNG streams are keyed by grid-cell identity, so a resumed sweep
     (different todo/batch composition) samples exactly what the
@@ -214,6 +219,7 @@ def test_parse_confidence_truncation_guard():
     assert _parse_confidence("no number here", complete=False) is None
 
 
+@pytest.mark.slow
 def test_perturbation_sweep_multihost_shards(tmp_path, monkeypatch):
     """Under a (simulated) 2-process pod, each host sweeps HALF the grid
     into its own .hostN results + manifest (disjoint writes), and the two
@@ -287,6 +293,7 @@ def test_multihost_required_single_process_runtime_error_attribution(
         multihost.initialize(required=True)
 
 
+@pytest.mark.slow
 def test_multihost_shard_concat_and_merged_resume(tmp_path, monkeypatch):
     """The gather step: after both hosts sweep their shards, host 0 merges
     the .hostN workbooks + manifests into the FINAL artifact
@@ -342,6 +349,7 @@ def test_multihost_shard_concat_and_merged_resume(tmp_path, monkeypatch):
     assert grid_mod.pending_cells(cells, merged_manifest) == []
 
 
+@pytest.mark.slow
 def test_multihost_empty_host_still_merges(tmp_path, monkeypatch):
     """A pod larger than the grid: hosts with zero assigned cells write a
     header-only shard, so host 0's merge still produces the final artifact
@@ -444,6 +452,7 @@ def test_cli_concat_shards_xlsx_request_finds_csv_shards(tmp_path, capsys):
     assert "WARNING: no shard manifests" in out
 
 
+@pytest.mark.slow
 def test_pipelined_writer_failure_preserves_resume(tmp_path, monkeypatch):
     """A flush failure inside the writer thread must re-raise on the
     caller's thread, and the write-ahead guarantee must hold: only rows

@@ -1,0 +1,292 @@
+"""Same-weights two-precision accuracy audit (VERDICT r3 #3).
+
+PARITY.md's quantization tolerances were asserted from tiny-model tests;
+this tool MEASURES them at real size on the chip: the same weight tree is
+scored in bf16 and in int8(-dyn)+kvq8, and the distributions of
+|Δ relative_prob| and |Δ weighted_confidence| over ~200 synthetic prompts
+are recorded. Random weights measure the NUMERIC quantization path (s8xs8
+MXU dots, per-vector scales, int8 KV rounding) — not task accuracy on a
+trained checkpoint (still environment-blocked, PARITY.md) — but they turn
+'"expected" is not "measured"' into a number for exactly the arithmetic
+the sweeps run.
+
+Memory discipline for the 7B: bf16 (12.55 GiB) and int8 (6.4 GiB) trees
+cannot be resident together, and quantizing ON the chip would transiently
+hold both. So each precision runs in its own phase/process, and the int8
+phase builds the SAME bf16 tree on host CPU (jax PRNG is
+backend-deterministic), quantizes it host-side, and ships only int8 to the
+device.
+
+Run on the TPU:
+    python tools/precision_audit.py --model t0_3b            # one process
+    python tools/precision_audit.py --model llama2_7b --phase bf16
+    python tools/precision_audit.py --model llama2_7b --phase int8
+    python tools/precision_audit.py --model llama2_7b --phase diff
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+PARITY_MD = REPO / "PARITY.md"
+OUT_DIR = REPO / "tools" / "_precision_audit"
+
+N_PROMPTS = 200
+WORDS = ("coverage policy flood water damage claim insurer premium "
+         "exclusion endorsement peril deductible adjuster settle "
+         "liability clause binding interpret statute meaning levee "
+         "burglary petition affiliate foundry payment completion").split()
+
+
+def _prompts(n=N_PROMPTS, n_words=40):
+    import numpy as np
+
+    rng = np.random.default_rng(20260731)
+    return [" ".join(rng.choice(WORDS) for _ in range(n_words))
+            + " ? Respond with either Yes or No only ." for _ in range(n)]
+
+
+def _score_decoder(params, cfg, batch=2, max_new=2):
+    """(relative_prob, weighted_confidence) per prompt via the production
+    fused scorer (position-0 readouts — exactly what D6 stores)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine import score as score_mod
+    from lir_tpu.engine.runner import ScoringEngine
+
+    eng = ScoringEngine(params, cfg, FakeTokenizer(),
+                        RuntimeConfig(batch_size=batch, max_seq_len=256))
+    t1 = np.full((batch,), FakeTokenizer.YES, np.int32)
+    t2 = np.full((batch,), FakeTokenizer.NO, np.int32)
+    prompts = _prompts()
+    out = {"relative_prob": [], "yes_prob": [], "gap": [],
+           "weighted_confidence": []}
+    t0 = time.perf_counter()
+    for i in range(0, len(prompts), batch):
+        chunk = prompts[i:i + batch]
+        chunk = chunk + [chunk[-1]] * (batch - len(chunk))
+        fused = eng.decode_fused(chunk, t1, t2, with_digits=True,
+                                 max_new_tokens=max_new)
+        res = score_mod.readout_from_fused(
+            fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
+        n = len(prompts[i:i + batch])
+        out["relative_prob"].extend(
+            float(x) for x in np.asarray(res.relative_prob)[:n])
+        out["yes_prob"].extend(float(x) for x in np.asarray(res.yes_prob)[:n])
+        out["gap"].extend(
+            float(x) for x in np.asarray(res.yes_logprob - res.no_logprob)[:n])
+        out["weighted_confidence"].extend(
+            float(x) for x in np.asarray(fused.weighted_confidence)[:n])
+    print(f"# scored {len(out['yes_prob'])} prompts "
+          f"in {time.perf_counter() - t0:.0f}s")
+    return out
+
+
+def _result_path(model, tag):
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    return OUT_DIR / f"{model}_{tag}.json"
+
+
+def _dump(model, tag, out):
+    _result_path(model, tag).write_text(json.dumps(
+        dict(out, model=model, precision=tag)))
+    print(f"# wrote {_result_path(model, tag)}")
+
+
+def _delta_stats(a, b):
+    import numpy as np
+
+    d = np.abs(np.asarray(a) - np.asarray(b))
+    return {"mean": float(d.mean()), "p50": float(np.percentile(d, 50)),
+            "p95": float(np.percentile(d, 95)), "max": float(d.max())}
+
+
+def phase_bf16_7b(preset: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from lir_tpu.models import decoder
+    from tools.scale_validation import resolve_preset
+
+    cfg = resolve_preset(preset)
+    t0 = time.perf_counter()
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.bfloat16)
+    jax.block_until_ready(params)
+    print(f"# bf16 init {time.perf_counter() - t0:.0f}s "
+          f"({sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params)) / 2**30:.2f} GiB)")
+    _dump(preset, "bf16", _score_decoder(params, cfg, batch=2))
+
+
+def phase_int8_7b(preset: str) -> None:
+    import jax
+    import jax.numpy as jnp
+    import dataclasses
+
+    from lir_tpu.models import decoder, quant
+    from tools.scale_validation import resolve_preset
+
+    cfg = dataclasses.replace(resolve_preset(preset), kv_cache_int8=True)
+    cpus = jax.devices("cpu")
+    t0 = time.perf_counter()
+    # SAME weights as the bf16 phase: jax PRNG is backend-deterministic, so
+    # init_params(PRNGKey(0)) on host CPU equals the on-chip bf16 tree.
+    with jax.default_device(cpus[0]):
+        host = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                   dtype=jnp.bfloat16)
+        qhost = quant.quantize_decoder_params(host, dynamic=True)
+        del host
+    params = jax.device_put(qhost, jax.devices()[0])
+    jax.block_until_ready(params)
+    del qhost
+    print(f"# int8 host-quantize + ship {time.perf_counter() - t0:.0f}s")
+    _dump(preset, "int8", _score_decoder(params, cfg, batch=2))
+
+
+def phase_diff(preset: str, label: str) -> None:
+    a = json.loads(_result_path(preset, "bf16").read_text())
+    b = json.loads(_result_path(preset, "int8").read_text())
+    wc = _delta_stats(a["weighted_confidence"], b["weighted_confidence"])
+    text = _audit_report(
+        label, "position-0 fused readouts (the D6 quantities), separate "
+        "bf16/int8 phases over the same PRNGKey(0) tree", a, b,
+        extra_rows=(f"| weighted confidence (0-100, E[v] @ pos 0) | "
+                    f"{wc['mean']:.3f} | {wc['p50']:.3f} | {wc['p95']:.3f} | "
+                    f"{wc['max']:.3f} |"))
+    PARITY_MD.write_text(PARITY_MD.read_text() + text)
+    print(text)
+
+
+def run_t5() -> None:
+    """T0-3B bf16 vs int8 in one process (both fit: 5.31 + 2.72 GiB)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import gc
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import encdec, quant
+    from lir_tpu.models.registry import t0_3b
+
+    cfg = t0_3b()
+    out = {}
+    t0 = time.perf_counter()
+    params = encdec.init_params(cfg, jax.random.PRNGKey(0),
+                                dtype=jnp.bfloat16)
+    jax.block_until_ready(params)
+    print(f"# T0-3B bf16 init {time.perf_counter() - t0:.0f}s")
+    for tag in ("bf16", "int8"):
+        if tag == "int8":
+            params = quant.quantize_encdec_params(params, dynamic=False)
+            jax.block_until_ready(params)
+            gc.collect()
+        eng = ScoringEngine(params, cfg, FakeTokenizer(),
+                            RuntimeConfig(batch_size=8, max_seq_len=256),
+                            encoder_decoder=True)
+        prompts = _prompts(n_words=30)
+        rows = eng.score_prompts(prompts)
+        out[tag] = {
+            "relative_prob": [r.relative_prob for r in rows],
+            "yes_prob": [r.yes_prob for r in rows],
+            "gap": [r.yes_logprob - r.no_logprob for r in rows],
+        }
+        print(f"# T0-3B {tag}: {len(rows)} prompts scored")
+        _dump("t0_3b", tag, out[tag])
+    PARITY_MD.write_text(
+        PARITY_MD.read_text()
+        + _audit_report("T0-3B bf16 vs int8, same weights",
+                        "seq2seq scoring path (10-position readout); one "
+                        "process, same tree quantized in place",
+                        out["bf16"], out["int8"]))
+
+
+def _audit_report(label: str, how: str, a: dict, b: dict,
+                  extra_rows: str = "") -> str:
+    """The measured-delta section: absolute-prob and logit-gap deltas plus
+    the DECISION flip rate. relative_prob on random weights is reported
+    with its amplification mechanism made explicit: yes/no carry ~1/vocab
+    mass, so the ratio of two near-zero numbers magnifies a 1e-4 absolute
+    perturbation into O(0.1) ratio swings that a trained checkpoint's
+    O(0.1-1) masses would not see."""
+    import numpy as np
+
+    yp = _delta_stats(a["yes_prob"], b["yes_prob"])
+    rel = _delta_stats(a["relative_prob"], b["relative_prob"])
+    gap = _delta_stats(a["gap"], b["gap"])
+    ga = np.asarray(a["gap"])
+    gb = np.asarray(b["gap"])
+    flip_mask = np.sign(ga) != np.sign(gb)
+    flips = float(np.mean(flip_mask))
+    margin = float(np.mean(np.abs(ga)))
+    # Flip rate among CONFIDENT decisions (margin above the mean |gap|):
+    conf = np.abs(ga) > margin
+    flips_conf = (float(np.mean(flip_mask[conf])) if conf.any()
+                  else float("nan"))
+    mass = float(np.mean(np.asarray(a["yes_prob"])))
+    n = len(a["yes_prob"])
+    return f"""
+### {label} — measured {datetime.date.today()} (tools/precision_audit.py)
+
+{n} synthetic prompts, {how}. Random weights measure the NUMERIC
+quantization path, not task accuracy (real checkpoints remain
+environment-blocked):
+
+| quantity | mean |Δ| | p50 | p95 | max |
+|---|---|---|---|---|
+| yes_prob (absolute, = D6 Token_1_Prob) | {yp['mean']:.2e} | {yp['p50']:.2e} | {yp['p95']:.2e} | {yp['max']:.2e} |
+| yes-no logit gap (decision margin) | {gap['mean']:.2e} | {gap['p50']:.2e} | {gap['p95']:.2e} | {gap['max']:.2e} |
+| relative_prob (see caveat) | {rel['mean']:.2e} | {rel['p50']:.2e} | {rel['p95']:.2e} | {rel['max']:.2e} |
+{extra_rows}
+- binarized-decision flip rate (sign of the yes-no gap): **{flips:.1%}**
+  overall; **{flips_conf:.1%}** among decisions whose bf16 margin exceeds
+  the mean |gap| of {margin:.2f}
+- caveat — random weights are a WORST-CASE amplifier, not a proxy for a
+  trained checkpoint: with no signal, per-layer quantization error
+  compounds through the full depth and the diffuse softmax (mean
+  yes-prob mass {mass:.1e} ~ 1/vocab) leaves every decision margin at
+  noise level, so sign flips are near-coin-flips exactly where the
+  margin is ~0. What this pins: the numeric int8 path at real size is
+  finite/sane, absolute-prob deltas sit at the {yp['mean']:.0e} level on
+  ~1/vocab masses, and flips concentrate in noise-level margins (see the
+  confident-decision rate). Task-level accuracy on trained weights
+  remains environment-blocked (PARITY.md pretrained leg).
+"""
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="t0_3b")
+    ap.add_argument("--phase", default=None,
+                    choices=("bf16", "int8", "diff"),
+                    help="decoder-only models: run one precision per "
+                         "process (HBM), then --phase diff")
+    args = ap.parse_args()
+    if args.model == "t0_3b":
+        run_t5()
+    elif args.phase == "bf16":
+        phase_bf16_7b(args.model)
+    elif args.phase == "int8":
+        phase_int8_7b(args.model)
+    elif args.phase == "diff":
+        phase_diff(args.model,
+                   f"{args.model} bf16 vs int8-dyn+kvq8, same weights")
+    else:
+        raise SystemExit("--phase required for decoder-only models")
+
+
+if __name__ == "__main__":
+    main()

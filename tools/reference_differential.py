@@ -53,14 +53,31 @@ SCRIPTS = {
         REF / "survey_analysis/survey_analysis_consolidated.py",
     "analyze_llm_agreement_simple_bootstrap.py":
         REF / "survey_analysis/analyze_llm_agreement_simple_bootstrap.py",
+    "analyze_perturbation_results.py":
+        REF / "analysis/analyze_perturbation_results.py",
+    "analyze_results_base_versus_instruct.py":
+        REF / "analysis/analyze_results_base_versus_instruct.py",
+    "analyze_llm_human_agreement.py":
+        REF / "survey_analysis/analyze_llm_human_agreement.py",
+    "analyze_model_family_differences.py":
+        REF / "survey_analysis/analyze_model_family_differences.py",
+    "calculate_correlation_pvalues.py":
+        REF / "survey_analysis/calculate_correlation_pvalues.py",
+    "analyze_base_vs_instruct_vs_human.py":
+        REF / "survey_analysis/analyze_base_vs_instruct_vs_human.py",
+    "bootstrap_confidence_intervals.py":
+        REF / "survey_analysis/bootstrap_confidence_intervals.py",
 }
 
-_GDRIVE = re.compile(r"G:/My Drive/Computational/llm_interpretation/?")
+_GDRIVE_DIR = re.compile(r"G:/My Drive/Computational/llm_interpretation/")
+_GDRIVE = re.compile(r"G:/My Drive/Computational/llm_interpretation")
 
 
 def _patch(text: str) -> str:
+    text = _GDRIVE_DIR.sub("./", text)
     text = _GDRIVE.sub(".", text)
     text = text.replace("pd.read_excel", "pd.read_csv")
+    text = text.replace(".to_excel(", ".to_csv(")
     text = text.replace("combined_results.xlsx", "combined_results.csv")
     text = text.replace("results_30_multi_model.xlsx", "combined_results.csv")
     return text
@@ -172,7 +189,109 @@ def capture() -> dict:
     golden["llm_human_agreement_bootstrap"] = json.loads(
         (SANDBOX / "llm_human_agreement_bootstrap.json").read_text())
 
+    # The 2,025-line perturbation analyzer (C20-C27 in one script): per-model
+    # summary stats, KS/AD normality, the zero/one-inflated truncated-normal
+    # MC fit, within-prompt kappa, and both compliance checkers — run on the
+    # synthetic D6 whose edge model exercises every hairy branch.
+    from lir_tpu.data.synthetic import SYNTH_EDGE_MODEL, SYNTH_MODEL
+    _run("analyze_perturbation_results.py")
+    pert = {}
+    for model in (SYNTH_MODEL, SYNTH_EDGE_MODEL):
+        safe = model.replace(".", "_").replace("-", "_")
+        mdir = SANDBOX / "output" / safe
+        pert[model] = {
+            stem: pd.read_csv(mdir / f"{stem}.csv").to_dict(orient="list")
+            for stem in ("summary_statistics", "normality_test_results",
+                         "truncated_normal_test_results",
+                         "cohens_kappa_results",
+                         "output_compliance_results",
+                         "confidence_compliance_results")
+        }
+    golden["analyze_perturbation_results"] = pert
+
+    # C28: base-vs-instruct family deltas on the committed D2.
+    _run("analyze_results_base_versus_instruct.py")
+    adir = SANDBOX / "analysis_results"
+    golden["base_versus_instruct"] = {
+        stem: pd.read_csv(adir / f"{stem}.csv").to_dict(orient="list")
+        for stem in ("model_rel_prob_statistics",
+                     "prompt_rel_prob_differences",
+                     "prompt_rel_prob_heatmap_data")
+    }
+
+    # C39: per-model human-LLM agreement (MAE/MSE/correlation suite).
+    _run("analyze_llm_human_agreement.py")
+    golden["llm_human_agreement"] = json.loads(
+        (SANDBOX / "llm_human_agreement_analysis.json").read_text())
+
+    # C42: family differences — a print-only script; its stdout IS the
+    # artifact, so the numeric report is parsed into structure.
+    out = _run("analyze_model_family_differences.py")
+    golden["family_differences"] = _parse_family_differences(out)
+
+    # C43: correlation p-value suite. The full human pairwise list is tens
+    # of thousands of rows; keep the distribution-level comparison (every
+    # statistic the report prints) plus the complete LLM pair list.
+    _run("calculate_correlation_pvalues.py")
+    pv = json.loads(
+        (SANDBOX / "correlation_pvalues_analysis.json").read_text())
+    golden["correlation_pvalues"] = {
+        "comparison": pv["comparison"],
+        "llm_correlations": pv["llm_correlations"],
+        "n_human_correlations": len(pv["human_correlations"]),
+    }
+
+    # Base vs instruct vs human correlations (survey-side C28 companion).
+    _run("analyze_base_vs_instruct_vs_human.py")
+    golden["base_vs_instruct_vs_human"] = pd.read_csv(
+        SANDBOX / "model_human_correlations.csv").to_dict(orient="list")
+
+    # C38: the simulated-individual bootstrap (10,000 iterations of a
+    # pure-Python resampling loop — by far the slowest capture; hours).
+    if os.environ.get("LIR_SKIP_SLOW_BOOTSTRAP") != "1":
+        _run("bootstrap_confidence_intervals.py", timeout=6 * 3600)
+        golden["bootstrap_confidence_intervals"] = json.loads(
+            (SANDBOX / "bootstrap_confidence_intervals.json").read_text())
+
     return golden
+
+
+_FAMILY_ROW = re.compile(
+    r"^(\w+)\s+(MAE|MSE|MAPE)\s+([+\-\d.]+)%?\s+([+\-\d.]+)%?\s+"
+    r"([+\-\d.]+)%?\s+\[([+\-\d.]+)%?, ([+\-\d.]+)%?\]\s+(Yes|No)\s*$",
+    re.MULTILINE)
+_MC_FAMILY = re.compile(r"^([A-Z]+)\n-{60}", re.MULTILINE)
+_MC_ROW = re.compile(
+    r"^(MAE|MSE|MAPE): ([+\-\d.]+)%? \[([+\-\d.]+)%?, ([+\-\d.]+)%?\], "
+    r"p = ([\d.]+)\s*$", re.MULTILINE)
+
+
+def _parse_family_differences(stdout: str) -> dict:
+    """Structure analyze_model_family_differences.py's printed report:
+    the CI-combination summary table and the seed-42 Monte-Carlo section
+    (its only outputs — the script writes no files)."""
+    table = {}
+    for m in _FAMILY_ROW.finditer(stdout):
+        fam, metric = m.group(1), m.group(2)
+        table.setdefault(fam, {})[metric] = {
+            "base": float(m.group(3)), "instruct": float(m.group(4)),
+            "diff": float(m.group(5)),
+            "ci": [float(m.group(6)), float(m.group(7))],
+            "significant": m.group(8) == "Yes",
+        }
+    mc_section = stdout.split("BOOTSTRAP-BASED DIFFERENCE ANALYSIS", 1)[-1]
+    mc: dict = {}
+    fams = list(_MC_FAMILY.finditer(mc_section))
+    for i, fm in enumerate(fams):
+        seg = mc_section[fm.end():
+                         fams[i + 1].start() if i + 1 < len(fams) else None]
+        mc[fm.group(1)] = {
+            r.group(1): {"diff": float(r.group(2)),
+                         "ci": [float(r.group(3)), float(r.group(4))],
+                         "p": float(r.group(5))}
+            for r in _MC_ROW.finditer(seg)
+        }
+    return {"summary_table": table, "mc_differences": mc}
 
 
 def main() -> None:
