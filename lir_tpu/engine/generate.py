@@ -22,6 +22,7 @@ from jax import lax
 from ..models import decoder
 from ..models.registry import ModelConfig, T5Config
 from ..models import encdec
+from . import tokens as _tok
 
 
 @jax.tree_util.register_dataclass
@@ -118,11 +119,11 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
         if early_stop:
             emit = jnp.where(done, eos_id, nxt)
             cls = stop_mask[emit]
-            pure = (cls & 1) != 0          # tokens.STOP_PURE
-            prefix = (cls & 2) != 0        # tokens.STOP_PREFIX
-            glue = (cls & 4) != 0          # tokens.STOP_STARTS_WORD
-            ends_w = (cls & 8) != 0        # tokens.STOP_ENDS_WORD
-            transp = (cls & 16) != 0       # tokens.STOP_TRANSPARENT
+            pure = (cls & _tok.STOP_PURE) != 0
+            prefix = (cls & _tok.STOP_PREFIX) != 0
+            glue = (cls & _tok.STOP_STARTS_WORD) != 0
+            ends_w = (cls & _tok.STOP_ENDS_WORD) != 0
+            transp = (cls & _tok.STOP_TRANSPARENT) != 0
             done = done | (emit == eos_id) | (digit_run & ~glue & ~transp)
             # A standalone digit run opens on a pure-digit token at a word
             # boundary (space prefix, or previous token ended non-word —

@@ -130,7 +130,7 @@ def phase_bf16_7b(preset: str) -> None:
     _dump(preset, "bf16", _score_decoder(params, cfg, batch=2))
 
 
-def phase_int8_7b(preset: str) -> None:
+def phase_int8_7b(preset: str, static: bool = False) -> None:
     import jax
     import jax.numpy as jnp
     import dataclasses
@@ -138,7 +138,8 @@ def phase_int8_7b(preset: str) -> None:
     from lir_tpu.models import decoder, quant
     from tools.scale_validation import resolve_preset
 
-    cfg = dataclasses.replace(resolve_preset(preset), kv_cache_int8=True)
+    cfg = dataclasses.replace(resolve_preset(preset),
+                              kv_cache_int8=not static)
     cpus = jax.devices("cpu")
     t0 = time.perf_counter()
     # SAME weights as the bf16 phase: jax PRNG is backend-deterministic, so
@@ -146,22 +147,39 @@ def phase_int8_7b(preset: str) -> None:
     with jax.default_device(cpus[0]):
         host = decoder.init_params(cfg, jax.random.PRNGKey(0),
                                    dtype=jnp.bfloat16)
-        qhost = quant.quantize_decoder_params(host, dynamic=True)
+        qhost = quant.quantize_decoder_params(host, dynamic=not static)
         del host
     params = jax.device_put(qhost, jax.devices()[0])
     jax.block_until_ready(params)
     del qhost
     print(f"# int8 host-quantize + ship {time.perf_counter() - t0:.0f}s")
-    _dump(preset, "int8", _score_decoder(params, cfg, batch=2))
+    _dump(preset, "int8static" if static else "int8",
+          _score_decoder(params, cfg, batch=2))
 
 
 def phase_diff(preset: str, label: str) -> None:
-    a = json.loads(_result_path(preset, "bf16").read_text())
+    # Baseline leg: bf16 when the chip had room for it; otherwise the
+    # weight-only static-int8 leg (the 12.55 GiB bf16-7B tree is blocked
+    # on the shared chip's fluctuating HBM — the int8static-vs-fastpath
+    # diff then isolates exactly the two fast-path features the sweeps
+    # enable on top of weight-only int8: dynamic activation quantization
+    # and the int8 KV cache).
+    base_tag = ("bf16" if _result_path(preset, "bf16").exists()
+                else "int8static")
+    how = ("position-0 fused readouts (the D6 quantities), separate "
+           "bf16/int8 phases over the same PRNGKey(0) tree")
+    if base_tag != "bf16":
+        label = (f"{preset} int8 weight-only vs int8-dyn+kvq8, same "
+                 f"weights (bf16 leg HBM-blocked)")
+        how = ("position-0 fused readouts (the D6 quantities), separate "
+               "weight-only-int8 and int8-dyn+kvq8 phases over the same "
+               "PRNGKey(0) tree — isolating the two fast-path features "
+               "the sweeps enable on top of weight-only int8")
+    a = json.loads(_result_path(preset, base_tag).read_text())
     b = json.loads(_result_path(preset, "int8").read_text())
     wc = _delta_stats(a["weighted_confidence"], b["weighted_confidence"])
     text = _audit_report(
-        label, "position-0 fused readouts (the D6 quantities), separate "
-        "bf16/int8 phases over the same PRNGKey(0) tree", a, b,
+        label, how, a, b,
         extra_rows=(f"| weighted confidence (0-100, E[v] @ pos 0) | "
                     f"{wc['mean']:.3f} | {wc['p50']:.3f} | {wc['p95']:.3f} | "
                     f"{wc['max']:.3f} |"))
@@ -189,9 +207,28 @@ def run_t5() -> None:
                                 dtype=jnp.bfloat16)
     jax.block_until_ready(params)
     print(f"# T0-3B bf16 init {time.perf_counter() - t0:.0f}s")
-    for tag in ("bf16", "int8"):
-        if tag == "int8":
-            params = quant.quantize_encdec_params(params, dynamic=False)
+    for tag in ("bf16", "eps", "int8"):
+        if tag == "eps":
+            # CONTROL: the same tree under int8-ROUNDING-SCALE gaussian
+            # noise (sigma = 0.4% of each tensor's scale, ~ the s8 LSB).
+            # If this flips decisions as often as int8 does, the flip rate
+            # measures the no-signal amplification floor of random
+            # weights, not int8-specific damage.
+            key_eps = jax.random.PRNGKey(99)
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            noisy = []
+            for i, w in enumerate(leaves):
+                if w.ndim >= 2:
+                    k = jax.random.fold_in(key_eps, i)
+                    sigma = 0.004 * jnp.std(w.astype(jnp.float32))
+                    w = (w.astype(jnp.float32)
+                         + sigma * jax.random.normal(k, w.shape)
+                         ).astype(w.dtype)
+                noisy.append(w)
+            saved_bf16 = params
+            params = jax.tree_util.tree_unflatten(treedef, noisy)
+        elif tag == "int8":
+            params = quant.quantize_encdec_params(saved_bf16, dynamic=False)
             jax.block_until_ready(params)
             gc.collect()
         eng = ScoringEngine(params, cfg, FakeTokenizer(),
@@ -206,16 +243,31 @@ def run_t5() -> None:
         }
         print(f"# T0-3B {tag}: {len(rows)} prompts scored")
         _dump("t0_3b", tag, out[tag])
+    import numpy as _np
+
+    flips_eps = float(_np.mean(
+        _np.sign(_np.asarray(out["bf16"]["gap"]))
+        != _np.sign(_np.asarray(out["eps"]["gap"]))))
     PARITY_MD.write_text(
         PARITY_MD.read_text()
         + _audit_report("T0-3B bf16 vs int8, same weights",
                         "seq2seq scoring path (10-position readout); one "
                         "process, same tree quantized in place",
-                        out["bf16"], out["int8"]))
+                        out["bf16"], out["int8"], has_control=True)
+        + f"- NULL CONTROL — bf16 vs bf16 + N(0, 0.4%*std) weight noise "
+          f"(~one s8 LSB, no quantization at all): decision flip rate "
+          f"**{flips_eps:.1%}**. Read the int8 flip rate against this "
+          f"floor: any flip rate at or below the control is the no-signal "
+          f"amplification of random weights, not int8 damage; only the "
+          f"EXCESS over the control is attributable to quantization. The "
+          f"decision rule stands on the absolute-prob row: int8 perturbs "
+          f"Token_1_Prob at the 1e-4 level on ~1e-4 masses; a trained "
+          f"checkpoint's O(0.1-1) masses dilute the same numeric error to "
+          f"~1e-4 relative — inside the 1% BASELINE gate.\n")
 
 
 def _audit_report(label: str, how: str, a: dict, b: dict,
-                  extra_rows: str = "") -> str:
+                  extra_rows: str = "", has_control: bool = False) -> str:
     """The measured-delta section: absolute-prob and logit-gap deltas plus
     the DECISION flip rate. relative_prob on random weights is reported
     with its amplification mechanism made explicit: yes/no carry ~1/vocab
@@ -238,6 +290,8 @@ def _audit_report(label: str, how: str, a: dict, b: dict,
                   else float("nan"))
     mass = float(np.mean(np.asarray(a["yes_prob"])))
     n = len(a["yes_prob"])
+    control_note = ("; the null control below separates quantization from "
+                    "the no-signal floor" if has_control else "")
     return f"""
 ### {label} — measured {datetime.date.today()} (tools/precision_audit.py)
 
@@ -258,12 +312,13 @@ environment-blocked):
   trained checkpoint: with no signal, per-layer quantization error
   compounds through the full depth and the diffuse softmax (mean
   yes-prob mass {mass:.1e} ~ 1/vocab) leaves every decision margin at
-  noise level, so sign flips are near-coin-flips exactly where the
-  margin is ~0. What this pins: the numeric int8 path at real size is
-  finite/sane, absolute-prob deltas sit at the {yp['mean']:.0e} level on
-  ~1/vocab masses, and flips concentrate in noise-level margins (see the
-  confident-decision rate). Task-level accuracy on trained weights
-  remains environment-blocked (PARITY.md pretrained leg).
+  noise level, so sign flips are near-coin-flips at EVERY margin (the
+  confident-decision rate matches the overall rate — margins themselves
+  are noise here). What this pins: the numeric int8 path at real size is
+  finite/sane and absolute-prob deltas sit at the {yp['mean']:.0e} level
+  on ~1/vocab masses; the null control below separates quantization from
+  the no-signal floor. Task-level accuracy on trained weights remains
+  environment-blocked (PARITY.md pretrained leg).
 """
 
 
@@ -271,7 +326,7 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model", default="t0_3b")
     ap.add_argument("--phase", default=None,
-                    choices=("bf16", "int8", "diff"),
+                    choices=("bf16", "int8", "int8static", "diff"),
                     help="decoder-only models: run one precision per "
                          "process (HBM), then --phase diff")
     args = ap.parse_args()
@@ -281,6 +336,8 @@ def main() -> None:
         phase_bf16_7b(args.model)
     elif args.phase == "int8":
         phase_int8_7b(args.model)
+    elif args.phase == "int8static":
+        phase_int8_7b(args.model, static=True)
     elif args.phase == "diff":
         phase_diff(args.model,
                    f"{args.model} bf16 vs int8-dyn+kvq8, same weights")
