@@ -101,6 +101,47 @@ class TestPerturbationAnalysis:
         )
         np.testing.assert_allclose(df["Relative_Prob"], expected)
 
+    def test_compliance_counts_local_format_rows(self):
+        """A D6 produced by the LOCAL sweep stores 'Log Probabilities' as a
+        {token_id: logprob} map — the reference's content parser skips such
+        rows, which used to leave the compliance report at 0/0. Local rows
+        must classify from 'Model Response' text; reference-style rows
+        (content format, or word-keyed maps) keep the executed reference's
+        semantics exactly (test_reference_differential pins those)."""
+        import json
+
+        from lir_tpu.data.prompts import LEGAL_PROMPTS
+        from lir_tpu.analysis.perturbation import (
+            add_relative_prob, check_output_compliance)
+
+        main = LEGAL_PROMPTS[0].main
+        local_map = json.dumps({"17": -0.5, "348": -1.2})
+        word_map = json.dumps({"Covered": -0.5, "Not": -1.5})
+        content = json.dumps(
+            {"content": [{"token": "Covered", "logprob": -0.1}]})
+        rows = [
+            # local rows: classified via Model Response
+            {"Log Probabilities": local_map, "Model Response": "Covered"},
+            {"Log Probabilities": local_map,
+             "Model Response": "Not Covered"},
+            {"Log Probabilities": local_map, "Model Response": "maybe so"},
+            # reference content row: parsed as before
+            {"Log Probabilities": content, "Model Response": "ignored"},
+            # reference-style word-keyed map: SKIPPED (reference parity)
+            {"Log Probabilities": word_map, "Model Response": "Covered"},
+        ]
+        df = pd.DataFrame([
+            dict(r, **{"Original Main Part": main, "Token_1_Prob": 0.6,
+                       "Token_2_Prob": 0.3}) for r in rows])
+        out = check_output_compliance(add_relative_prob(df), LEGAL_PROMPTS)
+        row = out.iloc[0]
+        assert int(row["Total_Samples"]) == 5
+        assert int(row["First_Token_Compliant"]) == 3   # 2 local + content
+        assert int(row["First_Token_Non_Compliant"]) == 1  # "maybe so"
+        # 'Not Covered' and 'Covered' full responses are subsequent-ok;
+        # content row "Covered" also ok.
+        assert int(row["Conditional_Subsequent_Compliant"]) == 3
+
     def test_relative_prob_zero_mass_is_nan(self):
         df = pd.DataFrame({"Token_1_Prob": [0.0], "Token_2_Prob": [0.0]})
         assert np.isnan(add_relative_prob(df)["Relative_Prob"].iloc[0])

@@ -211,8 +211,8 @@ def run_t5() -> None:
     for tag in ("bf16", "eps", "int8"):
         if tag == "eps":
             # CONTROL: the same tree under small gaussian weight noise
-            # (sigma = 0.4% of each tensor's std ~ 0.13 of the per-vector
-            # s8 LSB, which is ~max/127 ~ 3*std/127 for gaussian rows).
+            # (sigma = 0.4% of each tensor's std ~ 1/6 of the per-vector
+            # s8 LSB, which is ~max/127 ~ 3*std/127 for gaussian rows (0.004*127/3 ~ 0.17)).
             # If this flips decisions as often as int8 does, the flip rate
             # measures the no-signal amplification floor of random
             # weights, not int8-specific damage.
@@ -229,9 +229,13 @@ def run_t5() -> None:
                 noisy.append(w)
             saved_bf16 = params
             params = jax.tree_util.tree_unflatten(treedef, noisy)
+            del noisy, leaves
         elif tag == "int8":
-            params = None          # free the eps tree BEFORE quantizing:
-            gc.collect()           # bf16 + noisy + int8 would be ~13 GiB
+            # Free the eps tree BEFORE quantizing: bf16 + eps + int8 would
+            # be ~13 GiB. `eng` from the eps iteration also pins the tree.
+            params = None
+            eng = None  # noqa: F841 — drop the engine's params reference
+            gc.collect()
             params = quant.quantize_encdec_params(saved_bf16, dynamic=False)
             jax.block_until_ready(params)
             gc.collect()
@@ -259,7 +263,7 @@ def run_t5() -> None:
                         "process, same tree quantized in place",
                         out["bf16"], out["int8"], has_control=True)
         + f"- NULL CONTROL — bf16 vs bf16 + N(0, 0.4%*std) weight noise "
-          f"(~0.13 of the s8 LSB, no quantization at all): decision flip "
+          f"(~1/6 of the s8 LSB, no quantization at all): decision flip "
           f"rate "
           f"**{flips_eps:.1%}**. Read the int8 flip rate against this "
           f"floor: any flip rate at or below the control is the no-signal "
@@ -322,7 +326,7 @@ quantization path, not task accuracy (real checkpoints remain
 environment-blocked):
 
 | quantity | mean \\|Δ\\| | p50 | p95 | max |
-|---|---|---|---|---|---|
+|---|---|---|---|---|
 | yes_prob (absolute, = D6 Token_1_Prob) | {yp['mean']:.2e} | {yp['p50']:.2e} | {yp['p95']:.2e} | {yp['max']:.2e} |
 | yes-no logit gap (decision margin) | {gap['mean']:.2e} | {gap['p50']:.2e} | {gap['p95']:.2e} | {gap['max']:.2e} |
 | relative_prob (0-1; mean yes mass {mass:.1e} ~ 1/vocab amplifies) | {rel['mean']:.2e} | {rel['p50']:.2e} | {rel['p95']:.2e} | {rel['max']:.2e} |
