@@ -265,7 +265,10 @@ def main() -> None:
                  f"{n_params / 1e9:.2f}B {mode}, shared-prefix scoring, "
                  f"batch={sweep_batch}, {sweep_cells} cells, "
                  f"binary+confidence per cell; isolated step "
-                 f"{value:.1f} p/s at {mfu_str}, {dev.platform})"),
+                 f"{value:.1f} p/s at {mfu_str}; headline is the "
+                 f"cache-heaviest MHA architecture — GQA mistral-7b "
+                 f"measures 44.6 p/s at identical settings, SCALE.md; "
+                 f"{dev.platform})"),
         "vs_baseline": round(sweep_value / sweep_nominal, 3),
     }))
 
