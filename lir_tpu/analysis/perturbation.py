@@ -273,11 +273,15 @@ def check_output_compliance(
             else:
                 first_token, full_response = parsed
 
+            # Longest-matching expected token wins, so a target that is a
+            # string prefix of another (none today, but format wording can
+            # change) cannot steal the other's bucket by iteration order
+            # (ADVICE r4).
             matched = None
             for exp in expected["first_tokens"]:
                 if first_token == exp or first_token.startswith(exp):
-                    matched = exp
-                    break
+                    if matched is None or len(exp) > len(matched):
+                        matched = exp
             if matched is None:
                 first_bad += 1
                 continue

@@ -115,7 +115,6 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
         logits, cache, cache_mask, done, digit_run, prev_ew = carry
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         p_yes, p_no, top2 = _small_readout(logits, yes_ids, no_ids)
-        cache_mask = cache_mask.at[:, slot0 + t].set(1)
         if early_stop:
             emit = jnp.where(done, eos_id, nxt)
             cls = stop_mask[emit]
@@ -135,15 +134,26 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
                 (pure & (prefix | ~prev_ew)) | (digit_run & pure & ~prefix))
             prev_ew = jnp.where(transp, prev_ew, ends_w)
 
+            # Defensive (ADVICE r4): the slot write happens only when the
+            # step actually runs, so an early-stopped tail's final cache
+            # never marks unwritten KV slots as valid. No current caller
+            # reads that mask (both fused callers discard it) — this
+            # removes the latent hazard for future cache reuse, nothing
+            # more.
+            all_done = jnp.all(done)
+            step_mask = cache_mask.at[:, slot0 + t].set(1)
+
             def run(args):
                 lg, c = args
                 return decoder.decode_step(
-                    params, cfg, c, emit, pos0 + t, slot0 + t, cache_mask)
+                    params, cfg, c, emit, pos0 + t, slot0 + t, step_mask)
 
             new_logits, cache = lax.cond(
-                jnp.all(done), lambda args: args, run, (logits, cache))
+                all_done, lambda args: args, run, (logits, cache))
+            cache_mask = jnp.where(all_done, cache_mask, step_mask)
         else:
             emit = nxt
+            cache_mask = cache_mask.at[:, slot0 + t].set(1)
             new_logits, cache = decoder.decode_step(
                 params, cfg, cache, emit, pos0 + t, slot0 + t, cache_mask)
         return ((new_logits, cache, cache_mask, done, digit_run, prev_ew),

@@ -122,14 +122,43 @@ def digit_stop_classes(tokenizer, vocab_size: int) -> Optional[np.ndarray]:
     except Exception:  # noqa: BLE001 — added-token gaps
         return None
 
-    def _classify(t) -> int:
+    # Transparency comes from the tokenizer's own metadata, not surface
+    # form: ordinary vocab pieces can fullmatch <...> yet decode to literal
+    # text (<div>, <br> in code-trained vocabs) — those must be classified
+    # by their surface like any other token (ADVICE r4).
+    special_ids: set = set()
+    for i in (getattr(tokenizer, "all_special_ids", None) or ()):
+        special_ids.add(int(i))
+    added = getattr(tokenizer, "added_tokens_decoder", None)
+    if added:
+        try:
+            for tid, tok in added.items():
+                if getattr(tok, "special", False):
+                    special_ids.add(int(tid))
+        except Exception:  # noqa: BLE001 — non-dict implementations
+            pass
+    to_string = getattr(tokenizer, "convert_tokens_to_string", None)
+
+    def _classify(i: int, t) -> int:
         if t is None:
             return 0
         m = _BYTE_FORM.fullmatch(t)
         if m:
             t = chr(int(m.group(1), 16))   # the byte's actual character
-        elif _SPECIAL_FORM.fullmatch(t):
+        elif i in special_ids:
             return STOP_TRANSPARENT
+        elif _SPECIAL_FORM.fullmatch(t):
+            # Looks special but isn't registered: either a raw-tokenizer
+            # special invisible to metadata (decodes to "") or a literal
+            # vocab piece like <div> — ask the tokenizer which.
+            if to_string is not None:
+                try:
+                    surface = to_string([t])
+                except Exception:  # noqa: BLE001
+                    surface = t
+                if surface == "":
+                    return STOP_TRANSPARENT
+                t = surface
         stripped = t.lstrip("".join(_SPACE_PREFIX))
         prefix = len(stripped) < len(t)
         cls = STOP_PREFIX if prefix else 0
@@ -144,7 +173,7 @@ def digit_stop_classes(tokenizer, vocab_size: int) -> Optional[np.ndarray]:
         return cls
 
     mask = np.zeros((vocab_size,), dtype=np.int32)
-    mask[:n] = [_classify(t) for t in toks]
+    mask[:n] = [_classify(i, t) for i, t in enumerate(toks)]
     return mask
 
 

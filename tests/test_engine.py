@@ -416,20 +416,24 @@ def test_fused_decode_digit_early_stop_mechanics():
 def test_digit_stop_classes_surface_semantics():
     """The early-stop class table must read DECODED surfaces, not raw
     strings: byte tokens map to their byte ('<0x0A>' is a newline, '<0x30>'
-    is the digit 0), bracketed specials are transparent, space-prefixed
-    digits are standalone-integer openers, and letter-glued pieces ('st',
-    'a1b') glue — so '1st' never reads as a parseable integer."""
+    is the digit 0), REGISTERED specials are transparent (metadata, not
+    surface form: an unregistered <div> that decodes to literal text must
+    classify by its surface — ADVICE r4), space-prefixed digits are
+    standalone-integer openers, and letter-glued pieces ('st', 'a1b') glue
+    — so '1st' never reads as a parseable integer."""
     class Stub:
+        all_special_ids = [4, 5]
+
         def convert_ids_to_tokens(self, ids):
             table = ["▁Yes", "▁85", "<0x0A>", "<0x30>", "</s>",
                      "<|reserved_special_token_0|>", "a1b", "100",
-                     "st", ",", "Ġ42", "Ġ"]
+                     "st", ",", "Ġ42", "Ġ", "<div>"]
             return [table[i] for i in ids]
 
         def __len__(self):
-            return 12
+            return 13
 
-    cls = tok.digit_stop_classes(Stub(), 12)
+    cls = tok.digit_stop_classes(Stub(), 13)
     P, X, W, E, T = (tok.STOP_PURE, tok.STOP_PREFIX, tok.STOP_STARTS_WORD,
                      tok.STOP_ENDS_WORD, tok.STOP_TRANSPARENT)
     assert cls[0] == X | E                 # ▁Yes: fresh word, not digits
@@ -446,6 +450,27 @@ def test_digit_stop_classes_surface_semantics():
     # 'Ġ' alone is a letter CODEPOINT but decodes to a bare space: prefix
     # only, NOT word-ending ('\n' + '85' must still open a digit run).
     assert cls[11] == X
+    # Unregistered <div> is literal text (code-trained vocabs), NOT
+    # transparent: both bracket chars are non-word → plain terminator.
+    assert cls[12] == 0
+
+    class RawStub:
+        """No special-id metadata; transparency must come from the
+        decode-to-empty check instead."""
+
+        def convert_ids_to_tokens(self, ids):
+            table = ["</s>", "<div>"]
+            return [table[i] for i in ids]
+
+        def convert_tokens_to_string(self, toks):
+            return "".join("" if t == "</s>" else t for t in toks)
+
+        def __len__(self):
+            return 2
+
+    cls2 = tok.digit_stop_classes(RawStub(), 2)
+    assert cls2[0] == T
+    assert cls2[1] == 0
 
 
 @pytest.mark.slow

@@ -1,8 +1,12 @@
-"""Differential parity against the EXECUTED reference (VERDICT r1 #2).
+"""Differential parity against the EXECUTED reference (VERDICT r1 #2, r3 #1).
 
-tools/reference_differential.py ran the reference's own analysis scripts
-(model_comparison_graph.py, calculate_cohens_kappa.py,
-survey_analysis_consolidated.py, analyze_llm_agreement_simple_bootstrap.py)
+tools/reference_differential.py ran ALL 11 actually-runnable reference
+analysis/survey scripts (the full list is its SCRIPTS dict; of the 15
+scripts total, perturb_prompts.py and both compare_* scripts need API
+keys / GPU weights and are covered instead by the staged-oracle
+differentials in test_reference_scorer_oracle.py and
+test_reference_perturb_oracle.py, and
+analyze_llm_agreement_bootstrap.py (C40) is dead code — see PARITY.md)
 on the committed data CSVs + the pinned synthetic D6 + our regenerated D7,
 capturing every numeric artifact into tests/golden/reference_executed.json.
 These tests recompute the same quantities with lir_tpu's pipelines from the

@@ -435,10 +435,12 @@ def run_summarize() -> None:
     import re as _re
 
     text = SCALE_MD.read_text()
-    # Strip any previous generated block.
+    # Strip any previous generated block INCLUDING adjacent blank lines, so
+    # regeneration is a fixed point (blank padding must not accumulate).
     text = _re.sub(
-        _re.escape(SUMMARY_START) + r".*?" + _re.escape(SUMMARY_END) + r"\n?",
-        "", text, flags=_re.DOTALL)
+        r"\n*" + _re.escape(SUMMARY_START) + r".*?"
+        + _re.escape(SUMMARY_END) + r"\n*",
+        "\n\n", text, flags=_re.DOTALL)
 
     rows = []
     section = ""
@@ -468,12 +470,30 @@ def run_summarize() -> None:
                 model, mode = m.group(1), m.group(2)
         elif model is not None and line.startswith("|"):
             cells = [c.strip() for c in line.strip("|").split("|")]
-            # fused-step tables: | batch | compile | step | prompts/s | ...
-            if len(cells) >= 4:
-                try:
-                    best = max(best, float(cells[3]))
-                except ValueError:
-                    pass
+            # Locate the prompts/s column from the table HEADER (a cell
+            # naming the unit without carrying a number), never a fixed
+            # index — reordered/added columns must not silently record a
+            # wrong best (ADVICE r4).
+            if all(_re.fullmatch(r"[-: ]*", c) for c in cells):
+                pass                    # separator row keeps current header
+            elif not _re.search(r"\d", cells[0]):
+                # Header row: the label column has no digit, while every
+                # model-section data row leads with a batch size. A header
+                # WITHOUT a p/s column starts a non-throughput table and
+                # must invalidate the stale header so its rows aren't read
+                # at the old column index.
+                if any("p/s" in c or "prompts/s" in c for c in cells):
+                    header_cells = cells
+                else:
+                    header_cells = None
+            elif header_cells:
+                col = next((k for k, h in enumerate(header_cells)
+                            if "p/s" in h or "prompts/s" in h), None)
+                if col is not None and len(cells) > col:
+                    try:
+                        best = max(best, float(cells[col].strip("*")))
+                    except ValueError:
+                        pass
         elif model is None and line.startswith("|"):
             cells = [c.strip() for c in line.strip("|").split("|")]
             if any("p/s" in c or "prompts/s" in c for c in cells):
