@@ -402,7 +402,14 @@ def config_from_hf(hf_cfg) -> Tuple[ModelConfig, str]:
                            pos_embedding="learned", norm="layernorm",
                            norm_eps=hf_cfg.layer_norm_epsilon, activation="gelu_new",
                            gated_mlp=False, qkv_bias=True, attn_out_bias=True,
-                           mlp_bias=True, tie_embeddings=True), "gpt2"
+                           mlp_bias=True,
+                           # HF GPT-2 defaults to tied embeddings, but the
+                           # config is authoritative: an untied checkpoint
+                           # carries a real lm_head.weight that MUST be
+                           # used (scoring through wte^T instead silently
+                           # rewrites every logit).
+                           tie_embeddings=bool(getattr(
+                               hf_cfg, "tie_word_embeddings", True))), "gpt2"
     if mt == "gpt_neox":
         return ModelConfig(**common, intermediate_size=hf_cfg.intermediate_size,
                            pos_embedding="rotary", rotary_pct=hf_cfg.rotary_pct,

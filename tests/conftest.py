@@ -26,6 +26,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import sys  # noqa: E402
+from pathlib import Path  # noqa: E402
+
+# Shared tiny-checkpoint builders (tools/tiny_checkpoints.py) back both the
+# oracle capture tools and the checkpoint-based differentials.
+_TOOLS = str(Path(__file__).resolve().parent.parent / "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -32,35 +32,13 @@ pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
 
 @pytest.fixture(scope="module")
 def bpe_checkpoint(tmp_path_factory):
-    """Train a real byte-level BPE tokenizer + save a GPT-2 checkpoint."""
-    import transformers as tf
-    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
-    from lir_tpu.data.prompts import WORD_MEANING_QUESTIONS
+    """Train a real byte-level BPE tokenizer + save a GPT-2 checkpoint
+    (shared builder: tools/tiny_checkpoints.py, also used by the staged
+    reference-scorer oracle so both sides score identical weights)."""
+    from tiny_checkpoints import build_bpe_gpt2
 
-    corpus = list(WORD_MEANING_QUESTIONS) + [
-        "Yes", "No", " Yes", " No", "Answer either 'Yes' or 'No'.",
-        "Question: Answer:", "Is a tomato a vegetable?",
-        " ".join(str(i) for i in range(101)),
-    ]
-    tok = Tokenizer(models.BPE(unk_token=None))
-    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
-    tok.decoder = decoders.ByteLevel()
-    trainer = trainers.BpeTrainer(
-        vocab_size=1024, special_tokens=["<|endoftext|>"],
-        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
-    tok.train_from_iterator(corpus, trainer)
-    fast = tf.PreTrainedTokenizerFast(
-        tokenizer_object=tok, eos_token="<|endoftext|>")
-
-    torch.manual_seed(0)
-    model = tf.GPT2LMHeadModel(tf.GPT2Config(
-        vocab_size=len(fast), n_embd=64, n_layer=2, n_head=4,
-        n_positions=256)).eval()
     path = tmp_path_factory.mktemp("real_ckpt") / "bpe-gpt2"
-    path.mkdir()
-    model.save_pretrained(path, safe_serialization=True)
-    fast.save_pretrained(path)
-    return path, model, fast
+    return build_bpe_gpt2(path)
 
 
 def _reference_yes_no(model, tokenizer, prompt: str, yes_id: int, no_id: int,
@@ -90,8 +68,11 @@ def _reference_yes_no(model, tokenizer, prompt: str, yes_id: int, no_id: int,
 def test_unmocked_load_and_score_matches_torch(bpe_checkpoint):
     path, torch_model, fast = bpe_checkpoint
 
+    # max_seq_len 256: the formatted few-shot prompt is ~134 BPE tokens and
+    # buckets are powers of two — 128 would silently left-truncate while the
+    # torch reference scores the full prompt.
     engine = load_engine(path, RuntimeConfig(batch_size=4, max_new_tokens=12,
-                                             max_seq_len=128))
+                                             max_seq_len=256))
     # The real tokenizer resolved the LEADING-SPACE ids (hard part #1).
     assert engine.yes_id == fast(" Yes", add_special_tokens=False).input_ids[0]
     assert engine.no_id == fast(" No", add_special_tokens=False).input_ids[0]
@@ -162,46 +143,13 @@ def test_real_pretrained_checkpoint_smoke():
 def sp_checkpoint(tmp_path_factory):
     """Build a GENUINE sentencepiece-style tokenizer (Unigram model +
     Metaspace pre-tokenizer, the llama/t5 scheme) + a random-weight Llama
-    checkpoint saved with save_pretrained. The Unigram vocab is constructed
-    explicitly — word pieces ("▁Yes", "▁No", "▁85", ...) scored above a
-    full char-fallback alphabet — so the metaspace resolution under test is
-    deterministic, exactly like a trained sentencepiece model's."""
-    import transformers as tf
-    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
-    from lir_tpu.data.prompts import WORD_MEANING_QUESTIONS
+    checkpoint saved with save_pretrained (shared builder:
+    tools/tiny_checkpoints.py; the Unigram vocab is constructed explicitly
+    so the metaspace resolution under test is deterministic)."""
+    from tiny_checkpoints import build_sp_llama
 
-    corpus = list(WORD_MEANING_QUESTIONS) + [
-        "Yes", "No", "Answer either 'Yes' or 'No'.",
-        "Question: Answer:", "Is a tomato a vegetable?",
-        "Give a confidence number from 0 to 100",
-    ]
-    words = sorted({w for line in corpus for w in line.split()})
-    chars = sorted({c for line in corpus for c in line} | {"▁"})
-    pieces = {"<unk>": 0.0, "<s>": 0.0, "</s>": 0.0}
-    for w in words:
-        pieces.setdefault("▁" + w, -8.0)
-    for v in range(101):
-        pieces.setdefault("▁" + str(v), -8.0)
-        pieces.setdefault(str(v), -9.0)
-    for c in chars:
-        pieces.setdefault(c, -12.0)
-    tok = Tokenizer(models.Unigram(list(pieces.items()), unk_id=0))
-    tok.pre_tokenizer = pre_tokenizers.Metaspace()
-    tok.decoder = decoders.Metaspace()
-    fast = tf.PreTrainedTokenizerFast(
-        tokenizer_object=tok, bos_token="<s>", eos_token="</s>",
-        unk_token="<unk>")
-
-    torch.manual_seed(1)
-    model = tf.LlamaForCausalLM(tf.LlamaConfig(
-        vocab_size=len(fast), hidden_size=64, num_hidden_layers=2,
-        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
-        max_position_embeddings=256, tie_word_embeddings=False)).eval()
     path = tmp_path_factory.mktemp("real_ckpt_sp") / "sp-llama"
-    path.mkdir()
-    model.save_pretrained(path, safe_serialization=True)
-    fast.save_pretrained(path)
-    return path, model, fast
+    return build_sp_llama(path)
 
 
 def test_sentencepiece_metaspace_yes_no_resolution(sp_checkpoint):

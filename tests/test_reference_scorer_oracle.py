@@ -1,0 +1,170 @@
+"""C13 oracle = the reference's EXECUTED scorer (VERDICT r4 #1).
+
+tools/reference_scorer_oracle.py staged compare_base_vs_instruct.py /
+compare_instruct_models.py with mechanical patches only, imported the
+reference's own `get_yes_no_logprobs` (compare_base_vs_instruct.py:185-305,
+compare_instruct_models.py:171-293), and ran it on CPU torch against the
+deterministic tiny checkpoints from tools/tiny_checkpoints.py — including
+the programmed-chain GPT-2 that forces top-2 matches at positions 0/2/5,
+as runner-up at 3, and never (pos-0 fallback), and a bos-prepending
+tokenizer that executes the reference's special-token grab (:244-247).
+Every captured field lives in tests/golden/reference_executed.json
+["scorer_oracle"]. These tests rebuild the IDENTICAL checkpoints, score
+the identical prompts with lir_tpu's production engine
+(factory.load_engine -> engine/score.py), and diff row-by-row. The scan
+rule's semantics are therefore pinned against executed reference code, not
+a reimplementation.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lir_tpu.config import RuntimeConfig
+from lir_tpu.models.factory import load_engine
+
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
+GOLDEN_PATH = Path(__file__).parent / "golden" / "reference_executed.json"
+
+PROB_ABS = 2e-3     # CPU f32 torch vs XLA logit-level agreement
+REL = 0.01          # the BASELINE ≤1% gate for derived readouts
+
+
+@pytest.fixture(scope="module")
+def golden():
+    if not GOLDEN_PATH.exists():
+        pytest.skip("run tools/reference_differential.py first")
+    data = json.loads(GOLDEN_PATH.read_text())
+    if "scorer_oracle" not in data:
+        pytest.skip("run tools/reference_scorer_oracle.py first")
+    return data["scorer_oracle"]
+
+
+@pytest.fixture(scope="module")
+def ckpt_root(tmp_path_factory):
+    return tmp_path_factory.mktemp("oracle_ckpts")
+
+
+def _engine(path, max_new=50):
+    # max_seq_len 256: the formatted few-shot prompts are ~134 tokens and
+    # buckets are powers of two — 128 would silently left-truncate.
+    return load_engine(path, RuntimeConfig(batch_size=4,
+                                           max_new_tokens=max_new,
+                                           max_seq_len=256))
+
+
+def _diff_case(row, ref, *, check_completion=False):
+    """Row-by-row diff of one engine PromptScore against one executed
+    reference result dict."""
+    assert row.position_found == ref["position_found"], (
+        row.prompt, row.position_found, ref["position_found"])
+    assert row.yes_no_found == ref["yes_no_found"]
+    assert abs(row.yes_prob - ref["yes_prob"]) < PROB_ABS
+    assert abs(row.no_prob - ref["no_prob"]) < PROB_ABS
+    # Derived readouts under the 1% gate wherever they are numerically
+    # meaningful. Below ~1e-6 masses the engine's 1e-10 softmax epsilon
+    # and the reference's raw ratio diverge by construction (documented in
+    # engine/score.py); the raw probabilities above already pin those.
+    if "odds_ratio" in ref and ref["no_prob"] > 1e-6:
+        assert abs(row.odds_ratio - ref["odds_ratio"]) <= (
+            REL * max(abs(ref["odds_ratio"]), 1e-9))
+    denom = ref["yes_prob"] + ref["no_prob"]
+    if "relative_prob" in ref and denom > 1e-6:
+        assert abs(row.relative_prob - ref["relative_prob"]) <= (
+            REL * max(abs(ref["relative_prob"]), 1e-9))
+    if check_completion:
+        assert row.completion.strip() == ref["completion"].strip()
+
+
+def _run_group(golden, ckpt_root, key, builder, *,
+               check_completion=False, max_new=50):
+    group = golden[key]
+    path = ckpt_root / key
+    built = builder(path)
+    engine = _engine(path, max_new=max_new)
+    # Target-id resolution must agree with what the EXECUTED reference
+    # resolved (it never adds specials for these tokenizers).
+    assert engine.yes_id == group["yes_id"]
+    assert engine.no_id == group["no_id"]
+    prompts = [c["prompt"] for c in group["cases"]]
+    rows = engine.score_prompts(prompts)
+    for row, case in zip(rows, group["cases"]):
+        # Both reference variants ran; their scan rules are identical, so
+        # diff against each (cbvi carries odds_ratio, cim relative_prob).
+        _diff_case(row, case["ref_cbvi"], check_completion=check_completion)
+        _diff_case(row, case["ref_cim"], check_completion=check_completion)
+    return built, engine, rows
+
+
+def test_bpe_gpt2_matches_executed_reference(golden, ckpt_root):
+    from tiny_checkpoints import build_bpe_gpt2
+    _run_group(golden, ckpt_root, "bpe-gpt2", build_bpe_gpt2)
+
+
+def test_sp_llama_matches_executed_reference(golden, ckpt_root):
+    from tiny_checkpoints import build_sp_llama
+    _run_group(golden, ckpt_root, "sp-llama", build_sp_llama)
+
+
+def test_sp_t5_matches_executed_reference(golden, ckpt_root):
+    """The enc-dec branch (compare_base_vs_instruct.py:188-237): ids from
+    tokenizer("Yes"), scores scanned from decoder steps."""
+    from tiny_checkpoints import build_sp_t5
+    _run_group(golden, ckpt_root, "sp-t5", build_sp_t5, max_new=12)
+
+
+def test_chain_gpt2_pins_scan_positions(golden, ckpt_root):
+    """The programmed-chain checkpoint forces every scan outcome the rule
+    can produce: found at 0 (immediate), 2 and 5 (after preamble), found
+    as the top-2 RUNNER-UP at 3, and never found -> position-0 fallback
+    (compare_base_vs_instruct.py:280-285). Completions compare exactly —
+    +10/+5 margins leave no framework tie-break slack."""
+    from tiny_checkpoints import build_chain_gpt2
+    group = golden["chain-gpt2"]
+    # The capture asserted the reference hit the designed outcomes; pin
+    # them here too so the golden can't drift.
+    designed = {k: tuple(v) for k, v in group["designed"].items()}
+    for case in group["cases"]:
+        want = designed[case["key"]]
+        assert (case["ref_cbvi"]["position_found"],
+                case["ref_cbvi"]["yes_no_found"]) == want
+    _, _, rows = _run_group(golden, ckpt_root, "chain-gpt2",
+                            lambda p: build_chain_gpt2(p)[:3],
+                            check_completion=True)
+    # The never-found case must have scanned ALL 10 positions without a
+    # match on our side as well (fallback, not an early find).
+    never = [r for r, c in zip(rows, group["cases"]) if c["key"] == "never"]
+    assert never[0].yes_no_found is False
+    assert never[0].position_found == 0
+
+
+def test_bos_tokenizer_quirk_executed_and_fixed(golden, ckpt_root):
+    """EXECUTED reference fact (not a reading of its source): with a
+    bos-prepending tokenizer (real LlamaTokenizer encode semantics), the
+    reference's `tokenizer(" Yes").input_ids[0]` (:244-247) resolves BOTH
+    targets to <s>, so yes_prob == no_prob and relative_prob degenerates
+    to exactly 0.5 for every prompt. lir_tpu resolves targets with
+    add_special_tokens=False (engine/tokens.first_token_id) — fixed, not
+    replicated (PARITY.md "Reference defects")."""
+    from tiny_checkpoints import build_sp_llama
+    group = golden["sp-llama-bos"]
+    assert group["yes_id"] == group["bos_id"]
+    assert group["no_id"] == group["bos_id"]
+    ref = group["cases"][0]["ref_cim"]
+    assert ref["relative_prob"] == 0.5
+    assert ref["yes_prob"] == ref["no_prob"]
+
+    path = ckpt_root / "sp-llama-bos"
+    build_sp_llama(path, add_bos=True)
+    engine = _engine(path)
+    # Our resolution lands on the metaspace pieces, never the special.
+    assert engine.yes_id != group["bos_id"]
+    assert engine.no_id != group["bos_id"]
+    assert engine.yes_id == golden["sp-llama"]["yes_id"]
+    row = engine.score_prompts([group["cases"][0]["prompt"]])[0]
+    assert np.isfinite(row.relative_prob)
+    # The engine keeps a real signal where the reference's is constant.
+    assert row.yes_prob != row.no_prob
