@@ -100,29 +100,36 @@ def build_batch_requests(
     model: str,
     reasoning_model: bool = False,
     reasoning_runs: int = 10,
+    skip_reasoning_logprobs: bool = True,
 ) -> Tuple[List[Dict[str, object]], Dict[str, GridCell]]:
     """Expand grid cells into chat-completion batch requests with a
-    custom_id -> cell map (perturb_prompts.py:190-269). Binary requests get
-    temperature 0, logprobs top-20; confidence requests are plain.
-    Reasoning models (no logprobs exposed) repeat each binary request
-    ``reasoning_runs`` times; the decoder averages answer counts
-    (REASONING_MODEL_RUNS, perturb_prompts.py:47,220,412-446)."""
+    custom_id -> cell map (perturb_prompts.py:190-269). Non-reasoning
+    bodies carry temperature 0 / max_tokens 500 / logprobs top-20 on BOTH
+    formats — the confidence request's logprobs feed the weighted E[v]
+    readout (:504-526) — plus the reference's response_format field.
+    Reasoning models (no logprobs exposed) default to the reference's
+    SKIP_REASONING_MODEL_LOGPROBS=True mode (confidence request only,
+    :211); with skip_reasoning_logprobs=False each binary request repeats
+    ``reasoning_runs`` times and the decoder averages answer counts
+    (REASONING_MODEL_RUNS, perturb_prompts.py:47,220,412-446). Body
+    fields pinned against the EXECUTED reference
+    (tools/reference_perturb_oracle.py)."""
     requests: List[Dict[str, object]] = []
     id_map: Dict[str, GridCell] = {}
 
-    def add(custom_id: str, cell: GridCell, fmt: str, prompt: str) -> None:
+    def add(custom_id: str, cell: GridCell, prompt: str) -> None:
         body: Dict[str, object] = {
             "model": model,
             "messages": [{"role": "user", "content": prompt}],
+            "response_format": {"type": "text"},
         }
         if reasoning_model:
             body["max_completion_tokens"] = 2000
         else:
-            body["temperature"] = 0
             body["max_tokens"] = 500
-            if fmt == "binary":
-                body["logprobs"] = True
-                body["top_logprobs"] = 20
+            body["temperature"] = 0.0
+            body["logprobs"] = True
+            body["top_logprobs"] = 20
         requests.append(
             {
                 "custom_id": custom_id,
@@ -136,12 +143,12 @@ def build_batch_requests(
     for cell in cells:
         base = f"p{cell.prompt_idx}_r{cell.rephrase_idx}"
         if reasoning_model:
-            for run in range(reasoning_runs):
-                add(f"{base}_binary_run{run}", cell, "binary",
-                    cell.binary_prompt)
+            if not skip_reasoning_logprobs:
+                for run in range(reasoning_runs):
+                    add(f"{base}_binary_run{run}", cell, cell.binary_prompt)
         else:
-            add(f"{base}_binary", cell, "binary", cell.binary_prompt)
-        add(f"{base}_confidence", cell, "confidence", cell.confidence_prompt)
+            add(f"{base}_binary", cell, cell.binary_prompt)
+        add(f"{base}_confidence", cell, cell.confidence_prompt)
     return requests, id_map
 
 
@@ -214,9 +221,13 @@ class ApiScore:
     confidence_value: Optional[int] = None
     weighted_confidence: Optional[float] = None
     run_responses: List[str] = dataclasses.field(default_factory=list)
+    reasoning_skipped: bool = False
+    binary_seen: bool = False
 
     @property
     def odds_ratio(self) -> float:
+        if self.reasoning_skipped:
+            return 0.0               # perturb_prompts.py:453 (skip mode)
         if self.token_2_prob > 0:
             return self.token_1_prob / self.token_2_prob
         return math.inf
@@ -227,13 +238,16 @@ def _first_token_probs(
     target_tokens: Tuple[str, str],
 ) -> Tuple[float, float]:
     """Scan the first position's top_logprobs for the two target tokens
-    (perturb_prompts.py:474-490); a missing target scores 0."""
+    (perturb_prompts.py:474-490); a missing target scores 0. Matching is
+    RAW string equality — the executed reference never strips, so a
+    leading-space ' Covered' token does NOT match target 'Covered'
+    (pinned by the oracle's lookalike entries)."""
     if not logprob_content:
         return 0.0, 0.0
     top = logprob_content[0].get("top_logprobs", [])
     p1 = p2 = 0.0
     for entry in top:
-        token = str(entry.get("token", "")).strip()
+        token = str(entry.get("token", ""))
         lp = float(entry.get("logprob", -math.inf))
         if token == target_tokens[0]:
             p1 = math.exp(lp)
@@ -245,31 +259,36 @@ def _first_token_probs(
 def _weighted_confidence(
     logprob_content: List[Dict[str, object]]
 ) -> Optional[float]:
-    """E[v] over integer tokens 0-100 in the first confidence position's
-    top_logprobs (perturb_prompts.py:504-526)."""
-    if not logprob_content:
-        return None
-    top = logprob_content[0].get("top_logprobs", [])
+    """E[v] over integer-bearing tokens 0-100 across EVERY generated
+    confidence position's top_logprobs (perturb_prompts.py:504-526: the
+    reference iterates the full content list, and extracts integers with
+    the same \\b(\\d+)\\b search the text parse uses — '85%' contributes
+    85, '150' is range-excluded)."""
     num, den = 0.0, 0.0
-    for entry in top:
-        token = str(entry.get("token", "")).strip()
-        if not token.isdigit():
-            continue
-        v = int(token)
-        if not 0 <= v <= 100:
-            continue
-        p = math.exp(float(entry.get("logprob", -math.inf)))
-        num += v * p
-        den += p
+    for token_info in logprob_content:
+        for entry in token_info.get("top_logprobs", []) or []:
+            m = re.search(r"\b(\d+)\b", str(entry.get("token", "")))
+            if not m:
+                continue
+            v = int(m.group(1))
+            if not 0 <= v <= 100:
+                continue
+            p = math.exp(float(entry.get("logprob", -math.inf)))
+            num += v * p
+            den += p
     return num / den if den > 0 else None
 
 
 def decode_batch_results(
     results: Iterable[Dict[str, object]],
     id_map: Dict[str, GridCell],
+    reasoning_skip: bool = False,
 ) -> Dict[str, ApiScore]:
     """Re-key raw batch result objects by custom_id and extract the
-    measurement fields (perturb_prompts.py:352-549)."""
+    measurement fields (perturb_prompts.py:352-549). With
+    ``reasoning_skip`` (the reference's SKIP_REASONING_MODEL_LOGPROBS
+    mode, a confidence-only grid) rows carry the reference's literal
+    placeholders and odds_ratio 0.0 (:448-466)."""
     scores: Dict[str, ApiScore] = {}
     id_pattern = re.compile(r"^(p\d+_r\d+)_(binary(?:_run\d+)?|confidence)$")
     for obj in results:
@@ -279,37 +298,60 @@ def decode_batch_results(
         if cell is None or m_id is None:
             continue
         base_id, fmt = m_id.group(1), m_id.group(2)
-        body = (
-            obj.get("response", {}).get("body", {})
-            if isinstance(obj.get("response"), dict)
-            else {}
-        )
+        # The reference creates the per-cell entry for every KNOWN
+        # custom_id, but extracts fields only from lines that carry a
+        # response body — errored lines leave their leg empty
+        # (perturb_prompts.py:370-396).
+        score = scores.setdefault(base_id, ApiScore(custom_id=base_id))
+        response = obj.get("response")
+        body = (response.get("body", {})
+                if isinstance(response, dict) else {})
+        if not body:
+            log.warning("no response body for %s: %s", custom_id,
+                        (obj.get("error") or {}).get("message", "unknown"))
+            continue
         choices = body.get("choices") or [{}]
         message = choices[0].get("message", {}) or {}
         text = str(message.get("content", "") or "")
-        logprobs = choices[0].get("logprobs") or {}
-        content = logprobs.get("content") or []
+        raw_logprobs = choices[0].get("logprobs", {})
+        content = (raw_logprobs or {}).get("content") or []
 
-        score = scores.setdefault(base_id, ApiScore(custom_id=base_id))
         if fmt == "binary":
-            score.response_text = text
+            score.binary_seen = True
+            score.response_text = text.strip()
             score.token_1_prob, score.token_2_prob = _first_token_probs(
                 content, cell.target_tokens
             )
-            score.log_probabilities = json.dumps(
-                {
-                    str(e.get("token", "")): float(e.get("logprob", 0.0))
-                    for e in (content[0].get("top_logprobs", []) if content else [])
-                }
-            )
+            # D6 "Log Probabilities" stores the reference's exact string:
+            # str() of the full logprobs object (:540) — the format the
+            # compliance checker (C25) parses.
+            score.log_probabilities = str(raw_logprobs)
         elif fmt.startswith("binary_run"):
             # Reasoning-model run: counted later in _finalize_reasoning.
             score.run_responses.append(text.strip())
         else:
-            score.confidence_text = text
+            score.confidence_text = text.strip()
             m = re.search(r"\b(\d+)\b", text)
             score.confidence_value = int(m.group(1)) if m else None
             score.weighted_confidence = _weighted_confidence(content)
+
+    if reasoning_skip:
+        # Skip-mode rows are emitted even when their confidence line
+        # errored (values stay None) and always carry the reference's
+        # literal placeholders (:448-466).
+        for score in scores.values():
+            score.reasoning_skipped = True
+            score.response_text = "N/A (skipped for reasoning model)"
+            score.log_probabilities = "N/A for reasoning models"
+            score.weighted_confidence = score.confidence_value
+    else:
+        # A cell with no successful binary leg (single errored binary, or
+        # zero successful reasoning runs) is dropped with a warning
+        # (:408-410).
+        for base_id in [b for b, s in scores.items()
+                        if not s.binary_seen and not s.run_responses]:
+            log.warning("no binary results for %s — row dropped", base_id)
+            del scores[base_id]
 
     _finalize_reasoning(scores, id_map)
     return scores
@@ -341,6 +383,8 @@ def _finalize_reasoning(
          score.response_text) = count_averaged_responses(
             score.run_responses, t1, t2)
         # Reasoning models expose no logprobs; weighted confidence falls
-        # back to the parsed integer (perturb_prompts.py:446).
+        # back to the parsed integer and the D6 logprob column carries the
+        # reference's literal placeholder (perturb_prompts.py:446,540).
         if score.weighted_confidence is None:
             score.weighted_confidence = score.confidence_value
+        score.log_probabilities = "N/A for reasoning models"

@@ -52,15 +52,23 @@ class GridCell:
 
 
 def build_grid(model: str, prompts: Sequence[LegalPrompt],
-               perturbations: Sequence[Sequence[str]]) -> List[GridCell]:
+               perturbations: Sequence[Sequence[str]],
+               include_original: bool = True) -> List[GridCell]:
     """Expand the full grid for one model.
 
-    ``perturbations[i]`` is the rephrasing list for ``prompts[i]`` (the
-    original main part is always included as rephrase_idx 0, mirroring the
-    reference scoring the original alongside its rephrasings)."""
+    ``perturbations[i]`` is the rephrasing list for ``prompts[i]``. The
+    EXECUTED reference grid contains only the rephrasings
+    (create_batch_requests iterates the rephrasing lists alone,
+    perturb_prompts.py:200-213 — pinned by tools/reference_perturb_oracle.py);
+    ``include_original=True`` (the local-pipeline default) additionally
+    scores the unperturbed original as rephrase_idx 0, a lir_tpu
+    extension that anchors each prompt's perturbation distribution. Pass
+    ``include_original=False`` for reference-exact grids (the API-backend
+    oracle differential does)."""
     cells: List[GridCell] = []
     for pi, (prompt, rephrasings) in enumerate(zip(prompts, perturbations)):
-        variants = [prompt.main, *rephrasings]
+        variants = ([prompt.main, *rephrasings] if include_original
+                    else list(rephrasings))
         for ri, rephrased in enumerate(variants):
             cells.append(GridCell(
                 model=model, prompt_idx=pi, rephrase_idx=ri,

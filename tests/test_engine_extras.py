@@ -318,11 +318,20 @@ class TestApiBackend:
 
     def test_reasoning_model_requests(self):
         cells = grid_mod.build_grid("o3", LEGAL_PROMPTS[:1], [["v1"]])
+        # Default = the reference's SKIP_REASONING_MODEL_LOGPROBS=True
+        # mode: confidence-only grid (perturb_prompts.py:211).
         requests, _ = api_mod.build_batch_requests(
             cells, "o3", reasoning_model=True
         )
+        assert [r["custom_id"] for r in requests] == [
+            "p0_r0_confidence", "p0_r1_confidence"]
         assert all(r["body"]["max_completion_tokens"] == 2000 for r in requests)
         assert all("temperature" not in r["body"] for r in requests)
+        # Non-skip mode: 10 binary runs + confidence per cell.
+        requests, _ = api_mod.build_batch_requests(
+            cells, "o3", reasoning_model=True, skip_reasoning_logprobs=False
+        )
+        assert len(requests) == 22
 
     def test_end_to_end_decode(self):
         cells = grid_mod.build_grid("gpt-x", LEGAL_PROMPTS[:1], [["v1"]])
@@ -375,7 +384,8 @@ class TestReasoningRuns:
     def test_run_requests_and_averaging(self):
         cells = grid_mod.build_grid("o3", LEGAL_PROMPTS[:1], [[]])
         requests, id_map = api_mod.build_batch_requests(
-            cells, "o3", reasoning_model=True, reasoning_runs=4
+            cells, "o3", reasoning_model=True, reasoning_runs=4,
+            skip_reasoning_logprobs=False
         )
         # 1 cell -> 4 binary runs + 1 confidence.
         assert len(requests) == 5

@@ -1,0 +1,252 @@
+"""perturb_prompts.py EXECUTED as the C3/C4/C6/C8 oracle (VERDICT r4 #2).
+
+tools/reference_perturb_oracle.py staged the reference's perturb_prompts.py
+with mechanical patches and ran it END TO END against stub openai/anthropic
+clients replaying the deterministic payloads in tools/perturb_oracle_data.py
+— twice: scenario A (Step-1 rephrasing generation through the reference's
+numbered-list parser, seed-42 random subset of 20, reasoning model in its
+default confidence-only SKIP mode) and scenario B (canned perturbations
+loaded through the reference's own verification path, full grid, 10-run
+reasoning averaging). The capture (tests/golden/reference_perturb_oracle.json)
+holds every uploaded batch request and the final 15-column workbook.
+
+These tests rebuild the same grids with lir_tpu (engine/grid +
+backends/api), replay the IDENTICAL payloads through decode_batch_results,
+and diff: request bodies positionally (grid cardinality + custom_id
+mapping), every workbook measurement column at exact/≤1%, the rephrasing
+parser byte-for-byte, and the seed-42 subset selection.
+"""
+
+import hashlib
+import json
+import math
+from pathlib import Path
+
+import pytest
+
+from lir_tpu.backends import api as api_mod
+from lir_tpu.data import schemas
+from lir_tpu.data.prompts import LEGAL_PROMPTS
+from lir_tpu.engine import grid as grid_mod
+from lir_tpu.engine.rephrase import parse_numbered_rephrasings
+
+pytestmark = pytest.mark.slow  # heavy lane: see tests/conftest.py
+
+GOLDEN_PATH = Path(__file__).parent / "golden" / "reference_perturb_oracle.json"
+
+REGULAR = "gpt-4.1-2025-04-14"
+REASONING = "o3-2025-04-16"
+REL = 0.01
+N_SESSIONS = 100                      # perturb_prompts.py:791
+
+
+@pytest.fixture(scope="module")
+def golden():
+    if not GOLDEN_PATH.exists():
+        pytest.skip("run tools/reference_perturb_oracle.py first")
+    return json.loads(GOLDEN_PATH.read_text())
+
+
+def _scenario_a_rephrasings():
+    """Regenerate what the executed reference parsed in Step 1: sessions
+    run sequentially, 100 per prompt, prompts in order — the stub Claude's
+    call counter is therefore prompt_idx * 100 + session."""
+    from perturb_oracle_data import parsed_rephrasings
+
+    out = []
+    for p_idx, prompt in enumerate(LEGAL_PROMPTS):
+        rephrasings = []
+        for s in range(N_SESSIONS):
+            rephrasings.extend(
+                parsed_rephrasings(p_idx * N_SESSIONS + s, prompt.main))
+        out.append(rephrasings)
+    return out
+
+
+def _scenario_b_rephrasings():
+    from reference_perturb_oracle import _canned_perturbations
+
+    return [item["rephrasings"] for item in _canned_perturbations()]
+
+
+def _cells_for(scenario: str, model: str):
+    # include_original=False: the executed reference's grid is the
+    # rephrasings alone (the original-prompt cell is a lir_tpu extension).
+    if scenario == "scenario_a":
+        cells = grid_mod.build_grid(model, LEGAL_PROMPTS,
+                                    _scenario_a_rephrasings(),
+                                    include_original=False)
+        return grid_mod.random_subset(cells, 20, seed=42)
+    return grid_mod.build_grid(model, LEGAL_PROMPTS,
+                               _scenario_b_rephrasings(),
+                               include_original=False)
+
+
+def _requests_for(scenario: str, model: str):
+    cells = _cells_for(scenario, model)
+    if model == REASONING:
+        return api_mod.build_batch_requests(
+            cells, model, reasoning_model=True,
+            skip_reasoning_logprobs=(scenario == "scenario_a"))
+    return api_mod.build_batch_requests(cells, model)
+
+
+def test_step1_parser_matches_executed_reference(golden):
+    """The reference's Step-1 parser ran against 500 canned Claude
+    sessions; its saved perturbations.json is hash-pinned. Our parser
+    produces the identical rephrasings from the identical texts, so the
+    two parsers agree byte-for-byte on preambles, 'N.'/'N ' forms, and
+    continuation lines."""
+    from perturb_oracle_data import claude_rephrasings, parsed_rephrasings
+
+    expected = []
+    for p_idx, prompt in enumerate(LEGAL_PROMPTS):
+        item = {
+            "original_main": prompt.main,
+            "response_format": prompt.response_format,
+            "target_tokens": list(prompt.target_tokens),
+            "confidence_format": prompt.confidence_format,
+            "rephrasings": _scenario_a_rephrasings()[p_idx],
+        }
+        expected.append(item)
+    digest = hashlib.sha256(
+        json.dumps(expected, sort_keys=True, ensure_ascii=False)
+        .encode()).hexdigest()
+    pg = golden["scenario_a"]["perturbations"]
+    assert digest == pg["sha256"], "executed parser output drifted"
+    assert pg["counts"] == [len(i["rephrasings"]) for i in expected]
+    assert pg["samples"] == [i["rephrasings"][:3] for i in expected]
+
+    # OUR parser on the same canned session texts == the regenerated
+    # (hash-verified) reference output.
+    for k in (0, 137, 499):
+        main = LEGAL_PROMPTS[k // N_SESSIONS].main
+        assert parse_numbered_rephrasings(
+            claude_rephrasings(k, main)) == parsed_rephrasings(k, main)
+
+
+@pytest.mark.parametrize("scenario", ["scenario_a", "scenario_b"])
+@pytest.mark.parametrize("model", [REGULAR, REASONING])
+def test_grid_matches_executed_reference(golden, scenario, model):
+    """Positional body-for-body equality with the captured uploads: same
+    cardinality, same (prompt, rephrase, format, run) order, identical
+    request bodies (model, messages, response_format, sampling/logprob
+    params). custom_id naming differs by design (ours is structured,
+    the reference counts req-N) — positional equality carries the
+    mapping."""
+    ref_requests = golden[scenario]["uploads"][model]
+    ours, _ = _requests_for(scenario, model)
+    assert len(ours) == len(ref_requests)
+    for our_req, ref_req in zip(ours, ref_requests):
+        assert our_req["body"] == ref_req["body"]
+        assert our_req["method"] == ref_req["method"]
+        assert our_req["url"] == ref_req["url"]
+
+
+def _row_key(row):
+    return (row["Model"], row["Original Main Part"],
+            row["Rephrased Main Part"])
+
+
+@pytest.mark.parametrize("scenario", ["scenario_a", "scenario_b"])
+def test_decoder_matches_executed_workbook(golden, scenario):
+    """Replay the identical batch payloads through decode_batch_results
+    and diff every D6 measurement column against the workbook the
+    executed reference wrote."""
+    from perturb_oracle_data import openai_batch_result_line
+
+    rows_by_key = {}
+    for model in (REGULAR, REASONING):
+        ref_requests = golden[scenario]["uploads"][model]
+        ours, id_map = _requests_for(scenario, model)
+        # The payload the reference decoded, re-keyed onto our custom ids
+        # (positional identity established by the grid test).
+        results = []
+        for our_req, ref_req in zip(ours, ref_requests):
+            line = json.loads(openai_batch_result_line(ref_req))
+            line["custom_id"] = our_req["custom_id"]
+            results.append(line)
+        skip = model == REASONING and scenario == "scenario_a"
+        scores = api_mod.decode_batch_results(results, id_map,
+                                              reasoning_skip=skip)
+        for base_id, score in scores.items():
+            cell = id_map.get(
+                f"{base_id}_confidence") or id_map.get(f"{base_id}_binary")
+            rows_by_key[(model, cell.original_main,
+                         cell.rephrased_main)] = (score, cell)
+
+    workbook = golden[scenario]["workbook"]
+    assert (golden[scenario]["workbook_columns"]
+            == list(schemas.PERTURBATION_COLUMNS))
+    assert len(workbook) == len(rows_by_key)
+    for row in workbook:
+        score, cell = rows_by_key[_row_key(row)]
+        assert score.response_text == row["Model Response"]
+        assert score.confidence_text == row["Model Confidence Response"]
+        assert score.log_probabilities == row["Log Probabilities"]
+        assert score.token_1_prob == pytest.approx(
+            row["Token_1_Prob"], rel=REL, abs=1e-12)
+        assert score.token_2_prob == pytest.approx(
+            row["Token_2_Prob"], rel=REL, abs=1e-12)
+        ref_odds = row["Odds_Ratio"]
+        if ref_odds is None:          # pandas serializes inf as null
+            assert math.isinf(score.odds_ratio)
+        else:
+            assert score.odds_ratio == pytest.approx(ref_odds, rel=REL)
+        if row["Confidence Value"] is None:
+            assert score.confidence_value is None
+        else:
+            assert score.confidence_value == int(row["Confidence Value"])
+        if row["Weighted Confidence"] is None:
+            assert score.weighted_confidence is None
+        else:
+            assert score.weighted_confidence == pytest.approx(
+                row["Weighted Confidence"], rel=REL)
+        assert (f"{cell.rephrased_main} {cell.response_format}"
+                == row["Full Rephrased Prompt"])
+        assert (f"{cell.rephrased_main} {cell.confidence_format}"
+                == row["Full Confidence Prompt"])
+
+
+def test_error_line_semantics_match_reference():
+    """Errored batch lines follow the reference's asymmetric handling
+    (perturb_prompts.py:370-410,448-466): a cell whose single binary
+    result errored is DROPPED (warning), while a skip-mode cell whose
+    confidence errored is still emitted with None values and the literal
+    placeholders."""
+    cells = grid_mod.build_grid(REGULAR, LEGAL_PROMPTS[:1], [["v1"]],
+                                include_original=False)
+    _, id_map = api_mod.build_batch_requests(cells, REGULAR)
+    err = {"custom_id": "p0_r0_binary", "response": None,
+           "error": {"message": "rate limited"}}
+    good_conf = {"custom_id": "p0_r0_confidence", "response": {"body": {
+        "choices": [{"message": {"content": "88"}, "logprobs": None}]}}}
+    scores = api_mod.decode_batch_results([err, good_conf], id_map)
+    assert scores == {}
+
+    cells = grid_mod.build_grid(REASONING, LEGAL_PROMPTS[:1], [["v1"]],
+                                include_original=False)
+    _, id_map = api_mod.build_batch_requests(cells, REASONING,
+                                             reasoning_model=True)
+    err_conf = {"custom_id": "p0_r0_confidence", "response": None,
+                "error": {"message": "expired"}}
+    scores = api_mod.decode_batch_results([err_conf], id_map,
+                                          reasoning_skip=True)
+    s = scores["p0_r0"]
+    assert s.response_text == "N/A (skipped for reasoning model)"
+    assert s.log_probabilities == "N/A for reasoning models"
+    assert s.confidence_value is None
+    assert s.weighted_confidence is None
+    assert s.odds_ratio == 0.0
+
+
+def test_random_subset_matches_executed_selection(golden):
+    """grid.random_subset with seed 42 picks the SAME 20 perturbations
+    the executed reference's create_random_subset chose (both sample an
+    identically ordered population through seeded Mersenne Twister)."""
+    cells = _cells_for("scenario_a", REGULAR)
+    ours = {(c.original_main, c.rephrased_main) for c in cells}
+    assert len(cells) == 20
+    ref = {(r["Original Main Part"], r["Rephrased Main Part"])
+           for r in golden["scenario_a"]["workbook"]}
+    assert ours == ref

@@ -1,0 +1,212 @@
+"""Deterministic canned API responses for the perturb_prompts oracle.
+
+Shared between the sandbox stub clients (tools/reference_perturb_oracle.py
+writes stubs that import this module) and the lir_tpu-side differential
+(tests/test_reference_perturb_oracle.py) so both sides replay IDENTICAL
+payloads. Every payload is a pure function of the request, no RNG.
+
+The response variants are chosen to exercise every branch of the
+reference's decoder (perturb_prompts.py:398-549): clean target answers,
+answers matching neither target, targets missing from top_logprobs
+(division-by-zero -> inf odds), leading-space token lookalikes that must
+NOT match the exact-equality rule, multi-position confidence logprobs,
+out-of-range integers (>100) excluded from E[v], integers embedded in
+non-digit tokens ("85%"), unparseable confidence text, and the reasoning
+if/elif counting quirk where a "Not Covered" run counts as "Covered"
+(:422-426 — substring containment, first branch wins).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Tuple
+
+# target_tokens keyed by a stable marker: the response_format text is
+# appended verbatim to every rephrased binary prompt, so it identifies the
+# original prompt inside any request (perturb_prompts.py:215).
+PROMPT_TARGETS: List[Tuple[str, Tuple[str, str]]] = [
+    ("Answer only 'Covered' if insurance covers the loss",
+     ("Covered", "Not")),
+    ("Answer only 'First Petition' if the first filing date",
+     ("Ultimate", "First")),
+    ("Answer only 'Existing Affiliates' or 'Future Affiliates'",
+     ("Existing", "Future")),
+    ("Answer only 'Monthly Installment Payments' or 'Payment Upon Completion'",
+     ("Monthly", "Payment")),
+]
+# Prompts 0 and 4 share a response format; target lookup falls through to
+# the first match, which is correct (same targets).
+
+CONFIDENCE_MARKER = "How confident are you"
+
+
+def targets_for(full_prompt: str) -> Tuple[str, str]:
+    for marker, targets in PROMPT_TARGETS:
+        if marker in full_prompt:
+            return targets
+    return ("Covered", "Not")          # confidence prompts: unused
+
+
+def _variant(custom_id: str) -> int:
+    m = re.search(r"(\d+)", custom_id)
+    return int(m.group(1)) if m else 0
+
+
+def claude_rephrasings(call_idx: int, main_prompt: str) -> str:
+    """Canned Claude message text for one rephrasing session: numbered
+    list with the parser's edge cases (preamble line, 'N.' and 'N '
+    forms, an unnumbered continuation line)."""
+    stem = main_prompt.split("?")[0][:40].strip()
+    k = call_idx
+    return (
+        "Here are 20 rephrasings of the question:\n"
+        "\n"
+        f"1. Could you analyze (v{k}a) whether {stem}?\n"
+        f"2 In your view (v{k}b), {stem}?\n"
+        f"3. Considering the terms (v{k}c),\n"
+        f"   does the provision discussed in {stem}\n"
+        f"   apply here?\n"
+    )
+
+
+def parsed_rephrasings(call_idx: int, main_prompt: str) -> List[str]:
+    """What the reference's parser (perturb_prompts.py:812-835) extracts
+    from claude_rephrasings — kept next to the generator so drift between
+    the canned text and expectations is impossible."""
+    stem = main_prompt.split("?")[0][:40].strip()
+    k = call_idx
+    return [
+        f"Could you analyze (v{k}a) whether {stem}?",
+        f"In your view (v{k}b), {stem}?",
+        f"Considering the terms (v{k}c), does the provision discussed in "
+        f"{stem} apply here?",
+    ]
+
+
+def _top(entries: List[Tuple[str, float]]) -> List[Dict[str, object]]:
+    return [{"token": t, "logprob": lp} for t, lp in entries]
+
+
+def binary_logprob_content(variant: int, t1: str, t2: str
+                           ) -> List[Dict[str, object]]:
+    v = variant % 4
+    if v == 0:        # both targets present; leading-space lookalikes too
+        top = _top([(t1, -0.1054), (t2, -2.3026), (" " + t1, -3.0),
+                    (" " + t2, -3.5), ("The", -4.0)])
+    elif v == 1:      # reversed preference
+        top = _top([(t2, -0.3567), (t1, -1.2040), ("Answer", -5.0)])
+    elif v == 2:      # neither target in top-20 -> probs 0, odds inf
+        top = _top([("I", -0.5), ("cannot", -1.0), ("tell", -1.5)])
+    else:             # target_1 only -> token_2_prob 0 -> odds inf
+        top = _top([(t1, -0.2231), ("perhaps", -2.0)])
+    return [{"token": top[0]["token"], "logprob": top[0]["logprob"],
+             "top_logprobs": top}]
+
+
+def binary_text(variant: int, t1: str, t2: str) -> str:
+    return [t1, t2, "I cannot tell from the term alone.", t1][variant % 4]
+
+
+def confidence_payload(variant: int) -> Tuple[str, List[Dict[str, object]]]:
+    """(message text, logprobs content) for a confidence request. The
+    content spans MULTIPLE positions — the reference's E[v] accumulates
+    top_logprobs across every generated position (:513-526) — and
+    includes >100 integers (excluded) and digits embedded in non-digit
+    tokens like '85%' (included via the \\b(\\d+)\\b search)."""
+    v = variant % 4
+    if v == 0:
+        text = "85"
+        content = [
+            {"token": "85", "logprob": -0.2231, "top_logprobs": _top(
+                [("85", -0.2231), ("90", -2.3026), ("150", -1.0),
+                 ("eighty", -3.0)])},
+            {"token": ".", "logprob": -0.1, "top_logprobs": _top(
+                [(".", -0.1), ("100", -4.6052), ("0", -5.0)])},
+        ]
+    elif v == 1:
+        text = "I am 72% confident in this reading."
+        content = [
+            {"token": "I", "logprob": -0.3, "top_logprobs": _top(
+                [("I", -0.3), ("72", -1.6094)])},
+            {"token": " am", "logprob": -0.2, "top_logprobs": _top(
+                [(" am", -0.2), ("85%", -2.9957)])},
+        ]
+    elif v == 2:
+        text = "Unable to quantify."
+        content = [
+            {"token": "Unable", "logprob": -0.4, "top_logprobs": _top(
+                [("Unable", -0.4), ("to", -1.2)])},
+        ]
+    else:
+        text = "Confidence: 60 out of 100"
+        content = [
+            {"token": "Confidence", "logprob": -0.5, "top_logprobs": _top(
+                [("Confidence", -0.5), ("60", -0.9163)])},
+            {"token": " 60", "logprob": -0.3, "top_logprobs": _top(
+                [(" 60", -0.3), ("101", -0.5), ("40", -2.5257)])},
+        ]
+    return text, content
+
+
+def reasoning_binary_text(run_idx: int, t1: str, t2: str) -> str:
+    """Run texts for the 10-run average: 5 plain target_1, 3 'Not
+    <target_1>'-style texts CONTAINING target_1 (the if/elif containment
+    quirk counts these as target_1), 1 target_2-only, 1 neither."""
+    if run_idx < 5:
+        return f"{t1}."
+    if run_idx < 8:
+        return f"Not {t1}" if t2 == "Not" else f"{t2} {t1} mix"
+    if run_idx < 9:
+        return f"{t2} side prevails" if t1 not in t2 else t2
+    return "No clear answer."
+
+
+def openai_batch_result_line(request: Dict[str, object]) -> str:
+    """One JSONL result line for one batch request, as the OpenAI Batch
+    API would return it (the shapes the reference reads at :386-396 and
+    :472-526)."""
+    custom_id = str(request["custom_id"])
+    body = request["body"]
+    content_text = str(body["messages"][0]["content"])
+    is_reasoning = "max_completion_tokens" in body
+    wants_logprobs = bool(body.get("logprobs"))
+    t1, t2 = targets_for(content_text)
+    v = _variant(custom_id)
+
+    # Non-reasoning grids alternate binary/confidence on even/odd counters;
+    # v // 2 walks each format through ALL its variants.
+    if CONFIDENCE_MARKER in content_text:
+        if is_reasoning:
+            text = str(40 + (v % 5) * 10)          # "40".."80"
+            choice: Dict[str, object] = {"message": {"content": text}}
+        else:
+            text, content = confidence_payload(v // 2)
+            choice = {"message": {"content": text},
+                      "logprobs": {"content": content}}
+    else:
+        if is_reasoning:
+            # runs are consecutive counters within one rephrase's block
+            text = reasoning_binary_text(v % 10, t1, t2)
+            choice = {"message": {"content": text}}
+        else:
+            text = binary_text(v // 2, t1, t2)
+            choice = {"message": {"content": text}}
+            if wants_logprobs:
+                choice["logprobs"] = {
+                    "content": binary_logprob_content(v // 2, t1, t2)}
+
+    result = {
+        "id": f"batch_req_{custom_id}",
+        "custom_id": custom_id,
+        "response": {
+            "status_code": 200,
+            "body": {
+                "choices": [choice],
+                "usage": {"prompt_tokens": max(len(content_text) // 4, 1),
+                          "completion_tokens": 7},
+            },
+        },
+        "error": None,
+    }
+    return json.dumps(result)

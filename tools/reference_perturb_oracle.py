@@ -1,0 +1,292 @@
+"""Execute the reference's perturb_prompts.py against stub API clients
+(VERDICT r4 #2) — the L1a/L2 leg of the executed-reference differential.
+
+perturb_prompts.py needs live OpenAI/Anthropic keys, so it had never been
+RUN; its grid builder (create_batch_requests, :190-269), batch decoder
+(extract_results_from_batch, :398-549), rephrasing parser (:812-835),
+random subset sampler (:109-159) and 15-column workbook (:964-1016) were
+pinned only by reimplementation. This tool stages the script with
+mechanical patches (gdrive paths -> sandbox, xlsx -> csv, two models, no
+thread pool) plus stub `openai`/`anthropic`/`config` modules that replay
+the DETERMINISTIC canned payloads from tools/perturb_oracle_data.py, and
+executes it twice:
+
+- scenario A: no perturbations file -> Step 1 runs against the stub
+  Claude (100 sessions x 5 prompts, numbered-list parsing with
+  continuation lines), then PROCESS_RANDOM_SUBSET=True cuts the grid to
+  the seed-42 subset of 20; reasoning model in its default
+  SKIP_REASONING_MODEL_LOGPROBS=True confidence-only mode.
+- scenario B: canned perturbations.json (4 rephrasings/prompt, loaded
+  via the reference's own verification path), full grid,
+  SKIP_REASONING_MODEL_LOGPROBS=False -> the 10-run reasoning averaging
+  and containment-counting quirk execute.
+
+Captured into tests/golden/reference_perturb_oracle.json: every uploaded
+batch request (grid + custom_id mapping + bodies), the final workbook
+rows, the saved perturbations (hash + samples; the canned generator is
+shared so tests regenerate the full list), and the stdout log tail.
+tests/test_reference_perturb_oracle.py diffs lir_tpu's backends/api +
+engine/rephrase + engine/grid against this captured execution.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+REF_SCRIPT = Path("/root/reference/analysis/perturb_prompts.py")
+SANDBOX = Path("/tmp/lir_ref_perturb_oracle")
+GOLDEN = REPO / "tests" / "golden" / "reference_perturb_oracle.json"
+
+GDRIVE = "gdrive/My Drive/Computational/llm_interpretation"
+
+OPENAI_STUB = '''\
+"""Stub OpenAI client: batches complete instantly with deterministic
+payloads from tools/perturb_oracle_data.py; every upload is copied to
+captured/ before the reference deletes its input file."""
+import json
+from pathlib import Path
+
+from perturb_oracle_data import openai_batch_result_line
+
+_CAPTURE = Path(__file__).parent / "captured"
+
+
+class _Obj:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class _Files:
+    def __init__(self, store):
+        self._s = store
+
+    def create(self, file=None, purpose=None):
+        data = file.read()
+        if isinstance(data, bytes):
+            data = data.decode("utf-8")
+        fid = "file-%d" % len(self._s["uploads"])
+        self._s["uploads"][fid] = data
+        _CAPTURE.mkdir(exist_ok=True)
+        (_CAPTURE / ("upload_%s.jsonl" % fid)).write_text(data)
+        return _Obj(id=fid)
+
+    def content(self, file_id):
+        return _Obj(content=self._s["outputs"][file_id].encode("utf-8"))
+
+
+class _Batches:
+    def __init__(self, store):
+        self._s = store
+
+    def create(self, input_file_id=None, endpoint=None,
+               completion_window=None, metadata=None):
+        bid = "batch-%d" % len(self._s["batches"])
+        lines = self._s["uploads"][input_file_id].strip().splitlines()
+        out = "\\n".join(openai_batch_result_line(json.loads(ln))
+                         for ln in lines if ln)
+        ofid = "out-%s" % bid
+        self._s["outputs"][ofid] = out
+        self._s["batches"][bid] = _Obj(
+            id=bid, status="completed", output_file_id=ofid, errors=None)
+        return self._s["batches"][bid]
+
+    def retrieve(self, batch_id):
+        return self._s["batches"][batch_id]
+
+
+class OpenAI:
+    def __init__(self, api_key=None):
+        store = {"uploads": {}, "outputs": {}, "batches": {}}
+        self.files = _Files(store)
+        self.batches = _Batches(store)
+'''
+
+ANTHROPIC_STUB = '''\
+"""Stub Anthropic client: messages.create returns the canned numbered
+rephrasing lists (call-indexed, deterministic)."""
+import re
+
+from perturb_oracle_data import claude_rephrasings
+
+HUMAN_PROMPT = "\\n\\nHuman:"
+AI_PROMPT = "\\n\\nAssistant:"
+
+
+class _Content:
+    def __init__(self, text):
+        self.text = text
+
+
+class _Response:
+    def __init__(self, text):
+        self.content = [_Content(text)]
+
+
+class _Messages:
+    def __init__(self):
+        self.calls = 0
+
+    def create(self, model=None, max_tokens=None, temperature=None,
+               messages=None):
+        prompt = messages[0]["content"]
+        m = re.search(r'###"(.*)"###', prompt, re.DOTALL)
+        main = m.group(1) if m else prompt
+        text = claude_rephrasings(self.calls, main)
+        self.calls += 1
+        return _Response(text)
+
+
+class Anthropic:
+    def __init__(self, api_key=None):
+        self.messages = _Messages()
+'''
+
+ANTHROPIC_EXC = '''\
+class OverloadedError(Exception):
+    pass
+
+
+class RateLimitError(Exception):
+    pass
+
+
+class APIError(Exception):
+    pass
+
+
+class APIStatusError(Exception):
+    pass
+'''
+
+
+def _patch(text: str, scenario: str) -> str:
+    text = text.replace(GDRIVE, "work")
+    text = text.replace("pd.read_excel", "pd.read_csv")
+    text = text.replace(".to_excel(", ".to_csv(")
+    text = text.replace(".xlsx", ".csv")
+    # Two models: one regular + one reasoning (config-list trim; every
+    # model runs the identical code path).
+    old_models = text[text.index("MODELS_TO_TEST = ["):]
+    old_models = old_models[:old_models.index("]") + 1]
+    text = text.replace(
+        old_models,
+        'MODELS_TO_TEST = ["gpt-4.1-2025-04-14", "o3-2025-04-16"]')
+    text = text.replace("PROCESS_BATCHES_IN_PARALLEL = True",
+                        "PROCESS_BATCHES_IN_PARALLEL = False")
+    if scenario == "A":
+        text = text.replace("PROCESS_RANDOM_SUBSET = False",
+                            "PROCESS_RANDOM_SUBSET = True")
+    else:
+        text = text.replace("SKIP_REASONING_MODEL_LOGPROBS = True",
+                            "SKIP_REASONING_MODEL_LOGPROBS = False")
+    return text
+
+
+def _canned_perturbations() -> list:
+    """Scenario B's pre-existing perturbations.json, built from lir_tpu's
+    LEGAL_PROMPTS — the reference verifies each loaded tuple against its
+    own hardcoded prompts (:747-760), so a successful load also proves
+    byte-parity of our prompt data."""
+    from lir_tpu.data.prompts import LEGAL_PROMPTS
+
+    data = []
+    for p in LEGAL_PROMPTS:
+        data.append({
+            "original_main": p.main,
+            "response_format": p.response_format,
+            "target_tokens": list(p.target_tokens),
+            "confidence_format": p.confidence_format,
+            "rephrasings": [
+                f"(B{j}) {p.main.split('?')[0][:60].strip()} — restated?"
+                for j in range(4)
+            ],
+        })
+    return data
+
+
+def _run_scenario(scenario: str) -> dict:
+    box = SANDBOX / scenario
+    if box.exists():
+        shutil.rmtree(box)
+    (box / "anthropic").mkdir(parents=True)
+    (box / "work").mkdir()
+    (box / "openai.py").write_text(OPENAI_STUB)
+    (box / "anthropic" / "__init__.py").write_text(ANTHROPIC_STUB)
+    (box / "anthropic" / "_exceptions.py").write_text(ANTHROPIC_EXC)
+    (box / "config.py").write_text(
+        'ANTHROPIC_API_KEY = "stub"\nOPENAI_API_KEY = "stub"\n')
+    (box / "perturb_staged.py").write_text(
+        _patch(REF_SCRIPT.read_text(), scenario))
+    if scenario == "B":
+        (box / "work" / "perturbations.json").write_text(
+            json.dumps(_canned_perturbations(), indent=2,
+                       ensure_ascii=False))
+
+    env = {
+        "PYTHONPATH": f"{box}:{REPO / 'tools'}:{REPO}",
+        "PYTHONHASHSEED": "0",
+        "PATH": "/usr/bin:/bin",
+        "HOME": str(box),
+    }
+    proc = subprocess.run(
+        [sys.executable, "perturb_staged.py"], cwd=box, env=env,
+        capture_output=True, text=True, timeout=1800)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout[-4000:])
+        sys.stderr.write(proc.stderr[-4000:])
+        raise SystemExit(f"scenario {scenario} failed")
+
+    # Collect: uploaded grids (grouped by model), workbook, perturbations.
+    uploads: dict = {}
+    for f in sorted((box / "captured").glob("upload_*.jsonl"),
+                    key=lambda p: int(p.stem.rsplit("-", 1)[1])):
+        reqs = [json.loads(ln) for ln in f.read_text().splitlines() if ln]
+        model = reqs[0]["body"]["model"]
+        uploads.setdefault(model, []).extend(reqs)
+
+    import pandas as pd
+    workbook = pd.read_csv(box / "work" / "results_30_multi_model.csv")
+    columns = list(workbook.columns)        # golden is sort_keys=True;
+    rows = json.loads(workbook.to_json(orient="records"))
+
+    pert_file = box / "work" / "perturbations.json"
+    pert = json.loads(pert_file.read_text())
+    pert_summary = {
+        "sha256": hashlib.sha256(
+            json.dumps(pert, sort_keys=True, ensure_ascii=False)
+            .encode()).hexdigest(),
+        "counts": [len(item["rephrasings"]) for item in pert],
+        "samples": [item["rephrasings"][:3] for item in pert],
+    }
+
+    return {
+        "stdout_tail": proc.stdout[-2500:],
+        "uploads": uploads,
+        "workbook": rows,
+        "workbook_columns": columns,
+        "perturbations": pert_summary,
+    }
+
+
+def main() -> None:
+    golden = {
+        "scenario_a": _run_scenario("A"),
+        "scenario_b": _run_scenario("B"),
+    }
+    GOLDEN.write_text(json.dumps(golden, indent=1, sort_keys=True))
+    for key, g in golden.items():
+        n_req = {m: len(v) for m, v in g["uploads"].items()}
+        print(f"{key}: requests={n_req} workbook_rows={len(g['workbook'])}")
+    print(f"captured into {GOLDEN}")
+
+
+if __name__ == "__main__":
+    main()
