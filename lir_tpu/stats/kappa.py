@@ -18,7 +18,7 @@ bootstrap variants are vmapped over resample indices.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -156,10 +156,23 @@ def _agreement_rates(binary: jnp.ndarray) -> jnp.ndarray:
     return agree / total
 
 
+def _checked_indices(arr, n_boot: int, n: int) -> jax.Array:
+    """Validate injected replay indices with hard errors: XLA gathers
+    CLAMP out-of-range indices, so bad inputs would silently produce
+    plausible-but-wrong bootstrap quantities."""
+    a = np.asarray(arr, np.int32)
+    if a.shape != (n_boot, n):
+        raise ValueError(f"indices shape {a.shape} != ({n_boot}, {n})")
+    if a.size and (a.min() < 0 or a.max() >= n):
+        raise ValueError("indices out of range")
+    return jnp.asarray(a)
+
+
 def aggregate_kappa(
     binary: np.ndarray,
     key: jax.Array,
     n_boot: int = 1000,
+    indices: Optional[Tuple[np.ndarray, np.ndarray]] = None,
 ) -> Dict[str, float]:
     """Pooled kappa across all raters with a bootstrap CI.
 
@@ -167,6 +180,9 @@ def aggregate_kappa(
     549-672): observed = mean per-prompt pair-agreement rate; chance =
     p1^2 + p0^2 over the flattened matrix; bootstrap resamples the
     per-prompt agreement rates and the flattened values independently.
+    ``indices`` (test-only) injects explicit (rate_idx, flat_idx) resample
+    index arrays so the executed-reference differential can replay the
+    reference's exact np.random stream (VERDICT r4 #6).
     """
     b = jnp.asarray(np.asarray(binary, dtype=np.float32))
     rates = _agreement_rates(b)
@@ -177,9 +193,13 @@ def aggregate_kappa(
     chance = p1 * p1 + (1 - p1) * (1 - p1)
     kappa = (observed - chance) / (1 - chance) if chance < 1 else 0.0
 
-    k1, k2 = jax.random.split(key)
-    rate_idx = resample_indices(k1, n_boot, rates.shape[0])
-    flat_idx = resample_indices(k2, n_boot, flat.shape[0])
+    if indices is not None:
+        rate_idx = _checked_indices(indices[0], n_boot, rates.shape[0])
+        flat_idx = _checked_indices(indices[1], n_boot, flat.shape[0])
+    else:
+        k1, k2 = jax.random.split(key)
+        rate_idx = resample_indices(k1, n_boot, rates.shape[0])
+        flat_idx = resample_indices(k2, n_boot, flat.shape[0])
     samples = np.asarray(_aggregate_kappa_boot_jit(rates, flat, rate_idx, flat_idx))
     samples = samples[np.isfinite(samples)]
     return {
@@ -199,18 +219,26 @@ def self_kappa_bootstrap(
     decisions: np.ndarray,
     key: jax.Array,
     n_boot: int = 1000,
+    indices: Optional[Tuple[np.ndarray, np.ndarray]] = None,
 ) -> Dict[str, float]:
     """Perturbation 'self-kappa': kappa between two independent bootstrap
     resamples of one decision vector, averaged over n_boot draws.
 
     Parity: calculate_cohens_kappa.py:185-216. NaN draws (constant identical
     resamples) are dropped, mirroring the reference's try/except skip.
+    ``indices`` (test-only) injects explicit (idx1, idx2) arrays so the
+    differential can replay the reference's per-prompt seed-42 interleaved
+    idx1/idx2 stream (VERDICT r4 #6).
     """
     d = jnp.asarray(np.asarray(decisions, dtype=np.int32))
     n = d.shape[0]
-    k1, k2 = jax.random.split(key)
-    idx1 = resample_indices(k1, n_boot, n)
-    idx2 = resample_indices(k2, n_boot, n)
+    if indices is not None:
+        idx1 = _checked_indices(indices[0], n_boot, n)
+        idx2 = _checked_indices(indices[1], n_boot, n)
+    else:
+        k1, k2 = jax.random.split(key)
+        idx1 = resample_indices(k1, n_boot, n)
+        idx2 = resample_indices(k2, n_boot, n)
     samples = np.asarray(_self_kappa_boot_jit(d, idx1, idx2))
     samples = samples[np.isfinite(samples)]
     if samples.size == 0:

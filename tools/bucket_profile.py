@@ -50,8 +50,12 @@ def main() -> None:
     args = ap.parse_args()
 
     import functools
+    import os
 
     import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # env alone is too late under the axon sitecustomize (conftest.py)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
     from jax import lax
@@ -91,12 +95,23 @@ def main() -> None:
                             jnp.int32)
     digit_vals = jnp.asarray(np.linspace(0, 100, 32), jnp.float32)
 
+    # params MUST be a traced argument: closing over a 7B tree embeds it
+    # as multi-GB compile-time constants.
+    def _vary(prefix, carry):
+        # The body must be LOOP-CARRIED or XLA hoists the (otherwise
+        # loop-invariant) model computation out of the scan and every
+        # length times the same single execution. A carry-dependent token
+        # offset (0 on iter 0, 1 after — cost-identical) forces true
+        # per-iteration execution.
+        off = jnp.clip(jnp.abs(carry).astype(jnp.int32), 0, 1)
+        return jnp.minimum(prefix + off, cfg.vocab_size - 1)
+
     @functools.partial(jax.jit, static_argnames=("reps", "bin_t", "conf_t"))
-    def scan_full(prefix, reps, bin_t, conf_t):
+    def scan_full(params, prefix, reps, bin_t, conf_t):
         def body(carry, _):
             out_a, out_b = generate.greedy_decode_fused_shared(
-                params, cfg, prefix, pmask, sfx, smask, sfx, smask,
-                yes_ids, no_ids, digit_ids, digit_vals,
+                params, cfg, _vary(prefix, carry), pmask, sfx, smask, sfx,
+                smask, yes_ids, no_ids, digit_ids, digit_vals,
                 max_new_a=bin_t, max_new_b=conf_t)
             # Consume every output so nothing is dead-code-eliminated.
             chk = (out_a.p_yes.sum() + out_b.weighted_confidence.sum()
@@ -106,11 +121,11 @@ def main() -> None:
         return total
 
     @functools.partial(jax.jit, static_argnames=("reps",))
-    def scan_prefill(prefix, reps):
+    def scan_prefill(params, prefix, reps):
         T0 = S + S2 + 16
         def body(carry, _):
-            logits, cache, pos = decoder.prefill(params, cfg, prefix,
-                                                 pmask, T0)
+            logits, cache, pos = decoder.prefill(
+                params, cfg, _vary(prefix, carry), pmask, T0)
             chk = logits.sum() + jax.tree_util.tree_leaves(cache)[0].sum(
                 dtype=jnp.float32)
             return carry + chk.astype(jnp.float32), ()
@@ -120,11 +135,11 @@ def main() -> None:
     def per_iter_ms(fn, *static) -> float:
         short, long_ = 2, args.reps
         for reps in (short, long_):          # compile both lengths
-            fn(prefix, reps, *static).block_until_ready()
+            fn(params, prefix, reps, *static).block_until_ready()
         t = {}
         for reps in (short, long_):
             t0 = time.perf_counter()
-            fn(prefix, reps, *static).block_until_ready()
+            fn(params, prefix, reps, *static).block_until_ready()
             t[reps] = time.perf_counter() - t0
         return (t[long_] - t[short]) / (long_ - short) * 1000.0
 

@@ -1,0 +1,148 @@
+"""Measure the on-pod rephraser (C3) at real size (VERDICT r4 #4).
+
+The zero-external-API pipeline replaces the reference's Step 1 — 100
+Claude sessions x 20 numbered rephrasings per legal prompt, temperature
+0.9, ~500-token responses (perturb_prompts.py:787-835) — with a local 7B
+sampler (engine/rephrase.py). r4 shipped it parser-parity-tested but
+never MEASURED: no rephrasings/s/chip, no sampling-decode profile, no
+parser yield.
+
+This bench runs the PRODUCTION path (rephraser_from_engine ->
+generate_rephrasings -> parse_numbered_rephrasings) on the TPU with the
+offline-trained byte-BPE tokenizer and a 7B-dimension programmed-chain
+model (tools/chain7b.py: zero attention/MLP at full matmul cost) whose
+sampled output is a numbered-list cycle — every generated line is a
+parseable "N text?" item, so parser yield is measured on REAL text, and
+the 512-token sampled responses match the reference's session shape. The
+real legal prompts are the rephrasing subjects (450-token requests in
+this vocab -> the 512 bucket).
+
+Run on the TPU:  python tools/rephrase_bench.py [--sessions 16 --batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+if str(REPO / "tools") not in sys.path:
+    sys.path.insert(0, str(REPO / "tools"))
+
+SCALE_MD = REPO / "SCALE.md"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sessions", type=int, default=16,
+                    help="sessions per prompt (reference runs 100)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=512,
+                    help="sampled tokens per session (reference responses "
+                         "are ~500 tokens)")
+    ap.add_argument("--prompts", type=int, default=2,
+                    help="how many of the 5 legal prompts to rephrase")
+    ap.add_argument("--no-record", action="store_true")
+    args = ap.parse_args()
+
+    from chain7b import (bench_setup, last_token_id, ship_quantized_chain,
+                         single_token_id, vocab_word_pieces)
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data.prompts import LEGAL_PROMPTS, rephrase_request
+    from lir_tpu.engine.rephrase import (generate_rephrasings,
+                                         rephraser_from_engine)
+    from lir_tpu.engine.runner import ScoringEngine
+
+    jax, dev, on_accel, fast, cfg, mode = bench_setup(
+        max_seq_len=1024, smoke_name="rephrase-smoke")
+    if not on_accel:
+        args.max_new = min(args.max_new, 64)
+
+    # --- chain: a numbered-list CYCLE the parser can score ---------------
+    # "1 w1 w2 ... w20?\n" repeating: every ~23-token line is a parseable
+    # "N text" item (the no-dot numbered form, perturb_prompts.py:826-828).
+    anchor = last_token_id(fast, rephrase_request(LEGAL_PROMPTS[0].main))
+    one = single_token_id(fast, "1")
+    qm = single_token_id(fast, "?")
+    nl = fast(chr(10), add_special_tokens=False).input_ids[-1]
+    words = vocab_word_pieces(fast, 20, {anchor, one, qm, nl})
+    cycle = [one] + words + [qm, nl]
+    chain = {}
+    for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+        chain[a] = (b, b)               # (argmax == runner-up: sampling at
+        # temperature 0.9 cannot leave the cycle)
+    chain[anchor] = (one, one)
+    # Every other request token also enters the cycle, so all legal
+    # prompts anchor identically regardless of their final BPE piece.
+    params = ship_quantized_chain(jax, dev, cfg, chain, junk_next=one,
+                                  junk_second=one)
+
+    rt = RuntimeConfig(batch_size=args.batch, max_seq_len=1024)
+    engine = ScoringEngine(params, cfg, fast, rt)
+    gen_text = rephraser_from_engine(engine, temperature=0.9,
+                                     max_new_tokens=args.max_new)
+
+    prompts = LEGAL_PROMPTS[:args.prompts]
+    key = jax.random.PRNGKey(0)
+
+    # Warmup (compiles the 512-bucket sampling decode).
+    generate_rephrasings(gen_text, prompts[:1], key,
+                         sessions_per_prompt=args.batch,
+                         sessions_per_batch=args.batch)
+
+    t0 = time.perf_counter()
+    results = generate_rephrasings(gen_text, prompts, key,
+                                   sessions_per_prompt=args.sessions,
+                                   sessions_per_batch=args.batch)
+    dt = time.perf_counter() - t0
+
+    n_sessions = args.sessions * len(prompts)
+    total = sum(len(r) for _, r in results)
+    per_session = total / n_sessions
+    line_len = len(cycle)
+    ceiling = args.max_new / line_len
+    toks_s = n_sessions * args.max_new / dt
+    print(f"{n_sessions} sessions x {args.max_new} sampled tokens in "
+          f"{dt:.1f}s")
+    print(f"rephrasings: {total} parsed = {per_session:.1f}/session "
+          f"(line ceiling {ceiling:.1f}) -> {total / dt:.2f} "
+          f"rephrasings/s/chip")
+    print(f"sampling decode: {toks_s:.0f} tok/s at batch {args.batch} "
+          f"(seq 512 prompt + {args.max_new} sampled)")
+    ref_total = 5 * 100 * 20            # reference Step-1 volume
+    eta_min = ref_total / max(total / dt, 1e-9) / 60
+    print(f"reference Step-1 volume (5x100x20 = {ref_total}) ETA on one "
+          f"chip: {eta_min:.1f} min")
+
+    if args.no_record or not on_accel:
+        return
+    date = datetime.date.today().isoformat()
+    SCALE_MD.write_text(SCALE_MD.read_text() + f"""
+## on-pod rephraser (C3) MEASURED — {dev.device_kind}, {date}
+
+{mode}, batch {args.batch}, temperature 0.9, {args.max_new}-token sampled
+sessions over the REAL legal-prompt requests (450-token -> 512 bucket),
+production path rephraser_from_engine -> generate_rephrasings -> parser
+(tools/rephrase_bench.py; programmed-chain weights emit parseable
+numbered lines at full 7B matmul cost):
+
+- {n_sessions} sessions in {dt:.1f}s -> **{total / dt:.2f}
+  rephrasings/s/chip** ({per_session:.1f} parsed/session against a
+  {ceiling:.1f}-line ceiling — parser yield
+  {per_session / ceiling:.0%})
+- sampling decode: **{toks_s:.0f} tok/s** at batch {args.batch}
+- the reference's full Step-1 volume (5 prompts x 100 sessions x 20 =
+  {ref_total} rephrasings) lands in **~{eta_min:.0f} min on one chip** —
+  the zero-external-API pipeline's Step 1 now has a measured cost next
+  to its Step 2.
+""")
+    print("recorded to SCALE.md")
+
+
+if __name__ == "__main__":
+    main()
