@@ -141,6 +141,23 @@ def test_chain_gpt2_pins_scan_positions(golden, ckpt_root):
     assert never[0].position_found == 0
 
 
+@pytest.mark.parametrize("key,never", [("chain-t5-pos2", False),
+                                       ("chain-t5-never", True)])
+def test_chain_t5_pins_encdec_scan_positions(golden, ckpt_root, key, never):
+    """The enc-dec branch at NON-fallback positions: the chain T5's
+    zeroed cross-attention makes its decoder output a designed constant,
+    so the executed reference finds Yes in the top-2 at position 2 (or
+    never -> position-0 fallback) and our T5 capture path must land on
+    the identical outcome, completion included."""
+    from tiny_checkpoints import build_chain_t5
+    group = golden[key]
+    assert [group["cases"][0]["ref_cbvi"]["position_found"],
+            group["cases"][0]["ref_cbvi"]["yes_no_found"]] == group["designed"]
+    _run_group(golden, ckpt_root, key,
+               lambda p: build_chain_t5(p, never=never)[:3],
+               check_completion=not never, max_new=12)
+
+
 def test_bos_tokenizer_quirk_executed_and_fixed(golden, ckpt_root):
     """EXECUTED reference fact (not a reading of its source): with a
     bos-prepending tokenizer (real LlamaTokenizer encode semantics), the

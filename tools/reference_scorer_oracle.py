@@ -97,8 +97,8 @@ def capture() -> dict:
     from lir_tpu.data.prompts import (format_base_prompt,
                                       format_instruct_prompt)
     from tiny_checkpoints import (CHAIN_PROMPTS, build_bpe_gpt2,
-                                  build_chain_gpt2, build_sp_llama,
-                                  build_sp_t5)
+                                  build_chain_gpt2, build_chain_t5,
+                                  build_sp_llama, build_sp_t5)
 
     mods = {name: _stage(name, src, cut)
             for name, (src, cut) in SCRIPTS.items()}
@@ -164,6 +164,21 @@ def capture() -> dict:
         for mname in mods:
             assert case[mname]["position_found"] == want_pos, case
             assert case[mname]["yes_no_found"] == want_found, case
+
+    # --- programmed-chain T5: non-fallback positions on the ENC-DEC
+    # branch (cross-attention zeroed -> input-independent designed output)
+    for key, never in (("chain-t5-pos2", False), ("chain-t5-never", True)):
+        _, model, tok, expected = build_chain_t5(ck / key, never=never)
+        entry = run_cases(key, model, tok,
+                          [("instruct0",
+                            format_instruct_prompt(questions[0]))])
+        entry["designed"] = list(expected)
+        entry["yes_id"] = tok("Yes").input_ids[0]
+        entry["no_id"] = tok("No").input_ids[0]
+        for case in entry["cases"]:
+            for mname in mods:
+                assert case[mname]["position_found"] == expected[0], case
+                assert case[mname]["yes_no_found"] == expected[1], case
 
     # --- bos-prepending tokenizer: the special-token grab, executed ------
     _, model, tok = build_sp_llama(ck / "sp-llama-bos", add_bos=True)

@@ -166,6 +166,75 @@ def build_sp_t5(path: Path):
     return path, model, fast
 
 
+def build_chain_t5(path: Path, never: bool = False):
+    """Programmed-chain T5 for the ENC-DEC scorer branch
+    (compare_base_vs_instruct.py:188-237): all attention (self + cross) and
+    FFN weights zeroed, one-hot shared embeddings, untied programmed
+    lm_head. Cross-attention zero makes the decoder input-INDEPENDENT: the
+    chain runs from decoder_start (pad), so every prompt produces the same
+    designed completion — "w1 w2 Yes </s>" (top-2 find at position 2) or,
+    with ``never=True``, a 3-word cycle whose top-2 never contains
+    Yes/No inside the 10-position scan (the pos-0 fallback, :228-233).
+    Returns (path, model, fast, (expected_position, expected_found))."""
+    import torch
+    import transformers as tf
+
+    fast = _sp_tokenizer(with_pad=True)
+
+    def pid(piece: str) -> int:
+        # The backing tokenizer's token_to_id returns None for a missing
+        # piece (the fast wrapper would silently fall back to <unk>).
+        i = fast._tokenizer.token_to_id(piece)
+        assert i is not None, f"piece {piece!r} not in vocab"
+        return int(i)
+
+    yes = pid("▁Yes")
+    w = [pid("▁" + t) for t in ("a", "form", "of")]
+    pad, eos = fast.pad_token_id, fast.eos_token_id
+    if never:
+        chain = {pad: (w[0], w[1]), w[0]: (w[1], w[2]), w[1]: (w[2], w[0]),
+                 w[2]: (w[0], w[1])}
+        expected = (0, False)
+    else:
+        chain = {pad: (w[0], w[1]), w[0]: (w[1], w[2]),
+                 w[1]: (yes, w[2]), yes: (eos, w[0]), eos: (eos, w[0])}
+        expected = (2, True)
+
+    torch.manual_seed(4)
+    model = tf.T5ForConditionalGeneration(tf.T5Config(
+        vocab_size=len(fast), d_model=64, d_kv=16, d_ff=128,
+        num_layers=1, num_decoder_layers=1, num_heads=4,
+        decoder_start_token_id=pad, pad_token_id=pad, eos_token_id=eos,
+        tie_word_embeddings=False)).eval()
+    sd = model.state_dict()
+    with torch.no_grad():
+        for k, v in sd.items():
+            if any(s in k for s in ("SelfAttention", "EncDecAttention",
+                                    "DenseReluDense")):
+                v.zero_()
+            elif "layer_norm" in k or "final_layer_norm" in k:
+                v.fill_(1.0)
+        basis = {t: i for i, t in enumerate(chain)}
+        junk = len(basis)
+        assert junk < 64
+        model.shared.weight.zero_()
+        model.shared.weight[:, junk] = 4.0
+        for t, b in basis.items():
+            model.shared.weight[t, junk] = 0.0
+            model.shared.weight[t, b] = 4.0
+        model.lm_head.weight.zero_()           # (V, D)
+        for t, (nxt, second) in chain.items():
+            model.lm_head.weight[nxt, basis[t]] += 10.0
+            model.lm_head.weight[second, basis[t]] += 5.0
+        model.lm_head.weight[w[0], junk] += 10.0
+        model.lm_head.weight[w[1], junk] += 5.0
+
+    path.mkdir(parents=True, exist_ok=True)
+    model.save_pretrained(path, safe_serialization=True)
+    fast.save_pretrained(path)
+    return path, model, fast, expected
+
+
 # ---------------------------------------------------------------------------
 # Programmed-chain GPT-2: argmax sequence is a designed function of the
 # last prompt token, with +10/+5 margins so top-2 membership is exact on
