@@ -7,9 +7,15 @@ BASELINE.json's metric, measured honestly:
   activation quantization + s8 x s8 MXU dots, the TPU-native analogue of
   the 8-bit mode the reference runs (compare_base_vs_instruct.py:431-435,
   BitsAndBytesConfig(load_in_8bit) = LLM.int8() vector-wise quantization).
-  Random weights; throughput does not depend on weight values. On CPU
-  (smoke runs, no real chip) a 136M-param flagship config keeps the bench
-  runnable; the JSON labels which config ran.
+  Weights are chain-PROGRAMMED (tools/chain7b.py) at identical matmul
+  cost: per decode step the throughput is weight-independent, but the
+  headline's decode LENGTH is content-dependent by design — the shipped
+  digit early stop ends the confidence decode at the answer, so the sweep
+  is measured over real-text responses whose answer lands at a
+  representative position (see _production_chain). Random weights +
+  FakeTokenizer remain the fallback (stop never arms, full budget paid).
+  On CPU (smoke runs, no real chip) a 136M-param flagship config keeps
+  the bench runnable; the JSON labels which config ran.
 
 - **Verified timing.** Under the tunneled-axon dispatch path,
   ``jax.block_until_ready`` returns before the device finishes (measured:
@@ -138,9 +144,17 @@ def main() -> None:
         # int8 KV cache: half the cache HBM -> batch 48 fits (the knee);
         # decode attention runs s8 dots like the dynamic weight mode.
         cfg = dataclasses.replace(llama2_7b(), kv_cache_int8=True)
-        params = quant.random_quantized_params(cfg, jax.random.PRNGKey(0),
-                                               dtype=jnp.bfloat16,
-                                               dynamic=True)
+        # Production-default content: chain-programmed weights at FULL
+        # 7B/32000-vocab matmul cost whose responses are real text (the
+        # confidence answer completes at the corpus-median decode step),
+        # so the sweep measures the SHIPPED digit-early-stop default
+        # instead of the FakeTokenizer worst case. Falls back to random
+        # weights + FakeTokenizer (stop silently off) if unavailable.
+        params, sweep_tok, expect_conf = _production_chain(cfg)
+        if params is None:
+            params = quant.random_quantized_params(
+                cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16,
+                dynamic=True)
         candidates = TPU_CANDIDATES
         nominal = BENCH_NOMINAL_7B
         mode = "int8-dyn+kvq8"
@@ -152,6 +166,7 @@ def main() -> None:
         candidates = CPU_CANDIDATES
         nominal = BENCH_NOMINAL_CPU
         mode = "fp32"
+        sweep_tok, expect_conf = None, None
 
     n_params = sum(
         int(np.prod(l.shape)) for l in jax.tree.leaves(
@@ -255,7 +270,14 @@ def main() -> None:
 
     # ---- primary: the end-to-end perturbation sweep (BASELINE's metric).
     sweep_value, sweep_batch, sweep_cells = _sweep_path(
-        params, cfg, on_accel)
+        params, cfg, on_accel, tokenizer=sweep_tok, expect_conf=expect_conf)
+    stop_str = ("digit early stop ON over real-text responses "
+                "(production default; real BPE tokenizer, programmed-chain "
+                "weights at identical matmul cost, answer at decode step 3 "
+                "— conservatively past the corpus-median position 0-1, "
+                "SCALE.md; stop-OFF worst case printed as a comment)"
+                if sweep_tok is not None
+                else "digit early stop OFF (content-free fallback)")
     sweep_nominal = (BENCH_NOMINAL_7B_SWEEP if on_accel
                      else BENCH_NOMINAL_CPU_SWEEP)
     print(json.dumps({
@@ -264,21 +286,81 @@ def main() -> None:
         "unit": (f"prompts/s end-to-end perturbation sweep ({cfg.name} "
                  f"{n_params / 1e9:.2f}B {mode}, shared-prefix scoring, "
                  f"batch={sweep_batch}, {sweep_cells} cells, "
-                 f"binary+confidence per cell; isolated step "
+                 f"binary+confidence per cell, {stop_str}; isolated step "
                  f"{value:.1f} p/s at {mfu_str}; headline is the "
                  f"cache-heaviest MHA architecture — GQA mistral-7b "
-                 f"measures 44.6 p/s at identical settings, SCALE.md; "
-                 f"{dev.platform})"),
+                 f"measures 44.6 p/s at identical stop-OFF settings, "
+                 f"SCALE.md; {dev.platform})"),
         "vs_baseline": round(sweep_value / sweep_nominal, 3),
     }))
+    if sweep_tok is not None:
+        # Transparency: the content-free worst case (FakeTokenizer exposes
+        # no per-token strings, so the digit stop cannot arm and every
+        # confidence cell pays the full 8-step budget). Runs AFTER the
+        # headline JSON so a failure here can never discard the
+        # already-measured production result.
+        try:
+            nostop_value, nostop_batch, _ = _sweep_path(params, cfg,
+                                                        on_accel)
+            print(f"# sweep stop-OFF worst case (FakeTokenizer, batch "
+                  f"{nostop_batch}): {nostop_value:.3f} p/s",
+                  file=sys.stderr)
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# stop-OFF transparency run failed ({err!r}); "
+                  "headline above is unaffected", file=sys.stderr)
 
 
-def _sweep_path(params, cfg, on_accel: bool):
+def _production_chain(cfg):
+    """Chain-programmed params at the FULL flagship size (tools/chain7b:
+    zero attention/MLP at full matmul cost, one-hot embeddings, lm_head
+    transition table — throughput-identical to random weights) plus the
+    offline-trained byte-BPE tokenizer. Responses are real text: the
+    binary prompt answers ' Yes.', the confidence prompt emits its
+    single-token integer ' 85' at decode step 3 — one-two steps LATER
+    than the corpus-median answer word position of 0-1 (SCALE.md
+    "confidence decode budget"), i.e. a conservative stop point: a real
+    checkpoint answering at the median refunds MORE budget than this
+    measurement claims. The stop then arms exactly as shipped
+    (`sweep_early_stop` default). Returns (params, tokenizer, 85), or
+    (None, None, None) to signal the content-free fallback."""
+    try:
+        tools = Path(__file__).resolve().parent / "tools"
+        if str(tools) not in sys.path:
+            sys.path.insert(0, str(tools))
+        import jax as _jax
+        from chain7b import (CHAIN_CONFIDENCE_FORMAT, CHAIN_RESPONSE_FORMAT,
+                             confidence_chain, ship_quantized_chain)
+        from tiny_checkpoints import build_bpe_tokenizer
+
+        fast = build_bpe_tokenizer()
+        chain, junk_next, junk_second = confidence_chain(
+            fast, CHAIN_RESPONSE_FORMAT, CHAIN_CONFIDENCE_FORMAT,
+            answer_step=3)
+        params = ship_quantized_chain(_jax, _jax.devices()[0], cfg, chain,
+                                      junk_next=junk_next,
+                                      junk_second=junk_second)
+        return params, fast, 85
+    except (Exception, SystemExit) as err:  # noqa: BLE001 — bench must
+        # still report (vocab_word_pieces raises SystemExit, which
+        # `except Exception` would let escape past the fallback)
+        print(f"# production-chain path unavailable ({err!r}); falling "
+              "back to random weights + FakeTokenizer (stop OFF)",
+              file=sys.stderr)
+        return None, None, None
+
+
+def _sweep_path(params, cfg, on_accel: bool, tokenizer=None,
+                expect_conf=None):
     """Measure `run_perturbation_sweep` end-to-end: grid build, manifest,
     shared-prefix fused scoring, top-20 logprob maps, D6 + manifest writes.
     A warmup sweep (one full bucket, separate results dir) absorbs the two
     jit compiles; the timed sweep runs all-warm, matching steady state
-    where one compile serves ~20k grid cells."""
+    where one compile serves ~20k grid cells.
+
+    With ``tokenizer`` (the production-chain path) the engine scores
+    through real per-token strings, the digit early stop arms, and every
+    row's parsed confidence is asserted equal to ``expect_conf``; without
+    it, FakeTokenizer content-free scoring (stop silently off)."""
     import numpy as np
 
     from lir_tpu.backends.fake import FakeTokenizer
@@ -290,19 +372,28 @@ def _sweep_path(params, cfg, on_accel: bool):
     batches = SWEEP_BATCHES_TPU if on_accel else SWEEP_BATCHES_CPU
     cells = SWEEP_CELLS_TPU if on_accel else SWEEP_CELLS_CPU
     rng = np.random.default_rng(7)
-    words = ("coverage policy flood water damage claim insurer premium "
-             "exclusion endorsement peril deductible adjuster settle "
-             "liability clause binding interpret statute meaning").split()
-    n_words = 170 if on_accel else 12   # 256-token bucket on the chip
+    if tokenizer is not None:
+        from chain7b import (CHAIN_CONFIDENCE_FORMAT, CHAIN_RESPONSE_FORMAT,
+                             bucket_sized_words)
+        words, n_words = bucket_sized_words(tokenizer, rng)
+        response_format = CHAIN_RESPONSE_FORMAT
+        confidence_format = CHAIN_CONFIDENCE_FORMAT
+    else:
+        words = ("coverage policy flood water damage claim insurer premium "
+                 "exclusion endorsement peril deductible adjuster settle "
+                 "liability clause binding interpret statute meaning").split()
+        n_words = 170 if on_accel else 12   # 256-token bucket on the chip
+        response_format = "Respond with either ' Yes' or ' No' only ."
+        confidence_format = "Give a confidence number from 0 to 100 ."
 
     def long_text():
         return " ".join(rng.choice(words) for _ in range(n_words)) + " ?"
 
     lp = (LegalPrompt(
         main=long_text(),
-        response_format="Respond with either ' Yes' or ' No' only .",
+        response_format=response_format,
         target_tokens=("Yes", "No"),
-        confidence_format="Give a confidence number from 0 to 100 ."),)
+        confidence_format=confidence_format),)
 
     def run(engine, n_cells, tag):
         perts = ([long_text() for _ in range(n_cells - 1)],)
@@ -314,11 +405,17 @@ def _sweep_path(params, cfg, on_accel: bool):
             dt = time.perf_counter() - t0
         assert len(rows) == n_cells, (len(rows), n_cells)
         assert all(np.isfinite(r.token_1_prob) for r in rows)
+        if expect_conf is not None:
+            bad = [r.confidence_value for r in rows
+                   if r.confidence_value != expect_conf]
+            assert not bad, f"chain confidences off: {bad[:5]}"
         return dt
 
     last_oom = None
     for batch in batches:
-        engine = ScoringEngine(params, cfg, FakeTokenizer(),
+        engine = ScoringEngine(params, cfg,
+                               tokenizer if tokenizer is not None
+                               else FakeTokenizer(),
                                RuntimeConfig(batch_size=batch,
                                              max_seq_len=512))
         try:

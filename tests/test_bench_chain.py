@@ -1,0 +1,104 @@
+"""bench.py's production-chain sweep path, exercised on CPU.
+
+The TPU headline (bench.py `_production_chain` + `_sweep_path`) scores a
+chain-programmed model through the REAL offline-trained BPE tokenizer so
+the shipped digit-early-stop default arms (reference workload:
+perturb_prompts.py:398-549 parses a standalone integer out of each
+confidence response). This guards that configuration end-to-end at toy
+size: every swept row must parse confidence == 85 and the tokenizer must
+actually provide a stop-class table (the two things the headline number
+depends on beyond raw throughput).
+"""
+
+import pytest
+
+import bench as bench_mod
+from chain7b import (CHAIN_CONFIDENCE_FORMAT, CHAIN_RESPONSE_FORMAT,
+                     chain_param_tree, confidence_chain,
+                     ship_quantized_chain)
+from tiny_checkpoints import build_bpe_tokenizer
+
+from lir_tpu.engine import tokens as tok
+
+pytestmark = pytest.mark.slow  # real-tokenizer sweep: heavy lane
+
+
+def test_bench_production_chain_sweep_cpu():
+    import jax.numpy as jnp
+
+    from lir_tpu.models.registry import ModelConfig
+
+    fast = build_bpe_tokenizer()
+    vocab = (len(fast) + 127) // 128 * 128
+    cfg = ModelConfig(name="bench-chain-smoke", vocab_size=vocab,
+                      hidden_size=64, n_layers=2, n_heads=4,
+                      intermediate_size=128, max_seq_len=512,
+                      tie_embeddings=False)
+    chain, junk_next, junk_second = confidence_chain(
+        fast, CHAIN_RESPONSE_FORMAT,
+        CHAIN_CONFIDENCE_FORMAT, answer_step=3)
+    params = chain_param_tree(cfg, chain, junk_next=junk_next,
+                              junk_second=junk_second, dtype=jnp.float32)
+
+    # The early stop can only arm if the tokenizer yields surface classes.
+    assert tok.digit_stop_classes(fast, cfg.vocab_size) is not None
+
+    # _sweep_path itself asserts confidence_value == 85 on every row when
+    # expect_conf is set — a wrong scan position, a truncation-rejected
+    # parse, or a stop firing before the integer completes all fail here.
+    value, batch, cells = bench_mod._sweep_path(
+        params, cfg, on_accel=False, tokenizer=fast, expect_conf=85)
+    assert value > 0
+    assert cells == bench_mod.SWEEP_CELLS_CPU
+
+
+@pytest.mark.parametrize("family", ["llama", "gpt2ish"])
+def test_ship_quantized_chain_matches_host_quantize(family):
+    """The on-device chain builder must equal quantize_decoder_params of
+    the host-built tree leaf-for-leaf (structure, dtypes, payloads,
+    scale floors) — it is what the TPU bench actually ships."""
+    import jax
+    import numpy as np
+
+    from lir_tpu.models import quant
+    from lir_tpu.models.registry import ModelConfig
+
+    fast = build_bpe_tokenizer()
+    vocab = (len(fast) + 127) // 128 * 128
+    extra = (dict() if family == "llama" else
+             dict(norm="layernorm", gated_mlp=False, qkv_bias=True,
+                  attn_out_bias=True, mlp_bias=True,
+                  pos_embedding="learned", embedding_norm=True))
+    cfg = ModelConfig(name=f"chain-eq-{family}", vocab_size=vocab,
+                      hidden_size=64, n_layers=2, n_heads=4,
+                      intermediate_size=128, max_seq_len=64,
+                      tie_embeddings=False, **extra)
+    chain, junk_next, junk_second = confidence_chain(
+        fast, CHAIN_RESPONSE_FORMAT,
+        CHAIN_CONFIDENCE_FORMAT, answer_step=3)
+
+    host = quant.quantize_decoder_params(
+        chain_param_tree(cfg, chain, junk_next=junk_next,
+                         junk_second=junk_second),
+        dynamic=True)
+    dev = jax.devices("cpu")[0]
+    shipped = ship_quantized_chain(jax, dev, cfg, chain,
+                                   junk_next=junk_next,
+                                   junk_second=junk_second)
+
+    is_q = lambda x: isinstance(x, quant.QuantTensor)  # noqa: E731
+    ph, sh = (jax.tree.leaves_with_path(t, is_leaf=is_q)
+              for t in (host, shipped))
+    assert [p for p, _ in ph] == [p for p, _ in sh]
+    for (path, a), (_, b) in zip(ph, sh):
+        if is_q(a):
+            assert is_q(b) and a.dynamic == b.dynamic, path
+            np.testing.assert_array_equal(np.asarray(a.q), np.asarray(b.q),
+                                          err_msg=str(path))
+            np.testing.assert_allclose(np.asarray(a.scale),
+                                       np.asarray(b.scale), rtol=1e-6,
+                                       err_msg=str(path))
+        else:
+            assert a.dtype == b.dtype, path
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=str(path))

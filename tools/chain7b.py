@@ -29,19 +29,13 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 
-def chain_param_tree(cfg, chain: Dict[int, Tuple[int, int]],
-                     junk_next: int, junk_second: int, dtype=None):
-    """Build the decoder param tree (models/decoder.init_params layout)
-    realizing ``chain``; unlisted tokens all map to (junk_next,
-    junk_second). cfg must have tie_embeddings=False."""
-    import jax.numpy as jnp
-
-    dtype = dtype or jnp.bfloat16
-    assert not cfg.tie_embeddings, "chain tree needs an untied lm_head"
-    D, H, K, hd, F, L, V = (cfg.hidden_size, cfg.n_heads, cfg.n_kv_heads,
-                            cfg.head_dim, cfg.intermediate_size,
-                            cfg.n_layers, cfg.vocab_size)
-
+def _chain_content_leaves(cfg, chain: Dict[int, Tuple[int, int]],
+                          junk_next: int, junk_second: int):
+    """(tok_embed, lm_head) numpy fp32 — the only value-bearing leaves of
+    a chain tree: one-hot basis embeddings + the transition-table head.
+    Shared by the host builder (chain_param_tree) and the on-device
+    builder (ship_quantized_chain) so their designed outputs agree."""
+    D, V = cfg.hidden_size, cfg.vocab_size
     basis: Dict[int, int] = {}
     for t in chain:
         basis[t] = len(basis)
@@ -60,15 +54,26 @@ def chain_param_tree(cfg, chain: Dict[int, Tuple[int, int]],
         lm_head[basis[t], second] += 5.0
     lm_head[junk_axis, junk_next] += 10.0
     lm_head[junk_axis, junk_second] += 5.0
+    return tok_embed, lm_head
+
+
+def _chain_layout(cfg, dtype, jnp, linear):
+    """The decoder param layout (models/decoder.init_params flag cascade)
+    with every big linear built by ``linear(*shape)`` — dense zeros on
+    the host path, zero QuantTensors on the on-device path. Single source
+    so the two chain builders cannot drift; the content leaves
+    (tok_embed / lm_head) are attached by the callers."""
+    D, H, K, hd, F, L = (cfg.hidden_size, cfg.n_heads, cfg.n_kv_heads,
+                         cfg.head_dim, cfg.intermediate_size, cfg.n_layers)
 
     def zeros(*shape):
         return jnp.zeros(shape, dtype)
 
     layers = {
         "ln1": {"scale": jnp.ones((L, D), dtype)},
-        "wq": zeros(L, D, H * hd), "wk": zeros(L, D, K * hd),
-        "wv": zeros(L, D, K * hd), "wo": zeros(L, H * hd, D),
-        "w_up": zeros(L, D, F), "w_down": zeros(L, F, D),
+        "wq": linear(L, D, H * hd), "wk": linear(L, D, K * hd),
+        "wv": linear(L, D, K * hd), "wo": linear(L, H * hd, D),
+        "w_up": linear(L, D, F), "w_down": linear(L, F, D),
     }
     if not cfg.shared_block_ln:
         layers["ln2"] = {"scale": jnp.ones((L, D), dtype)}
@@ -77,7 +82,7 @@ def chain_param_tree(cfg, chain: Dict[int, Tuple[int, int]],
         if "ln2" in layers:
             layers["ln2"]["bias"] = zeros(L, D)
     if cfg.gated_mlp:
-        layers["w_gate"] = zeros(L, D, F)
+        layers["w_gate"] = linear(L, D, F)
     if cfg.qkv_bias:
         layers["bq"] = zeros(L, H * hd)
         layers["bk"] = zeros(L, K * hd)
@@ -88,7 +93,7 @@ def chain_param_tree(cfg, chain: Dict[int, Tuple[int, int]],
         layers["b_up"] = zeros(L, F)
         layers["b_down"] = zeros(L, D)
 
-    params = {"tok_embed": jnp.asarray(tok_embed, dtype), "layers": layers}
+    params = {"layers": layers}
     if cfg.pos_embedding == "learned":
         params["pos_embed"] = zeros(cfg.max_seq_len + cfg.learned_pos_offset,
                                     D)
@@ -100,6 +105,23 @@ def chain_param_tree(cfg, chain: Dict[int, Tuple[int, int]],
         if cfg.norm == "layernorm":
             fl["bias"] = zeros(D)
         params["final_ln"] = fl
+    return params
+
+
+def chain_param_tree(cfg, chain: Dict[int, Tuple[int, int]],
+                     junk_next: int, junk_second: int, dtype=None):
+    """Build the decoder param tree (models/decoder.init_params layout)
+    realizing ``chain``; unlisted tokens all map to (junk_next,
+    junk_second). cfg must have tie_embeddings=False."""
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    assert not cfg.tie_embeddings, "chain tree needs an untied lm_head"
+    tok_embed, lm_head = _chain_content_leaves(cfg, chain, junk_next,
+                                               junk_second)
+    params = _chain_layout(cfg, dtype, jnp,
+                           linear=lambda *s: jnp.zeros(s, dtype))
+    params["tok_embed"] = jnp.asarray(tok_embed, dtype)
     params["lm_head"] = jnp.asarray(lm_head, dtype)
     return params
 
@@ -129,6 +151,66 @@ def vocab_word_pieces(tokenizer, n: int, taken) -> list:
             if len(out) == n:
                 return out
     raise SystemExit(f"vocab too small: found {len(out)}/{n} word pieces")
+
+
+# The two production-sweep format strings the chain anchors on (their LAST
+# token is each response's transition trigger). Shared by bench.py and
+# earlystop_bench so the recorded headline and the early-stop study stay
+# apples-to-apples: editing one side only would silently anchor the two
+# chains on different tokens.
+CHAIN_RESPONSE_FORMAT = "Respond with either Yes or No only please"
+CHAIN_CONFIDENCE_FORMAT = "Give a confidence number from 0 to 100"
+
+
+def confidence_chain(fast, response_format: str, confidence_format: str,
+                     answer_step: int = 3):
+    """Transition table realizing the production sweep's two response
+    shapes on tokenizer ``fast``: the binary prompt (ending in
+    ``response_format``'s last token) answers " Yes."-style, and the
+    confidence prompt (ending in ``confidence_format``'s last token)
+    emits ``answer_step - 1`` non-digit preamble words, then the
+    single-token integer " 85", then ".", then EOS — the shape the digit
+    early stop (engine/tokens.digit_stop_classes) halts on, at the
+    corpus-measured answer position (SCALE.md "confidence decode budget":
+    median answer word 0-1 across 1,382 committed reference rows).
+
+    Returns ``(chain, junk_next, junk_second)`` for
+    :func:`chain_param_tree` / :func:`ship_quantized_chain`."""
+    conf_anchor = last_token_id(fast, confidence_format)
+    bin_anchor = last_token_id(fast, response_format)
+    eos = fast.eos_token_id
+    digit = single_token_id(fast, " 85")
+    dot = single_token_id(fast, ".")
+    yes = single_token_id(fast, " Yes")
+    # Preamble words (never digits): emitted before the integer so the
+    # stop has real work to do at answer-step > 0.
+    taken = {conf_anchor, bin_anchor, eos, digit, dot, yes}
+    # vocab_word_pieces returns exactly this many pieces or raises.
+    pre = vocab_word_pieces(fast, max(answer_step - 1, 1), taken)
+    chain = {}
+    seq = [conf_anchor] + pre[:max(answer_step - 1, 0)] + [digit, dot, eos]
+    for a, b in zip(seq, seq[1:]):
+        chain.setdefault(a, (b, dot))
+    chain[bin_anchor] = (yes, dot)
+    chain.setdefault(yes, (dot, eos))
+    chain[eos] = (eos, dot)
+    cast = [conf_anchor, bin_anchor, eos, digit, dot, yes] + pre
+    assert len(set(cast)) == len(cast), "chain token collision"
+    return chain, dot, eos
+
+
+def bucket_sized_words(fast, rng, target_tokens: int = 205):
+    """(word list, words-per-text) sizing rephrased mains to land in the
+    256-token bucket under tokenizer ``fast`` — corpus words are
+    multi-piece in a small trained vocab, so a fixed word count would
+    spill into the 512 bucket and OOM the measured batch."""
+    from lir_tpu.data.prompts import WORD_MEANING_QUESTIONS
+
+    words = sorted({w for q in WORD_MEANING_QUESTIONS for w in q.split()
+                    if w.isalpha()})
+    sample = " ".join(rng.choice(words) for _ in range(50))
+    per_word = len(fast(sample, add_special_tokens=False).input_ids) / 50
+    return words, max(int(target_tokens / per_word), 8)
 
 
 def bench_setup(max_seq_len: int, smoke_name: str):
@@ -168,14 +250,37 @@ def bench_setup(max_seq_len: int, smoke_name: str):
 
 
 def ship_quantized_chain(jax, dev, cfg, chain, junk_next, junk_second):
-    """Build + quantize the chain tree on HOST CPU (a bf16 7B tree
-    on-device is ~12.6 GiB and OOMs beside its own int8 copy), then ship
-    only the int8 tree to the accelerator."""
+    """Assemble the dynamic-int8 chain tree DIRECTLY on the accelerator.
+
+    Every layer matrix of a chain tree is zeros, and ``quant.quantize`` of
+    a zero matrix is exactly ``q = 0`` with the zero-safe scale floor
+    ``1e-8 / 127`` — so those QuantTensors are constructed on-device with
+    no host build and no transfer. Only the content-bearing leaves
+    (one-hot tok_embed bf16 + the transition-table lm_head, quantized
+    weight-only on device like quantize_decoder_params does) ship over
+    the wire: ~0.4 GiB instead of the full 6.7 GiB int8 tree, which at
+    tunnel bandwidth dominated bench start-up (~6 min host quantize +
+    transfer measured before this path)."""
+    import jax.numpy as jnp
+
     from lir_tpu.models import quant
 
-    cpu = jax.devices("cpu")[0]
-    with jax.default_device(cpu):
-        params = chain_param_tree(cfg, chain, junk_next=junk_next,
-                                  junk_second=junk_second)
-        params = quant.quantize_decoder_params(params, dynamic=True)
-    return jax.device_put(params, dev)
+    assert not cfg.tie_embeddings, "chain tree needs an untied lm_head"
+    tok_embed, lm_head = _chain_content_leaves(cfg, chain, junk_next,
+                                               junk_second)
+    dtype = jnp.bfloat16
+
+    with jax.default_device(dev):
+        def zq(*shape):
+            # quantize(zeros) == zero payload + the 1e-8/127 scale floor
+            # (quant.quantize); dynamic matches random_quantized_params.
+            return quant.QuantTensor(
+                q=jnp.zeros(shape, jnp.int8),
+                scale=jnp.full(shape[:-2] + shape[-1:], 1e-8 / 127.0,
+                               jnp.float32),
+                dynamic=True)
+
+        params = _chain_layout(cfg, dtype, jnp, linear=zq)
+        params["tok_embed"] = jnp.asarray(tok_embed, dtype)
+        params["lm_head"] = quant.quantize(jnp.asarray(lm_head, dtype))
+    return params

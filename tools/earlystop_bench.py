@@ -55,8 +55,9 @@ def main() -> None:
 
     import numpy as np
 
-    from chain7b import (bench_setup, last_token_id, ship_quantized_chain,
-                         single_token_id, vocab_word_pieces)
+    from chain7b import (CHAIN_CONFIDENCE_FORMAT, CHAIN_RESPONSE_FORMAT,
+                         bench_setup, bucket_sized_words, confidence_chain,
+                         ship_quantized_chain)
     from lir_tpu.config import RuntimeConfig
     from lir_tpu.data.prompts import LegalPrompt
     from lir_tpu.engine.runner import ScoringEngine
@@ -65,57 +66,27 @@ def main() -> None:
     jax, dev, on_accel, fast, cfg, mode = bench_setup(
         max_seq_len=512, smoke_name="earlystop-smoke")
 
-    # Prompts: word-meaning corpus words (in-vocab, ~1 token each) so the
-    # rephrased mains land in the 256 bucket like the real legal prompts.
-    from lir_tpu.data.prompts import WORD_MEANING_QUESTIONS
-    words = sorted({w for q in WORD_MEANING_QUESTIONS for w in q.split()
-                    if w.isalpha()})
+    # Prompts: word-meaning corpus words (in-vocab, ~1 token each), sized
+    # so the rephrased mains land in the 256 bucket like the real sweeps.
     rng = np.random.default_rng(7)
-
-    # Size the rephrased mains so prompts land in the 256 bucket like the
-    # real sweeps (corpus words are multi-piece in this 826-token vocab —
-    # a fixed word count would spill into the 512 bucket and OOM batch 40).
-    sample = " ".join(rng.choice(words) for _ in range(50))
-    per_word = len(fast(sample, add_special_tokens=False).input_ids) / 50
-    n_words = max(int(205 / per_word), 8)
+    words, n_words = bucket_sized_words(fast, rng)
 
     def long_text():
         return " ".join(rng.choice(words) for _ in range(n_words)) + " ?"
 
-    response_format = "Respond with either Yes or No only please"
-    confidence_format = "Give a confidence number from 0 to 100"
+    response_format = CHAIN_RESPONSE_FORMAT
+    confidence_format = CHAIN_CONFIDENCE_FORMAT
     lp = (LegalPrompt(main=long_text(), response_format=response_format,
                       target_tokens=("Yes", "No"),
                       confidence_format=confidence_format),)
     perts = ([long_text() for _ in range(args.cells - 1)],)
 
-    # --- chain: designed responses --------------------------------------
-    conf_anchor = last_token_id(fast, confidence_format)
-    bin_anchor = last_token_id(fast, response_format)
-    eos = fast.eos_token_id
-    digit = single_token_id(fast, " 85")
-    dot = single_token_id(fast, ".")
-    yes = single_token_id(fast, " Yes")
-    # Preamble words (never digits): emitted before the integer so the
-    # stop has real work to do at answer-step > 0.
-    taken = {conf_anchor, bin_anchor, eos, digit, dot, yes}
-    pre = vocab_word_pieces(fast, max(args.answer_step - 1, 1), taken)
-    assert args.answer_step - 1 <= len(pre), (
-        "preamble shorter than requested answer step — the recorded "
-        "SCALE.md config would misstate the measurement")
-    chain = {}
-    seq = [conf_anchor] + pre[:max(args.answer_step - 1, 0)] + [digit, dot,
-                                                               eos]
-    for a, b in zip(seq, seq[1:]):
-        chain.setdefault(a, (b, dot))
-    chain[bin_anchor] = (yes, dot)
-    chain.setdefault(yes, (dot, eos))
-    chain[eos] = (eos, dot)
-    cast = [conf_anchor, bin_anchor, eos, digit, dot, yes] + pre
-    assert len(set(cast)) == len(cast), "chain token collision"
-
-    params = ship_quantized_chain(jax, dev, cfg, chain, junk_next=dot,
-                                  junk_second=eos)
+    # --- chain: designed responses (emit ' 85' at answer_step, then EOS).
+    chain, junk_next, junk_second = confidence_chain(
+        fast, response_format, confidence_format,
+        answer_step=args.answer_step)
+    params = ship_quantized_chain(jax, dev, cfg, chain, junk_next=junk_next,
+                                  junk_second=junk_second)
 
     def build_engine(conf_tokens: int, early: bool) -> ScoringEngine:
         rt = RuntimeConfig(batch_size=args.batch, max_seq_len=512,
