@@ -271,13 +271,13 @@ def main() -> None:
     # ---- primary: the end-to-end perturbation sweep (BASELINE's metric).
     sweep_value, sweep_batch, sweep_cells = _sweep_path(
         params, cfg, on_accel, tokenizer=sweep_tok, expect_conf=expect_conf)
-    stop_str = ("digit early stop ON over real-text responses "
-                "(production default; real BPE tokenizer, programmed-chain "
-                "weights at identical matmul cost, answer at decode step 3 "
-                "— conservatively past the corpus-median position 0-1, "
-                "SCALE.md; stop-OFF worst case printed as a comment)"
-                if sweep_tok is not None
-                else "digit early stop OFF (content-free fallback)")
+    stop_str = ("confidence digit stop + binary EOS stop ON over "
+                "real-text responses (production default; real BPE "
+                "tokenizer, programmed-chain weights at identical matmul "
+                "cost, answer at decode step 3 — conservatively past the "
+                "corpus-median position 0-1, SCALE.md; stop-OFF worst "
+                "case printed as a comment)" if sweep_tok is not None
+                else "early stops OFF (content-free fallback)")
     sweep_nominal = (BENCH_NOMINAL_7B_SWEEP if on_accel
                      else BENCH_NOMINAL_CPU_SWEEP)
     print(json.dumps({

@@ -216,6 +216,7 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
                                digit_vals: jax.Array, max_new_a: int,
                                max_new_b: int, topk: int = 20,
                                prefill_fn=None, stop_mask_b: jax.Array = None,
+                               stop_mask_a: jax.Array = None,
                                eos_id: jax.Array = None
                                ) -> Tuple[FusedDecodeOut, FusedDecodeOut]:
     """TWO fused greedy decodes sharing ONE prefill over a common prefix.
@@ -258,8 +259,13 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
                            yes_ids, no_ids, d_ids, d_vals, new_tokens, topk,
                            stop_mask=stop_mask, eos_id=eos_id)
 
+    # The binary branch (A) takes, when provided, the EOS-only stop
+    # (tokens.eos_only_stop_classes: all-transparent classes reduce the
+    # done rule to emit == eos) — its numeric readout is position 0 and
+    # its response text is EOS-trimmed downstream, so skipped trailing
+    # steps are pure EOS fill.
     out_a, cache_a = branch(cache, sfx_a, sfx_a_mask, max_new_a,
-                            empty_ids, empty_vals)
+                            empty_ids, empty_vals, stop_mask=stop_mask_a)
     # The confidence branch (B) takes the digit table and, when provided,
     # the digit early stop — only its first complete integer is read.
     out_b, _ = branch(cache_a, sfx_b, sfx_b_mask, max_new_b,

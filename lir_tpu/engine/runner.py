@@ -129,6 +129,7 @@ class ScoringEngine:
             self.buckets = fitting
         self._digit_table: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._digit_stop_mask: Any = False  # False = not resolved yet
+        self._eos_stop_mask: Optional[jax.Array] = None
 
     @property
     def digit_stop_mask(self) -> Optional[jax.Array]:
@@ -146,6 +147,21 @@ class ScoringEngine:
                     mask = jnp.asarray(m)
             self._digit_stop_mask = mask
         return self._digit_stop_mask
+
+    @property
+    def eos_stop_mask(self) -> Optional[jax.Array]:
+        """(V,) all-transparent class table (tokens.eos_only_stop_classes)
+        arming a pure all-rows-emitted-EOS stop on the sweep's binary
+        branch. Gated on :attr:`digit_stop_mask` being available — the
+        same real-tokenizer-with-EOS condition — so content-free
+        tokenizers (FakeTokenizer) stay fully stop-free on BOTH branches
+        and the bench's stop-OFF comparison keeps its meaning."""
+        if self.digit_stop_mask is None:
+            return None
+        if self._eos_stop_mask is None:
+            self._eos_stop_mask = jnp.asarray(
+                tok.eos_only_stop_classes(self.cfg.vocab_size))
+        return self._eos_stop_mask
 
     @property
     def digit_table(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -178,7 +194,7 @@ class ScoringEngine:
                      no_ids: np.ndarray, with_digits: bool = False,
                      max_new_tokens: Optional[int] = None,
                      pretokenized: Optional[Sequence[Sequence[int]]] = None,
-                     early_stop: bool = False):
+                     early_stop: bool = False, eos_stop: bool = False):
         """The production scoring path: one jitted decode with the C13/D6
         readouts fused into the scan (no (B, T, V) logit stack). Decoder-only
         models only; T5 keeps the capture path (tiny vocab stacks).
@@ -187,17 +203,22 @@ class ScoringEngine:
         sweep passes its short per-cell budget, config.RuntimeConfig).
         ``pretokenized`` skips tokenization when the caller already holds
         the token ids (the shared-prefix fallback path). ``early_stop``
-        enables the confidence digit early stop (generate._fused_tail) when
-        the tokenizer supports it — only valid for calls whose downstream
-        readout is position-0 + first-integer parse."""
+        enables the confidence digit early stop (generate._fused_tail);
+        ``eos_stop`` the pure all-rows-emitted-EOS stop instead
+        (:attr:`eos_stop_mask` — the sweep's binary branch). Both are
+        gated on tokenizer support and only valid for calls whose
+        downstream readout is position-0 (+ first-integer parse for the
+        digit variant)."""
         assert not self.encoder_decoder
+        assert not (early_stop and eos_stop), "pick one stop rule"
         toks, mask = self._pad_batch(prompts, pretokenized)
         if with_digits:
             digit_ids, digit_vals = self.digit_table
         else:
             digit_ids = np.zeros((0,), np.int32)
             digit_vals = np.zeros((0,), np.float32)
-        stop_mask = self.digit_stop_mask if early_stop else None
+        stop_mask = (self.digit_stop_mask if early_stop
+                     else self.eos_stop_mask if eos_stop else None)
         return generate.greedy_decode_fused(
             self.params, self.cfg, toks, mask,
             jnp.asarray(yes_ids, jnp.int32), jnp.asarray(no_ids, jnp.int32),
@@ -280,7 +301,8 @@ class ScoringEngine:
                 "with two full prefills", fallback_reason)
             fused = self.decode_fused(binary_prompts, yes_ids, no_ids,
                                       max_new_tokens=new_tokens,
-                                      pretokenized=bin_ids)
+                                      pretokenized=bin_ids,
+                                      eos_stop=early_stop)
             cfused = self.decode_fused(confidence_prompts, yes_ids, no_ids,
                                        with_digits=True,
                                        max_new_tokens=conf_tokens,
@@ -302,6 +324,7 @@ class ScoringEngine:
             jnp.asarray(digit_ids), jnp.asarray(digit_vals),
             max_new_a=new_tokens, max_new_b=conf_tokens,
             prefill_fn=self._prefill_fn, stop_mask_b=stop_mask,
+            stop_mask_a=(None if stop_mask is None else self.eos_stop_mask),
             eos_id=(None if stop_mask is None
                     else jnp.int32(self.eos_id)))
 

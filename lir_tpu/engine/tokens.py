@@ -82,6 +82,19 @@ STOP_ENDS_WORD = 8    # last decoded char is a word char
 STOP_TRANSPARENT = 16  # decodes to nothing (bracketed specials): invisible
                        # to the text, so it must not start/stop anything
 
+def eos_only_stop_classes(vocab_size: int) -> np.ndarray:
+    """(vocab_size,) all-STOP_TRANSPARENT class table: under
+    generate._fused_tail's rule a transparent token freezes every piece
+    of text state (no digit run ever opens), so the only remaining done
+    condition is ``emit == eos_id`` — a pure all-rows-emitted-EOS stop
+    with exactly the trim-at-EOS semantics the host applies to response
+    text anyway (runner.decode_completion / HF generate parity). Used for
+    the sweep's BINARY branch, whose numeric readout consumes position 0
+    only (perturb_prompts.py:474-526): skipped trailing steps can never
+    change a recorded value, they are pure EOS fill."""
+    return np.full((vocab_size,), STOP_TRANSPARENT, np.int32)
+
+
 _SPACE_PREFIX = ("▁", "Ġ", "Ċ", " ", "\t", "\n", "\r")
 _BYTE_FORM = re.compile(r"<0[xX]([0-9A-Fa-f]{2})>")
 _SPECIAL_FORM = re.compile(r"<[^<>]*>")

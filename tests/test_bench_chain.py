@@ -52,6 +52,83 @@ def test_bench_production_chain_sweep_cpu():
     assert cells == bench_mod.SWEEP_CELLS_CPU
 
 
+def test_binary_branch_eos_stop_preserves_rows():
+    """The EOS-only stop on the sweep's binary branch (runner.eos_stop_mask
+    -> generate.greedy_decode_fused_shared stop_mask_a) must change
+    nothing a consumer reads: position-0 readouts bitwise equal, response
+    text equal after the EOS trim every path applies, and the confidence
+    branch's parsed integer unchanged."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chain7b import single_token_id
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models.registry import ModelConfig
+
+    fast = build_bpe_tokenizer()
+    vocab = (len(fast) + 127) // 128 * 128
+    cfg = ModelConfig(name="eos-stop-smoke", vocab_size=vocab,
+                      hidden_size=64, n_layers=2, n_heads=4,
+                      intermediate_size=128, max_seq_len=512,
+                      tie_embeddings=False)
+    chain, junk_next, junk_second = confidence_chain(
+        fast, CHAIN_RESPONSE_FORMAT, CHAIN_CONFIDENCE_FORMAT, answer_step=3)
+    # confidence_chain maps EOS -> EOS; remap it to a VISIBLE token so the
+    # unstopped decode keeps emitting text after EOS while a working stop
+    # forces EOS fill — otherwise both runs are byte-identical and a dead
+    # stop_mask_a wiring would pass this test unnoticed.
+    eos = fast.eos_token_id
+    dot = single_token_id(fast, ".")
+    chain[eos] = (dot, eos)
+    params = chain_param_tree(cfg, chain, junk_next=junk_next,
+                              junk_second=junk_second, dtype=jnp.float32)
+    engine = ScoringEngine(params, cfg, fast,
+                           RuntimeConfig(batch_size=4, max_seq_len=512))
+    assert engine.eos_stop_mask is not None
+
+    mains = ["what is the meaning of flood damage here",
+             "does the policy cover the water loss",
+             "is the clause binding on the insurer",
+             "should the exclusion apply to the claim"]
+    bins = [m + " " + CHAIN_RESPONSE_FORMAT for m in mains]
+    confs = [m + " " + CHAIN_CONFIDENCE_FORMAT for m in mains]
+    yes_ids = np.full((4,), single_token_id(fast, " Yes"), np.int32)
+    no_ids = np.full((4,), single_token_id(fast, " No"), np.int32)
+
+    outs = [engine.decode_fused_shared(bins, confs, yes_ids, no_ids,
+                                       new_tokens=6, conf_tokens=8,
+                                       early_stop=stop)
+            for stop in (False, True)]
+    (a0, b0), (a1, b1) = outs
+
+    # Engagement probe: every row reaches EOS inside the budget, the
+    # unstopped run emits visible text after it (the remapped chain), and
+    # the stopped run's post-EOS tail is pure EOS fill. A dead stop_mask_a
+    # wiring fails here instead of passing vacuously.
+    g0, g1 = np.asarray(a0.generated), np.asarray(a1.generated)
+    assert (g0 == eos).any(axis=1).all(), "chain must reach EOS in budget"
+    for r0, r1 in zip(g0, g1):
+        k = int(np.argmax(r0 == eos))
+        assert (r0[k + 1:] != eos).any(), "probe chain must talk past EOS"
+        assert (r1[k:] == eos).all(), "stop did not engage (no EOS fill)"
+
+    # Float readouts cross two differently-jitted programs — allclose, not
+    # bitwise (tests/test_engine.py parity convention).
+    np.testing.assert_allclose(np.asarray(a1.p_yes[:, 0]),
+                               np.asarray(a0.p_yes[:, 0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(a1.p_no[:, 0]),
+                               np.asarray(a0.p_no[:, 0]), rtol=1e-6)
+    for r0, r1 in zip(g0, g1):
+        assert (engine.decode_completion(r1)
+                == engine.decode_completion(r0))
+    # Confidence branch: the parsed integer's source tokens are unchanged
+    # by the binary branch's stop.
+    for r0, r1 in zip(np.asarray(b0.generated), np.asarray(b1.generated)):
+        assert (engine.decode_completion(r1)
+                == engine.decode_completion(r0))
+
+
 @pytest.mark.parametrize("family", ["llama", "gpt2ish"])
 def test_ship_quantized_chain_matches_host_quantize(family):
     """The on-device chain builder must equal quantize_decoder_params of
