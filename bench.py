@@ -113,6 +113,15 @@ def main() -> None:
     ap.add_argument("--allow-ungated", action="store_true",
                     help="report numbers even when the chip kind is missing "
                          "from the MFU peak table (default: abort)")
+    ap.add_argument("--model", default="llama2_7b",
+                    help="models.registry preset name for the accelerator "
+                         "bench (default: llama2_7b, the cache-heaviest "
+                         "MHA architecture = the headline; e.g. mistral_7b "
+                         "for the GQA comparison)")
+    ap.add_argument("--sweep-batches", default=None,
+                    help="comma-separated sweep batch ladder override "
+                         "(e.g. 48,40 for GQA models whose smaller KV "
+                         "cache fits batch 48)")
     args = ap.parse_args()
 
     from lir_tpu.engine import generate, score
@@ -140,10 +149,24 @@ def main() -> None:
     if on_accel:
         import dataclasses
 
-        from lir_tpu.models.registry import llama2_7b
+        from lir_tpu.models import registry
+        preset = getattr(registry, args.model, None)
+        try:
+            cfg0 = preset() if callable(preset) else None
+        except TypeError:  # e.g. --model ModelConfig (required args)
+            cfg0 = None
+        if not isinstance(cfg0, registry.ModelConfig):
+            # Catches misspellings AND real-but-unusable attributes: a T5
+            # preset (t0_3b) or a class name would crash later with a raw
+            # traceback; this bench scores decoder-only ModelConfigs.
+            print(f"BENCH ABORT: {args.model!r} is not a decoder-only "
+                  "registry preset (expected a zero-arg function in "
+                  "lir_tpu.models.registry returning a ModelConfig, e.g. "
+                  "llama2_7b, mistral_7b, falcon_7b)", file=sys.stderr)
+            sys.exit(1)
         # int8 KV cache: half the cache HBM -> batch 48 fits (the knee);
         # decode attention runs s8 dots like the dynamic weight mode.
-        cfg = dataclasses.replace(llama2_7b(), kv_cache_int8=True)
+        cfg = dataclasses.replace(cfg0, kv_cache_int8=True)
         # Production-default content: chain-programmed weights at FULL
         # 7B/32000-vocab matmul cost whose responses are real text (the
         # confidence answer completes at the corpus-median decode step),
@@ -269,8 +292,11 @@ def main() -> None:
           file=sys.stderr)
 
     # ---- primary: the end-to-end perturbation sweep (BASELINE's metric).
+    batch_override = (tuple(int(b) for b in args.sweep_batches.split(","))
+                      if args.sweep_batches else None)
     sweep_value, sweep_batch, sweep_cells = _sweep_path(
-        params, cfg, on_accel, tokenizer=sweep_tok, expect_conf=expect_conf)
+        params, cfg, on_accel, tokenizer=sweep_tok, expect_conf=expect_conf,
+        batches=batch_override)
     stop_str = ("confidence digit stop + binary EOS stop ON over "
                 "real-text responses (production default; real BPE "
                 "tokenizer, programmed-chain weights at identical matmul "
@@ -280,6 +306,9 @@ def main() -> None:
                 else "early stops OFF (content-free fallback)")
     sweep_nominal = (BENCH_NOMINAL_7B_SWEEP if on_accel
                      else BENCH_NOMINAL_CPU_SWEEP)
+    arch_note = ("; headline is the cache-heaviest MHA architecture — "
+                 "see SCALE.md for the faster GQA alternatives"
+                 if cfg.name == "llama-2-7b" else "")
     print(json.dumps({
         "metric": "sweep_prompts_per_sec_per_chip",
         "value": round(sweep_value, 3),
@@ -287,10 +316,8 @@ def main() -> None:
                  f"{n_params / 1e9:.2f}B {mode}, shared-prefix scoring, "
                  f"batch={sweep_batch}, {sweep_cells} cells, "
                  f"binary+confidence per cell, {stop_str}; isolated step "
-                 f"{value:.1f} p/s at {mfu_str}; headline is the "
-                 f"cache-heaviest MHA architecture — GQA mistral-7b "
-                 f"measures 44.6 p/s at identical stop-OFF settings, "
-                 f"SCALE.md; {dev.platform})"),
+                 f"{value:.1f} p/s at {mfu_str}{arch_note}; "
+                 f"{dev.platform})"),
         "vs_baseline": round(sweep_value / sweep_nominal, 3),
     }))
     if sweep_tok is not None:
@@ -300,8 +327,8 @@ def main() -> None:
         # headline JSON so a failure here can never discard the
         # already-measured production result.
         try:
-            nostop_value, nostop_batch, _ = _sweep_path(params, cfg,
-                                                        on_accel)
+            nostop_value, nostop_batch, _ = _sweep_path(
+                params, cfg, on_accel, batches=batch_override)
             print(f"# sweep stop-OFF worst case (FakeTokenizer, batch "
                   f"{nostop_batch}): {nostop_value:.3f} p/s",
                   file=sys.stderr)
@@ -350,7 +377,7 @@ def _production_chain(cfg):
 
 
 def _sweep_path(params, cfg, on_accel: bool, tokenizer=None,
-                expect_conf=None):
+                expect_conf=None, batches=None):
     """Measure `run_perturbation_sweep` end-to-end: grid build, manifest,
     shared-prefix fused scoring, top-20 logprob maps, D6 + manifest writes.
     A warmup sweep (one full bucket, separate results dir) absorbs the two
@@ -369,7 +396,8 @@ def _sweep_path(params, cfg, on_accel: bool, tokenizer=None,
     from lir_tpu.engine.runner import ScoringEngine
     from lir_tpu.engine.sweep import run_perturbation_sweep
 
-    batches = SWEEP_BATCHES_TPU if on_accel else SWEEP_BATCHES_CPU
+    if batches is None:
+        batches = SWEEP_BATCHES_TPU if on_accel else SWEEP_BATCHES_CPU
     cells = SWEEP_CELLS_TPU if on_accel else SWEEP_CELLS_CPU
     rng = np.random.default_rng(7)
     if tokenizer is not None:
@@ -418,17 +446,22 @@ def _sweep_path(params, cfg, on_accel: bool, tokenizer=None,
                                else FakeTokenizer(),
                                RuntimeConfig(batch_size=batch,
                                              max_seq_len=512))
+        # Time an exact multiple of the batch: a ragged tail pads into a
+        # DIFFERENT batch shape whose fresh compile would land inside the
+        # timed run — a bench artifact (production amortizes one compile
+        # over ~20k grid cells), not production cost.
+        cells_b = max(1, round(cells / batch)) * batch
         try:
             t_warm = run(engine, batch, "warmup")
             print(f"# sweep warmup (batch {batch}, incl. compiles): "
                   f"{t_warm:.1f}s", file=sys.stderr)
-            dt = run(engine, cells, "timed")
+            dt = run(engine, cells_b, "timed")
         except Exception as err:  # noqa: BLE001 — OOM falls back, rest raises
             if _is_oom(err):
                 last_oom = err
                 continue
             raise
-        return cells / dt, batch, cells
+        return cells_b / dt, batch, cells_b
     print(f"BENCH ABORT: every sweep batch candidate OOMed; last: {last_oom}",
           file=sys.stderr)
     sys.exit(1)
