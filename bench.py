@@ -108,6 +108,14 @@ def _is_oom(err: Exception) -> bool:
     return is_oom_error(err)
 
 
+def _tools_on_path() -> None:
+    """Make tools/ importable (chain7b, tiny_checkpoints, the shared
+    registry-preset resolver in scale_validation)."""
+    tools = Path(__file__).resolve().parent / "tools"
+    if str(tools) not in sys.path:
+        sys.path.insert(0, str(tools))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--allow-ungated", action="store_true",
@@ -123,6 +131,21 @@ def main() -> None:
                          "(e.g. 48,40 for GQA models whose smaller KV "
                          "cache fits batch 48)")
     args = ap.parse_args()
+
+    # Flag validation FIRST — a malformed ladder must abort before the
+    # multi-minute param init and isolated-step measurement, not after.
+    batch_override = None
+    if args.sweep_batches:
+        try:
+            batch_override = tuple(int(b) for b in
+                                   args.sweep_batches.split(","))
+        except ValueError:
+            batch_override = ()
+        if not batch_override or any(b <= 0 for b in batch_override):
+            print(f"BENCH ABORT: --sweep-batches {args.sweep_batches!r} "
+                  "must be comma-separated positive ints (e.g. 48,40)",
+                  file=sys.stderr)
+            sys.exit(1)
 
     from lir_tpu.engine import generate, score
     from lir_tpu.models import decoder, quant
@@ -149,38 +172,38 @@ def main() -> None:
     if on_accel:
         import dataclasses
 
-        from lir_tpu.models import registry
-        preset = getattr(registry, args.model, None)
+        # The shared preset resolver (tools/scale_validation.py): rejects
+        # misspellings (listing the valid names), T5 presets, and class
+        # names — one resolver for every tool that takes --model.
+        _tools_on_path()
+        from scale_validation import resolve_preset
         try:
-            cfg0 = preset() if callable(preset) else None
-        except TypeError:  # e.g. --model ModelConfig (required args)
-            cfg0 = None
-        if not isinstance(cfg0, registry.ModelConfig):
-            # Catches misspellings AND real-but-unusable attributes: a T5
-            # preset (t0_3b) or a class name would crash later with a raw
-            # traceback; this bench scores decoder-only ModelConfigs.
-            print(f"BENCH ABORT: {args.model!r} is not a decoder-only "
-                  "registry preset (expected a zero-arg function in "
-                  "lir_tpu.models.registry returning a ModelConfig, e.g. "
-                  "llama2_7b, mistral_7b, falcon_7b)", file=sys.stderr)
+            cfg0 = resolve_preset(args.model)
+        except SystemExit as err:
+            print(f"BENCH ABORT: {err}", file=sys.stderr)
             sys.exit(1)
         # int8 KV cache: half the cache HBM -> batch 48 fits (the knee);
         # decode attention runs s8 dots like the dynamic weight mode.
         cfg = dataclasses.replace(cfg0, kv_cache_int8=True)
         # Production-default content: chain-programmed weights at FULL
-        # 7B/32000-vocab matmul cost whose responses are real text (the
-        # confidence answer completes at the corpus-median decode step),
-        # so the sweep measures the SHIPPED digit-early-stop default
+        # model-size matmul cost whose responses are real text (the
+        # confidence answer completes just past the corpus-median decode
+        # step), so the sweep measures the SHIPPED early-stop defaults
         # instead of the FakeTokenizer worst case. Falls back to random
-        # weights + FakeTokenizer (stop silently off) if unavailable.
-        params, sweep_tok, expect_conf = _production_chain(cfg)
+        # weights + FakeTokenizer (stops silently off) if unavailable.
+        # For tied-embedding presets the returned cfg is the chain-untied
+        # variant (identical step timing; see _production_chain).
+        orig_tied = cfg.tie_embeddings
+        params, sweep_tok, expect_conf, cfg = _production_chain(cfg)
         if params is None:
             params = quant.random_quantized_params(
                 cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16,
                 dynamic=True)
         candidates = TPU_CANDIDATES
         nominal = BENCH_NOMINAL_7B
-        mode = "int8-dyn+kvq8"
+        mode = "int8-dyn+kvq8" + ("+chain-untied-head"
+                                  if sweep_tok is not None and orig_tied
+                                  else "")
     else:
         from __graft_entry__ import _flagship_cfg
         cfg = _flagship_cfg()
@@ -292,8 +315,6 @@ def main() -> None:
           file=sys.stderr)
 
     # ---- primary: the end-to-end perturbation sweep (BASELINE's metric).
-    batch_override = (tuple(int(b) for b in args.sweep_batches.split(","))
-                      if args.sweep_batches else None)
     sweep_value, sweep_batch, sweep_cells = _sweep_path(
         params, cfg, on_accel, tokenizer=sweep_tok, expect_conf=expect_conf,
         batches=batch_override)
@@ -308,7 +329,10 @@ def main() -> None:
                      else BENCH_NOMINAL_CPU_SWEEP)
     arch_note = ("; headline is the cache-heaviest MHA architecture — "
                  "see SCALE.md for the faster GQA alternatives"
-                 if cfg.name == "llama-2-7b" else "")
+                 if cfg.name == "llama-2-7b" else
+                 "; vs_baseline is vs the llama-2-7b r2 sweep nominal — a "
+                 "cross-architecture ratio, not framework gain"
+                 if on_accel else "")
     print(json.dumps({
         "metric": "sweep_prompts_per_sec_per_chip",
         "value": round(sweep_value, 3),
@@ -348,32 +372,43 @@ def _production_chain(cfg):
     "confidence decode budget"), i.e. a conservative stop point: a real
     checkpoint answering at the median refunds MORE budget than this
     measurement claims. The stop then arms exactly as shipped
-    (`sweep_early_stop` default). Returns (params, tokenizer, 85), or
-    (None, None, None) to signal the content-free fallback."""
+    (`sweep_early_stop` default). Returns (params, tokenizer, 85,
+    cfg_to_use) — cfg_to_use is the chain-untied variant for
+    tied-embedding presets — or (None, None, None, cfg) for the
+    content-free fallback."""
     try:
-        tools = Path(__file__).resolve().parent / "tools"
-        if str(tools) not in sys.path:
-            sys.path.insert(0, str(tools))
+        import dataclasses
+
+        _tools_on_path()
         import jax as _jax
         from chain7b import (CHAIN_CONFIDENCE_FORMAT, CHAIN_RESPONSE_FORMAT,
                              confidence_chain, ship_quantized_chain)
         from tiny_checkpoints import build_bpe_tokenizer
 
+        # Tied-embedding presets (falcon, bloom, gpt2 family): a symmetric
+        # W W^T head cannot encode an asymmetric t -> next(t) table, so
+        # the chain INSTRUMENT unties the head. Per-step timing is
+        # identical (same matmul, same per-step weight read — sharing only
+        # changes aliasing), so the measured number is what a real TIED
+        # checkpoint does in production, where the stops arm on real
+        # weights without any instrument.
+        chain_cfg = (dataclasses.replace(cfg, tie_embeddings=False)
+                     if cfg.tie_embeddings else cfg)
         fast = build_bpe_tokenizer()
         chain, junk_next, junk_second = confidence_chain(
             fast, CHAIN_RESPONSE_FORMAT, CHAIN_CONFIDENCE_FORMAT,
             answer_step=3)
-        params = ship_quantized_chain(_jax, _jax.devices()[0], cfg, chain,
-                                      junk_next=junk_next,
+        params = ship_quantized_chain(_jax, _jax.devices()[0], chain_cfg,
+                                      chain, junk_next=junk_next,
                                       junk_second=junk_second)
-        return params, fast, 85
+        return params, fast, 85, chain_cfg
     except (Exception, SystemExit) as err:  # noqa: BLE001 — bench must
         # still report (vocab_word_pieces raises SystemExit, which
         # `except Exception` would let escape past the fallback)
         print(f"# production-chain path unavailable ({err!r}); falling "
               "back to random weights + FakeTokenizer (stop OFF)",
               file=sys.stderr)
-        return None, None, None
+        return None, None, None, cfg
 
 
 def _sweep_path(params, cfg, on_accel: bool, tokenizer=None,
