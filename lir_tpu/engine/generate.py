@@ -308,7 +308,7 @@ def greedy_decode(params, cfg: ModelConfig, tokens: jax.Array,
 def sample_decode(params, cfg: ModelConfig, tokens: jax.Array,
                   attn_mask: jax.Array, key: jax.Array,
                   temperature: float = 0.9, max_new_tokens: int = 50,
-                  prefill_fn=None) -> jax.Array:
+                  prefill_fn=None, eos_id: jax.Array = None) -> jax.Array:
     """Temperature sampling with the same prefill + lax.scan structure as
     greedy_decode, for the on-pod perturbation generator (the reference
     rephrases with temperature 0.9 via the Anthropic API,
@@ -320,18 +320,27 @@ def sample_decode(params, cfg: ModelConfig, tokens: jax.Array,
     on its key, not on which batch it rides in (resume-deterministic
     reasoning sweeps key rows by grid-cell identity).
 
+    ``eos_id`` arms the HF-generate-parity stop: a row emits EOS fill
+    after its first EOS (no post-EOS samples leak into text, matching the
+    API/HF semantics the reference relies on), and once EVERY row is done
+    the remaining scan steps skip the model forward via a scalar
+    lax.cond — a generous session budget then costs actual response
+    length. Non-done rows' draws are bit-identical to the unstopped
+    sampler (the per-step keys never depend on doneness).
+
     Returns generated (B, max_new_tokens) int32. Per-step logits are not
     captured — rephrasings need text only, and dropping the (B, T, V) stack
     keeps HBM free for long sample runs."""
     B, S = tokens.shape
     T = S + max_new_tokens
     per_row = is_per_row_keys(key)
+    early = eos_id is not None
     pf = prefill_fn or decoder.prefill
     logits0, cache, pos0 = pf(params, cfg, tokens, attn_mask, T)
     cache_mask0 = jnp.pad(attn_mask, ((0, 0), (0, max_new_tokens)))
 
     def step(carry, xs):
-        logits, cache, cache_mask = carry
+        logits, cache, cache_mask, done = carry
         t, step_key = xs
         scaled = logits / jnp.maximum(temperature, 1e-6)
         if per_row:
@@ -339,10 +348,26 @@ def sample_decode(params, cfg: ModelConfig, tokens: jax.Array,
         else:
             nxt = jax.random.categorical(step_key, scaled, axis=-1)
         nxt = nxt.astype(jnp.int32)
-        cache_mask = cache_mask.at[:, S + t].set(1)
-        new_logits, cache = decoder.decode_step(
-            params, cfg, cache, nxt, pos0 + t, S + t, cache_mask)
-        return (new_logits, cache, cache_mask), nxt
+        if early:
+            emit = jnp.where(done, eos_id, nxt)
+            done = done | (emit == eos_id)
+            all_done = jnp.all(done)
+            step_mask = cache_mask.at[:, S + t].set(1)
+
+            def run(args):
+                lg, c = args
+                return decoder.decode_step(
+                    params, cfg, c, emit, pos0 + t, S + t, step_mask)
+
+            new_logits, cache = lax.cond(
+                all_done, lambda args: args, run, (logits, cache))
+            cache_mask = jnp.where(all_done, cache_mask, step_mask)
+        else:
+            emit = nxt
+            cache_mask = cache_mask.at[:, S + t].set(1)
+            new_logits, cache = decoder.decode_step(
+                params, cfg, cache, emit, pos0 + t, S + t, cache_mask)
+        return (new_logits, cache, cache_mask, done), emit
 
     if per_row:
         # (T, B, 2): row b's stream at step t = fold_in(keys[b], t).
@@ -351,8 +376,8 @@ def sample_decode(params, cfg: ModelConfig, tokens: jax.Array,
         )(jnp.arange(max_new_tokens))
     else:
         keys = jax.random.split(key, max_new_tokens)
-    (_, _, _), gen = lax.scan(
-        step, (logits0, cache, cache_mask0),
+    (_, _, _, _), gen = lax.scan(
+        step, (logits0, cache, cache_mask0, jnp.zeros((B,), bool)),
         (jnp.arange(max_new_tokens), keys))
     return jnp.swapaxes(gen, 0, 1)
 

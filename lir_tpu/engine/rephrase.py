@@ -169,7 +169,12 @@ def rephraser_from_engine(engine, temperature: float = 0.9,
         gen = gen_mod.sample_decode(
             engine.params, engine.cfg, jnp.asarray(toks_arr),
             jnp.asarray(mask), key, temperature=temperature,
-            max_new_tokens=max_new_tokens)
+            max_new_tokens=max_new_tokens,
+            # HF/API-parity EOS stop: post-EOS tokens are trimmed from the
+            # text either way (decode_completion), so the only effect is
+            # refunding post-completion decode steps.
+            eos_id=(None if engine.eos_id is None
+                    else jnp.int32(engine.eos_id)))
         gen_host = np.asarray(jax.device_get(gen))
         return [engine.decode_completion(row) for row in gen_host]
 

@@ -368,7 +368,14 @@ class ScoringEngine:
             self.params, self.cfg, toks, mask, key, temperature=temperature,
             max_new_tokens=(self.rt.max_new_tokens if max_new_tokens is None
                             else max_new_tokens),
-            prefill_fn=self._prefill_fn)
+            prefill_fn=self._prefill_fn,
+            # HF/API-parity EOS stop: a finished row emits EOS fill (so
+            # the finished-inside-budget signal this method documents is
+            # preserved) and an all-done batch skips the remaining
+            # forwards; unfinished rows are bit-identical to the
+            # unstopped sampler.
+            eos_id=(None if self.eos_id is None
+                    else jnp.int32(self.eos_id)))
         gen = np.asarray(jax.device_get(gen))
         return ([self.decode_completion(gen[j])
                  for j in range(gen.shape[0])], gen)

@@ -137,6 +137,46 @@ class TestSampleDecode:
         assert g1.shape == (2, 6)
         np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
 
+    def test_eos_stop_matches_unstopped_up_to_eos(self):
+        """sample_decode(eos_id=...) must equal the unstopped sampler on
+        every row UP TO its first EOS, then emit EOS fill (HF-generate
+        parity). Probe engagement with a near-greedy chain that keeps
+        emitting a visible token after EOS: a dead eos_id wiring would
+        reproduce the unstopped tail and fail the fill assertion."""
+        import jax.numpy as jnp
+
+        from lir_tpu.models import decoder
+        from lir_tpu.models.registry import ModelConfig
+
+        # Deterministic chain at temperature ~0: 5 -> 6 -> EOS(3) -> 7 ...
+        import sys
+        from pathlib import Path
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "tools"))
+        from chain7b import chain_param_tree
+
+        eos = 3
+        cfg = ModelConfig(name="sample-eos-smoke", vocab_size=64,
+                          hidden_size=32, n_layers=2, n_heads=4,
+                          intermediate_size=64, max_seq_len=64,
+                          tie_embeddings=False)
+        chain = {5: (6, 7), 6: (eos, 7), eos: (7, 8), 7: (7, 8)}
+        params = chain_param_tree(cfg, chain, junk_next=7, junk_second=8,
+                                  dtype=jnp.float32)
+        toks = jnp.asarray(np.full((2, 4), 5, dtype=np.int32))
+        mask = jnp.ones_like(toks)
+        kw = dict(temperature=1e-4, max_new_tokens=6)
+        free = gen_mod.sample_decode(params, cfg, toks, mask, KEY, **kw)
+        stop = gen_mod.sample_decode(params, cfg, toks, mask, KEY,
+                                     eos_id=jnp.int32(eos), **kw)
+        free, stop = np.asarray(free), np.asarray(stop)
+        for r0, r1 in zip(free, stop):
+            k = int(np.argmax(r0 == eos))
+            assert (r0 == eos).any() and (r0[k + 1:] != eos).any(), \
+                "probe chain must emit EOS then keep talking"
+            np.testing.assert_array_equal(r1[:k + 1], r0[:k + 1])
+            assert (r1[k:] == eos).all(), "stop did not engage"
+
     def test_low_temperature_approaches_greedy(self):
         params, cfg, _ = _tiny_llama_params()
         import jax.numpy as jnp

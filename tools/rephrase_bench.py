@@ -47,6 +47,13 @@ def main() -> None:
                          "are ~500 tokens)")
     ap.add_argument("--prompts", type=int, default=2,
                     help="how many of the 5 legal prompts to rephrase")
+    ap.add_argument("--one-line-sessions", action="store_true",
+                    help="rewire the chain so every session EOSes after "
+                         "its first numbered line (~23 tokens): measures "
+                         "the sampler's HF-parity EOS stop through the "
+                         "production path — session cost should track "
+                         "actual response length, not --max-new. Implies "
+                         "--no-record (prints a comparison line instead)")
     ap.add_argument("--no-record", action="store_true")
     args = ap.parse_args()
 
@@ -77,6 +84,13 @@ def main() -> None:
         chain[a] = (b, b)               # (argmax == runner-up: sampling at
         # temperature 0.9 cannot leave the cycle)
     chain[anchor] = (one, one)
+    if args.one_line_sessions:
+        # First newline -> EOS: sessions are ~one-line long; with the
+        # sampler's EOS stop armed (rephraser_from_engine), the remaining
+        # --max-new budget must be refunded, not decoded.
+        eos = fast.eos_token_id
+        chain[nl] = (eos, eos)
+        chain[eos] = (eos, eos)
     # Every other request token also enters the cycle, so all legal
     # prompts anchor identically regardless of their final BPE piece.
     params = ship_quantized_chain(jax, dev, cfg, chain, junk_next=one,
@@ -105,6 +119,20 @@ def main() -> None:
     total = sum(len(r) for _, r in results)
     per_session = total / n_sessions
     line_len = len(cycle)
+
+    if args.one_line_sessions:
+        # ~line_len-token sessions under a --max-new budget: the EOS stop
+        # makes session cost track content length. The generic full-budget
+        # figures would be ~budget/line_len x inflated here (tokens the
+        # stop never decoded), so print only content-priced numbers.
+        print(f"one-line sessions (~{line_len + 1} decoded tokens + EOS "
+              f"fill) under a {args.max_new}-token budget: {n_sessions} "
+              f"sessions in {dt:.1f}s = {n_sessions / dt:.2f} sessions/s "
+              f"({dt / n_sessions:.2f} s/session), {total} lines parsed — "
+              f"the EOS stop refunds the unused budget; compare the "
+              f"full-budget cycle run in SCALE.md", flush=True)
+        return
+
     ceiling = args.max_new / line_len
     toks_s = n_sessions * args.max_new / dt
     print(f"{n_sessions} sessions x {args.max_new} sampled tokens in "
