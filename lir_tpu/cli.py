@@ -75,6 +75,17 @@ def _add_sweep(sub) -> None:
     _add_multihost_flag(p)
 
 
+def _positive_int(text: str) -> int:
+    """argparse type for decode budgets: a 0/negative budget would run an
+    empty decode scan whose position-0 readout is silently garbage."""
+    import argparse
+
+    value = int(text)
+    if value < 1:
+        raise argparse.ArgumentTypeError(f"{value} is not >= 1")
+    return value
+
+
 def _add_perturb(sub) -> None:
     p = sub.add_parser("perturb", help="perturbation grid sweep (D6)")
     p.add_argument("--checkpoints", type=Path, required=True)
@@ -93,11 +104,27 @@ def _add_perturb(sub) -> None:
     p.add_argument("--full-completions", action="store_true",
                    help="decode the reference's full 50-token Model "
                         "Response / Model Confidence Response text per "
-                        "cell instead of the short 4/16-token budgets — "
+                        "cell instead of the short 4/8-token budgets — "
                         "exact D6 text parity at ~1/4 the throughput "
                         "(measured 5.8 vs 23.9 p/s/chip; use "
                         "--batch-size 24, batch 40 OOMs with the larger "
-                        "cache)")
+                        "cache). Disables the early stops")
+    p.add_argument("--sweep-decode-tokens", type=_positive_int,
+                   default=None,
+                   help="binary-format decode budget per cell (default 4; "
+                        "the numeric readout consumes position 0 only)")
+    p.add_argument("--sweep-confidence-tokens", type=_positive_int,
+                   default=None,
+                   help="confidence-format decode budget per cell "
+                        "(default 8 — covers the measured answer "
+                        "positions, SCALE.md; with the early stop armed a "
+                        "generous budget costs actual response length, "
+                        "so size this for the WORST answer)")
+    p.add_argument("--no-early-stop", action="store_true",
+                   help="disable the digit/EOS early stops and always "
+                        "decode the full budgets (stops change no "
+                        "recorded value — PARITY.md; this flag exists "
+                        "for measurement, not correctness)")
     _add_multihost_flag(p)
 
 
@@ -202,10 +229,22 @@ def cmd_perturb(args) -> None:
     from .engine.sweep import run_perturbation_sweep
     from .models.factory import engine_factory
 
+    if args.full_completions and (args.sweep_decode_tokens is not None
+                                  or args.sweep_confidence_tokens is not None):
+        raise SystemExit(
+            "--full-completions decodes the reference's full 50-token "
+            "responses unconditionally; it cannot combine with "
+            "--sweep-decode-tokens / --sweep-confidence-tokens")
+    rt_kw = dict(batch_size=args.batch_size,
+                 sweep_full_completions=args.full_completions,
+                 sweep_early_stop=not args.no_early_stop)
+    if args.sweep_decode_tokens is not None:
+        rt_kw["sweep_decode_tokens"] = args.sweep_decode_tokens
+    if args.sweep_confidence_tokens is not None:
+        rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
     factory = engine_factory(
         args.checkpoints,
-        RuntimeConfig(batch_size=args.batch_size,
-                      sweep_full_completions=args.full_completions),
+        RuntimeConfig(**rt_kw),
         _parse_mesh(args.mesh), cache_root=args.param_cache,
         quantize_int8=args.int8, int8_dynamic=args.int8_dynamic,
         kv_cache_int8=args.kv_cache_int8,

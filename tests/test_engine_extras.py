@@ -590,6 +590,40 @@ sys.exit(0)
     assert "REACHED-REPORT" not in r.stdout
 
 
+def test_cli_perturb_budget_and_stop_flags(monkeypatch, tmp_path):
+    """The perturb subcommand must thread the decode-budget/stop flags
+    into RuntimeConfig (DEPLOY.md §1 tells operators to size
+    --sweep-confidence-tokens — the flag has to exist and land)."""
+    import lir_tpu.cli as cli
+
+    captured = {}
+
+    class _Stop(Exception):
+        pass
+
+    def fake_factory(root, rt, *a, **kw):
+        captured["rt"] = rt
+        raise _Stop
+
+    monkeypatch.setattr("lir_tpu.models.factory.engine_factory",
+                        fake_factory)
+    base = ["perturb", "--checkpoints", str(tmp_path), "--model", "m"]
+    with pytest.raises(_Stop):
+        cli.main(base + ["--sweep-confidence-tokens", "16",
+                         "--sweep-decode-tokens", "2", "--no-early-stop"])
+    rt = captured["rt"]
+    assert rt.sweep_confidence_tokens == 16
+    assert rt.sweep_decode_tokens == 2
+    assert rt.sweep_early_stop is False
+
+    with pytest.raises(_Stop):
+        cli.main(base)
+    rt = captured["rt"]                 # defaults untouched
+    assert rt.sweep_confidence_tokens == 8
+    assert rt.sweep_decode_tokens == 4
+    assert rt.sweep_early_stop is True
+
+
 def test_cli_bench_passes_clean_argv(monkeypatch):
     """`lir_tpu bench` must not leak the CLI's own argv into bench.py's
     argparse (bench.py now parses --allow-ungated itself)."""
