@@ -78,8 +78,6 @@ def _add_sweep(sub) -> None:
 def _positive_int(text: str) -> int:
     """argparse type for decode budgets: a 0/negative budget would run an
     empty decode scan whose position-0 readout is silently garbage."""
-    import argparse
-
     value = int(text)
     if value < 1:
         raise argparse.ArgumentTypeError(f"{value} is not >= 1")

@@ -36,26 +36,46 @@ def main() -> None:
                     help="long-context points the int8 KV cache unlocked "
                          "(seq 1024 batch 8 / seq 2048 batch 4, int8-dyn + "
                          "kvq8) — VERDICT r2 weak #4")
+    ap.add_argument("--points", default=None,
+                    help="override measurement points as SEQ:BATCH[,...] "
+                         "(e.g. 256:40 — the production sweep's prefill "
+                         "shape)")
+    ap.add_argument("--dyn-kvq8", action="store_true",
+                    help="measure in the production int8-dyn+kvq8 mode "
+                         "(what the sweep headline runs) instead of "
+                         "weight-only int8")
     args = ap.parse_args()
 
     dev = jax.devices()[0]
     assert dev.platform != "cpu", "run on the TPU (Pallas does not lower on CPU)"
 
     base = llama2_7b()
-    if args.long:
+    fast_path = args.long or args.dyn_kvq8
+    if fast_path:
         base = dataclasses.replace(base, kv_cache_int8=True)
     params = quant.random_quantized_params(base, jax.random.PRNGKey(0),
                                            dtype=jnp.bfloat16,
-                                           dynamic=args.long)
+                                           dynamic=fast_path)
     jax.block_until_ready(params)
     _ = float(params["layers"]["wq"].scale.reshape(-1)[0])
 
-    mode = ("int8-dyn + int8 KV cache" if args.long else "int8")
+    mode = ("int8-dyn + int8 KV cache" if fast_path else "int8")
     points = ([(1024, 8), (2048, 4)] if args.long
               else [(512, 8), (1024, 8)])
+    if args.points:
+        try:
+            points = [(int(s), int(b)) for s, b in
+                      (p.split(":") for p in args.points.split(","))]
+            assert points and all(s > 0 and b > 0 for s, b in points)
+        except (ValueError, AssertionError):
+            ap.error(f"--points {args.points!r} must be "
+                     "SEQ:BATCH[,SEQ:BATCH...] with positive ints")
     lines = [f"\n## flash-attention prefill delta — {dev.device_kind}, "
              f"{datetime.date.today()}"
-             f"{' (long-context, int8 KV)' if args.long else ''}\n\n"
+             # The long-context label belongs to --long's OWN points; a
+             # --points override replaces them, so the permanent record
+             # must not claim shapes that were not measured.
+             f"{' (long-context, int8 KV)' if args.long and not args.points else ''}\n\n"
              f"llama-2-7b {mode}, fused scoring step (prefill + 10 "
              "decode):\n\n"
              "| seq | batch | dense step s | flash step s | speedup |\n"
