@@ -422,12 +422,15 @@ def cmd_concat_shards(args) -> None:
 def cmd_bench(args) -> None:
     import runpy
 
-    # bench.py parses sys.argv itself (--allow-ungated); hand it a clean
-    # argv so the CLI's own subcommand tokens don't reach its parser.
+    # bench.py parses sys.argv itself; hand it a clean argv so the CLI's
+    # own subcommand tokens don't reach its parser.
     bench_path = Path(__file__).resolve().parent.parent / "bench.py"
+    fwd = []
+    if getattr(args, "allow_ungated", False):
+        fwd.append("--allow-ungated")
+    fwd += getattr(args, "bench_extra", [])
     old_argv = sys.argv
-    sys.argv = [str(bench_path)] + (
-        ["--allow-ungated"] if getattr(args, "allow_ungated", False) else [])
+    sys.argv = [str(bench_path)] + fwd
     try:
         runpy.run_path(str(bench_path), run_name="__main__")
     finally:
@@ -444,7 +447,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     _add_repro(sub)
     _add_survey(sub)
     bench_p = sub.add_parser(
-        "bench", help="prompts/sec/chip benchmark (end-to-end sweep path)")
+        "bench", help="prompts/sec/chip benchmark (end-to-end sweep path); "
+                      "unrecognized flags are forwarded to bench.py "
+                      "verbatim (--model, --sweep-batches, ... — see "
+                      "`python bench.py --help`)")
     bench_p.add_argument("--allow-ungated", action="store_true",
                          help="report even when the chip kind has no MFU "
                               "peak-table entry (default: abort)")
@@ -461,7 +467,14 @@ def main(argv: Optional[List[str]] = None) -> None:
                     help="expected shard count (default: walk host0, "
                          "host1, ... until the first gap)")
 
-    args = parser.parse_args(argv)
+    # bench.py owns its flag surface (it parses sys.argv itself); unknown
+    # flags on the bench subcommand are forwarded verbatim instead of
+    # hand-mirroring every bench.py option here. Every other subcommand
+    # still rejects unknowns.
+    args, extra = parser.parse_known_args(argv)
+    if extra and args.command != "bench":
+        parser.error(f"unrecognized arguments: {' '.join(extra)}")
+    args.bench_extra = extra
     if getattr(args, "int8_dynamic", False) and not getattr(args, "int8", False):
         parser.error("--int8-dynamic requires --int8 (it selects HOW int8 "
                      "matmuls run, not whether weights are quantized)")

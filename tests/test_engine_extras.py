@@ -647,3 +647,7 @@ def test_cli_bench_passes_clean_argv(monkeypatch):
 
     cli.main(["bench"])
     assert seen["argv"][1:] == []
+
+    cli.main(["bench", "--model", "mistral_7b", "--sweep-batches", "48,40"])
+    assert seen["argv"][1:] == ["--model", "mistral_7b",
+                                "--sweep-batches", "48,40"]

@@ -66,8 +66,9 @@ def main() -> None:
         try:
             points = [(int(s), int(b)) for s, b in
                       (p.split(":") for p in args.points.split(","))]
-            assert points and all(s > 0 and b > 0 for s, b in points)
-        except (ValueError, AssertionError):
+        except ValueError:
+            points = []
+        if not points or any(s < 1 or b < 1 for s, b in points):
             ap.error(f"--points {args.points!r} must be "
                      "SEQ:BATCH[,SEQ:BATCH...] with positive ints")
     lines = [f"\n## flash-attention prefill delta — {dev.device_kind}, "
