@@ -43,6 +43,16 @@ BASELINE.json's metric, measured honestly:
   comment line. vs_baseline compares against the first honest recording
   of the SWEEP-path definition (18.47 p/s, round 2, SCALE.md).
 
+- **Variable-length mode.** The headline's cells are fixed-length by
+  design (one bucket, compile-once timing); production grids are RAGGED
+  (real rephrasings spread ~2-4x in tokenized length). The varlen mode
+  draws per-cell lengths from the corpus distribution recorded in
+  SCALE.md and scores the SAME grid twice — ragged scheduler ON
+  (engine/scheduler.py: bucket ladder + slot refill + cross-cell prefix
+  reuse) vs the legacy single-bucket baseline — reporting both rates,
+  the ragged margin, and the scheduler's batch-occupancy % /
+  padding-waste % counters under the headline JSON's "varlen" key.
+
 Prints ONE JSON line.
 """
 
@@ -90,6 +100,22 @@ SWEEP_CELLS_TPU = 160
 SWEEP_BATCHES_CPU = (4,)
 SWEEP_CELLS_CPU = 8
 
+# Variable-length sweep mode (the ragged scheduler's acceptance
+# workload): per-cell rephrasing lengths are drawn by inverse-CDF from
+# the corpus length distribution recorded in SCALE.md ("rephrasing
+# length distribution" — deciles of rephrased-main length as a FRACTION
+# of the fixed-length bench's bucket-sized text). The median 1.0x keeps
+# the headline's 256-token bucket; the tails (0.30x..2.20x, the ~2-4x
+# spread real rephrasings of one legal main show) spread cells over ~5
+# ladder buckets, which is what the single-bucket baseline pads away.
+VARLEN_FRAC_DECILES = (0.30, 0.42, 0.55, 0.68, 0.82, 1.00, 1.18, 1.40,
+                       1.70, 2.20)
+VARLEN_CELLS_TPU = 160
+VARLEN_CELLS_CPU = 16
+# CPU smoke scales words UP (the fixed smoke's 12-word texts all land in
+# the smallest bucket, where ragged == baseline by construction).
+VARLEN_WORDS_CPU = 48
+
 SEQ = 256
 NEW_TOKENS = 10  # MAX_LOOK_AHEAD: the positions the C13 readout consumes
 
@@ -130,6 +156,10 @@ def main() -> None:
                     help="comma-separated sweep batch ladder override "
                          "(e.g. 48,40 for GQA models whose smaller KV "
                          "cache fits batch 48)")
+    ap.add_argument("--no-varlen", action="store_true",
+                    help="skip the variable-length sweep mode (corpus-"
+                         "sampled prompt lengths, ragged scheduler vs "
+                         "single-bucket baseline)")
     args = ap.parse_args()
 
     # Flag validation FIRST — a malformed ladder must abort before the
@@ -194,7 +224,8 @@ def main() -> None:
         # For tied-embedding presets the returned cfg is the chain-untied
         # variant (identical step timing; see _production_chain).
         orig_tied = cfg.tie_embeddings
-        params, sweep_tok, expect_conf, cfg = _production_chain(cfg)
+        params, sweep_tok, expect_conf, answer_step, cfg = \
+            _production_chain(cfg)
         if params is None:
             params = quant.random_quantized_params(
                 cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16,
@@ -212,7 +243,7 @@ def main() -> None:
         candidates = CPU_CANDIDATES
         nominal = BENCH_NOMINAL_CPU
         mode = "fp32"
-        sweep_tok, expect_conf = None, None
+        sweep_tok, expect_conf, answer_step = None, None, None
 
     n_params = sum(
         int(np.prod(l.shape)) for l in jax.tree.leaves(
@@ -318,11 +349,16 @@ def main() -> None:
     sweep_value, sweep_batch, sweep_cells = _sweep_path(
         params, cfg, on_accel, tokenizer=sweep_tok, expect_conf=expect_conf,
         batches=batch_override)
+    # Provenance derives from the chain's OWN constants (returned by
+    # _production_chain, owned by tools/chain7b.py) — changing the
+    # answer step or value there can never silently desync this string
+    # from what the programmed weights emit (ADVICE r5, bench.py:133).
     stop_str = ("confidence digit stop + binary EOS stop ON over "
                 "real-text responses (production default; real BPE "
                 "tokenizer, programmed-chain weights at identical matmul "
-                "cost, answer at decode step 3 — conservatively past the "
-                "corpus-median position 0-1, SCALE.md; stop-OFF worst "
+                f"cost, answer at decode step {answer_step} — "
+                "conservatively past the corpus-median position 0-1, "
+                "at the p90 bound, SCALE.md; stop-OFF worst "
                 "case printed as a comment)" if sweep_tok is not None
                 else "early stops OFF (content-free fallback)")
     sweep_nominal = (BENCH_NOMINAL_7B_SWEEP if on_accel
@@ -333,7 +369,20 @@ def main() -> None:
                  "; vs_baseline is vs the llama-2-7b r2 sweep nominal — a "
                  "cross-architecture ratio, not framework gain"
                  if on_accel else "")
-    print(json.dumps({
+    # Variable-length mode (corpus-sampled prompt lengths): runs BEFORE
+    # the headline print so its result can ride the one JSON line, but a
+    # failure here never discards the already-measured headline.
+    varlen = None
+    if not args.no_varlen:
+        try:
+            varlen = _varlen_sweep(params, cfg, on_accel,
+                                   tokenizer=sweep_tok,
+                                   expect_conf=expect_conf,
+                                   batches=batch_override)
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# varlen sweep mode failed ({err!r}); fixed-length "
+                  "headline is unaffected", file=sys.stderr)
+    headline = {
         "metric": "sweep_prompts_per_sec_per_chip",
         "value": round(sweep_value, 3),
         "unit": (f"prompts/s end-to-end perturbation sweep ({cfg.name} "
@@ -343,7 +392,10 @@ def main() -> None:
                  f"{value:.1f} p/s at {mfu_str}{arch_note}; "
                  f"{dev.platform})"),
         "vs_baseline": round(sweep_value / sweep_nominal, 3),
-    }))
+    }
+    if varlen is not None:
+        headline["varlen"] = varlen
+    print(json.dumps(headline))
     if sweep_tok is not None:
         # Transparency: the content-free worst case (FakeTokenizer exposes
         # no per-token strings, so the digit stop cannot arm and every
@@ -367,15 +419,17 @@ def _production_chain(cfg):
     transition table — throughput-identical to random weights) plus the
     offline-trained byte-BPE tokenizer. Responses are real text: the
     binary prompt answers ' Yes.', the confidence prompt emits its
-    single-token integer ' 85' at decode step 3 — one-two steps LATER
+    single-token integer (chain7b.CHAIN_CONFIDENCE_VALUE) at decode step
+    CHAIN_ANSWER_STEP — one-two steps LATER
     than the corpus-median answer word position of 0-1 (SCALE.md
     "confidence decode budget"), i.e. a conservative stop point: a real
     checkpoint answering at the median refunds MORE budget than this
     measurement claims. The stop then arms exactly as shipped
-    (`sweep_early_stop` default). Returns (params, tokenizer, 85,
-    cfg_to_use) — cfg_to_use is the chain-untied variant for
-    tied-embedding presets — or (None, None, None, cfg) for the
-    content-free fallback."""
+    (`sweep_early_stop` default). Returns (params, tokenizer,
+    expected_confidence, answer_step, cfg_to_use) — the middle two are
+    chain7b's CHAIN_CONFIDENCE_VALUE / CHAIN_ANSWER_STEP, cfg_to_use is
+    the chain-untied variant for tied-embedding presets — or
+    (None, None, None, None, cfg) for the content-free fallback."""
     try:
         import dataclasses
 
@@ -395,20 +449,24 @@ def _production_chain(cfg):
         chain_cfg = (dataclasses.replace(cfg, tie_embeddings=False)
                      if cfg.tie_embeddings else cfg)
         fast = build_bpe_tokenizer()
+        # answer step + confidence value come from chain7b's OWN
+        # constants, and are returned so the headline provenance string
+        # and the per-row assertion can never desync from the weights.
         chain, junk_next, junk_second = confidence_chain(
-            fast, CHAIN_RESPONSE_FORMAT, CHAIN_CONFIDENCE_FORMAT,
-            answer_step=3)
+            fast, CHAIN_RESPONSE_FORMAT, CHAIN_CONFIDENCE_FORMAT)
         params = ship_quantized_chain(_jax, _jax.devices()[0], chain_cfg,
                                       chain, junk_next=junk_next,
                                       junk_second=junk_second)
-        return params, fast, 85, chain_cfg
+        from chain7b import CHAIN_ANSWER_STEP, CHAIN_CONFIDENCE_VALUE
+        return (params, fast, CHAIN_CONFIDENCE_VALUE, CHAIN_ANSWER_STEP,
+                chain_cfg)
     except (Exception, SystemExit) as err:  # noqa: BLE001 — bench must
         # still report (vocab_word_pieces raises SystemExit, which
         # `except Exception` would let escape past the fallback)
         print(f"# production-chain path unavailable ({err!r}); falling "
               "back to random weights + FakeTokenizer (stop OFF)",
               file=sys.stderr)
-        return None, None, None, cfg
+        return None, None, None, None, cfg
 
 
 def _sweep_path(params, cfg, on_accel: bool, tokenizer=None,
@@ -433,7 +491,14 @@ def _sweep_path(params, cfg, on_accel: bool, tokenizer=None,
 
     if batches is None:
         batches = SWEEP_BATCHES_TPU if on_accel else SWEEP_BATCHES_CPU
-    cells = SWEEP_CELLS_TPU if on_accel else SWEEP_CELLS_CPU
+        cells = SWEEP_CELLS_TPU if on_accel else SWEEP_CELLS_CPU
+    else:
+        # --sweep-batches comparisons mix batch ladders (cross-arch
+        # tables); an lcm-friendly grid (240 = lcm of 48/40/24/16/8)
+        # makes different batch sizes time IDENTICAL grid sizes, so
+        # fixed per-run costs amortize the same way in every column
+        # (ADVICE r5, bench.py:455).
+        cells = 240 if on_accel else SWEEP_CELLS_CPU
     rng = np.random.default_rng(7)
     if tokenizer is not None:
         from chain7b import (CHAIN_CONFIDENCE_FORMAT, CHAIN_RESPONSE_FORMAT,
@@ -500,6 +565,127 @@ def _sweep_path(params, cfg, on_accel: bool, tokenizer=None,
     print(f"BENCH ABORT: every sweep batch candidate OOMed; last: {last_oom}",
           file=sys.stderr)
     sys.exit(1)
+
+
+def _varlen_sweep(params, cfg, on_accel: bool, tokenizer=None,
+                  expect_conf=None, batches=None):
+    """Variable-length sweep mode: ONE corpus-sampled grid (prompt
+    lengths drawn from VARLEN_FRAC_DECILES, the distribution recorded in
+    SCALE.md) scored TWICE through `run_perturbation_sweep` — ragged
+    scheduler ON (bucket ladder + slot refill + prefix groups) vs the
+    legacy single-bucket todo-order baseline — on identical cells, the
+    same batch size, and a full warmup each (every bucket shape compiles
+    before the timed run, matching steady state).
+
+    Returns the dict embedded under the headline JSON's "varlen" key:
+    both rates, the ragged margin, and the scheduler's occupancy /
+    padding-waste counters (profiling.OccupancyStats). Per-cell results
+    are identical between the two runs (pinned by tests/
+    test_scheduler.py); this measures dispatch composition only."""
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+
+    if batches is None:
+        batches = SWEEP_BATCHES_TPU if on_accel else SWEEP_BATCHES_CPU
+    cells = VARLEN_CELLS_TPU if on_accel else VARLEN_CELLS_CPU
+    rng = np.random.default_rng(13)
+    if tokenizer is not None:
+        from chain7b import (CHAIN_CONFIDENCE_FORMAT, CHAIN_RESPONSE_FORMAT,
+                             bucket_sized_words)
+        words, n_words = bucket_sized_words(tokenizer, rng)
+        response_format = CHAIN_RESPONSE_FORMAT
+        confidence_format = CHAIN_CONFIDENCE_FORMAT
+    else:
+        words = ("coverage policy flood water damage claim insurer premium "
+                 "exclusion endorsement peril deductible adjuster settle "
+                 "liability clause binding interpret statute meaning").split()
+        n_words = 170 if on_accel else VARLEN_WORDS_CPU
+        response_format = "Respond with either ' Yes' or ' No' only ."
+        confidence_format = "Give a confidence number from 0 to 100 ."
+
+    # Inverse-CDF draw over the recorded deciles; the same word counts
+    # feed both runs, so the two modes score byte-identical prompts.
+    u = rng.random(cells)
+    fracs = np.interp(u, np.linspace(0.0, 1.0, len(VARLEN_FRAC_DECILES)),
+                      VARLEN_FRAC_DECILES)
+    counts = [max(4, int(round(f * n_words))) for f in fracs]
+
+    def text(n):
+        return " ".join(rng.choice(words) for _ in range(n)) + " ?"
+
+    texts = [text(n) for n in counts]
+    lp = (LegalPrompt(main=texts[0], response_format=response_format,
+                      target_tokens=("Yes", "No"),
+                      confidence_format=confidence_format),)
+    perturbations = (texts[1:],)
+
+    def run(engine, tag):
+        with tempfile.TemporaryDirectory() as td:
+            t0 = time.perf_counter()
+            rows = run_perturbation_sweep(
+                engine, f"bench-varlen-{tag}", lp, perturbations,
+                Path(td) / "results.xlsx", checkpoint_every=1000)
+            dt = time.perf_counter() - t0
+        assert len(rows) == cells, (len(rows), cells)
+        assert all(np.isfinite(r.token_1_prob) for r in rows)
+        if expect_conf is not None:
+            bad = [r.confidence_value for r in rows
+                   if r.confidence_value != expect_conf]
+            assert not bad, f"chain confidences off: {bad[:5]}"
+        return dt
+
+    last_oom = None
+    for batch in batches:
+        engines = {
+            ragged: ScoringEngine(
+                params, cfg,
+                tokenizer if tokenizer is not None else FakeTokenizer(),
+                RuntimeConfig(batch_size=batch, max_seq_len=512,
+                              ragged_scheduler=ragged))
+            for ragged in (True, False)}
+        try:
+            out = {}
+            for ragged, engine in engines.items():
+                tag = "ragged" if ragged else "baseline"
+                t_warm = run(engine, f"{tag}-warmup")  # every shape compiles
+                print(f"# varlen warmup ({tag}, batch {batch}, incl. "
+                      f"compiles): {t_warm:.1f}s", file=sys.stderr)
+                out[ragged] = cells / run(engine, tag)
+        except Exception as err:  # noqa: BLE001 — OOM falls back, rest raises
+            if _is_oom(err):
+                last_oom = err
+                continue
+            raise
+        stats = engines[True].occupancy
+        result = {
+            "cells": cells, "batch": batch,
+            "ragged_p_s": round(out[True], 3),
+            "baseline_p_s": round(out[False], 3),
+            "ragged_vs_baseline": round(out[True] / out[False], 3),
+            "occupancy_pct": round(stats.occupancy_pct, 2),
+            "padding_waste_pct": round(stats.padding_waste_pct, 2),
+        }
+        if stats.decode_steps_paid:
+            result["decode_occupancy_pct"] = round(
+                stats.decode_occupancy_pct, 2)
+        if stats.grouped_cells:
+            result["grouped_cells"] = stats.grouped_cells
+        print(f"# varlen sweep (corpus-sampled lengths, {cells} cells, "
+              f"batch {batch}): ragged {out[True]:.3f} p/s vs "
+              f"single-bucket {out[False]:.3f} p/s "
+              f"({100 * (out[True] / out[False] - 1):+.1f}%); "
+              f"batch occupancy {result['occupancy_pct']:.1f}%, "
+              f"padding waste {result['padding_waste_pct']:.1f}%",
+              file=sys.stderr)
+        return result
+    print(f"# varlen sweep: every batch candidate OOMed; last: {last_oom}",
+          file=sys.stderr)
+    return None
 
 
 if __name__ == "__main__":

@@ -470,10 +470,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     # bench.py owns its flag surface (it parses sys.argv itself); unknown
     # flags on the bench subcommand are forwarded verbatim instead of
     # hand-mirroring every bench.py option here. Every other subcommand
-    # still rejects unknowns.
+    # still rejects unknowns — and so does anything typed BEFORE the
+    # `bench` subcommand (a typo of the CLI's own flags must fail with
+    # THIS parser's usage message, not bench.py's; ADVICE r5, cli.py:470).
     args, extra = parser.parse_known_args(argv)
-    if extra and args.command != "bench":
-        parser.error(f"unrecognized arguments: {' '.join(extra)}")
+    if extra:
+        argv_seq = list(sys.argv[1:] if argv is None else argv)
+        pre_bench = (argv_seq[:argv_seq.index("bench")]
+                     if args.command == "bench" else argv_seq)
+        bad = [t for t in extra if t in pre_bench]
+        if args.command != "bench" or bad:
+            parser.error("unrecognized arguments: "
+                         f"{' '.join(bad or extra)}")
     args.bench_extra = extra
     if getattr(args, "int8_dynamic", False) and not getattr(args, "int8", False):
         parser.error("--int8-dynamic requires --int8 (it selects HOW int8 "

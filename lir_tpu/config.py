@@ -84,6 +84,22 @@ class RuntimeConfig:
     sweep_early_stop: bool = True
     sweep_full_completions: bool = False
 
+    # Ragged sweep scheduler (engine/scheduler.py). ON: grid cells are
+    # tokenized up front, sorted into a ~sqrt(2) prompt-length bucket
+    # ladder (engine/tokens.bucket_ladder), drained per-bucket with slot
+    # refill, and cells sharing a long token prefix score through one
+    # shared prefill (cross-cell prefix reuse). OFF restores the legacy
+    # todo-order batching whose every mixed-length batch pads to its
+    # longest row (the bench's single-bucket baseline). Per-cell results
+    # are identical either way — left/right padding is masked out of
+    # every readout (pinned by tests/test_scheduler.py).
+    ragged_scheduler: bool = True
+    # Cross-cell prefix grouping engages for >= min_cells cells agreeing
+    # on >= min_prefix leading tokens AND on at least half their prefill
+    # (see scheduler.RaggedScheduler). 0 cells disables grouping.
+    sweep_group_min_prefix: int = 16
+    sweep_group_min_cells: int = 4
+
 
 @dataclasses.dataclass(frozen=True)
 class PerturbationConfig:

@@ -76,6 +76,7 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
                 yes_ids: jax.Array, no_ids: jax.Array, digit_ids: jax.Array,
                 digit_vals: jax.Array, max_new_tokens: int, topk: int,
                 stop_mask: jax.Array = None, eos_id: jax.Array = None,
+                stop_mask2: jax.Array = None, stop_sel: jax.Array = None,
                 ) -> Tuple[FusedDecodeOut, Tuple]:
     """The fused greedy scan shared by the full-prompt and shared-prefix
     paths: start from ``logits0`` (the first generated position), write
@@ -98,6 +99,12 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
     worst case. Per-step p_yes/p_no/top2 after a row's stop point reflect
     the EOS-fed model and must not be consumed (the sweep's confidence
     readout uses position 0 only).
+
+    ``stop_mask2`` + ``stop_sel`` ((B,) bool) select a SECOND class table
+    per row: rows where ``stop_sel`` is True read their emitted token's
+    class from ``stop_mask2`` instead of ``stop_mask``. The prefix-group
+    decode mixes both sweep formats in one batch and needs the binary
+    rows on the EOS-only table while confidence rows run the digit stop.
     """
     early_stop = stop_mask is not None and eos_id is not None
     # Position-0 extras (first generated position): top-k logprob map +
@@ -118,6 +125,8 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
         if early_stop:
             emit = jnp.where(done, eos_id, nxt)
             cls = stop_mask[emit]
+            if stop_mask2 is not None:
+                cls = jnp.where(stop_sel, stop_mask2[emit], cls)
             pure = (cls & _tok.STOP_PURE) != 0
             prefix = (cls & _tok.STOP_PREFIX) != 0
             glue = (cls & _tok.STOP_STARTS_WORD) != 0
@@ -206,8 +215,80 @@ def greedy_decode_fused(params, cfg: ModelConfig, tokens: jax.Array,
 
 
 @functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new", "topk", "prefill_fn",
+                                    "return_cache"),
+                   donate_argnames=("scratch_cache",))
+def greedy_decode_fused_grouped(params, cfg: ModelConfig, prefix: jax.Array,
+                                prefix_mask: jax.Array, sfx: jax.Array,
+                                sfx_mask: jax.Array, group_idx: jax.Array,
+                                yes_ids: jax.Array, no_ids: jax.Array,
+                                digit_ids: jax.Array, digit_vals: jax.Array,
+                                max_new: int, topk: int = 20,
+                                prefill_fn=None, stop_mask: jax.Array = None,
+                                stop_mask2: jax.Array = None,
+                                stop_sel: jax.Array = None,
+                                eos_id: jax.Array = None,
+                                return_cache: bool = False,
+                                scratch_cache=None):
+    """M fused greedy decodes sharing G <= M prefix prefills (cross-cell
+    prefix reuse).
+
+    Generalizes :func:`greedy_decode_fused_shared` from "two formats of one
+    row share that row's prefill" to "any member rows whose prompts share a
+    token prefix share ONE prefill": the ragged scheduler groups grid cells
+    whose tokenized prompts agree on a long prefix (all the sweep formats x
+    rephrasings of one base prompt, when the rephrasings preserve the
+    opening tokens), prefills each distinct prefix once as a (G, S)
+    LEFT-padded batch, and ``group_idx`` (M,) maps each member row to its
+    prefix. The member suffixes (M, S2) RIGHT-padded then run one chunked
+    teacher-forced extension over the row-gathered cache, followed by the
+    fused scan. Prefill FLOPs drop by the group fan-out M/G; the gathered
+    M-row cache is the same size the ungrouped path allocates.
+
+    ``stop_mask``/``stop_mask2``/``stop_sel`` give per-row stop tables (the
+    mixed-format batch runs EOS-only stops on binary rows and the digit
+    stop on confidence rows — see _fused_tail). The pairwise special case
+    (G rows, 2 members each, ``group_idx = [0, 0, 1, 1, ...]``) scores
+    identically to greedy_decode_fused_shared (pinned by
+    tests/test_scheduler.py).
+
+    ``return_cache=True`` additionally returns the scan's final KV cache;
+    ``scratch_cache`` (DONATED) accepts the previous same-shape dispatch's
+    returned cache so XLA writes this dispatch's cache into the same HBM
+    block — one cache buffer then serves an entire bucket queue instead of
+    an alloc/free per dispatch (see runner._CacheHandoff). Results never
+    depend on the scratch contents: prefill overwrites every slot and
+    attention is masked by ``cache_mask`` regardless.
+    """
+    del scratch_cache  # donated scratch: memory reuse only, never read
+    G, S = prefix.shape
+    M, S2 = sfx.shape
+    T0 = S + S2 + max_new
+    pf = prefill_fn or decoder.prefill
+    _, gcache, _ = pf(params, cfg, prefix, prefix_mask, T0)
+
+    from ..models import cache as cache_mod
+
+    cache = cache_mod.gather_rows(gcache, group_idx)
+    pm = jnp.take(prefix_mask, group_idx, axis=0)              # (M, S)
+    cm = jnp.concatenate(
+        [pm, sfx_mask, jnp.zeros((M, max_new), pm.dtype)], axis=1)
+    logits_l, cache2, pos = decoder.extend(
+        params, cfg, cache, sfx, sfx_mask, cm, S)
+    out, cache_f = _fused_tail(params, cfg, logits_l, cache2, cm, pos, S + S2,
+                               yes_ids, no_ids, digit_ids, digit_vals,
+                               max_new, topk, stop_mask=stop_mask,
+                               eos_id=eos_id, stop_mask2=stop_mask2,
+                               stop_sel=stop_sel)
+    if return_cache:
+        return out, cache_f
+    return out
+
+
+@functools.partial(jax.jit,
                    static_argnames=("cfg", "max_new_a", "max_new_b", "topk",
-                                    "prefill_fn"))
+                                    "prefill_fn", "return_cache"),
+                   donate_argnames=("scratch_cache",))
 def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
                                prefix_mask: jax.Array, sfx_a: jax.Array,
                                sfx_a_mask: jax.Array, sfx_b: jax.Array,
@@ -217,8 +298,9 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
                                max_new_b: int, topk: int = 20,
                                prefill_fn=None, stop_mask_b: jax.Array = None,
                                stop_mask_a: jax.Array = None,
-                               eos_id: jax.Array = None
-                               ) -> Tuple[FusedDecodeOut, FusedDecodeOut]:
+                               eos_id: jax.Array = None,
+                               return_cache: bool = False,
+                               scratch_cache=None):
     """TWO fused greedy decodes sharing ONE prefill over a common prefix.
 
     The perturbation sweep scores every grid cell under two formats whose
@@ -237,7 +319,15 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
 
     Returns (binary FusedDecodeOut, confidence FusedDecodeOut); the
     confidence branch gets the digit table, the binary branch skips it.
+    ``return_cache=True`` appends the final KV cache to the return value;
+    ``scratch_cache`` (DONATED) accepts the previous same-shape dispatch's
+    cache so XLA writes this one into the same HBM block — one buffer per
+    (bucket, batch) shape for a whole sweep instead of an alloc/free per
+    dispatch (runner._CacheHandoff). Results never depend on the scratch
+    contents: prefill overwrites every slot and attention is masked by
+    the cache masks regardless.
     """
+    del scratch_cache  # donated scratch: memory reuse only, never read
     B, S = prefix.shape
     S2a, S2b = sfx_a.shape[1], sfx_b.shape[1]
     T0 = S + max(S2a + max_new_a, S2b + max_new_b)
@@ -268,8 +358,10 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
                             empty_ids, empty_vals, stop_mask=stop_mask_a)
     # The confidence branch (B) takes the digit table and, when provided,
     # the digit early stop — only its first complete integer is read.
-    out_b, _ = branch(cache_a, sfx_b, sfx_b_mask, max_new_b,
-                      digit_ids, digit_vals, stop_mask=stop_mask_b)
+    out_b, cache_b = branch(cache_a, sfx_b, sfx_b_mask, max_new_b,
+                            digit_ids, digit_vals, stop_mask=stop_mask_b)
+    if return_cache:
+        return out_a, out_b, cache_b
     return out_a, out_b
 
 

@@ -13,6 +13,7 @@ same key triple.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -38,11 +39,16 @@ class GridCell:
     confidence_format: str
     target_tokens: Tuple[str, str]
 
-    @property
+    # cached: the ragged scheduler touches each prompt string several
+    # times per sweep (tokenize at plan time, dispatch, row build) — a
+    # 20k-cell grid re-concatenating ~1 KB strings per access is pure
+    # waste. cached_property writes instance __dict__ directly, which a
+    # frozen dataclass permits.
+    @functools.cached_property
     def binary_prompt(self) -> str:
         return f"{self.rephrased_main} {self.response_format}"
 
-    @property
+    @functools.cached_property
     def confidence_prompt(self) -> str:
         return f"{self.rephrased_main} {self.confidence_format}"
 

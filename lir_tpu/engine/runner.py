@@ -34,6 +34,38 @@ def _tail_batch(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+class _CacheHandoff:
+    """Cross-dispatch KV-cache buffer reuse via donation.
+
+    The fused decode entry points can return their final cache and accept
+    the previous dispatch's cache as a DONATED scratch argument
+    (generate: ``return_cache``/``scratch_cache``); XLA then writes the
+    new dispatch's cache into the donated buffer, so one HBM block serves
+    every same-shape dispatch of a bucket queue instead of an alloc/free
+    per dispatch. A key change drops the old buffer (freed once its last
+    dispatch completes) and the next shape bootstraps fresh. ``take()``
+    removes the cache BEFORE the call so a dispatch that raises (OOM
+    fallback) can never re-donate a consumed buffer.
+
+    ``key`` must determine every cache-shape input (kind, bucket, batch,
+    suffix buckets, decode budget) — the scheduler plans those per bucket
+    precisely so consecutive dispatches share a key.
+    """
+
+    def __init__(self) -> None:
+        self._key = None
+        self._cache = None
+
+    def take(self, key: Tuple):
+        cache, k = self._cache, self._key
+        self._cache = self._key = None
+        return cache if k == key else None
+
+    def put(self, key: Tuple, cache) -> None:
+        self._key = key
+        self._cache = cache
+
+
 @dataclasses.dataclass
 class PromptScore:
     """One prompt's raw measurement. Sweep drivers wrap this into
@@ -109,9 +141,17 @@ class ScoringEngine:
         # through this lock. Contention is negligible: encode/decode are
         # each ~ms per bucket vs ~1.5 s of device work.
         self._tok_lock = threading.Lock()
-        # Length buckets: powers of two up to max_seq_len (≲700-token prompts).
-        self.buckets = [b for b in (64, 128, 256, 512, 1024)
-                        if b <= self.rt.max_seq_len] or [self.rt.max_seq_len]
+        # Length buckets. With the ragged scheduler: a ~sqrt(2) ladder
+        # (tokens.bucket_ladder) so short prompts prefill short shapes —
+        # each edge compiles once and the scheduler keeps dispatches
+        # bucket-pure. Legacy mode keeps the powers-of-two set whose
+        # per-batch pick_bucket pads every mixed-length batch to its
+        # longest row (the bench's single-bucket baseline).
+        if self.rt.ragged_scheduler:
+            self.buckets = list(tok.bucket_ladder(self.rt.max_seq_len))
+        else:
+            self.buckets = [b for b in (64, 128, 256, 512, 1024)
+                            if b <= self.rt.max_seq_len] or [self.rt.max_seq_len]
         if getattr(cfg, "pos_embedding", None) == "learned":
             # A bucket + generation budget past the learned-position table
             # would read beyond pos_embed (gpt2/opt tables are exactly
@@ -130,6 +170,11 @@ class ScoringEngine:
         self._digit_table: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._digit_stop_mask: Any = False  # False = not resolved yet
         self._eos_stop_mask: Optional[jax.Array] = None
+        # Cross-dispatch KV-cache buffer reuse (donation) + the last
+        # sweep's scheduler counters (profiling.OccupancyStats) — set by
+        # sweep.run_perturbation_sweep, read by bench.py.
+        self._handoff = _CacheHandoff()
+        self.occupancy = None
 
     @property
     def digit_stop_mask(self) -> Optional[jax.Array]:
@@ -233,7 +278,12 @@ class ScoringEngine:
                             confidence_prompts: Sequence[str],
                             yes_ids: np.ndarray, no_ids: np.ndarray,
                             new_tokens: int, conf_tokens: int,
-                            early_stop: bool = False):
+                            early_stop: bool = False,
+                            pretokenized_a: Optional[Sequence[Sequence[int]]] = None,
+                            pretokenized_b: Optional[Sequence[Sequence[int]]] = None,
+                            bucket: Optional[int] = None,
+                            sfx_buckets_ab: Optional[Tuple[int, int]] = None,
+                            reuse_cache: bool = False):
         """Score BOTH sweep formats with ONE shared-prefix prefill.
 
         Each grid cell's binary and confidence prompts share the long
@@ -245,12 +295,24 @@ class ScoringEngine:
         generate.greedy_decode_fused_shared: one prefill + two chunked
         suffix extensions instead of two full prefills. Returns
         (binary FusedDecodeOut, confidence FusedDecodeOut).
+
+        The ragged scheduler passes ``pretokenized_a/b`` (cells were
+        tokenized once at planning time), an explicit prefix ``bucket``
+        and per-bucket ``sfx_buckets_ab`` (shape stability across a
+        bucket queue), and ``reuse_cache=True`` to thread the KV cache
+        buffer through the dispatch chain via donation (_CacheHandoff).
+        The fallback guards below still apply and win over the overrides.
         """
         assert not self.encoder_decoder
-        with self._tok_lock:
-            bin_ids = [self.tokenizer(p).input_ids for p in binary_prompts]
-            conf_ids = [self.tokenizer(p).input_ids
-                        for p in confidence_prompts]
+        if pretokenized_a is not None:
+            bin_ids = [list(i) for i in pretokenized_a]
+            conf_ids = [list(i) for i in pretokenized_b]
+        else:
+            with self._tok_lock:
+                bin_ids = [self.tokenizer(p).input_ids
+                           for p in binary_prompts]
+                conf_ids = [self.tokenizer(p).input_ids
+                            for p in confidence_prompts]
         lcp = [tok.shared_prefix_len(a, b)
                for a, b in zip(bin_ids, conf_ids)]
         pad_id = tok.pad_token_id(self.tokenizer)
@@ -259,9 +321,17 @@ class ScoringEngine:
         sfx_b_ids = [b[n:] for b, n in zip(conf_ids, lcp)]
         max_sfx = max(len(s) for s in sfx_a_ids + sfx_b_ids)
         max_total = max(len(r) for r in bin_ids + conf_ids)
-        bucket = tok.pick_bucket([max(n, 1) for n in lcp], self.buckets)
-        ba = tok.pick_bucket([len(s) for s in sfx_a_ids], sfx_buckets)
-        bb = tok.pick_bucket([len(s) for s in sfx_b_ids], sfx_buckets)
+        if bucket is None or bucket < max(max(n, 1) for n in lcp):
+            bucket = tok.pick_bucket([max(n, 1) for n in lcp], self.buckets)
+        if sfx_buckets_ab is not None:
+            ba, bb = sfx_buckets_ab
+            ba = max(ba, tok.pick_bucket(
+                [len(s) for s in sfx_a_ids], sfx_buckets))
+            bb = max(bb, tok.pick_bucket(
+                [len(s) for s in sfx_b_ids], sfx_buckets))
+        else:
+            ba = tok.pick_bucket([len(s) for s in sfx_a_ids], sfx_buckets)
+            bb = tok.pick_bucket([len(s) for s in sfx_b_ids], sfx_buckets)
         fallback_reason = None
         if max_sfx > max(sfx_buckets):
             # A suffix longer than the largest bucket would be silently
@@ -315,18 +385,112 @@ class ScoringEngine:
         sfx_b, sfx_b_mask = tok.right_pad_ids(sfx_b_ids, bb, pad_id)
         digit_ids, digit_vals = self.digit_table
         stop_mask = self.digit_stop_mask if early_stop else None
+        kwargs = dict(
+            max_new_a=new_tokens, max_new_b=conf_tokens,
+            prefill_fn=self._prefill_fn, stop_mask_b=stop_mask,
+            stop_mask_a=(None if stop_mask is None else self.eos_stop_mask),
+            eos_id=(None if stop_mask is None
+                    else jnp.int32(self.eos_id)))
+        if reuse_cache:
+            key = ("shared", bucket, len(bin_ids), ba, bb, new_tokens,
+                   conf_tokens, early_stop)
+            fused, cfused, cache = generate.greedy_decode_fused_shared(
+                self.params, self.cfg, jnp.asarray(prefix),
+                jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
+                jnp.asarray(sfx_a_mask), jnp.asarray(sfx_b),
+                jnp.asarray(sfx_b_mask),
+                jnp.asarray(yes_ids, jnp.int32),
+                jnp.asarray(no_ids, jnp.int32),
+                jnp.asarray(digit_ids), jnp.asarray(digit_vals),
+                return_cache=True, scratch_cache=self._handoff.take(key),
+                **kwargs)
+            self._handoff.put(key, cache)
+            return fused, cfused
         return generate.greedy_decode_fused_shared(
             self.params, self.cfg, jnp.asarray(prefix),
             jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
             jnp.asarray(sfx_a_mask), jnp.asarray(sfx_b),
             jnp.asarray(sfx_b_mask),
             jnp.asarray(yes_ids, jnp.int32), jnp.asarray(no_ids, jnp.int32),
-            jnp.asarray(digit_ids), jnp.asarray(digit_vals),
-            max_new_a=new_tokens, max_new_b=conf_tokens,
-            prefill_fn=self._prefill_fn, stop_mask_b=stop_mask,
-            stop_mask_a=(None if stop_mask is None else self.eos_stop_mask),
-            eos_id=(None if stop_mask is None
-                    else jnp.int32(self.eos_id)))
+            jnp.asarray(digit_ids), jnp.asarray(digit_vals), **kwargs)
+
+    def decode_fused_grouped(self, groups, yes_ids: np.ndarray,
+                             no_ids: np.ndarray, new_tokens: int,
+                             conf_tokens: int, early_stop: bool,
+                             bucket: int, sfx_bucket: int,
+                             reuse_cache: bool = False):
+        """Cross-cell prefix reuse: score every member prompt of
+        ``groups`` (scheduler.PrefixGroup-shaped: ``.items`` with
+        ``.bin_ids``/``.conf_ids``, shared ``.plen``) with ONE prefill per
+        group. Member rows are laid out [bin, conf] per cell, cells in
+        group order; ``yes_ids``/``no_ids`` are per-CELL in that order.
+
+        Returns (FusedDecodeOut over the padded member batch, real member
+        row count) — callers slice even rows for the binary readout and
+        odd rows for the confidence readout. Both formats run one shared
+        decode budget max(new_tokens, conf_tokens); with ``early_stop``
+        the binary rows take the EOS-only stop table and the confidence
+        rows the digit stop (per-row selection, generate._fused_tail), so
+        the extra binary steps retire the moment the row answers.
+        """
+        assert not self.encoder_decoder
+        pad_id = tok.pad_token_id(self.tokenizer)
+        prefix_ids, sfx_ids, group_idx, cell_rows = [], [], [], 0
+        for g in groups:
+            gi = len(prefix_ids)
+            prefix_ids.append(list(g.items[0].bin_ids[:g.plen]))
+            for it in g.items:
+                sfx_ids.append(list(it.bin_ids[g.plen:]))
+                sfx_ids.append(list(it.conf_ids[g.plen:]))
+                group_idx += [gi, gi]
+                cell_rows += 1
+        m = len(sfx_ids)
+        g_pad = _tail_batch(len(prefix_ids), self.rt.batch_size)
+        m_pad = _tail_batch(m, 2 * self.rt.batch_size)
+        prefix_ids += [prefix_ids[-1]] * (g_pad - len(prefix_ids))
+        sfx_ids += [sfx_ids[-1]] * (m_pad - m)
+        group_idx += [group_idx[-1]] * (m_pad - m)
+        if max(len(p) for p in prefix_ids) > bucket:
+            raise ValueError("scheduler planned a group prefix longer than "
+                             "its bucket")  # planning bug, never truncate
+        if (getattr(self.cfg, "pos_embedding", None) == "learned"
+                and bucket + sfx_bucket + max(new_tokens, conf_tokens)
+                > self.cfg.max_seq_len):
+            raise ValueError("scheduler planned a grouped dispatch past the "
+                             "learned-position table")
+
+        prefix, prefix_mask = tok.left_pad_ids(prefix_ids, bucket, pad_id)
+        sfx, sfx_mask = tok.right_pad_ids(sfx_ids, sfx_bucket, pad_id)
+        yes2 = np.repeat(np.asarray(yes_ids, np.int32), 2)
+        no2 = np.repeat(np.asarray(no_ids, np.int32), 2)
+        yes2 = np.concatenate([yes2, np.repeat(yes2[-1:], m_pad - m)])
+        no2 = np.concatenate([no2, np.repeat(no2[-1:], m_pad - m)])
+        digit_ids, digit_vals = self.digit_table
+        stop_mask = self.digit_stop_mask if early_stop else None
+        kwargs = dict(
+            max_new=max(new_tokens, conf_tokens),
+            prefill_fn=self._prefill_fn,
+            stop_mask=(None if stop_mask is None else self.eos_stop_mask),
+            stop_mask2=stop_mask,
+            stop_sel=(None if stop_mask is None else
+                      jnp.asarray(np.arange(m_pad) % 2 == 1)),
+            eos_id=(None if stop_mask is None else jnp.int32(self.eos_id)))
+        args = (self.params, self.cfg, jnp.asarray(prefix),
+                jnp.asarray(prefix_mask), jnp.asarray(sfx),
+                jnp.asarray(sfx_mask),
+                jnp.asarray(np.asarray(group_idx, np.int32)),
+                jnp.asarray(yes2), jnp.asarray(no2),
+                jnp.asarray(digit_ids), jnp.asarray(digit_vals))
+        if reuse_cache:
+            key = ("grouped", bucket, g_pad, m_pad, sfx_bucket,
+                   kwargs["max_new"], early_stop)
+            out, cache = generate.greedy_decode_fused_grouped(
+                *args, return_cache=True,
+                scratch_cache=self._handoff.take(key), **kwargs)
+            self._handoff.put(key, cache)
+        else:
+            out = generate.greedy_decode_fused_grouped(*args, **kwargs)
+        return out, m
 
     def decode_completion(self, generated_ids: np.ndarray) -> str:
         """Token ids -> text, stopping at the first EOS (HF generate parity —

@@ -26,7 +26,10 @@ from ..data import schemas
 from ..data.prompts import LegalPrompt
 from ..utils.logging import get_logger
 from ..utils.manifest import SweepManifest
+from ..utils.profiling import OccupancyStats
+from . import generate
 from . import grid as grid_mod
+from . import scheduler as sched_mod
 from . import score as score_mod
 from . import tokens as tok
 from .runner import ScoringEngine, _tail_batch
@@ -136,6 +139,7 @@ def run_perturbation_sweep(
     manifest = manifest or SweepManifest(
         results_path.with_suffix(".manifest.jsonl"),
         grid_mod.RESUME_KEY_FIELDS)
+    engine.occupancy = None  # set by _run_pipelined's ragged planner
     cells = grid_mod.build_grid(model_name, prompts, perturbations)
     cells = grid_mod.random_subset(cells, subset_size, seed)
     if shard_grid:
@@ -224,6 +228,49 @@ def run_perturbation_sweep(
     return rows
 
 
+def _steps_used(gen_row: np.ndarray, eos_id) -> int:
+    """Decode steps a row actually used: up to and including its first
+    EOS (stopped rows emit EOS fill afterwards), else the full budget."""
+    hits = np.flatnonzero(np.asarray(gen_row) == eos_id)
+    return int(hits[0]) + 1 if hits.size else int(len(gen_row))
+
+
+def _plan_ragged(engine, todo, new_tokens, conf_tokens):
+    """Tokenize the pending grid ONCE and plan every dispatch through the
+    ragged scheduler (bucket ladder + slot refill + prefix groups). The
+    plan and its occupancy counters hang off ``engine.occupancy`` for the
+    bench/operators."""
+    with engine._tok_lock:
+        bin_ids = [engine.tokenizer(c.binary_prompt).input_ids
+                   for c in todo]
+        conf_ids = [engine.tokenizer(c.confidence_prompt).input_ids
+                    for c in todo]
+    items = sched_mod.build_items(bin_ids, conf_ids, todo)
+    stats = OccupancyStats()
+    max_extent = (engine.cfg.max_seq_len
+                  if getattr(engine.cfg, "pos_embedding", None) == "learned"
+                  else None)
+    planner = sched_mod.RaggedScheduler(
+        engine.buckets, engine.rt.batch_size,
+        new_budget=max(new_tokens, conf_tokens),
+        decode_cost=new_tokens + conf_tokens, max_extent=max_extent,
+        min_group_prefix=engine.rt.sweep_group_min_prefix,
+        min_group_cells=engine.rt.sweep_group_min_cells,
+        group_cells=engine.rt.sweep_group_min_cells > 0,
+        stats=stats)
+    dispatches = planner.schedule(items)
+    engine.occupancy = stats
+    log.info(
+        "ragged schedule: %d cells -> %d dispatches over buckets %s "
+        "(occupancy %.1f%%, padding waste %.1f%%, refilled %d, "
+        "grouped %d)", len(todo), len(dispatches),
+        sorted({d.bucket for d in dispatches}), stats.occupancy_pct,
+        stats.padding_waste_pct,
+        sum(b.refilled for b in stats.buckets.values()),
+        stats.grouped_cells)
+    return dispatches, stats
+
+
 def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                    manifest, checkpoint_every, new_tokens, conf_tokens,
                    rows, pending_rows) -> None:
@@ -241,6 +288,16 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
       the critical path between dispatches (VERDICT r2 weak #1: the end-to-
       end sweep ran at 49% of the isolated scoring rate).
 
+    With ``engine.rt.ragged_scheduler`` the batches come from the ragged
+    scheduler's plan (engine/scheduler.py) instead of todo order: cells
+    are bucketed by real tokenized prefix length, ragged bucket tails are
+    refilled into the next bucket (slot refill), and long-shared-prefix
+    cells score through one grouped prefill. Per-cell results are
+    IDENTICAL either way (padding is masked out of every readout; pinned
+    by tests/test_scheduler.py) — only dispatch composition and row order
+    change, and the manifest keys rows by cell identity so resume is
+    unaffected.
+
     The queue is bounded (depth 2) so at most ~3 buckets of decode outputs
     are live on device — outputs are small (generated ids + top-20 maps),
     but unbounded dispatch-ahead would also tokenize the whole grid up
@@ -255,12 +312,40 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
     work_q: "queue.Queue" = queue.Queue(maxsize=2)
     failed = threading.Event()
     writer_err: List[BaseException] = []
+    early_stop = (engine.rt.sweep_early_stop
+                  and not engine.rt.sweep_full_completions)
+    ragged = bool(engine.rt.ragged_scheduler and todo
+                  and not engine.encoder_decoder)
+    occupancy = None
+    stop_armed = False
+    if ragged:
+        dispatches, occupancy = _plan_ragged(engine, todo, new_tokens,
+                                             conf_tokens)
+        stop_armed = early_stop and engine.digit_stop_mask is not None
+        # Fresh handoff per sweep: the first dispatch of each bucket then
+        # always runs the scratchless jit signature and later ones the
+        # donated-cache signature — the same two executables a warmup
+        # sweep over the same shapes compiles, so steady-state timing
+        # never hits a fresh compile mid-run.
+        from .runner import _CacheHandoff
+
+        engine._handoff = _CacheHandoff()
 
     def _drain(batch, fused, res, cfused):
         res_h, lp_vals, lp_ids, gen_host = jax.device_get(
             (res, fused.topk_logprobs, fused.topk_ids, fused.generated))
         wconf, cgen_host = jax.device_get(
             (cfused.weighted_confidence, cfused.generated))
+        if occupancy is not None and stop_armed:
+            # Decode-step occupancy: rows retired by the early stop idle
+            # until the batch's slowest row (profiling.OccupancyStats).
+            for j in range(len(batch)):
+                occupancy.add_decode(
+                    _steps_used(gen_host[j], engine.eos_id),
+                    int(gen_host.shape[1]))
+                occupancy.add_decode(
+                    _steps_used(cgen_host[j], engine.eos_id),
+                    int(cgen_host.shape[1]))
         for j, cell in enumerate(batch):
             completion = engine.decode_completion(gen_host[j])
             conf_text = engine.decode_completion(cgen_host[j])
@@ -307,12 +392,10 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 writer_err.append(e)
                 failed.set()
 
-    wt = threading.Thread(target=_writer, name="sweep-writer", daemon=True)
-    wt.start()
-    try:
+    def _dispatch_legacy():
         for start in range(0, len(todo), B):
             if failed.is_set():
-                break
+                return
             batch = todo[start:start + B]
             n = len(batch)
             # Tail bucket: pad to the next power of two instead of the full
@@ -335,11 +418,83 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 [c.binary_prompt for c in full],
                 [c.confidence_prompt for c in full],
                 t1, t2, new_tokens=new_tokens, conf_tokens=conf_tokens,
-                early_stop=(engine.rt.sweep_early_stop
-                            and not engine.rt.sweep_full_completions))
+                early_stop=early_stop)
             res = score_mod.readout_from_fused(
                 fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
             work_q.put((batch, fused, res, cfused))
+
+    def _dispatch_ragged():
+        for d in dispatches:
+            if failed.is_set():
+                return
+            batch = d.cells
+            n = len(d.items)
+            if d.kind == "shared":
+                bsz = B if n == B else _tail_batch(n, B)
+                full_items = list(d.items) + [d.items[-1]] * (bsz - n)
+                t1 = np.asarray(
+                    [target_ids[it.cell.prompt_idx][0]
+                     for it in full_items], np.int32)
+                t2 = np.asarray(
+                    [target_ids[it.cell.prompt_idx][1]
+                     for it in full_items], np.int32)
+                fused, cfused = engine.decode_fused_shared(
+                    [it.cell.binary_prompt for it in full_items],
+                    [it.cell.confidence_prompt for it in full_items],
+                    t1, t2, new_tokens=new_tokens, conf_tokens=conf_tokens,
+                    early_stop=early_stop,
+                    pretokenized_a=[it.bin_ids for it in full_items],
+                    pretokenized_b=[it.conf_ids for it in full_items],
+                    bucket=d.bucket,
+                    sfx_buckets_ab=(d.sfx_bucket_a, d.sfx_bucket_b),
+                    reuse_cache=True)
+                res = score_mod.readout_from_fused(
+                    fused, jnp.asarray(t1), jnp.asarray(t2),
+                    scan_positions=1)
+            else:
+                t1 = np.asarray(
+                    [target_ids[it.cell.prompt_idx][0]
+                     for it in d.items], np.int32)
+                t2 = np.asarray(
+                    [target_ids[it.cell.prompt_idx][1]
+                     for it in d.items], np.int32)
+                out, m = engine.decode_fused_grouped(
+                    d.groups, t1, t2, new_tokens, conf_tokens, early_stop,
+                    d.bucket, max(d.sfx_bucket_a, d.sfx_bucket_b),
+                    reuse_cache=True)
+                # Member rows are [bin, conf] per cell: even rows carry
+                # the binary readout, odd rows the confidence one. Both
+                # ran the shared max(new, conf) budget, so each branch
+                # view trims its per-step fields back to ITS budget —
+                # greedy decoding is prefix-stable, so the trimmed tokens
+                # equal what a budget-exact decode would have produced
+                # (and the extra steps retire via the EOS stop when
+                # armed).
+                def _branch(start, budget):
+                    idx = slice(start, m, 2)
+                    return generate.FusedDecodeOut(
+                        generated=out.generated[idx, :budget],
+                        p_yes=out.p_yes[idx, :budget],
+                        p_no=out.p_no[idx, :budget],
+                        top2_ids=out.top2_ids[idx, :budget],
+                        topk_logprobs=out.topk_logprobs[idx],
+                        topk_ids=out.topk_ids[idx],
+                        weighted_confidence=out.weighted_confidence[idx])
+
+                fused = _branch(0, new_tokens)
+                cfused = _branch(1, conf_tokens)
+                res = score_mod.readout_from_fused(
+                    fused, jnp.asarray(t1), jnp.asarray(t2),
+                    scan_positions=1)
+            work_q.put((batch, fused, res, cfused))
+
+    wt = threading.Thread(target=_writer, name="sweep-writer", daemon=True)
+    wt.start()
+    try:
+        if ragged:
+            _dispatch_ragged()
+        else:
+            _dispatch_legacy()
     finally:
         work_q.put(None)
         wt.join()

@@ -26,6 +26,41 @@ from .registry import ModelConfig, T5Config
 
 log = get_logger(__name__)
 
+# Batch axis of every KV-cache leaf. decoder.init_cache lays the cache out
+# (L, K, T, B, hd) — and int8 scale leaves (L, K, T, B) — so the batch is
+# axis 3 in both flavors, which is what makes the row gather below one
+# uniform tree_map.
+KV_BATCH_AXIS = 3
+
+
+def gather_rows(cache: Any, row_idx: jax.Array) -> Any:
+    """Broadcast/reorder KV-cache rows: leaf[..., row_idx, ...] along the
+    batch axis, for every leaf of either cache flavor (bf16 pair or int8
+    payload+scale pairs).
+
+    This is the cross-cell prefix-reuse primitive: the prefix-group decode
+    prefills one cache row per *distinct* shared prefix (G rows), then
+    gathers it out to one row per member prompt (M rows, ``row_idx`` maps
+    member -> group) before the per-member suffix extension. The gather is
+    a copy — the M-row cache is the same size the ungrouped path allocates
+    anyway — but the quadratic prefill ran over G <= M rows.
+    """
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda a: jnp.take(a, row_idx, axis=KV_BATCH_AXIS), cache)
+
+
+def kv_cache_bytes(cfg, batch: int, max_len: int, dtype_bytes: int = 2) -> int:
+    """HBM bytes of one decode KV cache at (batch, max_len) — the number
+    the scheduler's batch-ladder sizing and DEPLOY.md's bucket-tuning
+    notes reason about. int8 caches store a 1-byte payload plus an fp32
+    scale per (head, position, row) vector."""
+    per_side = cfg.n_layers * cfg.n_kv_heads * max_len * batch
+    if getattr(cfg, "kv_cache_int8", False):
+        return 2 * (per_side * cfg.head_dim + per_side * 4)
+    return 2 * per_side * cfg.head_dim * dtype_bytes
+
 _CFG_KINDS = {"decoder": ModelConfig, "t5": T5Config}
 
 

@@ -1,0 +1,24 @@
+"""jax version compatibility for the parallel package.
+
+The repo targets current jax (top-level ``jax.shard_map`` with the
+``check_vma`` kwarg); older runtimes keep shard_map under
+``jax.experimental`` with the kwarg's previous name ``check_rep``. This
+shim resolves both so every parallel module imports one symbol.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax keeps it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(*args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(*args, **kwargs)

@@ -13,7 +13,8 @@ depends on beyond raw throughput).
 import pytest
 
 import bench as bench_mod
-from chain7b import (CHAIN_CONFIDENCE_FORMAT, CHAIN_RESPONSE_FORMAT,
+from chain7b import (CHAIN_ANSWER_STEP, CHAIN_CONFIDENCE_FORMAT,
+                     CHAIN_CONFIDENCE_VALUE, CHAIN_RESPONSE_FORMAT,
                      chain_param_tree, confidence_chain,
                      ship_quantized_chain)
 from tiny_checkpoints import build_bpe_tokenizer
@@ -36,7 +37,7 @@ def test_bench_production_chain_sweep_cpu():
                       tie_embeddings=False)
     chain, junk_next, junk_second = confidence_chain(
         fast, CHAIN_RESPONSE_FORMAT,
-        CHAIN_CONFIDENCE_FORMAT, answer_step=3)
+        CHAIN_CONFIDENCE_FORMAT, answer_step=CHAIN_ANSWER_STEP)
     params = chain_param_tree(cfg, chain, junk_next=junk_next,
                               junk_second=junk_second, dtype=jnp.float32)
 
@@ -47,7 +48,7 @@ def test_bench_production_chain_sweep_cpu():
     # expect_conf is set — a wrong scan position, a truncation-rejected
     # parse, or a stop firing before the integer completes all fail here.
     value, batch, cells = bench_mod._sweep_path(
-        params, cfg, on_accel=False, tokenizer=fast, expect_conf=85)
+        params, cfg, on_accel=False, tokenizer=fast, expect_conf=CHAIN_CONFIDENCE_VALUE)
     assert value > 0
     assert cells == bench_mod.SWEEP_CELLS_CPU
 
@@ -73,7 +74,7 @@ def test_binary_branch_eos_stop_preserves_rows():
                       intermediate_size=128, max_seq_len=512,
                       tie_embeddings=False)
     chain, junk_next, junk_second = confidence_chain(
-        fast, CHAIN_RESPONSE_FORMAT, CHAIN_CONFIDENCE_FORMAT, answer_step=3)
+        fast, CHAIN_RESPONSE_FORMAT, CHAIN_CONFIDENCE_FORMAT, answer_step=CHAIN_ANSWER_STEP)
     # confidence_chain maps EOS -> EOS; remap it to a VISIBLE token so the
     # unstopped decode keeps emitting text after EOS while a working stop
     # forces EOS fill — otherwise both runs are byte-identical and a dead
@@ -152,7 +153,7 @@ def test_ship_quantized_chain_matches_host_quantize(family):
                       tie_embeddings=False, **extra)
     chain, junk_next, junk_second = confidence_chain(
         fast, CHAIN_RESPONSE_FORMAT,
-        CHAIN_CONFIDENCE_FORMAT, answer_step=3)
+        CHAIN_CONFIDENCE_FORMAT, answer_step=CHAIN_ANSWER_STEP)
 
     host = quant.quantize_decoder_params(
         chain_param_tree(cfg, chain, junk_next=junk_next,
@@ -164,7 +165,8 @@ def test_ship_quantized_chain_matches_host_quantize(family):
                                    junk_second=junk_second)
 
     is_q = lambda x: isinstance(x, quant.QuantTensor)  # noqa: E731
-    ph, sh = (jax.tree.leaves_with_path(t, is_leaf=is_q)
+    # tree_util spelling: older jax has no jax.tree.leaves_with_path.
+    ph, sh = (jax.tree_util.tree_leaves_with_path(t, is_leaf=is_q)
               for t in (host, shipped))
     assert [p for p, _ in ph] == [p for p, _ in sh]
     for (path, a), (_, b) in zip(ph, sh):

@@ -651,3 +651,23 @@ def test_cli_bench_passes_clean_argv(monkeypatch):
     cli.main(["bench", "--model", "mistral_7b", "--sweep-batches", "48,40"])
     assert seen["argv"][1:] == ["--model", "mistral_7b",
                                 "--sweep-batches", "48,40"]
+
+
+def test_cli_bench_rejects_unknowns_before_subcommand(monkeypatch):
+    """Only tokens AFTER the `bench` subcommand forward to bench.py; a
+    typo of the CLI's own flags (which argparse sees before the
+    subcommand) fails with the CLI's usage error, not bench.py's
+    (ADVICE r5, cli.py:470)."""
+    import lir_tpu.cli as cli
+
+    called = []
+    monkeypatch.setattr("runpy.run_path",
+                        lambda path, run_name: called.append(path))
+    for argv in (["--typo", "bench"],
+                 ["--allow-ungatd", "bench", "--model", "x"]):
+        with pytest.raises(SystemExit) as exc:
+            cli.main(argv)
+        assert exc.value.code == 2      # argparse usage error
+    assert called == []                  # bench.py never ran
+    cli.main(["bench", "--no-varlen"])   # post-subcommand still forwards
+    assert called

@@ -1,0 +1,304 @@
+"""Ragged sweep scheduler tests (engine/scheduler.py).
+
+Pins the three properties the scheduler's callers rely on:
+- planning is deterministic and TOTAL (every grid cell lands in exactly
+  one dispatch, identical inputs plan identical schedules),
+- slot refill / bucket-ladder dispatch composition changes ONLY the
+  batching — per-cell sweep results are identical to the legacy
+  todo-order path on the fake backend,
+- the cross-cell prefix-group decode reproduces decode_fused_shared on
+  its pairwise special case (one cell per group, [bin, conf] members).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import RuntimeConfig
+from lir_tpu.engine import scheduler as sched_mod
+from lir_tpu.engine import tokens as tok
+from lir_tpu.utils.profiling import OccupancyStats
+
+
+# ---------------------------------------------------------------------------
+# Bucket ladder (tokens.bucket_ladder / assign_bucket) — pure host-side
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder_shape_and_alignment():
+    edges = tok.bucket_ladder(1024)
+    assert edges == tuple(sorted(set(edges)))          # strictly increasing
+    assert edges[-1] == 1024                           # covers the ceiling
+    for e in edges:
+        # flash-eligibility: lane-friendly under one block, whole blocks
+        # above it (tokens.FLASH_BLOCK) — a misaligned edge silently
+        # drops every dispatch in its bucket to dense attention.
+        assert e % (16 if e <= tok.FLASH_BLOCK else tok.FLASH_BLOCK) == 0
+    # ~sqrt(2) spacing keeps worst-case padding bounded: no step doubles.
+    for a, b in zip(edges, edges[1:]):
+        assert b <= 2 * a
+    # Tiny ceilings degenerate to a single bucket.
+    assert tok.bucket_ladder(48) == (48,)
+
+
+def test_assign_bucket_total_and_deterministic():
+    edges = tok.bucket_ladder(512)
+    for n in range(1, 600):
+        b = tok.assign_bucket(n, edges)
+        assert b in edges
+        if n <= max(edges):
+            # smallest covering edge
+            assert b >= n and all(e < n for e in edges if e < b)
+        else:
+            # over-long: largest bucket (left-truncation semantics)
+            assert b == max(edges)
+        assert tok.assign_bucket(n, edges) == b
+
+
+# ---------------------------------------------------------------------------
+# Planning: totality, determinism, slot refill accounting
+# ---------------------------------------------------------------------------
+
+def _items(lengths, fmt_len=6):
+    """SweepItems with distinct token contents: per-cell prompts share
+    their first `n` tokens between formats (lcp == n)."""
+    items = []
+    for i, n in enumerate(lengths):
+        base = [100 + i] * n
+        items.append(sched_mod.SweepItem(
+            cell=("cell", i), bin_ids=tuple(base + [7] * fmt_len),
+            conf_ids=tuple(base + [9] * fmt_len), lcp=n))
+    return items
+
+
+def test_schedule_is_total_and_deterministic():
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(4, 500, 57).tolist()
+    buckets = tok.bucket_ladder(512)
+
+    def plan():
+        planner = sched_mod.RaggedScheduler(buckets, 8, stats=OccupancyStats())
+        return planner.schedule(_items(lengths))
+
+    dispatches = plan()
+    seen = [it.cell for d in dispatches for it in d.items]
+    assert sorted(seen) == sorted(("cell", i) for i in range(len(lengths)))
+    assert len(seen) == len(set(seen))  # exactly once
+    for d in dispatches:
+        assert d.kind in ("shared", "grouped")
+        assert d.bucket in buckets
+        # every member's planned prefix fits its dispatch bucket
+        for it in d.items:
+            assert min(it.prefix_len, max(buckets)) <= d.bucket
+
+    again = plan()
+    assert [(d.kind, d.bucket, d.cells) for d in dispatches] == \
+           [(d.kind, d.bucket, d.cells) for d in again]
+
+
+def test_slot_refill_promotes_ragged_tail_once():
+    # 9 short cells at batch 4: two full dispatches + a 1-cell tail. The
+    # cost model promotes the tail into the 96 bucket (1 * 96 < 1-slot
+    # padded dispatch at 64? no — vs _tail_batch(1,4)=1 slot * 64) only
+    # when cheaper, so just pin totality + the refilled counter's books.
+    lengths = [30] * 9 + [90] * 4
+    stats = OccupancyStats()
+    planner = sched_mod.RaggedScheduler(
+        tok.bucket_ladder(256), 4, group_cells=False, stats=stats)
+    dispatches = planner.schedule(_items(lengths))
+    assert sum(len(d.items) for d in dispatches) == len(lengths)
+    assert sum(b.cells for b in stats.buckets.values()) == len(lengths)
+    assert sum(b.refilled for b in stats.buckets.values()) == \
+           sum(d.refilled for d in dispatches)
+    assert 0.0 < stats.occupancy_pct <= 100.0
+    assert 0.0 <= stats.padding_waste_pct < 100.0
+
+
+def test_prefix_groups_form_only_on_long_shared_prefixes():
+    # 4 cells sharing 24 leading tokens (>= min_group_prefix, >= half of
+    # each prefill) group; 4 cells with disjoint prompts never do.
+    shared = [50 + i for i in range(24)]
+    items = []
+    for i in range(4):
+        ids = shared + [200 + i] * (4 + i)
+        items.append(sched_mod.SweepItem(
+            cell=("g", i), bin_ids=tuple(ids + [7] * 5),
+            conf_ids=tuple(ids + [9] * 5), lcp=len(ids)))
+    solo = _items([40, 45, 50, 55])
+    planner = sched_mod.RaggedScheduler(
+        tok.bucket_ladder(256), 8, stats=OccupancyStats())
+    dispatches = planner.schedule(items + solo)
+    grouped = [d for d in dispatches if d.kind == "grouped"]
+    assert len(grouped) == 1
+    assert sorted(it.cell for it in grouped[0].items) == \
+           sorted(("g", i) for i in range(4))
+    assert grouped[0].groups[0].plen >= 24
+    # the disjoint cells all ride shared dispatches
+    rest = [it.cell for d in dispatches if d.kind == "shared"
+            for it in d.items]
+    assert sorted(rest) == sorted(("cell", i) for i in range(4))
+
+
+# ---------------------------------------------------------------------------
+# Engine-level parity on the fake backend
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(rt, seed=2):
+    import jax
+
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="sched-smoke", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=64, n_layers=2, n_heads=4,
+                      intermediate_size=128, max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(seed))
+    return ScoringEngine(params, cfg, FakeTokenizer(), rt), params, cfg
+
+
+def _varlen_grid(rng):
+    """2 prompts x variable-length rephrasings spanning several buckets;
+    prompt 0's rephrasings share their first 20 words so the ragged run
+    also exercises the cross-cell prefix-group path."""
+    from lir_tpu.data.prompts import LegalPrompt
+
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible adjuster settle "
+             "liability clause binding interpret statute meaning").split()
+
+    def text(n):
+        return " ".join(rng.choice(words) for _ in range(n)) + " ?"
+
+    shared_head = " ".join(rng.choice(words) for _ in range(20))
+    prompts = (
+        LegalPrompt(main=shared_head + " " + text(8),
+                    response_format="Answer Yes or No .",
+                    target_tokens=("Yes", "No"),
+                    confidence_format="Give a number from 0 to 100 ."),
+        LegalPrompt(main=text(30),
+                    response_format="Answer Yes or No .",
+                    target_tokens=("Yes", "No"),
+                    confidence_format="Give a number from 0 to 100 ."),
+    )
+    perturbations = (
+        # same 20-word head, short tails -> a 4+ cell prefix group
+        [shared_head + " " + text(4 + i) for i in range(4)],
+        # disjoint, strongly varied lengths -> bucket ladder + refill
+        [text(n) for n in (5, 90, 140, 12, 70, 25, 110)],
+    )
+    return prompts, perturbations
+
+
+@pytest.mark.slow
+def test_ragged_sweep_matches_legacy_per_cell(tmp_path):
+    """The tentpole's safety property: bucket ladder + slot refill +
+    prefix grouping change dispatch COMPOSITION only — every cell's D6
+    readout equals the legacy todo-order path's: token/text readouts bit
+    for bit, float readouts to shape-fusion tolerance (a cell padded to
+    a different bucket length fuses slightly differently; the last ulp
+    of a logprob can move)."""
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+
+    rng = np.random.default_rng(11)
+    prompts, perturbations = _varlen_grid(rng)
+
+    def run(ragged, sub):
+        rt = RuntimeConfig(batch_size=4, max_seq_len=256,
+                           ragged_scheduler=ragged)
+        engine, _, _ = _tiny_engine(rt)
+        rows = run_perturbation_sweep(
+            engine, "sched-tiny", prompts, perturbations,
+            tmp_path / sub / "results.xlsx", checkpoint_every=100)
+        return rows, engine
+
+    rows_r, eng_r = run(True, "ragged")
+    rows_l, _ = run(False, "legacy")
+    assert len(rows_r) == len(rows_l) == 13
+
+    def key(r):
+        return (r.original_main, r.rephrased_main)
+
+    by_key = {key(r): r for r in rows_l}
+    assert set(map(key, rows_r)) == set(by_key)
+    for r in rows_r:
+        l = by_key[key(r)]
+        assert r.model_response == l.model_response
+        assert r.model_confidence_response == l.model_confidence_response
+        assert r.confidence_value == l.confidence_value
+        np.testing.assert_allclose(r.token_1_prob, l.token_1_prob,
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(r.token_2_prob, l.token_2_prob,
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(r.weighted_confidence,
+                                   l.weighted_confidence,
+                                   rtol=1e-5, atol=1e-7)
+        lp_r, lp_l = (json.loads(r.log_probabilities),
+                      json.loads(l.log_probabilities))
+        assert list(lp_r) == list(lp_l)  # same top-20 ids, same order
+        np.testing.assert_allclose(list(lp_r.values()),
+                                   list(lp_l.values()), atol=2e-6)
+
+    # The ragged run actually scheduled (counters populated and sane).
+    stats = eng_r.occupancy
+    assert stats is not None
+    assert sum(b.cells for b in stats.buckets.values()) == 13
+    assert 0.0 < stats.occupancy_pct <= 100.0
+    assert 0.0 <= stats.padding_waste_pct < 100.0
+    assert stats.grouped_cells >= 4  # the shared-head rephrasings grouped
+
+
+@pytest.mark.slow
+def test_grouped_decode_matches_shared_pairwise():
+    """decode_fused_grouped on one-cell groups ([bin, conf] members,
+    group_idx = [0,0,1,1,...]) == decode_fused_shared on the same
+    prompts — the pairwise special case the grouped path generalizes."""
+    engine, _, _ = _tiny_engine(
+        RuntimeConfig(batch_size=4, max_seq_len=256))
+    mains = [f"the quick brown fox {i} jumps over the lazy dog "
+             f"word {i * 7} extra filler text here" for i in range(4)]
+    bins = [m + " Respond with either Yes or No only" for m in mains]
+    confs = [m + " Give a confidence number from 0 to 100" for m in mains]
+    t1 = np.full((4,), FakeTokenizer.YES, np.int32)
+    t2 = np.full((4,), FakeTokenizer.NO, np.int32)
+    NEW = 4
+
+    ftok = engine.tokenizer
+    bin_ids = [ftok(p).input_ids for p in bins]
+    conf_ids = [ftok(p).input_ids for p in confs]
+    items = sched_mod.build_items(bin_ids, conf_ids, list(range(4)))
+    groups = [sched_mod.PrefixGroup(items=(it,), plen=it.lcp)
+              for it in items]
+    bucket = tok.pick_bucket([it.prefix_len for it in items],
+                             engine.buckets)
+    sfx = tok.pick_bucket(
+        [max(len(it.bin_ids), len(it.conf_ids)) - it.lcp for it in items],
+        sched_mod.SUFFIX_BUCKETS)
+
+    out, m = engine.decode_fused_grouped(
+        groups, t1, t2, NEW, NEW, early_stop=False,
+        bucket=bucket, sfx_bucket=sfx)
+    assert m == 8
+    ref_a, ref_b = engine.decode_fused_shared(
+        bins, confs, t1, t2, new_tokens=NEW, conf_tokens=NEW,
+        early_stop=False)
+
+    for start, ref in ((0, ref_a), (1, ref_b)):
+        rows = slice(start, m, 2)
+        np.testing.assert_array_equal(np.asarray(out.generated[rows]),
+                                      np.asarray(ref.generated))
+        np.testing.assert_allclose(np.asarray(out.p_yes[rows]),
+                                   np.asarray(ref.p_yes),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out.p_no[rows]),
+                                   np.asarray(ref.p_no),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(out.topk_ids[rows]),
+                                      np.asarray(ref.topk_ids))
+        np.testing.assert_allclose(np.asarray(out.topk_logprobs[rows]),
+                                   np.asarray(ref.topk_logprobs),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out.weighted_confidence[1:m:2]),
+        np.asarray(ref_b.weighted_confidence), rtol=1e-5, atol=1e-6)

@@ -51,7 +51,16 @@ class TestRingAttention:
         q, k, v = _qkv()
         qs = jax.device_put(q, seq_sharded(seq_mesh))
         out = ring_attention(qs, qs, qs, seq_mesh)
-        assert out.sharding.spec == seq_sharded(seq_mesh).spec
+
+        # jax versions differ on whether trailing None axes are kept in a
+        # result spec; compare specs normalized to the same rank.
+        def _norm(spec):
+            axes = list(spec)
+            while axes and axes[-1] is None:
+                axes.pop()
+            return tuple(axes)
+
+        assert _norm(out.sharding.spec) == _norm(seq_sharded(seq_mesh).spec)
 
     def test_jit_compatible(self, seq_mesh):
         q, k, v = _qkv(S=32)

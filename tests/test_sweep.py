@@ -280,11 +280,15 @@ def test_multihost_required_single_process_runtime_error_attribution(
 
     monkeypatch.setattr(jax.distributed, "initialize", boom)
     monkeypatch.setattr(jax, "process_count", lambda: 1)
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    # raising=False: older jax has no is_initialized at all (multihost
+    # probes it defensively), so the patch must not require the attribute.
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True,
+                        raising=False)
     with pytest.raises(RuntimeError, match="SINGLE-process topology"):
         multihost.initialize(required=True)
     # With no runtime at all, the plain bring-up-failed error stands.
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False,
+                        raising=False)
     with pytest.raises(RuntimeError, match="bring-up failed"):
         multihost.initialize(required=True)
     # initialize() "succeeding" but finding no peers is the same hazard.

@@ -1,0 +1,101 @@
+"""Answer-position percentiles from the reference's committed responses.
+
+The r4 budget cut (SCALE.md "confidence decode budget") recorded the
+corpus MEDIAN answer word position (0-1) plus within-4/within-8 rates;
+ADVICE r5 (bench.py:380) pointed out the headline's conservatism claim
+("answer_step=3 is past the median") is median-only — a right-skewed
+answer-length distribution would refund less budget in production than
+the bench measures. This tool recomputes the full percentile set —
+median, MEAN, and P90 — from the same rows (the only real-model text in
+the zero-egress image: `model_comparison_results.csv` +
+`instruct_model_comparison_results.csv`), so SCALE.md can record the
+skew-robust numbers next to the median.
+
+Run where the reference data is mounted (tests/conftest.py
+REFERENCE_DATA, default /root/reference/data):
+
+    python tools/answer_position_stats.py [--data-dir DIR]
+
+Prints one markdown table row per corpus; paste into SCALE.md "answer
+position mean / p90". Without the mount it exits 2 with a pointer
+(the percentile BOUNDS derivable from the recorded within-4 rates are
+already in SCALE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+CSVS = ("model_comparison_results.csv",
+        "instruct_model_comparison_results.csv")
+# First standalone Yes/No (either case) — the same first-match rule the
+# sweep's binarizer applies to responses.
+ANSWER = re.compile(r"\b(yes|no)\b", re.IGNORECASE)
+
+
+def answer_word_pos(text: str):
+    """0-based word index of the first Yes/No token in ``text``, or None
+    when the response never answers (those rows are excluded, matching
+    the r4 'rows found' accounting)."""
+    if not isinstance(text, str):
+        return None
+    m = ANSWER.search(text)
+    if m is None:
+        return None
+    return len(text[:m.start()].split())
+
+
+def corpus_stats(csv_path: Path):
+    import numpy as np
+    import pandas as pd
+
+    df = pd.read_csv(csv_path)
+    pos = [p for p in (answer_word_pos(t) for t in df["model_output"])
+           if p is not None]
+    if not pos:
+        return None
+    a = np.asarray(pos)
+    return {
+        "rows": int(a.size),
+        "median": float(np.median(a)),
+        "mean": float(a.mean()),
+        "p90": float(np.percentile(a, 90)),
+        "within4": float((a <= 4).mean()),
+        "within8": float((a <= 8).mean()),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", type=Path,
+                    default=Path("/root/reference/data"),
+                    help="directory holding the reference CSVs "
+                         "(tests/conftest.py REFERENCE_DATA)")
+    args = ap.parse_args()
+    if not args.data_dir.is_dir():
+        print(f"reference data not mounted at {args.data_dir} — see "
+              "SCALE.md 'answer position mean / p90' for the bounds "
+              "derivable without it", file=sys.stderr)
+        sys.exit(2)
+
+    print("| corpus | rows | median | mean | p90 | within 4 | within 8 |")
+    print("|---|---|---|---|---|---|---|")
+    for name in CSVS:
+        path = args.data_dir / name
+        if not path.exists():
+            print(f"| {name} | MISSING | | | | | |")
+            continue
+        s = corpus_stats(path)
+        if s is None:
+            print(f"| {name} | 0 answered | | | | | |")
+            continue
+        print(f"| {name.removesuffix('_results.csv')} | {s['rows']} "
+              f"| {s['median']:.1f} | {s['mean']:.2f} | {s['p90']:.1f} "
+              f"| {s['within4']:.1%} | {s['within8']:.1%} |")
+
+
+if __name__ == "__main__":
+    main()

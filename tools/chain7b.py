@@ -161,9 +161,20 @@ def vocab_word_pieces(tokenizer, n: int, taken) -> list:
 CHAIN_RESPONSE_FORMAT = "Respond with either Yes or No only please"
 CHAIN_CONFIDENCE_FORMAT = "Give a confidence number from 0 to 100"
 
+# The chain's measured-response constants, owned HERE so bench.py derives
+# its printed "answer at decode step N" provenance and its per-row
+# expected-confidence assertion from the same source that programs the
+# weights — changing the answer step or value can then never silently
+# desync the headline JSON from what the chain actually emits (ADVICE r5,
+# bench.py:133). CHAIN_ANSWER_STEP is one-two steps PAST the
+# corpus-median answer word position of 0-1 (SCALE.md "confidence decode
+# budget"), i.e. a conservative stop point.
+CHAIN_ANSWER_STEP = 3
+CHAIN_CONFIDENCE_VALUE = 85
+
 
 def confidence_chain(fast, response_format: str, confidence_format: str,
-                     answer_step: int = 3):
+                     answer_step: int = CHAIN_ANSWER_STEP):
     """Transition table realizing the production sweep's two response
     shapes on tokenizer ``fast``: the binary prompt (ending in
     ``response_format``'s last token) answers " Yes."-style, and the
@@ -179,7 +190,7 @@ def confidence_chain(fast, response_format: str, confidence_format: str,
     conf_anchor = last_token_id(fast, confidence_format)
     bin_anchor = last_token_id(fast, response_format)
     eos = fast.eos_token_id
-    digit = single_token_id(fast, " 85")
+    digit = single_token_id(fast, f" {CHAIN_CONFIDENCE_VALUE}")
     dot = single_token_id(fast, ".")
     yes = single_token_id(fast, " Yes")
     # Preamble words (never digits): emitted before the integer so the
