@@ -1,0 +1,23 @@
+# Convenience targets. `make verify` is the pre-ship gate: it runs the
+# ROADMAP tier-1 suite and fails if the pass count drops below the
+# recorded floor (tools/check_tier1.py — the floor lives there).
+
+.PHONY: verify test bench install-hooks
+
+verify:
+	python tools/check_tier1.py
+
+# The raw tier-1 suite without the floor gate (interactive debugging).
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
+bench:
+	python bench.py
+
+# Run the tier-1 guard automatically before every `git push`.
+install-hooks:
+	printf '#!/bin/sh\nexec python tools/check_tier1.py\n' > .git/hooks/pre-push
+	chmod +x .git/hooks/pre-push
+	@echo "pre-push hook installed: tier-1 guard runs before every push"

@@ -10,7 +10,13 @@ experiment and analysis is one subcommand of ``python -m lir_tpu``:
   analyze      all statistical analyses over existing artifacts
   survey       human-survey pipeline -> every survey JSON artifact
   bench        the prompts/sec/chip benchmark (end-to-end sweep path)
+  precompile   warm the persistent compile cache for a model/ladder
   concat-shards  merge per-host .hostN sweep shards into the final artifact
+
+Every command runs with the persistent XLA compilation cache ON (compiled
+executables survive process restarts — utils/compile_cache.py; dir from
+--compile-cache-dir > $LIR_TPU_COMPILE_CACHE > ~/.cache/lir_tpu/xla;
+--no-compile-cache opts out).
 
 Model weights must be local checkpoint directories (zero egress); pass
 --checkpoints pointing at a root containing ``<org>__<name>`` dirs.
@@ -124,6 +130,33 @@ def _add_perturb(sub) -> None:
                         "recorded value — PARITY.md; this flag exists "
                         "for measurement, not correctness)")
     _add_multihost_flag(p)
+
+
+def _add_precompile(sub) -> None:
+    p = sub.add_parser(
+        "precompile",
+        help="warm the compile cache for a model/ladder ahead of serving: "
+             "AOT-compile every bucket-ladder executable (in parallel) "
+             "into the persistent cache, so the serving process — or "
+             "every restarted/autoscaled worker — deserializes instead "
+             "of compiling. Run once per host (caches are per-host).")
+    p.add_argument("--checkpoints", type=Path, required=True)
+    p.add_argument("--model", required=True)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--mesh", type=str, default=None)
+    p.add_argument("--param-cache", type=Path, default=None)
+    p.add_argument("--int8", action="store_true")
+    p.add_argument("--int8-dynamic", action="store_true")
+    p.add_argument("--kv-cache-int8", action="store_true")
+    p.add_argument("--sweep-decode-tokens", type=_positive_int, default=None)
+    p.add_argument("--sweep-confidence-tokens", type=_positive_int,
+                   default=None)
+    p.add_argument("--sfx-buckets", default="8,16",
+                   help="suffix bucket edges to warm per ladder edge "
+                        "(default 8,16 — the edges short sweep format "
+                        "instructions land in)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="parallel compile threads (0 = one per core)")
 
 
 def _add_rephrase(sub) -> None:
@@ -257,6 +290,45 @@ def cmd_perturb(args) -> None:
         subset_size=args.subset_size,
     )
     log.info("perturbation sweep wrote %d rows", len(rows))
+
+
+def cmd_precompile(args) -> None:
+    import time
+
+    from .config import RuntimeConfig
+    from .engine import compile_plan
+    from .models.factory import engine_factory
+
+    rt_kw = dict(batch_size=args.batch_size)
+    if args.sweep_decode_tokens is not None:
+        rt_kw["sweep_decode_tokens"] = args.sweep_decode_tokens
+    if args.sweep_confidence_tokens is not None:
+        rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
+    try:
+        sfx = tuple(int(b) for b in args.sfx_buckets.split(","))
+    except ValueError:
+        sfx = ()
+    if not sfx or any(b <= 0 for b in sfx):
+        raise SystemExit(f"--sfx-buckets {args.sfx_buckets!r} must be "
+                         "comma-separated positive ints (e.g. 8,16)")
+    factory = engine_factory(
+        args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
+        cache_root=args.param_cache, quantize_int8=args.int8,
+        int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8)
+    engine = factory(args.model)
+    specs = compile_plan.sweep_specs_for_ladder(engine, sfx_buckets=sfx)
+    t0 = time.perf_counter()
+    registry = compile_plan.precompile_async(engine, specs,
+                                             max_workers=args.workers)
+    ok = registry.wait()
+    stats = engine.compile_stats
+    log.info("precompiled %d/%d executables in %.1fs wall "
+             "(%.1fs compile total; manifest %s); per-shape: %s",
+             ok, len(specs), time.perf_counter() - t0, stats.compile_s,
+             registry.manifest_key,
+             {k: round(v, 2) for k, v in sorted(stats.shapes.items())})
+    if ok < len(specs):
+        sys.exit(1)
 
 
 def cmd_rephrase(args) -> None:
@@ -439,9 +511,17 @@ def cmd_bench(args) -> None:
 
 def main(argv: Optional[List[str]] = None) -> None:
     parser = argparse.ArgumentParser(prog="lir_tpu", description=__doc__)
+    parser.add_argument("--compile-cache-dir", type=Path, default=None,
+                        help="persistent XLA compile cache directory "
+                             "(default: $LIR_TPU_COMPILE_CACHE or "
+                             "~/.cache/lir_tpu/xla)")
+    parser.add_argument("--no-compile-cache", action="store_true",
+                        help="disable the persistent compile cache (every "
+                             "process then recompiles from scratch)")
     sub = parser.add_subparsers(dest="command", required=True)
     _add_sweep(sub)
     _add_perturb(sub)
+    _add_precompile(sub)
     _add_rephrase(sub)
     _add_analyze(sub)
     _add_repro(sub)
@@ -486,9 +566,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     if getattr(args, "int8_dynamic", False) and not getattr(args, "int8", False):
         parser.error("--int8-dynamic requires --int8 (it selects HOW int8 "
                      "matmuls run, not whether weights are quantized)")
+    if not args.no_compile_cache:
+        from .utils import compile_cache
+
+        compile_cache.enable_persistent_cache(args.compile_cache_dir)
     {
         "sweep": cmd_sweep,
         "perturb": cmd_perturb,
+        "precompile": cmd_precompile,
         "rephrase": cmd_rephrase,
         "analyze": cmd_analyze,
         "repro": cmd_repro,

@@ -100,6 +100,22 @@ class RuntimeConfig:
     sweep_group_min_prefix: int = 16
     sweep_group_min_cells: int = 4
 
+    # Compile plan (engine/compile_plan.py). With the ragged scheduler the
+    # whole sweep's dispatch shapes are known before the first dispatch,
+    # so every bucket executable is lowered + compiled CONCURRENTLY in
+    # background threads while the first bucket streams, and dispatches
+    # consume precompiled executables instead of paying trace-on-first-
+    # call serially inside the sweep. 0 workers = one per CPU core
+    # (capped at the shape count). OFF restores lazy per-shape jit.
+    aot_precompile: bool = True
+    precompile_workers: int = 0
+    # Persistent XLA compilation cache (utils/compile_cache.py): compiled
+    # executables survive process restarts, so a restarted worker / model
+    # swap / autoscale event deserializes instead of recompiling. None
+    # resolves $LIR_TPU_COMPILE_CACHE then ~/.cache/lir_tpu/xla; the CLI
+    # and bench enable it by default (--no-compile-cache opts out).
+    compile_cache_dir: Optional[str] = None
+
 
 @dataclasses.dataclass(frozen=True)
 class PerturbationConfig:

@@ -1,0 +1,371 @@
+"""Compile plan: parallel AOT precompilation of the sweep's executables.
+
+The ragged scheduler plans every dispatch shape up front, so nothing about
+compilation needs to be lazy: this module turns a dispatch plan into the
+exact set of (bucket, batch, suffix, variant) executables the sweep will
+call, lowers and compiles them CONCURRENTLY in background threads (XLA
+compilation releases the GIL) while the first bucket streams, and hands
+the engine an :class:`ExecutableRegistry` the dispatch path consults
+instead of triggering trace-on-first-call inside the timed loop.
+
+Three layers cooperate:
+
+1. **Persistent cache** (utils/compile_cache.py): every AOT compile goes
+   through JAX's disk cache, so a restarted worker deserializes instead
+   of recompiling — and because the lazy jit path hashes to the SAME HLO,
+   precompiled-vs-lazy results are not merely numerically equal but the
+   same executable.
+2. **This registry**: keyed by (engine manifest key, shape spec). The
+   manifest key covers model config, quant mode, mesh, and bucket ladder
+   (utils/compile_cache.manifest_key), so an executable compiled for one
+   configuration can never be looked up by another.
+3. **Observability** (utils/profiling.CompileStats): per-shape compile
+   seconds, registry hit / lazy-miss counts, persistent-cache hit/miss
+   deltas — logged per sweep and surfaced in bench.py's headline.
+
+The registry is an OPTIMIZATION: every lookup miss (unplanned shape, the
+runner's shared-prefix fallback path, a failed compile) falls through to
+the ordinary jitted call, which is always correct.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import get_logger
+from ..utils.profiling import CompileStats
+
+log = get_logger(__name__)
+
+TOPK = 20  # the D6 top-20 logprob map — fixed across every sweep caller
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeSpec:
+    """Everything that selects one compiled executable, shape-wise.
+
+    ``kind`` is "shared" (decode_fused_shared) or "grouped"
+    (decode_fused_grouped). ``batch`` is the PADDED member-row count the
+    runner will dispatch (shared: the padded batch; grouped: m_pad);
+    ``groups`` the padded prefill-row count (grouped only, else 0).
+    ``sfx_a``/``sfx_b`` are the right-pad suffix bucket edges (grouped
+    uses a single merged edge in ``sfx_a``). ``stops_armed`` records
+    whether the stop-mask arguments are arrays or None — that changes the
+    traced pytree, hence the executable. ``scratch`` selects the
+    donated-KV-cache variant (every dispatch after the first of a bucket
+    queue donates the previous cache — runner._CacheHandoff)."""
+
+    kind: str
+    bucket: int
+    batch: int
+    groups: int
+    sfx_a: int
+    sfx_b: int
+    new_tokens: int
+    conf_tokens: int
+    stops_armed: bool
+    scratch: bool
+
+    @property
+    def label(self) -> str:
+        sfx = (f"{self.sfx_a}+{self.sfx_b}" if self.kind == "shared"
+               else str(self.sfx_a))
+        var = "donated" if self.scratch else "fresh"
+        return (f"{self.kind}/b{self.bucket}x{self.batch}/sfx{sfx}"
+                f"/new{self.new_tokens}-{self.conf_tokens}/{var}")
+
+
+def shared_spec(bucket: int, batch: int, sfx_a: int, sfx_b: int,
+                new_tokens: int, conf_tokens: int, stops_armed: bool,
+                scratch: bool) -> ShapeSpec:
+    return ShapeSpec("shared", int(bucket), int(batch), 0, int(sfx_a),
+                     int(sfx_b), int(new_tokens), int(conf_tokens),
+                     bool(stops_armed), bool(scratch))
+
+
+def grouped_spec(bucket: int, groups: int, batch: int, sfx: int,
+                 max_new: int, stops_armed: bool,
+                 scratch: bool) -> ShapeSpec:
+    return ShapeSpec("grouped", int(bucket), int(batch), int(groups),
+                     int(sfx), 0, int(max_new), 0, bool(stops_armed),
+                     bool(scratch))
+
+
+def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
+               conf_tokens: int, stops_armed: bool) -> List[ShapeSpec]:
+    """Distinct executables a dispatch plan will call, in first-use order
+    (the precompile pool works the list front-to-back, so the first
+    bucket's executable compiles first and the dispatch loop rarely
+    waits). Mirrors the runner's padding/handoff behavior exactly:
+    the first dispatch of each handoff key runs the scratchless variant,
+    every consecutive same-key dispatch the donated one."""
+    specs: List[ShapeSpec] = []
+    seen = set()
+    prev_key: Optional[Tuple] = None
+    for d in dispatches:
+        g_pad, m_pad = d.padded_rows(batch_size)
+        if d.kind == "shared":
+            key = ("shared", d.bucket, m_pad, d.sfx_bucket_a,
+                   d.sfx_bucket_b, new_tokens, conf_tokens)
+            spec = shared_spec(d.bucket, m_pad, d.sfx_bucket_a,
+                               d.sfx_bucket_b, new_tokens, conf_tokens,
+                               stops_armed, scratch=(key == prev_key))
+        else:
+            sfx = max(d.sfx_bucket_a, d.sfx_bucket_b)
+            max_new = max(new_tokens, conf_tokens)
+            key = ("grouped", d.bucket, g_pad, m_pad, sfx, max_new)
+            spec = grouped_spec(d.bucket, g_pad, m_pad, sfx, max_new,
+                                stops_armed, scratch=(key == prev_key))
+        prev_key = key
+        if spec not in seen:
+            seen.add(spec)
+            specs.append(spec)
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Lowering: exact aval reconstruction of the runner's call sites
+# ---------------------------------------------------------------------------
+
+def _avals_shared(engine, spec: ShapeSpec):
+    """(args, kwargs) ShapeDtypeStructs matching runner.decode_fused_shared's
+    call into generate.greedy_decode_fused_shared — one canonical layout
+    shared with :func:`_registry_call` so lowering and dispatch can never
+    drift apart."""
+    import jax
+    import jax.numpy as jnp
+
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+    B = spec.batch
+    digit_ids, digit_vals = engine.digit_table
+    args = (engine.params, i32(B, spec.bucket), i32(B, spec.bucket),
+            i32(B, spec.sfx_a), i32(B, spec.sfx_a),
+            i32(B, spec.sfx_b), i32(B, spec.sfx_b),
+            i32(B), i32(B), i32(len(digit_ids)), f32(len(digit_vals)))
+    V = engine.cfg.vocab_size
+    kwargs = dict(
+        stop_mask_a=(i32(V) if spec.stops_armed else None),
+        stop_mask_b=(i32(V) if spec.stops_armed else None),
+        eos_id=(i32() if spec.stops_armed else None),
+    )
+    statics = dict(max_new_a=spec.new_tokens, max_new_b=spec.conf_tokens,
+                   topk=TOPK, prefill_fn=engine._prefill_fn,
+                   return_cache=True)
+    return args, kwargs, statics
+
+
+def _avals_grouped(engine, spec: ShapeSpec):
+    import jax
+    import jax.numpy as jnp
+
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+    G, M = spec.groups, spec.batch
+    digit_ids, digit_vals = engine.digit_table
+    args = (engine.params, i32(G, spec.bucket), i32(G, spec.bucket),
+            i32(M, spec.sfx_a), i32(M, spec.sfx_a), i32(M),
+            i32(M), i32(M), i32(len(digit_ids)), f32(len(digit_vals)))
+    V = engine.cfg.vocab_size
+    armed = spec.stops_armed
+    kwargs = dict(
+        stop_mask=(i32(V) if armed else None),
+        stop_mask2=(i32(V) if armed else None),
+        stop_sel=(jax.ShapeDtypeStruct((M,), jnp.bool_) if armed else None),
+        eos_id=(i32() if armed else None),
+    )
+    statics = dict(max_new=spec.new_tokens, topk=TOPK,
+                   prefill_fn=engine._prefill_fn, return_cache=True)
+    return args, kwargs, statics
+
+
+def _lower_compile(engine, spec: ShapeSpec):
+    """Lower + compile one spec; returns the jax Compiled executable.
+
+    The donated variant needs the KV-cache aval, which is exactly the
+    scratchless variant's returned cache — recovered via eval_shape
+    (tracing only, no device work)."""
+    from . import generate
+
+    if spec.kind == "shared":
+        fn = generate.greedy_decode_fused_shared
+        args, kwargs, statics = _avals_shared(engine, spec)
+    else:
+        fn = generate.greedy_decode_fused_grouped
+        args, kwargs, statics = _avals_grouped(engine, spec)
+    scratch = None
+    if spec.scratch:
+        out_shape = fn.eval_shape(args[0], engine.cfg, *args[1:],
+                                  scratch_cache=None, **kwargs, **statics)
+        scratch = out_shape[-1]  # the returned final cache's aval tree
+    lowered = fn.lower(args[0], engine.cfg, *args[1:],
+                       scratch_cache=scratch, **kwargs, **statics)
+    return lowered.compile()
+
+
+# Process-wide executable cache: the AOT analogue of jit's in-memory
+# executable cache. `.lower().compile()` bypasses the pjit cache, so
+# without this every sweep (bench warmup -> timed, back-to-back grids on
+# one engine, repeated tests) would re-pay its AOT compiles; with it, a
+# (manifest key, spec) pair compiles at most once per process. Safe by
+# keying: the manifest key covers model config, runtime knobs, quant
+# mode, mesh, ladder AND a params-aval fingerprint (runner), and the
+# compiled program binds only shapes/dtypes — params values are runtime
+# arguments, so engines sharing a key may share executables.
+_EXEC_CACHE: Dict[Tuple[str, ShapeSpec], Any] = {}
+_EXEC_CACHE_LOCK = threading.Lock()
+
+
+def exec_cache_clear() -> None:
+    """Drop the process-wide executable cache (tests; pairs with
+    jax.clear_caches() when simulating a cold restart in-process)."""
+    with _EXEC_CACHE_LOCK:
+        _EXEC_CACHE.clear()
+
+
+class ExecutableRegistry:
+    """Futures of compiled executables, keyed by ShapeSpec under one
+    engine manifest key.
+
+    ``get`` blocks only when the wanted shape is still compiling (the
+    pool works specs in dispatch order, so in the steady state the
+    executable is ready before its first dispatch); a missing or failed
+    spec returns None and the caller falls back to the lazily-jitted
+    path. Thread-safe: the sweep's dispatch thread reads while pool
+    threads write results."""
+
+    def __init__(self, manifest_key: str,
+                 stats: Optional[CompileStats] = None):
+        self.manifest_key = manifest_key
+        self.stats = stats if stats is not None else CompileStats()
+        self._futures: Dict[ShapeSpec, "Future"] = {}
+        self._lock = threading.Lock()
+        self._warned = False
+
+    def __len__(self) -> int:
+        return len(self._futures)
+
+    def submit(self, spec: ShapeSpec, engine, executor) -> None:
+        with self._lock:
+            if spec in self._futures:
+                return
+            cache_key = (self.manifest_key, spec)
+            with _EXEC_CACHE_LOCK:
+                cached = _EXEC_CACHE.get(cache_key)
+            if cached is not None:
+                fut: "Future" = Future()
+                fut.set_result(cached)
+                self._futures[spec] = fut
+                return
+
+            def task():
+                t0 = time.perf_counter()
+                compiled = _lower_compile(engine, spec)
+                self.stats.record_shape(spec.label,
+                                        time.perf_counter() - t0)
+                with _EXEC_CACHE_LOCK:
+                    _EXEC_CACHE[cache_key] = compiled
+                return compiled
+
+            self._futures[spec] = executor.submit(task)
+
+    def get(self, spec: ShapeSpec):
+        with self._lock:
+            fut = self._futures.get(spec)
+        if fut is None:
+            self.stats.lazy_misses += 1
+            return None
+        try:
+            compiled = fut.result()
+        except Exception as err:  # noqa: BLE001 — fall back to lazy jit
+            if not self._warned:
+                self._warned = True
+                log.warning("AOT compile failed for %s (%r); falling back "
+                            "to lazy jit for unserved shapes", spec.label,
+                            err)
+            self.stats.lazy_misses += 1
+            return None
+        self.stats.aot_hits += 1
+        return compiled
+
+    def wait(self) -> int:
+        """Block until every submitted compile finishes; returns the count
+        of successful executables (the precompile CLI's synchronous exit)."""
+        ok = 0
+        with self._lock:
+            futures = list(self._futures.items())
+        for spec, fut in futures:
+            try:
+                fut.result()
+                ok += 1
+            except Exception as err:  # noqa: BLE001
+                log.warning("precompile failed for %s: %r", spec.label, err)
+        return ok
+
+
+def precompile_async(engine, specs: Sequence[ShapeSpec],
+                     max_workers: int = 0) -> ExecutableRegistry:
+    """Kick off background compilation of every spec (dispatch order) and
+    return the registry immediately — the sweep's first dispatches stream
+    while later buckets' executables compile concurrently. The pool's
+    threads outlive this call; registry futures own the results."""
+    stats = getattr(engine, "compile_stats", None) or CompileStats()
+    registry = ExecutableRegistry(engine.cache_manifest_key, stats)
+    if not specs:
+        return registry
+    from ..utils import compile_cache
+
+    compile_cache.write_manifest(engine.cache_manifest_key, {
+        "model": engine.cfg, "runtime": engine.rt,
+        "buckets": engine.buckets,
+        "quant": compile_cache.quant_mode(engine.params),
+        "shapes": [s.label for s in specs]})
+    import os
+
+    workers = max_workers or min(len(specs), max(2, (os.cpu_count() or 4)))
+    executor = ThreadPoolExecutor(max_workers=workers,
+                                  thread_name_prefix="compile-plan")
+    for spec in specs:
+        registry.submit(spec, engine, executor)
+    executor.shutdown(wait=False)
+    return registry
+
+
+def registry_call(compiled, args: Tuple, kwargs: Dict[str, Any],
+                  scratch_cache):
+    """Invoke a registry executable with the canonical argument layout.
+
+    AOT-compiled functions take only the DYNAMIC arguments (static
+    cfg/budgets/flags were baked in at lower time), with the same
+    positional/keyword split the lowering used — args positional minus
+    cfg, stop args + scratch_cache by keyword."""
+    return compiled(*args, scratch_cache=scratch_cache, **kwargs)
+
+
+def sweep_specs_for_ladder(engine, sfx_buckets: Sequence[int] = (8, 16),
+                           ) -> List[ShapeSpec]:
+    """The warm-ahead-of-serving spec set (`lir_tpu precompile`): for every
+    bucket-ladder edge x candidate suffix edge, both handoff variants of
+    the shared-prefix executable at the engine's configured batch and
+    sweep budgets. Grouped-dispatch shapes depend on the realized prefix
+    groups, so serving still compiles those lazily (into the persistent
+    cache) the first time a grid forms them."""
+    rt = engine.rt
+    new_tokens = (rt.max_new_tokens if rt.sweep_full_completions
+                  else min(rt.sweep_decode_tokens, rt.max_new_tokens))
+    conf_tokens = (rt.max_new_tokens if rt.sweep_full_completions
+                   else min(rt.sweep_confidence_tokens, rt.max_new_tokens))
+    stops_armed = (rt.sweep_early_stop and not rt.sweep_full_completions
+                   and engine.digit_stop_mask is not None)
+    specs = []
+    for bucket in engine.buckets:
+        for sfx in sfx_buckets:
+            for scratch in (False, True):
+                specs.append(shared_spec(
+                    bucket, rt.batch_size, sfx, sfx, new_tokens,
+                    conf_tokens, stops_armed, scratch))
+    return specs

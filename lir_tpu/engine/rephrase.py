@@ -78,15 +78,44 @@ def generate_rephrasings(
     model. Sessions are batched — the reference's 100 sequential API calls
     per prompt become ceil(100/B) batched TPU sampling calls.
     """
+    # Two-phase closures (rephraser_from_engine) pipeline the loop: batch
+    # N+1 is DISPATCHED before batch N's ids are fetched, so the host-side
+    # device_get + text decode of batch N overlaps the device's sampling
+    # of batch N+1 instead of serializing with it (jax dispatch is async;
+    # the old loop blocked on np.asarray(jax.device_get(gen)) each batch).
+    # Plain callables keep the synchronous path.
+    dispatch = getattr(generate_text, "dispatch", None)
+    fetch = getattr(generate_text, "fetch", None)
+    pipelined = dispatch is not None and fetch is not None
+
     results: List[Tuple[PromptParts, List[str]]] = []
     for prompt in prompts:
         request = rephrase_request(prompt.main, n=rephrasings_per_session)
         all_rephrasings: List[str] = []
         remaining = sessions_per_prompt
+        pending = None  # in-flight device handle (pipelined mode)
+
+        def drain(handle) -> None:
+            try:
+                for text in fetch(handle):
+                    all_rephrasings.extend(parse_numbered_rephrasings(text))
+            except Exception as exc:  # session-skip parity (:841-843)
+                log.warning("rephrase batch failed (%s); skipping", exc)
+
         while remaining > 0:
             n = min(sessions_per_batch, remaining)
             remaining -= n
             key, sub = jax.random.split(key)
+            if pipelined:
+                try:
+                    handle = dispatch([request] * n, sub)
+                except Exception as exc:
+                    log.warning("rephrase batch failed (%s); skipping", exc)
+                    handle = None
+                if pending is not None:
+                    drain(pending)
+                pending = handle
+                continue
             try:
                 texts = generate_text([request] * n, sub)
             except Exception as exc:  # session-skip parity (:841-843)
@@ -94,6 +123,8 @@ def generate_rephrasings(
                 continue
             for text in texts:
                 all_rephrasings.extend(parse_numbered_rephrasings(text))
+        if pending is not None:
+            drain(pending)
         log.info(
             "Generated %d rephrasings for prompt %r",
             len(all_rephrasings), prompt.main[:50],
@@ -156,17 +187,25 @@ def rephraser_from_engine(engine, temperature: float = 0.9,
 
     Uses the sampling decoder (temperature 0.9 parity with
     perturb_prompts.py:802) over the engine's params/config/tokenizer.
+
+    The closure carries ``dispatch``/``fetch`` attributes splitting the
+    call at its sync point: ``dispatch`` tokenizes and launches the
+    sampling decode (jax dispatch is async — it returns a device handle
+    immediately), ``fetch`` blocks on ``device_get`` and decodes the
+    texts. generate_rephrasings uses the pair to overlap batch N's host
+    decode with batch N+1's device sampling; calling ``generate_text``
+    directly remains the synchronous compose of the two.
     """
     from . import generate as gen_mod
     from . import tokens as tok
     import jax.numpy as jnp
 
-    def generate_text(texts: Sequence[str], key: jax.Array) -> List[str]:
+    def dispatch(texts: Sequence[str], key: jax.Array) -> jax.Array:
         ids_list = [engine.tokenizer(t).input_ids for t in texts]
         bucket = tok.pick_bucket([len(i) for i in ids_list], engine.buckets)
         toks_arr, mask = tok.left_pad_ids(
             ids_list, bucket, tok.pad_token_id(engine.tokenizer))
-        gen = gen_mod.sample_decode(
+        return gen_mod.sample_decode(
             engine.params, engine.cfg, jnp.asarray(toks_arr),
             jnp.asarray(mask), key, temperature=temperature,
             max_new_tokens=max_new_tokens,
@@ -175,7 +214,14 @@ def rephraser_from_engine(engine, temperature: float = 0.9,
             # refunding post-completion decode steps.
             eos_id=(None if engine.eos_id is None
                     else jnp.int32(engine.eos_id)))
+
+    def fetch(gen: jax.Array) -> List[str]:
         gen_host = np.asarray(jax.device_get(gen))
         return [engine.decode_completion(row) for row in gen_host]
 
+    def generate_text(texts: Sequence[str], key: jax.Array) -> List[str]:
+        return fetch(dispatch(texts, key))
+
+    generate_text.dispatch = dispatch
+    generate_text.fetch = fetch
     return generate_text

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import RuntimeConfig
-from . import generate, score, tokens as tok
+from ..utils.profiling import CompileStats
+from . import compile_plan, generate, score, tokens as tok
 
 
 def _tail_batch(n: int, cap: int) -> int:
@@ -175,6 +176,47 @@ class ScoringEngine:
         # sweep.run_perturbation_sweep, read by bench.py.
         self._handoff = _CacheHandoff()
         self.occupancy = None
+        # Compile plan (engine/compile_plan.py): the sweep precompiles its
+        # planned shapes into this registry; the decode entry points below
+        # consult it and fall back to lazy jit on any miss. Stats record
+        # per-shape compile seconds + registry/persistent-cache hit rates.
+        self.compile_stats = CompileStats()
+        self.exec_registry = None
+        self._seq_mesh_note = (
+            None if seq_mesh is None
+            else (repr(getattr(seq_mesh, "shape", seq_mesh)), seq_impl))
+        self._manifest_key: Optional[str] = None
+
+    @property
+    def cache_manifest_key(self) -> str:
+        """Cache key covering model config, runtime knobs, quant mode,
+        mesh, and the bucket ladder (utils/compile_cache.manifest_key) —
+        the namespace under which this engine's executables are planned,
+        registered, and recorded in the on-disk manifest. Two engines
+        differing in ANY of those inputs get different keys, so a
+        registry or warmed cache can never serve a stale configuration."""
+        if self._manifest_key is None:
+            import jax as _jax
+
+            from ..utils import compile_cache
+
+            # Params fingerprint: shapes/dtypes/shardings (never values —
+            # executables bind avals only, so same-shape engines with
+            # different weights may share executables; differently
+            # sharded or dtyped params may not).
+            leaves = _jax.tree.leaves(self.params)
+            params_fp = [(tuple(getattr(l, "shape", ())),
+                          str(getattr(l, "dtype", type(l).__name__)),
+                          str(getattr(l, "sharding", None)))
+                         for l in leaves]
+            self._manifest_key = compile_cache.manifest_key(
+                self.cfg, self.rt, buckets=self.buckets,
+                quant=compile_cache.quant_mode(self.params),
+                mesh={"devices": _jax.device_count(),
+                      "platform": _jax.default_backend(),
+                      "seq_mesh": self._seq_mesh_note,
+                      "params": params_fp})
+        return self._manifest_key
 
     @property
     def digit_stop_mask(self) -> Optional[jax.Array]:
@@ -394,16 +436,29 @@ class ScoringEngine:
         if reuse_cache:
             key = ("shared", bucket, len(bin_ids), ba, bb, new_tokens,
                    conf_tokens, early_stop)
-            fused, cfused, cache = generate.greedy_decode_fused_shared(
-                self.params, self.cfg, jnp.asarray(prefix),
-                jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
-                jnp.asarray(sfx_a_mask), jnp.asarray(sfx_b),
-                jnp.asarray(sfx_b_mask),
-                jnp.asarray(yes_ids, jnp.int32),
-                jnp.asarray(no_ids, jnp.int32),
-                jnp.asarray(digit_ids), jnp.asarray(digit_vals),
-                return_cache=True, scratch_cache=self._handoff.take(key),
-                **kwargs)
+            scratch = self._handoff.take(key)
+            dyn_args = (self.params, jnp.asarray(prefix),
+                        jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
+                        jnp.asarray(sfx_a_mask), jnp.asarray(sfx_b),
+                        jnp.asarray(sfx_b_mask),
+                        jnp.asarray(yes_ids, jnp.int32),
+                        jnp.asarray(no_ids, jnp.int32),
+                        jnp.asarray(digit_ids), jnp.asarray(digit_vals))
+            exe = None
+            if self.exec_registry is not None:
+                exe = self.exec_registry.get(compile_plan.shared_spec(
+                    bucket, len(bin_ids), ba, bb, new_tokens, conf_tokens,
+                    stops_armed=stop_mask is not None,
+                    scratch=scratch is not None))
+            if exe is not None:
+                stop_kwargs = {k: kwargs[k] for k in
+                               ("stop_mask_a", "stop_mask_b", "eos_id")}
+                fused, cfused, cache = compile_plan.registry_call(
+                    exe, dyn_args, stop_kwargs, scratch)
+            else:
+                fused, cfused, cache = generate.greedy_decode_fused_shared(
+                    dyn_args[0], self.cfg, *dyn_args[1:],
+                    return_cache=True, scratch_cache=scratch, **kwargs)
             self._handoff.put(key, cache)
             return fused, cfused
         return generate.greedy_decode_fused_shared(
@@ -484,9 +539,23 @@ class ScoringEngine:
         if reuse_cache:
             key = ("grouped", bucket, g_pad, m_pad, sfx_bucket,
                    kwargs["max_new"], early_stop)
-            out, cache = generate.greedy_decode_fused_grouped(
-                *args, return_cache=True,
-                scratch_cache=self._handoff.take(key), **kwargs)
+            scratch = self._handoff.take(key)
+            exe = None
+            if self.exec_registry is not None:
+                exe = self.exec_registry.get(compile_plan.grouped_spec(
+                    bucket, g_pad, m_pad, sfx_bucket, kwargs["max_new"],
+                    stops_armed=stop_mask is not None,
+                    scratch=scratch is not None))
+            if exe is not None:
+                stop_kwargs = {k: kwargs[k] for k in
+                               ("stop_mask", "stop_mask2", "stop_sel",
+                                "eos_id")}
+                out, cache = compile_plan.registry_call(
+                    exe, (args[0],) + args[2:], stop_kwargs, scratch)
+            else:
+                out, cache = generate.greedy_decode_fused_grouped(
+                    *args, return_cache=True, scratch_cache=scratch,
+                    **kwargs)
             self._handoff.put(key, cache)
         else:
             out = generate.greedy_decode_fused_grouped(*args, **kwargs)

@@ -102,6 +102,20 @@ class Dispatch:
     def cells(self) -> List[Any]:
         return [it.cell for it in self.items]
 
+    def padded_rows(self, batch_size: int) -> Tuple[int, int]:
+        """(prefill rows, member rows) after the runner's power-of-two
+        tail padding — the EXACT shapes the engine will dispatch, so the
+        compile plan (engine/compile_plan.py) can lower every executable
+        before the first dispatch. Shared dispatches prefill and decode
+        the same padded batch; grouped dispatches prefill one row per
+        group and decode two member rows ([bin, conf]) per cell."""
+        n = len(self.items)
+        if self.kind == "shared":
+            b = batch_size if n == batch_size else _tail_batch(n, batch_size)
+            return b, b
+        return (_tail_batch(len(self.groups), batch_size),
+                _tail_batch(2 * n, 2 * batch_size))
+
 
 def build_items(bin_ids: Sequence[Sequence[int]],
                 conf_ids: Sequence[Sequence[int]],

@@ -27,6 +27,7 @@ from ..data.prompts import LegalPrompt
 from ..utils.logging import get_logger
 from ..utils.manifest import SweepManifest
 from ..utils.profiling import OccupancyStats
+from . import compile_plan
 from . import generate
 from . import grid as grid_mod
 from . import scheduler as sched_mod
@@ -185,9 +186,13 @@ def run_perturbation_sweep(
                 _flush(pending_rows, results_path, manifest)
                 pending_rows = []
     else:
+        engine.compile_stats.snapshot_persistent()
         _run_pipelined(engine, model_name, todo, target_ids, results_path,
                        manifest, checkpoint_every, new_tokens, conf_tokens,
                        rows, pending_rows)
+        engine.compile_stats.finish_persistent()
+        log.info("compile plan: %s",
+                 json.dumps(engine.compile_stats.summary()))
 
     if pending_rows:
         _flush(pending_rows, results_path, manifest)
@@ -330,6 +335,20 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
         from .runner import _CacheHandoff
 
         engine._handoff = _CacheHandoff()
+        # Compile plan: the schedule fixes every dispatch shape, so lower
+        # + compile ALL bucket executables in background threads while
+        # the first bucket streams — the dispatch loop then consumes
+        # precompiled executables (runner.exec_registry) instead of
+        # paying trace-on-first-call serially inside the timed loop.
+        engine.exec_registry = None
+        if engine.rt.aot_precompile:
+            specs = compile_plan.plan_specs(
+                dispatches, B, new_tokens, conf_tokens, stop_armed)
+            engine.exec_registry = compile_plan.precompile_async(
+                engine, specs, max_workers=engine.rt.precompile_workers)
+            log.info("compile plan: precompiling %d executable shapes "
+                     "in the background (manifest %s)", len(specs),
+                     engine.exec_registry.manifest_key)
 
     def _drain(batch, fused, res, cfused):
         res_h, lp_vals, lp_ids, gen_host = jax.device_get(

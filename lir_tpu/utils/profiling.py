@@ -210,6 +210,80 @@ class OccupancyStats:
         return out
 
 
+@dataclasses.dataclass
+class CompileStats:
+    """Compile-plan accounting (engine/compile_plan.py): where cold-start
+    time goes, and whether dispatches ran precompiled or traced lazily.
+
+    - ``shapes``: per-shape AOT compile seconds, keyed by the spec label
+      (kind/bucket/batch/suffixes/variant) — the itemized cold-start bill.
+    - ``aot_hits``: dispatches served by a registry executable;
+      ``lazy_misses``: dispatches that fell back to trace-on-first-call
+      (registry miss, failed compile, or precompile disabled).
+    - ``persistent_requests/hits``: XLA persistent-cache counters for the
+      window between ``snapshot_persistent()`` and ``finish_persistent()``
+      (the jax.monitoring events are process-global; the snapshot diff
+      scopes them to one sweep).
+    - ``cold_start_s`` / ``warm_start_s``: end-to-end warmup wall time with
+      a cold vs warm persistent cache — set by the bench, reported in its
+      headline JSON.
+    """
+
+    shapes: Dict[str, float] = dataclasses.field(default_factory=dict)
+    aot_hits: int = 0
+    lazy_misses: int = 0
+    persistent_requests: int = 0
+    persistent_hits: int = 0
+    cold_start_s: Optional[float] = None
+    warm_start_s: Optional[float] = None
+    _persistent_base: Optional[Dict[str, int]] = None
+
+    def record_shape(self, label: str, seconds: float) -> None:
+        self.shapes[label] = round(
+            self.shapes.get(label, 0.0) + seconds, 4)
+
+    @property
+    def compile_s(self) -> float:
+        """Total AOT compile seconds (sum over shapes; parallel compiles
+        overlap on the wall clock, so this bounds — not equals — the
+        cold-start contribution)."""
+        return round(sum(self.shapes.values()), 4)
+
+    def snapshot_persistent(self) -> None:
+        from . import compile_cache
+
+        self._persistent_base = compile_cache.persistent_cache_counters()
+
+    def finish_persistent(self) -> None:
+        from . import compile_cache
+
+        now = compile_cache.persistent_cache_counters()
+        base = self._persistent_base or {"requests": 0, "hits": 0}
+        self.persistent_requests += now["requests"] - base["requests"]
+        self.persistent_hits += now["hits"] - base["hits"]
+        self._persistent_base = now
+
+    def summary(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "aot_shapes": len(self.shapes),
+            "aot_compile_s": self.compile_s,
+            "aot_hits": self.aot_hits,
+            "lazy_misses": self.lazy_misses,
+            "persistent_cache_requests": self.persistent_requests,
+            "persistent_cache_hits": self.persistent_hits,
+            "persistent_cache_misses": (self.persistent_requests
+                                        - self.persistent_hits),
+        }
+        if self.shapes:
+            out["per_shape_compile_s"] = {
+                k: round(v, 3) for k, v in sorted(self.shapes.items())}
+        if self.cold_start_s is not None:
+            out["cold_start_s"] = round(self.cold_start_s, 3)
+        if self.warm_start_s is not None:
+            out["warm_start_s"] = round(self.warm_start_s, 3)
+        return out
+
+
 # Published peak dense-matmul throughput per chip (bf16 FLOPS). Weight-only
 # int8 still computes in bf16 on the MXU, so bf16 peak is the MFU denominator
 # there; dynamic int8 (s8 x s8 -> s32 dots) gets 2x this on every listed
