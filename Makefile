@@ -2,7 +2,7 @@
 # ROADMAP tier-1 suite and fails if the pass count drops below the
 # recorded floor (tools/check_tier1.py — the floor lives there).
 
-.PHONY: verify test bench install-hooks
+.PHONY: verify test bench serve-smoke install-hooks
 
 verify:
 	python tools/check_tier1.py
@@ -15,6 +15,12 @@ test:
 
 bench:
 	python bench.py
+
+# Online-serving smoke: boot the server on the fake backend, push 50
+# requests (incl. duplicate re-asks), assert zero sheds + nonzero dedup
+# hit rate + all-ok (tools/serve_smoke.py).
+serve-smoke:
+	JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
 # Run the tier-1 guard automatically before every `git push`.
 install-hooks:

@@ -24,9 +24,10 @@ import re
 import time
 from typing import Dict, Iterable, List, Optional, Protocol, Sequence, Tuple
 
-from ..config import Config
+from ..config import Config, RetryConfig
 from ..engine.grid import GridCell
 from ..utils.logging import get_logger
+from ..utils.retry import retry_with_exponential_backoff
 
 log = get_logger(__name__)
 
@@ -174,18 +175,32 @@ def run_batch(
     poll_interval: float = POLL_INTERVAL_S,
     max_wait: float = 24 * 3600,
     sleep=time.sleep,
+    retry: Optional[RetryConfig] = None,
 ) -> Optional[List[Dict[str, object]]]:
     """Upload -> create -> poll -> download one batch. Returns decoded
     result objects, or None on a terminal failure (the caller skips the
-    model, perturb_prompts.py:324-328)."""
+    model, perturb_prompts.py:324-328).
+
+    Every remote call runs under ``retry`` (utils/retry.py; default: the
+    reference's 10-retry/60 s policy capped to this call's ``max_wait`` so
+    retries can never outlive the batch window) — the reference wraps its
+    client calls in the same exponential-backoff helper."""
+    retry = retry if retry is not None else RetryConfig(max_elapsed=max_wait)
+
+    def _call(op, what):
+        return retry_with_exponential_backoff(
+            op, (Exception,), retry, sleep=sleep,
+            log=lambda msg: log.warning("%s: %s", what, msg))
+
     lines = [json.dumps(r) for r in requests]
-    file_id = transport.upload_jsonl(lines)
-    batch_id = transport.create_batch(file_id)
+    file_id = _call(lambda: transport.upload_jsonl(lines), "upload_jsonl")
+    batch_id = _call(lambda: transport.create_batch(file_id), "create_batch")
     log.info("batch %s created (%d requests)", batch_id, len(requests))
 
     waited = 0.0
     while waited < max_wait:
-        status = transport.batch_status(batch_id)
+        status = _call(lambda: transport.batch_status(batch_id),
+                       "batch_status")
         if status == "completed":
             break
         if status in TERMINAL_FAILURES:
@@ -197,10 +212,13 @@ def run_batch(
         log.error("batch %s timed out after %.0fs", batch_id, max_wait)
         return None
 
-    out_file = transport.batch_output_file(batch_id)
+    out_file = _call(lambda: transport.batch_output_file(batch_id),
+                     "batch_output_file")
     if out_file is None:
         return None
-    return [json.loads(line) for line in transport.download_jsonl(out_file)]
+    return [json.loads(line)
+            for line in _call(lambda: transport.download_jsonl(out_file),
+                              "download_jsonl")]
 
 
 # ---------------------------------------------------------------------------

@@ -6,6 +6,7 @@ experiment and analysis is one subcommand of ``python -m lir_tpu``:
 
   sweep        word-meaning model-comparison sweep -> D1/D2 CSVs
   perturb      perturbation grid sweep (with resume) -> D6 workbook
+  serve        online scoring service (continuous batching, JSONL io)
   rephrase     generate/refresh perturbations.json with a local model
   analyze      all statistical analyses over existing artifacts
   survey       human-survey pipeline -> every survey JSON artifact
@@ -159,6 +160,49 @@ def _add_precompile(sub) -> None:
                    help="parallel compile threads (0 = one per core)")
 
 
+def _add_serve(sub) -> None:
+    p = sub.add_parser(
+        "serve",
+        help="online scoring service: continuous-batching request queue "
+             "over the bucket ladder (lir_tpu/serve). Reads JSONL "
+             "requests from --requests (default stdin), writes one JSONL "
+             "result per line to stdout, ServeStats to stderr on exit. "
+             "Request lines: {\"id\", \"binary_prompt\", "
+             "\"confidence_prompt\"} or {\"prompt\"} with optional "
+             "\"response_format\"/\"confidence_format\", plus optional "
+             "\"targets\": [t1, t2], \"class\", \"deadline_s\"")
+    p.add_argument("--checkpoints", type=Path, required=True)
+    p.add_argument("--model", required=True)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--mesh", type=str, default=None)
+    p.add_argument("--param-cache", type=Path, default=None)
+    p.add_argument("--int8", action="store_true")
+    p.add_argument("--int8-dynamic", action="store_true")
+    p.add_argument("--kv-cache-int8", action="store_true")
+    p.add_argument("--sweep-decode-tokens", type=_positive_int, default=None)
+    p.add_argument("--sweep-confidence-tokens", type=_positive_int,
+                   default=None)
+    p.add_argument("--requests", type=str, default="-",
+                   help="JSONL request file, or '-' for stdin (default)")
+    p.add_argument("--queue-depth", type=int, default=256,
+                   help="admission-control bound; a submit into a full "
+                        "queue sheds the least-urgent request")
+    p.add_argument("--linger-ms", type=float, default=20.0,
+                   help="continuous-batching window: a partial bucket "
+                        "dispatches once its oldest request waited this "
+                        "long")
+    p.add_argument("--cache-entries", type=int, default=4096,
+                   help="content-addressed result cache capacity "
+                        "(0 disables dedup)")
+    p.add_argument("--deadline", action="append", default=None,
+                   metavar="CLASS=SECONDS",
+                   help="deadline class override, repeatable (default: "
+                        "interactive=10, batch=300)")
+    p.add_argument("--no-precompile", action="store_true",
+                   help="skip the boot AOT precompile of every "
+                        "(ladder, suffix, batch) executable")
+
+
 def _add_rephrase(sub) -> None:
     p = sub.add_parser("rephrase", help="generate perturbations.json locally")
     p.add_argument("--checkpoints", type=Path, required=True)
@@ -290,6 +334,79 @@ def cmd_perturb(args) -> None:
         subset_size=args.subset_size,
     )
     log.info("perturbation sweep wrote %d rows", len(rows))
+
+
+def cmd_serve(args) -> None:
+    import json
+
+    from .config import RuntimeConfig, ServeConfig
+    from .data.prompts import LEGAL_PROMPTS
+    from .models.factory import engine_factory
+    from .serve import ScoringServer, ServeRequest
+
+    rt_kw = dict(batch_size=args.batch_size)
+    if args.sweep_decode_tokens is not None:
+        rt_kw["sweep_decode_tokens"] = args.sweep_decode_tokens
+    if args.sweep_confidence_tokens is not None:
+        rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
+    classes = dict(ServeConfig().classes)
+    for spec in args.deadline or ():
+        name, sep, secs = spec.partition("=")
+        try:
+            classes[name] = float(secs)
+        except ValueError:
+            sep = ""
+        if not sep or not name:
+            raise SystemExit(f"--deadline {spec!r} must be CLASS=SECONDS")
+    serve_cfg = ServeConfig(
+        queue_depth=args.queue_depth, classes=tuple(classes.items()),
+        linger_s=args.linger_ms / 1000.0,
+        cache_entries=args.cache_entries)
+    factory = engine_factory(
+        args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
+        cache_root=args.param_cache, quantize_int8=args.int8,
+        int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8)
+    engine = factory(args.model)
+    server = ScoringServer(engine, args.model, serve_cfg,
+                           precompile=not args.no_precompile).start()
+
+    # Default formats: the canonical legal-prompt pair, so a bare
+    # {"prompt": ...} line scores exactly like a sweep cell.
+    default_rf = LEGAL_PROMPTS[0].response_format
+    default_cf = LEGAL_PROMPTS[0].confidence_format
+    stream = (sys.stdin if args.requests == "-"
+              else open(args.requests, encoding="utf-8"))
+    futures = []
+    try:
+        for i, line in enumerate(stream):
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            prompt = obj.get("prompt")
+            req = ServeRequest(
+                binary_prompt=obj.get(
+                    "binary_prompt",
+                    f"{prompt} {obj.get('response_format', default_rf)}"),
+                confidence_prompt=obj.get(
+                    "confidence_prompt",
+                    f"{prompt} {obj.get('confidence_format', default_cf)}"),
+                targets=tuple(obj.get("targets", ("Yes", "No"))),
+                klass=obj.get("class", serve_cfg.default_class),
+                deadline_s=obj.get("deadline_s"),
+                request_id=str(obj.get("id", i)))
+            futures.append(server.submit(req))
+    finally:
+        if stream is not sys.stdin:
+            stream.close()
+    for fut in futures:
+        r = fut.result()
+        print(json.dumps({k: v for k, v in vars(r).items()
+                          if not k.startswith("_")}), flush=True)
+    server.stop()
+    log.info("serve stats: %s", json.dumps(server.stats.summary()))
+    if not server.healthy:
+        sys.exit(1)
 
 
 def cmd_precompile(args) -> None:
@@ -521,6 +638,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     sub = parser.add_subparsers(dest="command", required=True)
     _add_sweep(sub)
     _add_perturb(sub)
+    _add_serve(sub)
     _add_precompile(sub)
     _add_rephrase(sub)
     _add_analyze(sub)
@@ -573,6 +691,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     {
         "sweep": cmd_sweep,
         "perturb": cmd_perturb,
+        "serve": cmd_serve,
         "precompile": cmd_precompile,
         "rephrase": cmd_rephrase,
         "analyze": cmd_analyze,

@@ -146,13 +146,81 @@ class StatsConfig:
 
 @dataclasses.dataclass(frozen=True)
 class RetryConfig:
-    """Exponential-backoff policy (reference: perturb_prompts.py:72-106)."""
+    """Exponential-backoff policy (reference: perturb_prompts.py:72-106).
+
+    ``full_jitter=True`` switches the multiplicative 0.8-1.2 jitter to
+    AWS-style full jitter (wait ~ U[0, delay]) — the right mode when many
+    clients retry against one contended resource (the serve supervisor's
+    device retries). ``max_elapsed`` caps the TOTAL time spent inside the
+    retry loop (attempts + sleeps): once another sleep would cross it, the
+    last failure is re-raised instead — so a retried call can never
+    overrun its caller's deadline. None keeps the reference's unbounded
+    behavior (the API backend's 24 h batch windows don't want a cap).
+    """
 
     max_retries: int = 10
     initial_delay: float = 60.0
     max_delay: float = 300.0
     backoff_factor: float = 1.5
     jitter: Tuple[float, float] = (0.8, 1.2)
+    full_jitter: bool = False
+    max_elapsed: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Online serving layer knobs (lir_tpu/serve).
+
+    - ``queue_depth``: admission-control bound. A submit into a full queue
+      either sheds the incoming request or (deadline-aware) evicts the
+      queued request with the LATEST deadline when the newcomer is more
+      urgent — bounded memory and bounded worst-case queueing delay.
+    - ``classes``: (name, deadline seconds) pairs. A request names its
+      class; its deadline defaults to the class deadline unless it carries
+      an explicit ``deadline_s``. Unknown classes fall back to
+      ``default_class``.
+    - ``linger_s``: continuous-batching window — a partially filled bucket
+      dispatches once its oldest request has waited this long (a full
+      batch dispatches immediately).
+    - ``cache_entries``: content-addressed result-cache capacity (LRU).
+      0 disables dedup.
+    - ``max_consecutive_failures``: after this many back-to-back dispatch
+      failures (each already retried per ``retry``) the server drains the
+      queue with error results and flips its health flag — a supervisor
+      (k8s, systemd) restarts it rather than letting it eat the queue.
+    - ``retry``: device-dispatch retry policy. Short, full-jitter, and
+      elapsed-capped — a transient XLA/runtime hiccup is retried inside
+      the request deadlines; a persistent fault fails fast into the
+      health-flag path.
+    """
+
+    queue_depth: int = 256
+    classes: Tuple[Tuple[str, float], ...] = (
+        ("interactive", 10.0), ("batch", 300.0))
+    default_class: str = "batch"
+    linger_s: float = 0.02
+    # Pad every dispatch to the FULL configured batch instead of the
+    # offline sweep's power-of-two tail: serving wants shape stability
+    # more than tail FLOP savings — one executable per (bucket, suffix)
+    # pair means no mid-traffic compiles, and degenerate tiny-batch
+    # programs are avoided (measured on the CPU smoke: a warm batch-1
+    # shared decode runs ~2.5x SLOWER than the warm batch-4 program).
+    # The batcher's online slot-refill promotion (serve/batcher.py)
+    # keeps the padding waste bounded the same way the offline
+    # planner's does.
+    pad_full: bool = True
+    cache_entries: int = 4096
+    max_consecutive_failures: int = 3
+    retry: RetryConfig = dataclasses.field(default_factory=lambda: RetryConfig(
+        max_retries=2, initial_delay=0.25, max_delay=2.0,
+        backoff_factor=2.0, full_jitter=True, max_elapsed=8.0))
+
+    def deadline_for(self, klass: str) -> float:
+        table = dict(self.classes)
+        if klass in table:
+            return table[klass]
+        return table.get(self.default_class,
+                         max(table.values()) if table else 300.0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -165,6 +233,7 @@ class Config:
     perturbation: PerturbationConfig = dataclasses.field(default_factory=PerturbationConfig)
     stats: StatsConfig = dataclasses.field(default_factory=StatsConfig)
     retry: RetryConfig = dataclasses.field(default_factory=RetryConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
     # Paths: everything under one results root; no personal gdrive paths.
     results_dir: Path = Path("results")

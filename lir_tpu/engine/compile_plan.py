@@ -347,13 +347,20 @@ def registry_call(compiled, args: Tuple, kwargs: Dict[str, Any],
 
 
 def sweep_specs_for_ladder(engine, sfx_buckets: Sequence[int] = (8, 16),
+                           batches: Optional[Sequence[int]] = None,
                            ) -> List[ShapeSpec]:
-    """The warm-ahead-of-serving spec set (`lir_tpu precompile`): for every
-    bucket-ladder edge x candidate suffix edge, both handoff variants of
-    the shared-prefix executable at the engine's configured batch and
-    sweep budgets. Grouped-dispatch shapes depend on the realized prefix
-    groups, so serving still compiles those lazily (into the persistent
-    cache) the first time a grid forms them."""
+    """The warm-ahead-of-serving spec set (`lir_tpu precompile` and the
+    serving layer's boot precompile): for every bucket-ladder edge x
+    candidate suffix edge x batch size, both handoff variants of the
+    shared-prefix executable at the engine's sweep budgets.
+
+    ``batches`` defaults to the engine's configured batch alone (the
+    offline sweep dispatches full batches except one tail); the online
+    server additionally warms the power-of-two TAIL batches
+    (serve_batches) because continuous batching dispatches partial
+    batches whenever the queue runs shallow. Grouped-dispatch shapes
+    depend on the realized prefix groups, so those still compile lazily
+    (into the persistent cache) the first time a grid forms them."""
     rt = engine.rt
     new_tokens = (rt.max_new_tokens if rt.sweep_full_completions
                   else min(rt.sweep_decode_tokens, rt.max_new_tokens))
@@ -364,8 +371,23 @@ def sweep_specs_for_ladder(engine, sfx_buckets: Sequence[int] = (8, 16),
     specs = []
     for bucket in engine.buckets:
         for sfx in sfx_buckets:
-            for scratch in (False, True):
-                specs.append(shared_spec(
-                    bucket, rt.batch_size, sfx, sfx, new_tokens,
-                    conf_tokens, stops_armed, scratch))
+            for batch in (batches if batches is not None
+                          else (rt.batch_size,)):
+                for scratch in (False, True):
+                    specs.append(shared_spec(
+                        bucket, batch, sfx, sfx, new_tokens,
+                        conf_tokens, stops_armed, scratch))
     return specs
+
+
+def serve_batches(batch_size: int) -> Tuple[int, ...]:
+    """Every padded batch shape the continuous batcher can dispatch at a
+    configured batch size: the full batch plus each power-of-two tail
+    below it (runner._tail_batch pads partial batches onto this grid)."""
+    out = []
+    b = 1
+    while b < batch_size:
+        out.append(b)
+        b *= 2
+    out.append(batch_size)
+    return tuple(out)

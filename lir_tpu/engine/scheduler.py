@@ -58,6 +58,23 @@ def _tail_batch(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
+                decode_cost: int) -> int:
+    """Row-token cost of dispatching ``n_rows`` cells at ``bucket_edge``:
+    a padded power-of-two batch prefilled at the edge, plus the fixed
+    decode scan (``decode_cost`` tokens per slot — the steps run whether
+    the slots carry work or padding).
+
+    This is THE decode-cost price model (linear param term dominates at
+    7B scale: prefill ~ bucket edge per row, each decode step ~ 1 token
+    per slot). Both the offline planner's slot-refill rule
+    (:meth:`RaggedScheduler._plan_shared`) and the online continuous
+    batcher's bucket-selection policy (serve/batcher.py) price dispatches
+    through this one helper so the two can't drift apart.
+    """
+    return _tail_batch(n_rows, batch_size) * (bucket_edge + decode_cost)
+
+
 @dataclasses.dataclass(frozen=True)
 class SweepItem:
     """One grid cell, tokenized. ``lcp`` is the binary/confidence shared
@@ -273,17 +290,14 @@ class RaggedScheduler:
             if not q:
                 continue
             nxt = self.buckets[bi + 1] if bi + 1 < len(self.buckets) else None
-            # Slot refill cost model, in row-token units (the linear
-            # param term dominates at 7B scale, so prefill ~ bucket edge
-            # per row and each decode step ~ 1 token per slot). Keeping
-            # the tail pays a WHOLE extra dispatch: a padded power-of-two
-            # batch prefilled at this edge plus its fixed decode scan
-            # (decode_cost tokens per slot — the steps run whether the
-            # slots carry work or padding). Promoting pays len(tail)
-            # rows at the next edge, where they fill slots of dispatches
-            # that run anyway (and cascade upward the same way).
+            # Slot refill under the shared price model (bucket_cost).
+            # Keeping the tail pays a WHOLE extra dispatch: a padded
+            # power-of-two batch prefilled at this edge plus its fixed
+            # decode scan. Promoting pays len(tail) rows at the next
+            # edge, where they fill slots of dispatches that run anyway
+            # (and cascade upward the same way).
             if (nxt is not None and len(q) * nxt
-                    < _tail_batch(len(q), B) * (edge + self.decode_cost)):
+                    < bucket_cost(len(q), edge, B, self.decode_cost)):
                 queues[nxt] = [(it, True) for it, _ in q] + queues[nxt]
             else:
                 out.append(Dispatch(

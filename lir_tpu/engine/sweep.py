@@ -327,14 +327,7 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
         dispatches, occupancy = _plan_ragged(engine, todo, new_tokens,
                                              conf_tokens)
         stop_armed = early_stop and engine.digit_stop_mask is not None
-        # Fresh handoff per sweep: the first dispatch of each bucket then
-        # always runs the scratchless jit signature and later ones the
-        # donated-cache signature — the same two executables a warmup
-        # sweep over the same shapes compiles, so steady-state timing
-        # never hits a fresh compile mid-run.
-        from .runner import _CacheHandoff
-
-        engine._handoff = _CacheHandoff()
+        engine.fresh_handoff()  # fresh donation chain per sweep
         # Compile plan: the schedule fixes every dispatch shape, so lower
         # + compile ALL bucket executables in background threads while
         # the first bucket streams — the dispatch loop then consumes

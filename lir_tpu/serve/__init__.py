@@ -1,0 +1,42 @@
+"""Online serving layer: continuous-batching request queue over the
+ragged scheduler's bucket/price model.
+
+Everything else in lir_tpu is an offline batch sweep launched from the
+CLI; this package turns the same engine into a long-running scoring
+service — the paper's workload (thousands of yes/no interpretation
+probes per model) is exactly the shape iteration-level continuous
+batching (Orca) and shared-prefix reuse (vLLM) were built for, and the
+bucket ladder + AOT executable registry already fix every dispatch shape
+ahead of time, which is the precondition for admitting streaming
+requests without new compiles.
+
+Components:
+
+- queue.RequestQueue — bounded admission control with per-class
+  deadlines and deadline-aware shed-on-overload.
+- cache.ResultCache — content-addressed dedup of identical
+  (model, prompt, target) probes.
+- batcher.ContinuousBatcher — snaps requests to the precompiled bucket
+  ladder, refills decode slots from the queue, prices dispatches with
+  the offline planner's own scheduler.bucket_cost model.
+- server.ScoringServer — the supervisor loop: retry with full jitter and
+  an elapsed cap (utils/retry.py), partial results on deadline expiry,
+  health-flag trip + queue drain on repeated device errors.
+
+Surface: the ``lir_tpu serve`` CLI subcommand (JSONL over stdin/stdout),
+profiling.ServeStats observability, and bench.py's Poisson open-loop
+load driver ("serve" headline key).
+"""
+
+from .batcher import ContinuousBatcher
+from .cache import ResultCache, content_key
+from .queue import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK, STATUS_SHED,
+                    RequestQueue, ServeFuture, ServeRequest, ServeResult)
+from .server import ScoringServer
+
+__all__ = [
+    "ContinuousBatcher", "ResultCache", "content_key",
+    "RequestQueue", "ServeFuture", "ServeRequest", "ServeResult",
+    "ScoringServer",
+    "STATUS_OK", "STATUS_EXPIRED", "STATUS_SHED", "STATUS_ERROR",
+]

@@ -1,0 +1,226 @@
+"""Continuous batcher: online dispatch formation over the bucket ladder.
+
+The offline ragged scheduler (engine/scheduler.py) plans a KNOWN grid up
+front; serving has an arrival process instead, so this module keeps the
+same bucket/price machinery but runs it incrementally, Orca-style at
+iteration granularity — here the "iteration" is one fused decode scan
+(the engine's decode programs are fixed-budget XLA scans, so admission
+happens between scans, and freed decode slots are refilled from the queue
+when the next dispatch forms):
+
+- **Bucket snapping**: every admitted request was tokenized at submit
+  time and snapped to the nearest edge of the SAME precompiled ladder the
+  offline sweep uses (tokens.assign_bucket over engine.buckets), so a
+  request reuses the sweep's executables — with the boot precompile
+  (compile_plan.sweep_specs_for_ladder + serve_batches) no request ever
+  triggers a trace.
+- **Slot refill**: a dispatch takes up to ``batch_size`` rows from one
+  bucket queue; rows whose deadline expired while queued resolve as
+  partial results and their slots refill from the same queue, so padding
+  never rides where real work is waiting. An UNDERFULL ripe bucket is
+  additionally promoted into the next bucket's queue whenever that
+  bucket has waiting work and scheduler.bucket_cost says the promoted
+  rows riding a fuller dispatch beat a padded tail of their own — the
+  offline planner's slot-refill rule, run incrementally.
+- **Price-model bucket selection**: among buckets that are ripe (full
+  batch, or the oldest row outwaited the linger window), dispatch the one
+  with the lowest cost per real row under scheduler.bucket_cost — the
+  exact price model the offline planner's slot-refill rule uses, so the
+  online and offline policies cannot drift apart.
+
+Per-request results are identical to the offline sweep's for the same
+cells (pinned by tests/test_serve.py): the dispatch path is the sweep's
+own decode_fused_shared call with the same pretokenized ids, bucket,
+suffix edges, budgets, and cache-handoff donation chain.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine import scheduler as sched_mod
+from ..engine import score as score_mod
+from ..engine import tokens as tok
+from ..engine.runner import _tail_batch
+from ..engine.sweep import _decode_complete, _parse_confidence
+from ..utils.profiling import ServeStats
+from .queue import (STATUS_EXPIRED, Pending, ServeResult)
+
+
+class ContinuousBatcher:
+    """Per-bucket queues + dispatch formation + the engine call."""
+
+    def __init__(self, engine, stats: ServeStats, linger_s: float,
+                 clock: Callable[[], float] = time.monotonic,
+                 pad_full: bool = True):
+        self.engine = engine
+        self.stats = stats
+        self.linger_s = float(linger_s)
+        self.clock = clock
+        self.pad_full = pad_full
+        self.batch = engine.rt.batch_size
+        rt = engine.rt
+        # Decode budgets: exactly the sweep's derivation (engine/sweep.py)
+        # so served scores equal swept scores.
+        self.new_tokens = (rt.max_new_tokens if rt.sweep_full_completions
+                           else min(rt.sweep_decode_tokens,
+                                    rt.max_new_tokens))
+        self.conf_tokens = (rt.max_new_tokens if rt.sweep_full_completions
+                            else min(rt.sweep_confidence_tokens,
+                                     rt.max_new_tokens))
+        self.early_stop = (rt.sweep_early_stop
+                           and not rt.sweep_full_completions)
+        self.decode_cost = self.new_tokens + self.conf_tokens
+        self._queues: Dict[int, Deque[Pending]] = {
+            int(b): deque() for b in engine.buckets}
+
+    # -- queue side ---------------------------------------------------------
+
+    def admit(self, pending: Pending) -> None:
+        self._queues[pending.bucket].append(pending)
+
+    @property
+    def pending_rows(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _expire(self, p: Pending, now: float) -> None:
+        """Deadline passed while queued: a PARTIAL confidence-free result
+        (status only; every measurement field None) instead of failing
+        the batch or silently dropping the request."""
+        self.stats.count("expired")
+        p.future.resolve(ServeResult(
+            request_id=p.request.request_id, status=STATUS_EXPIRED,
+            note=f"deadline passed before dispatch "
+                 f"(waited {now - p.t_submit:.3f}s)",
+            latency_s=now - p.t_submit))
+
+    def _dispatch_rows(self, n: int) -> int:
+        """Padded batch rows a dispatch of ``n`` real rows pays for:
+        the full batch under ``pad_full`` (shape stability), else the
+        offline sweep's power-of-two tail."""
+        return self.batch if self.pad_full else _tail_batch(n, self.batch)
+
+    def next_dispatch(self, now: float, flush: bool = False
+                      ) -> Optional[Tuple[int, List[Pending]]]:
+        """Form the next dispatch, or None when no bucket is ripe. A
+        bucket is ripe with a full batch, once its oldest request has
+        waited out the linger window, or unconditionally under ``flush``
+        (shutdown drain). An underfull ripe bucket promotes into a
+        NONEMPTY next bucket when the price model favors it (there must
+        be work there to ride — unlike the offline planner, the online
+        queue can't assume more same-bucket work is coming)."""
+        while True:
+            ripe = [edge for edge, q in self._queues.items() if q
+                    and (flush or len(q) >= self.batch
+                         or now - q[0].t_submit >= self.linger_s)]
+            if not ripe:
+                return None
+
+            def price(edge: int) -> Tuple[float, float]:
+                n = min(len(self._queues[edge]), self.batch)
+                per_row = sched_mod.bucket_cost(
+                    self._dispatch_rows(n), edge, self.batch,
+                    self.decode_cost) / n
+                return per_row, self._queues[edge][0].t_submit
+
+            edge = min(ripe, key=price)
+            q = self._queues[edge]
+            n = len(q)
+            if n < self.batch:
+                bigger = [b for b in sorted(self._queues) if b > edge]
+                nxt = bigger[0] if bigger else None
+                if (nxt is not None and self._queues[nxt]
+                        and n * nxt < sched_mod.bucket_cost(
+                            self._dispatch_rows(n), edge, self.batch,
+                            self.decode_cost)):
+                    promoted = [q.popleft() for _ in range(n)]
+                    for p in reversed(promoted):
+                        self._queues[nxt].appendleft(p)
+                    self.stats.count("promoted", n)
+                    continue    # re-select (promotion may cascade)
+            rows: List[Pending] = []
+            while q and len(rows) < self.batch:
+                p = q.popleft()
+                if now >= p.t_deadline:
+                    self._expire(p, now)  # slot refills from the queue
+                    continue
+                rows.append(p)
+            if rows:
+                return edge, rows
+            # every candidate row expired — re-scan the other buckets
+
+    def flush_all(self, status: str, note: str) -> int:
+        """Resolve every bucketed request with ``status`` (health-flag
+        drain); returns how many were flushed."""
+        n = 0
+        now = self.clock()
+        for q in self._queues.values():
+            while q:
+                p = q.popleft()
+                self.stats.count("errors")
+                p.future.resolve(ServeResult(
+                    request_id=p.request.request_id, status=status,
+                    note=note, latency_s=now - p.t_submit))
+                n += 1
+        return n
+
+    # -- engine side --------------------------------------------------------
+
+    def score(self, bucket: int, rows: List[Pending]) -> List[Dict]:
+        """One engine dispatch over ``rows`` (all snapped to ``bucket``),
+        mirroring the offline sweep's shared-dispatch path exactly:
+        power-of-two tail padding by repeating the last row, per-dispatch
+        suffix edges from the shared suffix ladder, pretokenized ids,
+        donated KV-cache handoff, position-0 readout. Returns one
+        measurement payload per REAL row (padding rows are dropped)."""
+        engine = self.engine
+        n = len(rows)
+        bsz = self._dispatch_rows(n)
+        full = list(rows) + [rows[-1]] * (bsz - n)
+        t1 = np.asarray([p.t1 for p in full], np.int32)
+        t2 = np.asarray([p.t2 for p in full], np.int32)
+        la = max(max(len(p.bin_ids) - p.lcp for p in full), 1)
+        lb = max(max(len(p.conf_ids) - p.lcp for p in full), 1)
+        ba = tok.pick_bucket([la], sched_mod.SUFFIX_BUCKETS)
+        bb = tok.pick_bucket([lb], sched_mod.SUFFIX_BUCKETS)
+        fused, cfused = engine.decode_fused_shared(
+            [p.request.binary_prompt for p in full],
+            [p.request.confidence_prompt for p in full],
+            t1, t2, new_tokens=self.new_tokens,
+            conf_tokens=self.conf_tokens, early_stop=self.early_stop,
+            pretokenized_a=[list(p.bin_ids) for p in full],
+            pretokenized_b=[list(p.conf_ids) for p in full],
+            bucket=bucket, sfx_buckets_ab=(ba, bb), reuse_cache=True)
+        res = score_mod.readout_from_fused(
+            fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
+        res_h, lp_vals, lp_ids, gen_host = jax.device_get(
+            (res, fused.topk_logprobs, fused.topk_ids, fused.generated))
+        wconf, cgen_host = jax.device_get(
+            (cfused.weighted_confidence, cfused.generated))
+        payloads: List[Dict] = []
+        for j in range(n):
+            conf_text = engine.decode_completion(cgen_host[j])
+            conf_complete = (engine.rt.sweep_full_completions
+                             or _decode_complete(cgen_host[j],
+                                                 engine.eos_id))
+            payloads.append(dict(
+                model_response=engine.decode_completion(gen_host[j]),
+                model_confidence_response=conf_text,
+                token_1_prob=float(res_h.yes_prob[j]),
+                token_2_prob=float(res_h.no_prob[j]),
+                log_probabilities=json.dumps({
+                    int(i): round(float(v), 6)
+                    for i, v in zip(lp_ids[j], lp_vals[j])}),
+                confidence_value=_parse_confidence(conf_text,
+                                                   conf_complete),
+                weighted_confidence=float(wconf[j]),
+            ))
+        self.stats.add_dispatch(n, bsz)
+        return payloads

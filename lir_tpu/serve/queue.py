@@ -1,0 +1,198 @@
+"""Request queue with admission control, per-class deadlines, and
+shed-on-overload.
+
+The queue is the serving layer's backpressure boundary: depth is bounded
+(``config.ServeConfig.queue_depth``), so memory and worst-case queueing
+delay are bounded too. When a submit arrives at a full queue the policy is
+deadline-aware: the newcomer is shed UNLESS it is more urgent than the
+least-urgent queued request (latest absolute deadline), in which case that
+request is shed instead — under overload the queue keeps the work most
+likely to still meet its deadline, rather than strict tail-drop.
+
+Everything here is host-side and engine-agnostic; the continuous batcher
+(serve/batcher.py) drains admitted entries into per-bucket queues.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, List, Optional, Tuple
+
+from ..utils.profiling import ServeStats
+
+# Result statuses, in order of decreasing happiness.
+STATUS_OK = "ok"
+STATUS_EXPIRED = "deadline_exceeded"
+STATUS_SHED = "shed"
+STATUS_ERROR = "error"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeRequest:
+    """One interpretation probe: the two sweep-format prompts of a grid
+    cell (grid.GridCell semantics) plus serving metadata. ``deadline_s``
+    overrides the request class's default deadline; ``klass`` names a
+    deadline class from config.ServeConfig.classes."""
+
+    binary_prompt: str
+    confidence_prompt: str
+    targets: Tuple[str, str] = ("Yes", "No")
+    klass: str = "batch"
+    deadline_s: Optional[float] = None
+    request_id: str = ""
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """What a request resolves to. ``status`` is "ok", or one of the
+    graceful degradations: "deadline_exceeded" rows return PARTIAL
+    confidence-free results (prompt acknowledged, every measurement field
+    None) rather than failing their batch; "shed" rows were refused
+    admission; "error" rows hit a device fault that outlived the retry
+    policy. ``cached=True`` marks a dedup hit served from the result
+    cache without touching the device."""
+
+    request_id: str
+    status: str
+    model_response: str = ""
+    model_confidence_response: str = ""
+    token_1_prob: Optional[float] = None
+    token_2_prob: Optional[float] = None
+    log_probabilities: str = ""
+    confidence_value: Optional[int] = None
+    weighted_confidence: Optional[float] = None
+    cached: bool = False
+    latency_s: float = 0.0
+    note: str = ""
+
+
+class ServeFuture:
+    """Minimal completion handle (threading.Event + slot): the submitting
+    thread blocks in :meth:`result`, the supervisor resolves exactly
+    once. No cancellation — the server resolves every admitted request
+    with SOME status (that's the graceful-degradation contract)."""
+
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self._result: Optional[ServeResult] = None
+
+    def resolve(self, result: ServeResult) -> None:
+        if self._done.is_set():        # first resolution wins
+            return
+        self._result = result
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        if not self._done.wait(timeout):
+            raise TimeoutError("serve request not resolved in time")
+        assert self._result is not None
+        return self._result
+
+
+@dataclasses.dataclass
+class Pending:
+    """An admitted request plus everything the batcher needs, computed
+    ONCE at submit time on the caller's thread (tokenization off the
+    supervisor's critical path): token ids for both formats, the shared
+    prefix split, the snapped ladder bucket, per-request target token
+    ids, and the content-address of the result-cache entry."""
+
+    request: ServeRequest
+    future: ServeFuture
+    t_submit: float
+    t_deadline: float
+    bin_ids: Tuple[int, ...] = ()
+    conf_ids: Tuple[int, ...] = ()
+    lcp: int = 0
+    bucket: int = 0
+    t1: int = 0
+    t2: int = 0
+    cache_key: str = ""
+
+    @property
+    def prefix_len(self) -> int:
+        return max(self.lcp, 1)
+
+
+class RequestQueue:
+    """Bounded FIFO with deadline-aware shedding (module docstring)."""
+
+    def __init__(self, maxlen: int, stats: Optional[ServeStats] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.maxlen = int(maxlen)
+        self.stats = stats if stats is not None else ServeStats()
+        self.clock = clock
+        self._dq: Deque[Pending] = deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dq)
+
+    def _shed(self, pending: Pending, note: str) -> None:
+        self.stats.count("shed")
+        pending.future.resolve(ServeResult(
+            request_id=pending.request.request_id, status=STATUS_SHED,
+            note=note, latency_s=self.clock() - pending.t_submit))
+
+    def offer(self, pending: Pending) -> bool:
+        """Admit or shed. Returns True when ``pending`` joined the queue
+        (its future will be resolved by the supervisor); False when it
+        was shed (its future is already resolved)."""
+        with self._nonempty:
+            if len(self._dq) < self.maxlen:
+                self._dq.append(pending)
+                self.stats.count("admitted")
+                self.stats.note_queue_depth(len(self._dq))
+                self._nonempty.notify()
+                return True
+            # Full: keep the most-urgent set. Evict the queued request
+            # with the LATEST deadline if the newcomer beats it.
+            victim = max(self._dq, key=lambda p: p.t_deadline)
+            if pending.t_deadline < victim.t_deadline:
+                self._dq.remove(victim)
+                self._dq.append(pending)
+                self.stats.count("admitted")
+                self._nonempty.notify()
+            else:
+                victim = pending
+        # resolve outside the lock (victim futures may have waiters)
+        self._shed(victim, note="queue full "
+                   f"(depth {self.maxlen}) — least-urgent request shed")
+        return victim is not pending
+
+    def drain(self) -> List[Pending]:
+        """Pop every queued request, FIFO (the supervisor moves them into
+        the batcher's bucket queues)."""
+        with self._lock:
+            out = list(self._dq)
+            self._dq.clear()
+        return out
+
+    def wait_nonempty(self, timeout: float) -> bool:
+        with self._nonempty:
+            if self._dq:
+                return True
+            return self._nonempty.wait(timeout)
+
+    def flush(self, status: str, note: str) -> int:
+        """Resolve every queued request with ``status`` (the drain path
+        of the health-flag trip); returns how many were flushed."""
+        drained = self.drain()
+        now = self.clock()
+        for p in drained:
+            if status == STATUS_SHED:
+                self.stats.count("shed")
+            else:
+                self.stats.count("errors")
+            p.future.resolve(ServeResult(
+                request_id=p.request.request_id, status=status, note=note,
+                latency_s=now - p.t_submit))
+        return len(drained)

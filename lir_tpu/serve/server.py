@@ -1,0 +1,230 @@
+"""Scoring server: the supervisor loop tying queue, cache, and batcher
+together into a long-running service.
+
+Lifecycle semantics (the graceful-degradation contract):
+
+- Every admitted request resolves with SOME status. Deadline-exceeded
+  rows return partial confidence-free results rather than failing their
+  batch; shed rows resolve immediately at submit.
+- Device dispatches run under the serve retry policy
+  (config.ServeConfig.retry: short, full-jitter, elapsed-capped —
+  utils/retry.py) so one transient XLA/runtime hiccup never surfaces to
+  clients.
+- After ``max_consecutive_failures`` dispatch failures in a row the
+  server drains the queue with error results and flips :attr:`healthy`
+  — the signal for an external supervisor (k8s liveness, systemd) to
+  restart the process; subsequent submits shed immediately instead of
+  queueing behind a dead device.
+
+Dedup rides in front of admission: a submit whose content address is
+already cached resolves without touching the queue or the device —
+perturbation-style traffic re-asks near-identical questions constantly,
+so this is the cheapest capacity the serving layer has.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..config import ServeConfig
+from ..engine import compile_plan
+from ..engine import tokens as tok
+from ..utils.logging import get_logger
+from ..utils.profiling import ServeStats
+from ..utils.retry import retry_with_exponential_backoff
+from .batcher import ContinuousBatcher
+from .cache import ResultCache, content_key
+from .queue import (STATUS_ERROR, STATUS_OK, STATUS_SHED, Pending,
+                    RequestQueue, ServeFuture, ServeRequest, ServeResult)
+
+log = get_logger(__name__)
+
+
+class ScoringServer:
+    """Continuous-batching scoring service over one ScoringEngine.
+
+    ``precompile=True`` AOT-compiles every (ladder edge x suffix edge x
+    padded batch) shared executable at boot (compile_plan.sweep_specs_
+    for_ladder with serve_batches — background threads, lazy-jit
+    fallback on any miss), so no request ever pays a trace.
+    """
+
+    def __init__(self, engine, model_name: str,
+                 config: Optional[ServeConfig] = None,
+                 stats: Optional[ServeStats] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 precompile: bool = False):
+        self.engine = engine
+        self.model_name = model_name
+        self.config = config or ServeConfig()
+        self.stats = stats if stats is not None else ServeStats()
+        self.clock = clock
+        self.queue = RequestQueue(self.config.queue_depth, self.stats,
+                                  clock)
+        self.cache = ResultCache(self.config.cache_entries, self.stats)
+        self.batcher = ContinuousBatcher(engine, self.stats,
+                                         self.config.linger_s, clock,
+                                         pad_full=self.config.pad_full)
+        self._engine_key = engine.cache_manifest_key
+        self._target_memo: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._consecutive = 0
+        self._healthy = True
+        engine.fresh_handoff()     # fresh donation chain per session
+        if precompile and engine.rt.aot_precompile:
+            # pad_full pins every dispatch to the full batch shape, so
+            # only that shape needs warming; tail mode warms the whole
+            # power-of-two grid.
+            batches = ((engine.rt.batch_size,) if self.config.pad_full
+                       else compile_plan.serve_batches(
+                           engine.rt.batch_size))
+            specs = compile_plan.sweep_specs_for_ladder(
+                engine, sfx_buckets=(8, 16), batches=batches)
+            engine.exec_registry = compile_plan.precompile_async(
+                engine, specs, max_workers=engine.rt.precompile_workers)
+            log.info("serve: precompiling %d executable shapes in the "
+                     "background", len(specs))
+
+    @property
+    def healthy(self) -> bool:
+        return self._healthy
+
+    # -- client side ---------------------------------------------------------
+
+    def _target_ids(self, targets: Tuple[str, str]) -> Tuple[int, int]:
+        ids = self._target_memo.get(targets)
+        if ids is None:
+            with self.engine._tok_lock:
+                t1, t2 = tok.target_token_ids(
+                    self.engine.tokenizer, targets,
+                    encoder_decoder=self.engine.encoder_decoder)
+            ids = (int(t1), int(t2))
+            self._target_memo[targets] = ids
+        return ids
+
+    def submit(self, request: ServeRequest) -> ServeFuture:
+        """Admit one request; returns a future that resolves with a
+        ServeResult (possibly immediately: dedup hit, shed, unhealthy).
+        Tokenization runs here on the caller's thread, keeping the
+        supervisor loop on the device's critical path only."""
+        self.stats.count("submitted")
+        fut = ServeFuture()
+        now = self.clock()
+        key = content_key(self._engine_key, request)
+        if self.cache.max_entries > 0:
+            hit = self.cache.get(key)
+            if hit is not None:
+                self.stats.count("completed")
+                self.stats.record_latency(self.clock() - now)
+                fut.resolve(ServeResult(
+                    request_id=request.request_id, status=STATUS_OK,
+                    cached=True, latency_s=self.clock() - now, **hit))
+                return fut
+        if not self._healthy:
+            self.stats.count("shed")
+            fut.resolve(ServeResult(
+                request_id=request.request_id, status=STATUS_SHED,
+                note="server unhealthy — repeated device errors"))
+            return fut
+        with self.engine._tok_lock:
+            bin_ids = tuple(int(i) for i in self.engine.tokenizer(
+                request.binary_prompt).input_ids)
+            conf_ids = tuple(int(i) for i in self.engine.tokenizer(
+                request.confidence_prompt).input_ids)
+        lcp = tok.shared_prefix_len(bin_ids, conf_ids)
+        t1, t2 = self._target_ids(tuple(request.targets))
+        deadline = (request.deadline_s if request.deadline_s is not None
+                    else self.config.deadline_for(request.klass))
+        pending = Pending(
+            request=request, future=fut, t_submit=now,
+            t_deadline=now + deadline, bin_ids=bin_ids, conf_ids=conf_ids,
+            lcp=lcp,
+            bucket=tok.assign_bucket(max(lcp, 1), self.engine.buckets),
+            t1=t1, t2=t2, cache_key=key)
+        self.queue.offer(pending)
+        return fut
+
+    # -- supervisor side -----------------------------------------------------
+
+    def start(self) -> "ScoringServer":
+        assert self._thread is None, "server already started"
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-supervisor",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        """Drain: finish everything queued (flushing partial buckets),
+        then stop the supervisor."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            stopping = self._stop.is_set()
+            for p in self.queue.drain():
+                self.batcher.admit(p)
+            d = self.batcher.next_dispatch(self.clock(), flush=stopping)
+            if d is None:
+                if (stopping and len(self.queue) == 0
+                        and self.batcher.pending_rows == 0):
+                    return
+                # Lingering rows need sub-window wakeups; an idle server
+                # can sleep longer (still bounded so stop() is prompt).
+                self.queue.wait_nonempty(
+                    0.005 if self.batcher.pending_rows else 0.05)
+                continue
+            self._dispatch(*d)
+
+    def _dispatch(self, bucket: int, rows) -> None:
+        try:
+            payloads = retry_with_exponential_backoff(
+                lambda: self.batcher.score(bucket, rows),
+                retry_on=(Exception,), config=self.config.retry,
+                log=lambda m: log.warning("serve dispatch retry: %s", m),
+                clock=self.clock)
+        except Exception as err:  # noqa: BLE001 — degraded, never crash
+            self._consecutive += 1
+            now = self.clock()
+            self.stats.count("errors", len(rows))
+            for p in rows:
+                p.future.resolve(ServeResult(
+                    request_id=p.request.request_id, status=STATUS_ERROR,
+                    note=f"device error after retries: {err!r}",
+                    latency_s=now - p.t_submit))
+            log.warning("serve: dispatch failed (%d consecutive): %r",
+                        self._consecutive, err)
+            if self._consecutive >= self.config.max_consecutive_failures:
+                self._trip_health(err)
+            return
+        self._consecutive = 0
+        now = self.clock()
+        for p, payload in zip(rows, payloads):
+            self.cache.put(p.cache_key, payload)
+            latency = now - p.t_submit
+            self.stats.count("completed")
+            if now > p.t_deadline:
+                self.stats.count("late")
+            self.stats.record_latency(latency)
+            p.future.resolve(ServeResult(
+                request_id=p.request.request_id, status=STATUS_OK,
+                latency_s=latency, **payload))
+
+    def _trip_health(self, err: BaseException) -> None:
+        """Repeated device errors: flip the health flag and drain every
+        waiting request with an error result — fail fast and visibly
+        instead of queueing behind a dead device."""
+        self._healthy = False
+        note = (f"server unhealthy after "
+                f"{self._consecutive} consecutive dispatch failures: "
+                f"{err!r}")
+        n = self.queue.flush(STATUS_ERROR, note)
+        n += self.batcher.flush_all(STATUS_ERROR, note)
+        log.error("serve: health flag tripped; drained %d queued "
+                  "requests (%s)", n, note)

@@ -284,6 +284,129 @@ class CompileStats:
         return out
 
 
+@dataclasses.dataclass
+class ServeStats:
+    """Online serving counters (lir_tpu/serve): the operator's one-look
+    view of queue health, admission control, dedup effectiveness, and
+    latency. Thread-safe — the supervisor loop and every submitting
+    thread mutate it concurrently.
+
+    Definitions (reported by ``summary()`` and bench.py's "serve" key):
+
+    - submitted / admitted / shed: admission-control accounting. ``shed``
+      counts both rejected newcomers and deadline-aware evictions
+      (serve/queue.py) — nonzero shed under steady load means the queue
+      depth or the fleet is undersized.
+    - dedup hit rate = cache hits / lookups — how often a probe was
+      answered from the content-addressed result cache without touching
+      the device (perturbation-style traffic re-asks near-identical
+      questions constantly).
+    - expired: rows whose deadline passed while queued; they return
+      partial confidence-free results. ``late``: rows that completed but
+      past their deadline (excluded from goodput).
+    - slot occupancy % = real request rows / padded batch slots across
+      every dispatch — the online analogue of OccupancyStats' batch
+      occupancy; low values mean the linger window is too short for the
+      arrival rate. ``promoted`` counts rows the batcher's online slot
+      refill moved into a bigger bucket's queue (scheduler.bucket_cost
+      said riding a fuller dispatch beats a padded tail of their own).
+    - latency percentiles (p50/p95/p99) over submit -> result seconds.
+    """
+
+    submitted: int = 0
+    admitted: int = 0
+    shed: int = 0
+    completed: int = 0
+    expired: int = 0
+    errors: int = 0
+    late: int = 0
+    dedup_hits: int = 0
+    dedup_misses: int = 0
+    dispatches: int = 0
+    slots_used: int = 0
+    slots_paid: int = 0
+    promoted: int = 0
+    queue_depth_peak: int = 0
+    _latencies: list = dataclasses.field(default_factory=list)
+    _max_latencies: int = 100_000
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def note_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth_peak = max(self.queue_depth_peak, depth)
+
+    def add_dispatch(self, used: int, paid: int) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.slots_used += used
+            self.slots_paid += paid
+
+    def record_latency(self, seconds: float) -> None:
+        with self._lock:
+            if len(self._latencies) < self._max_latencies:
+                self._latencies.append(float(seconds))
+
+    @property
+    def dedup_hit_rate(self) -> float:
+        n = self.dedup_hits + self.dedup_misses
+        return self.dedup_hits / n if n else 0.0
+
+    @property
+    def slot_occupancy_pct(self) -> float:
+        return (100.0 * self.slots_used / self.slots_paid
+                if self.slots_paid else 0.0)
+
+    def latency_percentiles(self) -> Dict[str, float]:
+        with self._lock:
+            lat = sorted(self._latencies)
+        if not lat:
+            return {"p50_s": 0.0, "p95_s": 0.0, "p99_s": 0.0}
+
+        def pct(p: float) -> float:
+            i = min(len(lat) - 1, max(0, int(round(p * (len(lat) - 1)))))
+            return lat[i]
+
+        return {"p50_s": round(pct(0.50), 4), "p95_s": round(pct(0.95), 4),
+                "p99_s": round(pct(0.99), 4)}
+
+    def goodput(self, elapsed_s: float) -> float:
+        """Requests completed WITHIN deadline per second of wall time —
+        the serving layer's headline rate (late completions and partial
+        results don't count; cache hits do: a served answer is a served
+        answer)."""
+        if elapsed_s <= 0:
+            return 0.0
+        return max(0, self.completed - self.late) / elapsed_s
+
+    def summary(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "shed": self.shed,
+            "completed": self.completed,
+            "expired": self.expired,
+            "errors": self.errors,
+            "late": self.late,
+            "dedup_hits": self.dedup_hits,
+            "dedup_misses": self.dedup_misses,
+            "dedup_hit_rate": round(self.dedup_hit_rate, 4),
+            "dispatches": self.dispatches,
+            "slot_occupancy_pct": round(self.slot_occupancy_pct, 2),
+            "promoted": self.promoted,
+            "queue_depth_peak": self.queue_depth_peak,
+        }
+        out.update(self.latency_percentiles())
+        return out
+
+
 # Published peak dense-matmul throughput per chip (bf16 FLOPS). Weight-only
 # int8 still computes in bf16 on the MXU, so bf16 peak is the MFU denominator
 # there; dynamic int8 (s8 x s8 -> s32 dots) gets 2x this on every listed

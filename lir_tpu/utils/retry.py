@@ -1,9 +1,19 @@
 """Exponential-backoff retry (reference: perturb_prompts.py:72-106).
 
 Generic over exception types so the same policy covers the optional remote-API
-backend and any transient local failure (e.g. filesystem hiccups on a
-preemptible host). Policy parity: 10 retries, 60 s initial delay capped at
-300 s, x1.5 backoff, uniform 0.8-1.2 jitter.
+backend, the serve supervisor's device dispatches, and any transient local
+failure (e.g. filesystem hiccups on a preemptible host). Default policy
+parity: 10 retries, 60 s initial delay capped at 300 s, x1.5 backoff, uniform
+0.8-1.2 jitter. Two extensions over the reference (config.RetryConfig):
+
+- ``full_jitter``: AWS-style full jitter (wait ~ U[0, delay]) instead of the
+  multiplicative band — decorrelates many clients retrying one contended
+  resource.
+- ``max_elapsed``: a cap on the TOTAL wall time the retry loop may consume
+  (attempts + sleeps). The reference's unbounded loop can exceed any caller
+  deadline (10 retries at 300 s is 50 minutes); with the cap, once another
+  sleep would cross it the last failure re-raises immediately, so a retried
+  call composes with the serving layer's per-request deadlines.
 """
 
 from __future__ import annotations
@@ -23,16 +33,29 @@ def retry_with_exponential_backoff(
     config: RetryConfig = RetryConfig(),
     sleep: Callable[[float], None] = time.sleep,
     log: Callable[[str], None] = print,
+    clock: Callable[[], float] = time.monotonic,
 ) -> T:
     delay = config.initial_delay
+    start = clock()
     for attempt in range(config.max_retries + 1):
         try:
             return fn()
         except retry_on as exc:
             if attempt == config.max_retries:
                 raise
-            jitter = random.uniform(*config.jitter)
-            wait = min(delay * jitter, config.max_delay)
+            if config.full_jitter:
+                wait = random.uniform(0.0, min(delay, config.max_delay))
+            else:
+                wait = min(delay * random.uniform(*config.jitter),
+                           config.max_delay)
+            if (config.max_elapsed is not None
+                    and clock() - start + wait > config.max_elapsed):
+                log(
+                    f"Attempt {attempt + 1}/{config.max_retries + 1} failed "
+                    f"({type(exc).__name__}: {exc}); next retry would exceed "
+                    f"the {config.max_elapsed:.1f}s elapsed cap — giving up"
+                )
+                raise
             log(
                 f"Attempt {attempt + 1}/{config.max_retries + 1} failed "
                 f"({type(exc).__name__}: {exc}); retrying in {wait:.1f}s"

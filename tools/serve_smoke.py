@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Serve smoke: boot the scoring server on the fake backend, push 50
+requests (40 unique + 10 duplicate re-asks), and assert the serving
+invariants the `make serve-smoke` CI target guards:
+
+- zero sheds (the queue is sized for the burst — admission control must
+  not fire on a healthy, correctly sized deployment),
+- a nonzero dedup hit rate (the duplicate re-asks hit the
+  content-addressed result cache instead of the device),
+- every request resolves "ok" and the server stays healthy.
+
+Runs hermetically on CPU with the FakeTokenizer + a tiny random decoder
+(the same stand-in the test suite uses); prints the ServeStats summary
+JSON on success.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_UNIQUE = 40
+N_DUP = 10
+
+
+def main() -> int:
+    import jax
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig, ServeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import ScoringServer, ServeRequest
+
+    cfg = ModelConfig(name="serve-smoke", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=1, n_heads=2,
+                      intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(7))
+    engine = ScoringEngine(params, cfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=8, max_seq_len=256))
+    server = ScoringServer(
+        engine, "serve-smoke",
+        ServeConfig(queue_depth=N_UNIQUE + N_DUP,
+                    classes=(("smoke", 600.0),), default_class="smoke",
+                    linger_s=0.01)).start()
+
+    def request(i: int, rid: str) -> ServeRequest:
+        body = f"clause {i} covers flood damage under policy {i * 3}"
+        return ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="smoke", request_id=rid)
+
+    futures = [server.submit(request(i, str(i))) for i in range(N_UNIQUE)]
+    # Wait for the originals so the duplicate re-asks hit a warm cache.
+    results = [f.result(timeout=600) for f in futures]
+    dup_results = [server.submit(request(i, f"dup{i}")).result(timeout=600)
+                   for i in range(N_DUP)]
+    server.stop()
+
+    stats = server.stats
+    failures = []
+    bad = [r.request_id for r in results + dup_results if r.status != "ok"]
+    if bad:
+        failures.append(f"non-ok results: {bad}")
+    if stats.shed != 0:
+        failures.append(f"sheds under a sized queue: {stats.shed}")
+    if stats.dedup_hits == 0 or stats.dedup_hit_rate <= 0.0:
+        failures.append("duplicate re-asks produced zero dedup hits")
+    if not all(r.cached for r in dup_results):
+        failures.append("a duplicate re-ask was scored on the device")
+    if not server.healthy:
+        failures.append("health flag tripped during the smoke")
+    if failures:
+        for f in failures:
+            print(f"SERVE-SMOKE FAIL: {f}")
+        return 1
+    print(json.dumps(stats.summary()))
+    print(f"serve smoke: OK ({N_UNIQUE} unique + {N_DUP} duplicate "
+          f"requests, {stats.dispatches} dispatches, dedup hit rate "
+          f"{stats.dedup_hit_rate:.2f})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
