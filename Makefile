@@ -2,7 +2,7 @@
 # ROADMAP tier-1 suite and fails if the pass count drops below the
 # recorded floor (tools/check_tier1.py — the floor lives there).
 
-.PHONY: verify test bench serve-smoke install-hooks
+.PHONY: verify test bench serve-smoke chaos-smoke install-hooks
 
 verify:
 	python tools/check_tier1.py
@@ -21,6 +21,16 @@ bench:
 # hit rate + all-ok (tools/serve_smoke.py).
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
+# Chaos smoke: seeded fault schedule on the fake backend — a sweep under
+# injected device errors + a mid-sweep kill + a torn manifest tail must
+# resume bitwise-identical (zero lost/duplicated rows); the serve
+# circuit breaker must trip and recover via its half-open probe; the
+# degradation ladder must isolate a poison row; a SIGTERM-style state
+# checkpoint must hand every pending request to a fresh server
+# (tools/chaos_smoke.py).
+chaos-smoke:
+	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
 # Run the tier-1 guard automatically before every `git push`.
 install-hooks:

@@ -201,6 +201,13 @@ def main() -> None:
                     help="skip the online-serving mode (open-loop "
                          "Poisson load driver over the continuous "
                          "batcher vs the offline sweep on one grid)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="also measure goodput UNDER a seeded transient "
+                         "fault schedule (lir_tpu/faults) vs fault-free "
+                         "on the same grid — recovered_dispatches, "
+                         "degraded_rows, and the goodput ratio land "
+                         "under the headline JSON's \"chaos\" key (the "
+                         "robustness cost, tracked like perf)")
     ap.add_argument("--compile-cache-dir", default=None,
                     help="persistent compile cache dir (default: a fresh "
                          "temp dir per run, so cold_start_s is a true "
@@ -469,6 +476,20 @@ def main() -> None:
                   "unaffected", file=sys.stderr)
     if serve is not None:
         headline["serve"] = serve
+    # Chaos mode (--chaos): the same serving layer under a seeded
+    # transient fault schedule — the robustness cost (recovery work +
+    # goodput delta) tracked alongside perf. Failures never discard the
+    # already-measured headline.
+    if args.chaos:
+        try:
+            chaos = _chaos_bench(params, cfg, on_accel,
+                                 tokenizer=sweep_tok,
+                                 batches=batch_override)
+            if chaos is not None:
+                headline["chaos"] = chaos
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# chaos bench mode failed ({err!r}); headline is "
+                  "unaffected", file=sys.stderr)
     print(json.dumps(headline))
     if sweep_tok is not None:
         # Transparency: the content-free worst case (FakeTokenizer exposes
@@ -973,6 +994,136 @@ def _serve_bench(params, cfg, on_accel: bool, tokenizer=None,
               file=sys.stderr)
         return out
     print(f"# serve mode: every batch candidate OOMed; last: {last_oom}",
+          file=sys.stderr)
+    return None
+
+
+def _chaos_bench(params, cfg, on_accel: bool, tokenizer=None,
+                 batches=None):
+    """Chaos mode: ONE grid served closed-loop twice — fault-free, then
+    under a seeded transient fault schedule (FaultPlan: Bernoulli
+    dispatch faults bounded by max_failures, i.e. a transient outage the
+    recovery machinery must outlast, injected UNDER the retry policy so
+    recovery is exercised, not bypassed). Reports the robustness
+    counters (profiling.FaultStats) and goodput-under-faults vs
+    fault-free goodput: the price of self-healing, tracked like perf.
+
+    Every request must still resolve "ok" — the fault schedule is
+    transient by construction, so a lost or errored request is a
+    recovery bug, not chaos."""
+    import numpy as np
+
+    from lir_tpu import faults
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RetryConfig, RuntimeConfig, ServeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.serve import ScoringServer, ServeRequest
+
+    if batches is None:
+        batches = SWEEP_BATCHES_TPU if on_accel else SWEEP_BATCHES_CPU
+    cells = 64 if on_accel else SERVE_CELLS_CPU
+    rng = np.random.default_rng(29)
+    if tokenizer is not None:
+        from chain7b import (CHAIN_CONFIDENCE_FORMAT, CHAIN_RESPONSE_FORMAT,
+                             bucket_sized_words)
+        words, n_words = bucket_sized_words(tokenizer, rng)
+        response_format = CHAIN_RESPONSE_FORMAT
+        confidence_format = CHAIN_CONFIDENCE_FORMAT
+    else:
+        words = ("coverage policy flood water damage claim insurer premium "
+                 "exclusion endorsement peril deductible").split()
+        n_words = 170 if on_accel else VARLEN_WORDS_CPU
+        response_format = "Respond with either ' Yes' or ' No' only ."
+        confidence_format = "Give a confidence number from 0 to 100 ."
+
+    def text():
+        return " ".join(rng.choice(words) for _ in range(n_words)) + " ?"
+
+    texts = [text() for _ in range(cells)]
+    serve_cfg = ServeConfig(
+        queue_depth=cells + 8, classes=(("chaos", 3600.0),),
+        default_class="chaos", linger_s=0.005,
+        # Short retries: the chaos bill should be recovery work, not
+        # backoff sleeps sized for a real device outage.
+        retry=RetryConfig(max_retries=2, initial_delay=0.02,
+                          max_delay=0.2, backoff_factor=2.0,
+                          full_jitter=True, max_elapsed=5.0),
+        breaker_cooldown_s=1.0)
+
+    def request(i, rid):
+        return ServeRequest(
+            binary_prompt=f"{texts[i]} {response_format}",
+            confidence_prompt=f"{texts[i]} {confidence_format}",
+            klass="chaos", request_id=rid)
+
+    last_oom = None
+    for batch in batches:
+        def make_engine():
+            return ScoringEngine(params, cfg,
+                                 tokenizer if tokenizer is not None
+                                 else FakeTokenizer(),
+                                 RuntimeConfig(batch_size=batch,
+                                               max_seq_len=512))
+
+        def one_session(schedules):
+            server = ScoringServer(make_engine(), "bench-chaos",
+                                   serve_cfg)
+            if schedules is not None:
+                # Share the server's FaultStats so injected and
+                # recovered counters land in ONE summary.
+                plan = faults.FaultPlan(seed=17, schedules=schedules,
+                                        stats=server.faults)
+                faults.wrap_server(server, plan)
+            server.start()
+            # warm pass: compile every shape outside the timed window
+            warm = [server.submit(request(i, f"w{i}"))
+                    for i in range(min(cells, 2 * batch))]
+            for f in warm:
+                f.result(timeout=600)
+            t0 = time.perf_counter()
+            futs = [server.submit(request(i, f"t{i}"))
+                    for i in range(cells)]
+            out = [f.result(timeout=600) for f in futs]
+            dt = time.perf_counter() - t0
+            server.stop()
+            return server, out, dt
+
+        try:
+            _, clean_out, clean_dt = one_session(None)
+            server, fault_out, fault_dt = one_session({
+                "dispatch": faults.SiteSchedule(
+                    rate=0.25, max_failures=max(2, cells // 8))})
+        except Exception as err:  # noqa: BLE001 — OOM falls back
+            if _is_oom(err):
+                last_oom = err
+                continue
+            raise
+        bad = [r.request_id for r in clean_out + fault_out
+               if r.status != "ok"]
+        if bad:
+            print(f"# chaos bench: requests not recovered to ok: {bad}",
+                  file=sys.stderr)
+        fstats = server.faults
+        out = {
+            "cells": cells, "batch": batch,
+            "injected_faults": fstats.injected_total,
+            "recovered_dispatches": fstats.recovered_dispatches,
+            "degraded_dispatches": fstats.degraded_dispatches,
+            "degraded_rows": fstats.degraded_rows,
+            "breaker_opens": fstats.breaker_opens,
+            "unrecovered_requests": len(bad),
+            "goodput_clean_p_s": round(cells / clean_dt, 3),
+            "goodput_faults_p_s": round(cells / fault_dt, 3),
+            "goodput_vs_clean": round(clean_dt / fault_dt, 3),
+        }
+        print(f"# chaos mode ({cells} reqs, {fstats.injected_total} "
+              f"injected faults): goodput {out['goodput_faults_p_s']:.3f} "
+              f"p/s under faults vs {out['goodput_clean_p_s']:.3f} clean "
+              f"({out['goodput_vs_clean']:.2f}x), recovered "
+              f"{fstats.recovered_dispatches} dispatches, degraded "
+              f"{fstats.degraded_rows} rows", file=sys.stderr)
+        return out
+    print(f"# chaos mode: every batch candidate OOMed; last: {last_oom}",
           file=sys.stderr)
     return None
 

@@ -201,6 +201,18 @@ def _add_serve(sub) -> None:
     p.add_argument("--no-precompile", action="store_true",
                    help="skip the boot AOT precompile of every "
                         "(ladder, suffix, batch) executable")
+    p.add_argument("--breaker-cooldown", type=float, default=30.0,
+                   help="circuit-breaker open->half-open cooldown in "
+                        "seconds: after max_consecutive_failures the "
+                        "server sheds for this long, then probes the "
+                        "device with one dispatch and recovers on "
+                        "success (DEPLOY.md §1e)")
+    p.add_argument("--state-checkpoint", type=Path, default=None,
+                   help="crash-consistent state file: SIGTERM stops the "
+                        "supervisor and atomically writes every "
+                        "unresolved request here; on boot, an existing "
+                        "file is re-submitted (dedup-deduplicated "
+                        "against anything already served)")
 
 
 def _add_rephrase(sub) -> None:
@@ -361,7 +373,8 @@ def cmd_serve(args) -> None:
     serve_cfg = ServeConfig(
         queue_depth=args.queue_depth, classes=tuple(classes.items()),
         linger_s=args.linger_ms / 1000.0,
-        cache_entries=args.cache_entries)
+        cache_entries=args.cache_entries,
+        breaker_cooldown_s=args.breaker_cooldown)
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
         cache_root=args.param_cache, quantize_int8=args.int8,
@@ -370,13 +383,29 @@ def cmd_serve(args) -> None:
     server = ScoringServer(engine, args.model, serve_cfg,
                            precompile=not args.no_precompile).start()
 
+    futures = []
+    if args.state_checkpoint is not None:
+        import signal
+
+        def _on_sigterm(signum, frame):
+            n = server.shutdown_checkpoint(args.state_checkpoint)
+            log.warning("SIGTERM: checkpointed %d pending requests -> %s"
+                        "; exiting", n, args.state_checkpoint)
+            sys.exit(0)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+        if args.state_checkpoint.exists():
+            # Resume the previous incarnation's unresolved requests
+            # BEFORE reading new traffic (their results print first).
+            futures.extend(server.resume_from_checkpoint(
+                args.state_checkpoint))
+
     # Default formats: the canonical legal-prompt pair, so a bare
     # {"prompt": ...} line scores exactly like a sweep cell.
     default_rf = LEGAL_PROMPTS[0].response_format
     default_cf = LEGAL_PROMPTS[0].confidence_format
     stream = (sys.stdin if args.requests == "-"
               else open(args.requests, encoding="utf-8"))
-    futures = []
     try:
         for i, line in enumerate(stream):
             line = line.strip()
@@ -404,7 +433,10 @@ def cmd_serve(args) -> None:
         print(json.dumps({k: v for k, v in vars(r).items()
                           if not k.startswith("_")}), flush=True)
     server.stop()
+    if args.state_checkpoint is not None and args.state_checkpoint.exists():
+        args.state_checkpoint.unlink()   # clean drain: nothing pending
     log.info("serve stats: %s", json.dumps(server.stats.summary()))
+    log.info("serve faults: %s", json.dumps(server.faults.summary()))
     if not server.healthy:
         sys.exit(1)
 

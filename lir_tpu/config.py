@@ -185,13 +185,27 @@ class ServeConfig:
     - ``cache_entries``: content-addressed result-cache capacity (LRU).
       0 disables dedup.
     - ``max_consecutive_failures``: after this many back-to-back dispatch
-      failures (each already retried per ``retry``) the server drains the
-      queue with error results and flips its health flag — a supervisor
-      (k8s, systemd) restarts it rather than letting it eat the queue.
+      failures (each already retried per ``retry``) the circuit breaker
+      OPENS (faults/breaker.py): the queue drains with error results and
+      submits shed until the breaker recovers — but unlike the pre-PR4
+      one-way health flag, after ``breaker_cooldown_s`` the breaker goes
+      HALF-OPEN and lets one probe dispatch through; probe success closes
+      it (healthy again), probe failure re-opens it for another cooldown.
+      A transient device outage costs one cheap probe per cooldown
+      instead of the whole process.
+    - ``breaker_cooldown_s``: how long the breaker stays open before the
+      half-open probe. Tune to the expected outage shape: ~30 s covers
+      driver restarts and preempted-neighbor wobbles; sub-second values
+      are for tests and chaos drivers (DEPLOY.md §1e).
+    - ``degrade_ladder``: on a dispatch that fails all its retries,
+      degrade instead of erroring the whole batch — drop the AOT
+      registry (lazy jit re-trace excludes a corrupt executable), retry
+      once, then bisect the batch to isolate poison rows; only the
+      culprit rows resolve as errors (faults/ladder.py).
     - ``retry``: device-dispatch retry policy. Short, full-jitter, and
       elapsed-capped — a transient XLA/runtime hiccup is retried inside
       the request deadlines; a persistent fault fails fast into the
-      health-flag path.
+      breaker path.
     """
 
     queue_depth: int = 256
@@ -211,6 +225,8 @@ class ServeConfig:
     pad_full: bool = True
     cache_entries: int = 4096
     max_consecutive_failures: int = 3
+    breaker_cooldown_s: float = 30.0
+    degrade_ladder: bool = True
     retry: RetryConfig = dataclasses.field(default_factory=lambda: RetryConfig(
         max_retries=2, initial_delay=0.25, max_delay=2.0,
         backoff_factor=2.0, full_jitter=True, max_elapsed=8.0))

@@ -21,6 +21,16 @@ from ..data.prompts import LegalPrompt
 
 RESUME_KEY_FIELDS = ("model", "original_main", "rephrased_main")
 
+# Manifest key field -> D6 results-workbook column. Seeding the resume
+# done-set from the results artifact (SweepManifest.from_existing_results)
+# needs this mapping: the workbook keeps the reference's column names
+# while the manifest keys stay snake_case.
+RESUME_COLUMN_MAP = {
+    "model": "Model",
+    "original_main": "Original Main Part",
+    "rephrased_main": "Rephrased Main Part",
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class GridCell:

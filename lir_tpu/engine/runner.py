@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import RuntimeConfig
-from ..utils.profiling import CompileStats
+from ..utils.profiling import CompileStats, FaultStats
 from . import compile_plan, generate, score, tokens as tok
 
 
@@ -182,6 +182,9 @@ class ScoringEngine:
         # per-shape compile seconds + registry/persistent-cache hit rates.
         self.compile_stats = CompileStats()
         self.exec_registry = None
+        # Failure-path accounting (lir_tpu/faults): the sweep's dispatch
+        # recovery and any wrapping FaultPlan count into this.
+        self.fault_stats = FaultStats()
         self._seq_mesh_note = (
             None if seq_mesh is None
             else (repr(getattr(seq_mesh, "shape", seq_mesh)), seq_impl))
@@ -195,6 +198,17 @@ class ScoringEngine:
         same two executables a warmup over the same shapes compiles, so
         steady-state timing never hits a fresh compile mid-stream."""
         self._handoff = _CacheHandoff()
+
+    def degrade_to_lazy(self) -> None:
+        """Degradation-ladder step one (lir_tpu/faults): drop the AOT
+        registry so subsequent dispatches fall back to lazy jit — a
+        fresh trace excludes a corrupt precompiled executable from the
+        fault hypothesis — and reset the donation chain, whose scratch
+        buffer a failed dispatch may have consumed or left in an
+        undefined state. Both rebuild themselves on demand; the cost is
+        one re-trace per shape, paid only after a real failure."""
+        self.exec_registry = None
+        self.fresh_handoff()
 
     @property
     def cache_manifest_key(self) -> str:

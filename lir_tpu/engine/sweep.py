@@ -22,11 +22,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..config import RetryConfig
 from ..data import schemas
 from ..data.prompts import LegalPrompt
 from ..utils.logging import get_logger
 from ..utils.manifest import SweepManifest
 from ..utils.profiling import OccupancyStats
+from ..utils.retry import retry_with_exponential_backoff
 from . import compile_plan
 from . import generate
 from . import grid as grid_mod
@@ -38,6 +40,44 @@ from .runner import ScoringEngine, _tail_batch
 log = get_logger(__name__)
 
 CHECKPOINT_EVERY = 100  # rows, perturb_prompts.py:975-984
+
+# Device-dispatch recovery policy for the offline sweep: a transient
+# XLA/runtime fault (or an injected chaos fault — lir_tpu/faults) costs
+# a short full-jitter retry window, not the sweep. Deliberately brief:
+# the sweep resumes from its manifest anyway, so a persistent outage
+# should fail fast into the operator's restart loop rather than sleep
+# through it.
+DISPATCH_RETRY = RetryConfig(max_retries=3, initial_delay=0.05,
+                             max_delay=1.0, backoff_factor=2.0,
+                             full_jitter=True, max_elapsed=30.0)
+
+
+def _dispatch_with_recovery(engine, call):
+    """Run one device dispatch with the sweep's self-healing ladder: on
+    failure, degrade the AOT registry to lazy jit (a corrupt precompiled
+    executable is the first suspect — runner.degrade_to_lazy also resets
+    the donation chain the failed dispatch may have consumed) and retry
+    under DISPATCH_RETRY. KeyboardInterrupt/SystemExit and simulated
+    preemptions (BaseException) always propagate — recovery outlives
+    faults, not kills."""
+    from ..utils.profiling import is_oom_error
+
+    try:
+        return call()
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as err:  # noqa: BLE001 — retried below
+        if is_oom_error(err):
+            raise  # capacity, not transience: the caller's batch
+            # ladder (bench/tools) owns OOM fallback
+        log.warning("sweep dispatch failed (%r); degrading AOT registry "
+                    "-> lazy jit and retrying", err)
+        engine.degrade_to_lazy()
+        out = retry_with_exponential_backoff(
+            call, retry_on=(Exception,), config=DISPATCH_RETRY,
+            log=lambda m: log.warning("sweep dispatch retry: %s", m))
+        engine.fault_stats.count("recovered_dispatches")
+        return out
 
 
 def run_word_meaning_sweep(
@@ -137,9 +177,19 @@ def run_perturbation_sweep(
         results_path = results_path.with_name(
             f"{results_path.stem}.host{i}{results_path.suffix}")
         log.info("multihost: process %d writes %s", i, results_path)
-    manifest = manifest or SweepManifest(
-        results_path.with_suffix(".manifest.jsonl"),
-        grid_mod.RESUME_KEY_FIELDS)
+    # Crash-consistent resume: the done-set is the UNION of the manifest
+    # and the rows already in the results artifact. The flush order is
+    # results-append THEN manifest-mark, so a kill between the two leaves
+    # rows only the results file knows about — a manifest-only resume
+    # would re-score and duplicate them (pinned by tools/chaos_smoke.py).
+    # (`manifest or ...` would silently replace an EMPTY explicit
+    # manifest — len() == 0 is falsy — discarding any wrapping/faking a
+    # caller attached to it; test None explicitly.)
+    if manifest is None:
+        manifest = SweepManifest.from_existing_results(
+            results_path.with_suffix(".manifest.jsonl"), results_path,
+            grid_mod.RESUME_KEY_FIELDS,
+            column_map=grid_mod.RESUME_COLUMN_MAP)
     engine.occupancy = None  # set by _run_pipelined's ragged planner
     cells = grid_mod.build_grid(model_name, prompts, perturbations)
     cells = grid_mod.random_subset(cells, subset_size, seed)
@@ -193,6 +243,9 @@ def run_perturbation_sweep(
         engine.compile_stats.finish_persistent()
         log.info("compile plan: %s",
                  json.dumps(engine.compile_stats.summary()))
+        if engine.fault_stats.recovered_dispatches:
+            log.info("fault recovery: %s",
+                     json.dumps(engine.fault_stats.summary()))
 
     if pending_rows:
         _flush(pending_rows, results_path, manifest)
@@ -426,11 +479,12 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 [target_ids[c.prompt_idx][0] for c in full], np.int32)
             t2 = np.asarray(
                 [target_ids[c.prompt_idx][1] for c in full], np.int32)
-            fused, cfused = engine.decode_fused_shared(
-                [c.binary_prompt for c in full],
-                [c.confidence_prompt for c in full],
-                t1, t2, new_tokens=new_tokens, conf_tokens=conf_tokens,
-                early_stop=early_stop)
+            fused, cfused = _dispatch_with_recovery(
+                engine, lambda: engine.decode_fused_shared(
+                    [c.binary_prompt for c in full],
+                    [c.confidence_prompt for c in full],
+                    t1, t2, new_tokens=new_tokens, conf_tokens=conf_tokens,
+                    early_stop=early_stop))
             res = score_mod.readout_from_fused(
                 fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
             work_q.put((batch, fused, res, cfused))
@@ -450,16 +504,17 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 t2 = np.asarray(
                     [target_ids[it.cell.prompt_idx][1]
                      for it in full_items], np.int32)
-                fused, cfused = engine.decode_fused_shared(
-                    [it.cell.binary_prompt for it in full_items],
-                    [it.cell.confidence_prompt for it in full_items],
-                    t1, t2, new_tokens=new_tokens, conf_tokens=conf_tokens,
-                    early_stop=early_stop,
-                    pretokenized_a=[it.bin_ids for it in full_items],
-                    pretokenized_b=[it.conf_ids for it in full_items],
-                    bucket=d.bucket,
-                    sfx_buckets_ab=(d.sfx_bucket_a, d.sfx_bucket_b),
-                    reuse_cache=True)
+                fused, cfused = _dispatch_with_recovery(
+                    engine, lambda: engine.decode_fused_shared(
+                        [it.cell.binary_prompt for it in full_items],
+                        [it.cell.confidence_prompt for it in full_items],
+                        t1, t2, new_tokens=new_tokens,
+                        conf_tokens=conf_tokens, early_stop=early_stop,
+                        pretokenized_a=[it.bin_ids for it in full_items],
+                        pretokenized_b=[it.conf_ids for it in full_items],
+                        bucket=d.bucket,
+                        sfx_buckets_ab=(d.sfx_bucket_a, d.sfx_bucket_b),
+                        reuse_cache=True))
                 res = score_mod.readout_from_fused(
                     fused, jnp.asarray(t1), jnp.asarray(t2),
                     scan_positions=1)
@@ -470,10 +525,12 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 t2 = np.asarray(
                     [target_ids[it.cell.prompt_idx][1]
                      for it in d.items], np.int32)
-                out, m = engine.decode_fused_grouped(
-                    d.groups, t1, t2, new_tokens, conf_tokens, early_stop,
-                    d.bucket, max(d.sfx_bucket_a, d.sfx_bucket_b),
-                    reuse_cache=True)
+                out, m = _dispatch_with_recovery(
+                    engine, lambda: engine.decode_fused_grouped(
+                        d.groups, t1, t2, new_tokens, conf_tokens,
+                        early_stop, d.bucket,
+                        max(d.sfx_bucket_a, d.sfx_bucket_b),
+                        reuse_cache=True))
                 # Member rows are [bin, conf] per cell: even rows carry
                 # the binary readout, odd rows the confidence one. Both
                 # ran the shared max(new, conf) budget, so each branch

@@ -1,0 +1,34 @@
+"""Fault-injection harness + self-healing primitives.
+
+The failure path engineered like the hot path (ROADMAP north star): a
+deterministic, seeded fault-injection layer (plan.FaultPlan) that wraps
+the engine and serve boundaries, and the three recovery mechanisms it
+proves out —
+
+- breaker.CircuitBreaker: the serve health flag as a real closed/open/
+  half-open breaker, so a transient device outage no longer kills the
+  server forever (serve/server.py);
+- ladder.degrade_dispatch: bisect a failing batch to isolate poison
+  rows, resolve only the culprits as errors (serve/server.py, after the
+  AOT->lazy fallback runner.ScoringEngine.degrade_to_lazy);
+- crash-consistent resume: torn-tail-tolerant fsync'd manifest appends
+  (utils/manifest.py), results-seeded done-sets (engine/sweep.py), and
+  the serve SIGTERM state checkpoint (server.shutdown_checkpoint).
+
+Chaos drivers: ``make chaos-smoke`` (tools/chaos_smoke.py) and
+``python bench.py --chaos`` run sweeps and serve sessions under seeded
+kill/fault schedules and assert zero lost / zero duplicated rows vs a
+fault-free run; counters land in profiling.FaultStats.
+"""
+
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from .ladder import degrade_dispatch
+from .plan import (SITES, FaultPlan, InjectedFault, InjectedPreemption,
+                   SiteSchedule, tear_jsonl_tail, wrap_engine, wrap_server)
+
+__all__ = [
+    "FaultPlan", "SiteSchedule", "InjectedFault", "InjectedPreemption",
+    "SITES", "wrap_engine", "wrap_server", "tear_jsonl_tail",
+    "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
+    "degrade_dispatch",
+]

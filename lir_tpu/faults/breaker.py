@@ -1,0 +1,108 @@
+"""Circuit breaker: the serve health flag upgraded into a state machine
+with a way BACK to healthy.
+
+The old contract (serve/server.py pre-PR4) was one-way: after
+``max_consecutive_failures`` dispatch failures the server drained its
+queue and flipped ``healthy`` False forever — correct for a dead device,
+wrong for the common case (a transient runtime wobble, a preempted
+neighbor, a driver hiccup) where the device comes back in seconds and
+the only thing keeping the server down is its own flag.
+
+Standard three-state breaker semantics instead:
+
+- CLOSED: normal operation. Failures increment a consecutive counter;
+  reaching ``failure_threshold`` opens the breaker (the caller drains
+  queued work with error results, exactly like the old trip).
+- OPEN: every submit sheds immediately — no queue can build up behind a
+  device that isn't answering. After ``cooldown_s`` the next state READ
+  promotes to HALF_OPEN (promotion is lazy: no timer thread; the first
+  submit or supervisor poll after the cooldown sees HALF_OPEN).
+- HALF_OPEN: admits traffic again; the first dispatch is the probe.
+  Success closes the breaker (healthy, counter reset); failure re-opens
+  it for another cooldown — one cheap dispatch is all an outage costs
+  per cooldown period.
+
+Every transition is recorded into profiling.FaultStats, so the recovery
+story of a chaos run is readable from ``transitions`` alone.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils.profiling import FaultStats
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Thread-safe closed/open/half-open breaker over a monotonic clock."""
+
+    def __init__(self, failure_threshold: int = 3,
+                 cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 stats: Optional[FaultStats] = None):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self.stats = stats if stats is not None else FaultStats()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def _transition(self, to: str) -> None:
+        # lock held by caller
+        frm, self._state = self._state, to
+        self.stats.transition(frm, to)
+
+    def _promote_locked(self) -> None:
+        """OPEN -> HALF_OPEN once the cooldown has elapsed."""
+        if (self._state == OPEN and self._opened_at is not None
+                and self.clock() - self._opened_at >= self.cooldown_s):
+            self._transition(HALF_OPEN)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._promote_locked()
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive
+
+    def allow(self) -> bool:
+        """May traffic flow? True in CLOSED and HALF_OPEN (the half-open
+        admissions become the probe dispatch)."""
+        return self.state != OPEN
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            if self._state == HALF_OPEN:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> bool:
+        """One dispatch failure (retries already exhausted). Returns True
+        when the breaker OPENED on this failure — the caller then drains
+        queued work with error results."""
+        with self._lock:
+            self._promote_locked()
+            self._consecutive += 1
+            if self._state == HALF_OPEN:
+                # The probe failed: back to OPEN for another cooldown.
+                self._opened_at = self.clock()
+                self._transition(OPEN)
+                return True
+            if (self._state == CLOSED
+                    and self._consecutive >= self.failure_threshold):
+                self._opened_at = self.clock()
+                self._transition(OPEN)
+                return True
+        return False

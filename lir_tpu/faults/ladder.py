@@ -1,0 +1,64 @@
+"""Degradation ladder: isolate poison rows instead of failing a batch.
+
+A dispatch that keeps failing after retries has two very different
+causes with two very different remedies:
+
+1. The DEVICE (or an executable) is broken — retrying subsets fails
+   everywhere. The caller should fail the dispatch and let the circuit
+   breaker take over.
+2. One ROW is poison — a pathological prompt that crashes the kernel, a
+   tokenizer edge case, a corrupt cache interaction. Failing the whole
+   batch punishes every innocent neighbor, and under continuous
+   batching the poison row re-queues with NEW neighbors and takes them
+   down too: one bad request can wedge a whole service.
+
+:func:`degrade_dispatch` tells them apart by bisection: retry the full
+batch once (the caller has usually just dropped the AOT registry via
+``ScoringEngine.degrade_to_lazy`` — a corrupt precompiled executable is
+remedy zero), then split-and-recurse; rows that fail ALONE are poison
+and come back as None, everything else comes back scored. Cost is
+O(poison * log batch) extra dispatches, zero when the full-batch retry
+succeeds.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+from .plan import InjectedPreemption  # noqa: F401  (re-export for callers)
+
+
+def degrade_dispatch(score_fn: Callable[[list], List[dict]],
+                     rows: Sequence,
+                     log: Optional[Callable[[str], None]] = None,
+                     ) -> List[Optional[dict]]:
+    """Score ``rows`` through ``score_fn`` (which takes a row subset and
+    returns one payload per row), bisecting on failure to isolate poison
+    rows. Returns a list aligned with ``rows``: a payload dict, or None
+    for rows that fail even in a batch of one.
+
+    KeyboardInterrupt/SystemExit/InjectedPreemption always propagate —
+    the ladder recovers work, it does not resist being killed.
+    """
+    rows = list(rows)
+    out: List[Optional[dict]] = [None] * len(rows)
+
+    def solve(lo: int, hi: int) -> None:
+        try:
+            payloads = score_fn(rows[lo:hi])
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as err:  # noqa: BLE001 — bisect decides
+            if hi - lo == 1:
+                if log is not None:
+                    log(f"poison row isolated at index {lo}: {err!r}")
+                return
+            mid = (lo + hi) // 2
+            solve(lo, mid)
+            solve(mid, hi)
+            return
+        out[lo:hi] = list(payloads)
+
+    if rows:
+        solve(0, len(rows))
+    return out

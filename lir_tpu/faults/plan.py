@@ -1,0 +1,189 @@
+"""Deterministic, seeded fault injection at the engine/serve boundaries.
+
+The reliability claims of this repo (resume-idempotent sweeps, a serving
+layer that degrades instead of crashing) are only claims until something
+actually kills the process mid-checkpoint and unplugs the device
+mid-dispatch. A :class:`FaultPlan` is that something, made reproducible:
+per-SITE failure schedules (explicit call indices + an optional seeded
+Bernoulli rate) that raise :class:`InjectedFault` — standing in for a
+transient XLA/runtime device error — or :class:`InjectedPreemption` — a
+simulated preemption/kill signal — at exactly the same calls on every
+run with the same seed.
+
+Sites are plain strings; the canonical ones (``SITES``) cover the
+boundaries the recovery machinery wraps:
+
+- ``dispatch``   — the engine's fused-decode calls / the batcher's score
+- ``compile``    — AOT registry compiles (compile_plan)
+- ``tokenize``   — tokenizer encode at submit/plan time
+- ``manifest_write``   — SweepManifest appends
+- ``checkpoint_write`` — serve state-checkpoint writes
+- ``preempt``    — an explicit preemption check (sweep/serve loops)
+
+``InjectedPreemption`` subclasses BaseException on purpose: a real
+SIGKILL does not flow through ``except Exception`` recovery paths, so
+neither may its simulation — it must unwind all the way out, exactly
+like the writer-thread re-raise contract in engine/sweep.py expects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ..utils.profiling import FaultStats
+
+SITES = ("dispatch", "compile", "tokenize", "manifest_write",
+         "checkpoint_write", "preempt")
+
+
+class InjectedFault(RuntimeError):
+    """A scheduled transient failure (device error stand-in)."""
+
+
+class InjectedPreemption(BaseException):
+    """A scheduled kill. BaseException so recovery code catching
+    Exception cannot accidentally 'survive' it — a real preemption
+    wouldn't ask first."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SiteSchedule:
+    """When one site fails.
+
+    - ``fail_calls``: explicit 0-based call indices that fail — the
+      precise tool (an outage is a contiguous range).
+    - ``rate``: additionally, a seeded Bernoulli failure probability per
+      call — the statistical tool (soak tests).
+    - ``max_failures``: hard bound on total injections at this site (a
+      rate-based schedule then models a TRANSIENT outage the recovery
+      machinery must outlast, not a permanently broken device).
+    - ``kind``: "fault" raises InjectedFault, "preempt" raises
+      InjectedPreemption.
+    """
+
+    fail_calls: Tuple[int, ...] = ()
+    rate: float = 0.0
+    max_failures: Optional[int] = None
+    kind: str = "fault"
+
+    @classmethod
+    def outage(cls, start: int, length: int) -> "SiteSchedule":
+        """Every call in [start, start+length) fails — a device outage."""
+        return cls(fail_calls=tuple(range(start, start + length)))
+
+    @classmethod
+    def kill_at(cls, call: int) -> "SiteSchedule":
+        """Simulated preemption at one call index."""
+        return cls(fail_calls=(call,), kind="preempt")
+
+
+class FaultPlan:
+    """Seeded per-site failure schedules + the counters they feed.
+
+    Thread-safe: call counters and the per-site PRNGs sit behind one
+    lock, so concurrent sites (the serve supervisor + submit threads)
+    see a single deterministic schedule.
+    """
+
+    def __init__(self, seed: int = 0,
+                 schedules: Optional[Dict[str, SiteSchedule]] = None,
+                 stats: Optional[FaultStats] = None):
+        self.seed = int(seed)
+        self.schedules: Dict[str, SiteSchedule] = dict(schedules or {})
+        self.stats = stats if stats is not None else FaultStats()
+        self._calls: Dict[str, int] = {}
+        self._injected: Dict[str, int] = {}
+        self._rngs: Dict[str, random.Random] = {}
+        self._lock = threading.Lock()
+
+    def calls(self, site: str) -> int:
+        with self._lock:
+            return self._calls.get(site, 0)
+
+    def injected(self, site: str) -> int:
+        with self._lock:
+            return self._injected.get(site, 0)
+
+    def _decide(self, site: str) -> Optional[SiteSchedule]:
+        """Advance the site's call counter; return its schedule when THIS
+        call should fail. One lock-held decision so schedules are exact
+        under concurrency."""
+        with self._lock:
+            idx = self._calls.get(site, 0)
+            self._calls[site] = idx + 1
+            sched = self.schedules.get(site)
+            if sched is None:
+                return None
+            done = self._injected.get(site, 0)
+            if sched.max_failures is not None and done >= sched.max_failures:
+                return None
+            fail = idx in sched.fail_calls
+            if not fail and sched.rate > 0.0:
+                rng = self._rngs.get(site)
+                if rng is None:
+                    # Site-keyed stream: adding a site never perturbs
+                    # another site's draws.
+                    rng = random.Random(f"{self.seed}:{site}")
+                    self._rngs[site] = rng
+                fail = rng.random() < sched.rate
+            if not fail:
+                return None
+            self._injected[site] = done + 1
+        return sched
+
+    def check(self, site: str) -> None:
+        """The injection point: raise when the schedule says this call
+        fails, else return. Every wrapped boundary calls this first."""
+        sched = self._decide(site)
+        if sched is None:
+            return
+        if sched.kind == "preempt":
+            self.stats.inject(site, preemption=True)
+            raise InjectedPreemption(
+                f"injected preemption at {site} call "
+                f"{self.calls(site) - 1}")
+        self.stats.inject(site)
+        raise InjectedFault(
+            f"injected fault at {site} call {self.calls(site) - 1}")
+
+    def wrap(self, site: str, fn: Callable) -> Callable:
+        """``fn`` with a fault check in front (schedule indexed by call
+        count at ``site``, not by wrapper)."""
+
+        def wrapped(*args, **kwargs):
+            self.check(site)
+            return fn(*args, **kwargs)
+
+        wrapped.__wrapped__ = fn  # type: ignore[attr-defined]
+        return wrapped
+
+
+def wrap_engine(engine, plan: FaultPlan):
+    """Inject the plan's ``dispatch`` site in front of the engine's fused
+    decode entry points (the sweep's device boundary). Instance-level
+    shadowing only — the class stays clean and other engines untouched."""
+    engine.decode_fused_shared = plan.wrap("dispatch",
+                                           engine.decode_fused_shared)
+    engine.decode_fused_grouped = plan.wrap("dispatch",
+                                            engine.decode_fused_grouped)
+    return engine
+
+
+def wrap_server(server, plan: FaultPlan):
+    """Inject the plan's ``dispatch`` site in front of the batcher's
+    score call (the serve device boundary — under the supervisor's retry
+    policy, so recovery is exercised, not bypassed)."""
+    server.batcher.score = plan.wrap("dispatch", server.batcher.score)
+    return server
+
+
+def tear_jsonl_tail(path, fragment: str = '{"model": "m", "orig') -> None:
+    """Append a torn (non-JSON, newline-free) fragment to a JSONL file —
+    the exact on-disk state a kill mid-append leaves behind. Chaos tests
+    use it to prove SweepManifest resume survives its own crash mode."""
+    with open(path, "a") as f:
+        f.write(fragment)
+        f.flush()

@@ -21,7 +21,10 @@ Components:
   the offline planner's own scheduler.bucket_cost model.
 - server.ScoringServer — the supervisor loop: retry with full jitter and
   an elapsed cap (utils/retry.py), partial results on deadline expiry,
-  health-flag trip + queue drain on repeated device errors.
+  a circuit breaker (open on repeated device errors, half-open probe
+  after a cooldown, closed on probe success — lir_tpu/faults), a
+  degradation ladder that bisects failing batches to isolate poison
+  rows, and a SIGTERM state checkpoint for preemption-safe restarts.
 
 Surface: the ``lir_tpu serve`` CLI subcommand (JSONL over stdin/stdout),
 profiling.ServeStats observability, and bench.py's Poisson open-loop

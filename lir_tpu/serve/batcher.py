@@ -90,6 +90,13 @@ class ContinuousBatcher:
     def pending_rows(self) -> int:
         return sum(len(q) for q in self._queues.values())
 
+    def snapshot(self) -> List[Pending]:
+        """Non-destructive copy of every bucketed entry, bucket order
+        (the serve state checkpoint reads this after the supervisor
+        loop has stopped — the batcher itself is supervisor-private, so
+        no lock is needed once that thread is joined)."""
+        return [p for _, q in sorted(self._queues.items()) for p in q]
+
     def _expire(self, p: Pending, now: float) -> None:
         """Deadline passed while queued: a PARTIAL confidence-free result
         (status only; every measurement field None) instead of failing

@@ -44,6 +44,19 @@ class ServeRequest:
     deadline_s: Optional[float] = None
     request_id: str = ""
 
+    def to_record(self) -> dict:
+        """JSON-safe dict for the serve state checkpoint."""
+        rec = dataclasses.asdict(self)
+        rec["targets"] = list(self.targets)
+        return rec
+
+    @classmethod
+    def from_record(cls, rec: dict) -> "ServeRequest":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in rec.items() if k in known}
+        kwargs["targets"] = tuple(kwargs.get("targets", ("Yes", "No")))
+        return cls(**kwargs)
+
 
 @dataclasses.dataclass
 class ServeResult:
@@ -175,6 +188,12 @@ class RequestQueue:
             out = list(self._dq)
             self._dq.clear()
         return out
+
+    def snapshot(self) -> List[Pending]:
+        """Non-destructive copy of the queued entries (the serve state
+        checkpoint reads this under SIGTERM)."""
+        with self._lock:
+            return list(self._dq)
 
     def wait_nonempty(self, timeout: float) -> bool:
         with self._nonempty:

@@ -10,11 +10,24 @@ Lifecycle semantics (the graceful-degradation contract):
   (config.ServeConfig.retry: short, full-jitter, elapsed-capped —
   utils/retry.py) so one transient XLA/runtime hiccup never surfaces to
   clients.
-- After ``max_consecutive_failures`` dispatch failures in a row the
-  server drains the queue with error results and flips :attr:`healthy`
-  — the signal for an external supervisor (k8s liveness, systemd) to
-  restart the process; subsequent submits shed immediately instead of
-  queueing behind a dead device.
+- A dispatch that exhausts its retries enters the DEGRADATION LADDER
+  (faults/ladder.py): drop the AOT registry (lazy jit re-trace excludes
+  a corrupt precompiled executable), retry the batch once, then bisect
+  to isolate poison rows — only the culprit rows resolve as errors, the
+  rest are scored, and one pathological request can no longer take its
+  neighbors (or, re-queued with new neighbors, the whole service) down.
+- After ``max_consecutive_failures`` full dispatch failures in a row the
+  CIRCUIT BREAKER opens (faults/breaker.py): the queue drains with error
+  results and submits shed — but after ``breaker_cooldown_s`` the
+  breaker goes half-open, admits traffic, and probes the device with the
+  next dispatch; success closes it (healthy again, no restart needed),
+  failure re-opens it for another cooldown. :attr:`healthy` reads the
+  breaker, so external supervisors keep their liveness signal.
+- On SIGTERM (preemption warning), :meth:`shutdown_checkpoint` stops the
+  supervisor WITHOUT finishing the backlog and writes every unresolved
+  request to an atomic JSON checkpoint; a restarted server re-submits
+  them via :meth:`resume_from_checkpoint` — zero lost requests across a
+  preemption, dedup-deduplicated against anything already served.
 
 Dedup rides in front of admission: a submit whose content address is
 already cached resolves without touching the queue or the device —
@@ -26,13 +39,16 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, Optional, Tuple
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..config import ServeConfig
 from ..engine import compile_plan
 from ..engine import tokens as tok
+from ..faults import CLOSED, HALF_OPEN, CircuitBreaker, degrade_dispatch
 from ..utils.logging import get_logger
-from ..utils.profiling import ServeStats
+from ..utils.manifest import atomic_write_json
+from ..utils.profiling import FaultStats, ServeStats
 from ..utils.retry import retry_with_exponential_backoff
 from .batcher import ContinuousBatcher
 from .cache import ResultCache, content_key
@@ -40,6 +56,8 @@ from .queue import (STATUS_ERROR, STATUS_OK, STATUS_SHED, Pending,
                     RequestQueue, ServeFuture, ServeRequest, ServeResult)
 
 log = get_logger(__name__)
+
+CHECKPOINT_VERSION = 1
 
 
 class ScoringServer:
@@ -67,12 +85,17 @@ class ScoringServer:
         self.batcher = ContinuousBatcher(engine, self.stats,
                                          self.config.linger_s, clock,
                                          pad_full=self.config.pad_full)
+        self.faults = FaultStats()
+        self.breaker = CircuitBreaker(
+            failure_threshold=self.config.max_consecutive_failures,
+            cooldown_s=self.config.breaker_cooldown_s,
+            clock=clock, stats=self.faults)
         self._engine_key = engine.cache_manifest_key
         self._target_memo: Dict[Tuple[str, str], Tuple[int, int]] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._consecutive = 0
-        self._healthy = True
+        self._abort = False          # stop WITHOUT draining (checkpoint)
+        self._inflight: List[Pending] = []
         engine.fresh_handoff()     # fresh donation chain per session
         if precompile and engine.rt.aot_precompile:
             # pad_full pins every dispatch to the full batch shape, so
@@ -90,7 +113,11 @@ class ScoringServer:
 
     @property
     def healthy(self) -> bool:
-        return self._healthy
+        """True while the circuit breaker is CLOSED. Half-open (probing
+        after a cooldown) reads unhealthy to external supervisors but
+        already admits traffic — a probe success flips this back True
+        without a restart."""
+        return self.breaker.state == CLOSED
 
     # -- client side ---------------------------------------------------------
 
@@ -107,9 +134,9 @@ class ScoringServer:
 
     def submit(self, request: ServeRequest) -> ServeFuture:
         """Admit one request; returns a future that resolves with a
-        ServeResult (possibly immediately: dedup hit, shed, unhealthy).
-        Tokenization runs here on the caller's thread, keeping the
-        supervisor loop on the device's critical path only."""
+        ServeResult (possibly immediately: dedup hit, shed, breaker
+        open). Tokenization runs here on the caller's thread, keeping
+        the supervisor loop on the device's critical path only."""
         self.stats.count("submitted")
         fut = ServeFuture()
         now = self.clock()
@@ -123,11 +150,12 @@ class ScoringServer:
                     request_id=request.request_id, status=STATUS_OK,
                     cached=True, latency_s=self.clock() - now, **hit))
                 return fut
-        if not self._healthy:
+        if not self.breaker.allow():
             self.stats.count("shed")
             fut.resolve(ServeResult(
                 request_id=request.request_id, status=STATUS_SHED,
-                note="server unhealthy — repeated device errors"))
+                note="server unhealthy — circuit breaker open "
+                     f"(cooldown {self.config.breaker_cooldown_s:.1f}s)"))
             return fut
         with self.engine._tok_lock:
             bin_ids = tuple(int(i) for i in self.engine.tokenizer(
@@ -168,6 +196,8 @@ class ScoringServer:
     def _loop(self) -> None:
         while True:
             stopping = self._stop.is_set()
+            if stopping and self._abort:
+                return           # checkpoint path: leave the backlog be
             for p in self.queue.drain():
                 self.batcher.admit(p)
             d = self.batcher.next_dispatch(self.clock(), flush=stopping)
@@ -182,49 +212,168 @@ class ScoringServer:
                 continue
             self._dispatch(*d)
 
-    def _dispatch(self, bucket: int, rows) -> None:
-        try:
-            payloads = retry_with_exponential_backoff(
-                lambda: self.batcher.score(bucket, rows),
-                retry_on=(Exception,), config=self.config.retry,
-                log=lambda m: log.warning("serve dispatch retry: %s", m),
-                clock=self.clock)
-        except Exception as err:  # noqa: BLE001 — degraded, never crash
-            self._consecutive += 1
-            now = self.clock()
-            self.stats.count("errors", len(rows))
-            for p in rows:
-                p.future.resolve(ServeResult(
-                    request_id=p.request.request_id, status=STATUS_ERROR,
-                    note=f"device error after retries: {err!r}",
-                    latency_s=now - p.t_submit))
-            log.warning("serve: dispatch failed (%d consecutive): %r",
-                        self._consecutive, err)
-            if self._consecutive >= self.config.max_consecutive_failures:
-                self._trip_health(err)
-            return
-        self._consecutive = 0
-        now = self.clock()
-        for p, payload in zip(rows, payloads):
-            self.cache.put(p.cache_key, payload)
-            latency = now - p.t_submit
-            self.stats.count("completed")
-            if now > p.t_deadline:
-                self.stats.count("late")
-            self.stats.record_latency(latency)
-            p.future.resolve(ServeResult(
-                request_id=p.request.request_id, status=STATUS_OK,
-                latency_s=latency, **payload))
+    def _resolve_ok(self, p: Pending, payload: Dict, now: float) -> None:
+        self.cache.put(p.cache_key, payload)
+        latency = now - p.t_submit
+        self.stats.count("completed")
+        if now > p.t_deadline:
+            self.stats.count("late")
+        self.stats.record_latency(latency)
+        p.future.resolve(ServeResult(
+            request_id=p.request.request_id, status=STATUS_OK,
+            latency_s=latency, **payload))
 
-    def _trip_health(self, err: BaseException) -> None:
-        """Repeated device errors: flip the health flag and drain every
-        waiting request with an error result — fail fast and visibly
-        instead of queueing behind a dead device."""
-        self._healthy = False
-        note = (f"server unhealthy after "
-                f"{self._consecutive} consecutive dispatch failures: "
-                f"{err!r}")
+    def _dispatch(self, bucket: int, rows) -> None:
+        probing = self.breaker.state == HALF_OPEN
+        attempts = {"n": 0}
+
+        def call():
+            attempts["n"] += 1
+            return self.batcher.score(bucket, rows)
+
+        self._inflight = list(rows)
+        try:
+            try:
+                payloads = retry_with_exponential_backoff(
+                    call, retry_on=(Exception,), config=self.config.retry,
+                    log=lambda m: log.warning("serve dispatch retry: %s",
+                                              m),
+                    clock=self.clock)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as err:  # noqa: BLE001 — degrade, never crash
+                self._dispatch_failed(bucket, rows, err, probing)
+                return
+            if attempts["n"] > 1:
+                # Transient fault outlived by the retry policy alone.
+                self.faults.count("recovered_dispatches")
+            self.breaker.record_success()
+            now = self.clock()
+            for p, payload in zip(rows, payloads):
+                self._resolve_ok(p, payload, now)
+        finally:
+            self._inflight = []
+
+    def _dispatch_failed(self, bucket: int, rows, err: BaseException,
+                         probing: bool) -> None:
+        """Retries exhausted on the full batch: run the degradation
+        ladder (unless this was a half-open probe — a probe exists to
+        test the device cheaply, not to bisect during an outage), and
+        only on TOTAL failure fall through to the breaker."""
+        if self.config.degrade_ladder and not probing:
+            self.faults.count("degraded_dispatches")
+            self.engine.degrade_to_lazy()
+            log.warning("serve: dispatch failed after retries (%r); "
+                        "degrading AOT registry -> lazy jit and bisecting "
+                        "%d rows", err, len(rows))
+            results = degrade_dispatch(
+                lambda rs: self.batcher.score(bucket, rs), rows,
+                log=lambda m: log.warning("serve degrade: %s", m))
+            n_ok = sum(r is not None for r in results)
+            if n_ok:
+                # The device works; the failure was transient or row-
+                # local. Culprit rows resolve as errors, neighbors are
+                # scored, the breaker sees a success.
+                self.faults.count("recovered_dispatches")
+                self.breaker.record_success()
+                now = self.clock()
+                n_poison = 0
+                for p, payload in zip(rows, results):
+                    if payload is None:
+                        n_poison += 1
+                        self.stats.count("errors")
+                        p.future.resolve(ServeResult(
+                            request_id=p.request.request_id,
+                            status=STATUS_ERROR,
+                            note=f"poison row isolated by the degradation "
+                                 f"ladder: {err!r}",
+                            latency_s=now - p.t_submit))
+                    else:
+                        self._resolve_ok(p, payload, now)
+                if n_poison:
+                    self.faults.count("degraded_rows", n_poison)
+                    log.warning("serve: degradation ladder isolated %d "
+                                "poison row(s) out of %d; dispatch "
+                                "recovered", n_poison, len(rows))
+                return
+        # Total failure: every row errors, the breaker counts it.
+        now = self.clock()
+        self.stats.count("errors", len(rows))
+        for p in rows:
+            p.future.resolve(ServeResult(
+                request_id=p.request.request_id, status=STATUS_ERROR,
+                note=f"device error after retries: {err!r}",
+                latency_s=now - p.t_submit))
+        opened = self.breaker.record_failure()
+        log.warning("serve: dispatch failed (%d consecutive, breaker %s)"
+                    ": %r", self.breaker.consecutive_failures,
+                    self.breaker.state, err)
+        if opened:
+            self._drain_open(err)
+
+    def _drain_open(self, err: BaseException) -> None:
+        """The breaker just opened: resolve every waiting request with an
+        error result — fail fast and visibly instead of queueing behind
+        a device that is not answering. Submits shed until the half-open
+        probe succeeds."""
+        note = (f"server unhealthy — circuit breaker open after "
+                f"{self.breaker.consecutive_failures} consecutive "
+                f"dispatch failures: {err!r}")
         n = self.queue.flush(STATUS_ERROR, note)
         n += self.batcher.flush_all(STATUS_ERROR, note)
-        log.error("serve: health flag tripped; drained %d queued "
-                  "requests (%s)", n, note)
+        log.error("serve: circuit breaker OPEN; drained %d queued "
+                  "requests; half-open probe in %.1fs (%s)", n,
+                  self.config.breaker_cooldown_s, note)
+
+    # -- crash-consistent shutdown/resume ------------------------------------
+
+    def pending_requests(self) -> List[ServeRequest]:
+        """Every admitted-but-unresolved request: queued, bucketed, and
+        in-flight rows whose futures have not resolved. Exact once the
+        supervisor thread is stopped; best-effort while it runs."""
+        pendings = (self.queue.snapshot() + self.batcher.snapshot()
+                    + list(self._inflight))
+        return [p.request for p in pendings if not p.future.done()]
+
+    def save_checkpoint(self, path) -> int:
+        """Atomically write the unresolved-request state (manifest.
+        atomic_write_json: tmp + fsync + rename — a kill mid-checkpoint
+        leaves the previous checkpoint, never a torn one). Returns the
+        number of requests checkpointed."""
+        reqs = [r.to_record() for r in self.pending_requests()]
+        atomic_write_json(Path(path), {
+            "version": CHECKPOINT_VERSION,
+            "model": self.model_name,
+            "requests": reqs,
+        })
+        return len(reqs)
+
+    def shutdown_checkpoint(self, path, timeout: float = 10.0) -> int:
+        """SIGTERM path (preemption warning): stop the supervisor WITHOUT
+        working off the backlog — the host has seconds, not minutes —
+        then checkpoint every unresolved request. In-flight dispatch
+        rows are included iff their futures have not resolved, so a
+        request is never both served and checkpointed."""
+        self._abort = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        n = self.save_checkpoint(path)
+        log.info("serve: shutdown checkpoint wrote %d pending requests "
+                 "-> %s", n, path)
+        return n
+
+    def resume_from_checkpoint(self, path) -> List[ServeFuture]:
+        """Re-submit every request from a shutdown checkpoint. Requests
+        the previous incarnation already served may ride the dedup cache
+        (same content address); unserved ones score fresh. Returns the
+        futures in checkpoint order."""
+        import json
+
+        data = json.loads(Path(path).read_text())
+        reqs = [ServeRequest.from_record(r)
+                for r in data.get("requests", ())]
+        log.info("serve: resuming %d checkpointed requests from %s",
+                 len(reqs), path)
+        return [self.submit(r) for r in reqs]

@@ -23,18 +23,47 @@ Key = Tuple[str, ...]
 
 
 class SweepManifest:
-    """Append-only record of completed grid cells, keyed by string tuples."""
+    """Append-only record of completed grid cells, keyed by string tuples.
+
+    Crash-consistent by construction: appends are a single fsync'd write
+    (plus a parent-directory fsync so the file itself survives a host
+    crash right after creation), and loading TOLERATES a torn trailing
+    line — the exact artifact the crash this manifest exists to survive
+    leaves behind. A torn (non-JSON or key-incomplete) tail is skipped
+    on load and truncated away by the next append; a malformed line
+    anywhere ELSE still raises, because that is corruption no crash of
+    ours produces."""
 
     def __init__(self, path: Path, key_fields: Tuple[str, ...]):
         self.path = Path(path)
         self.key_fields = key_fields
         self._done: Set[Key] = set()
+        # Byte offset to truncate to before the next append (a torn
+        # trailing line from a mid-append crash); None = file is clean.
+        self._truncate_to: Optional[int] = None
         if self.path.exists():
-            for line in self.path.read_text().splitlines():
-                if not line.strip():
+            raw = self.path.read_bytes()
+            pos = 0
+            chunks = raw.split(b"\n")
+            for i, chunk in enumerate(chunks):
+                start = pos
+                pos += len(chunk) + 1
+                if not chunk.strip():
                     continue
-                rec = json.loads(line)
-                self._done.add(tuple(str(rec[f]) for f in key_fields))
+                try:
+                    rec = json.loads(chunk.decode("utf-8"))
+                    key = tuple(str(rec[f]) for f in key_fields)
+                except (UnicodeDecodeError, json.JSONDecodeError, KeyError,
+                        TypeError):
+                    if all(not c.strip() for c in chunks[i + 1:]):
+                        # Torn tail: the crash happened mid-append. The
+                        # rows it named were NOT marked done, so a
+                        # resumed sweep re-scores them (write-ahead
+                        # order: results first, manifest second).
+                        self._truncate_to = start
+                        break
+                    raise
+                self._done.add(key)
 
     def __len__(self) -> int:
         return len(self._done)
@@ -60,10 +89,20 @@ class SweepManifest:
         if not lines:
             return
         self.path.parent.mkdir(parents=True, exist_ok=True)
+        created = not self.path.exists()
+        if self._truncate_to is not None and not created:
+            # Drop the torn tail found at load time BEFORE appending —
+            # otherwise the new first line glues onto the fragment and
+            # becomes unparseable itself.
+            with self.path.open("r+b") as f:
+                f.truncate(self._truncate_to)
+        self._truncate_to = None
         with self.path.open("a") as f:
             f.write("\n".join(lines) + "\n")
             f.flush()
             os.fsync(f.fileno())
+        if created:
+            _fsync_dir(self.path.parent)
 
     def pending(self, records: Iterable[Dict[str, object]]) -> Iterator[Dict[str, object]]:
         for rec in records:
@@ -76,18 +115,59 @@ class SweepManifest:
         manifest_path: Path,
         results_path: Optional[Path],
         key_fields: Tuple[str, ...],
+        column_map: Optional[Dict[str, str]] = None,
     ) -> "SweepManifest":
         """Seed the done-set from a prior results file, mirroring
-        load_existing_results (perturb_prompts.py:161-188)."""
+        load_existing_results (perturb_prompts.py:161-188).
+
+        This is the crash-consistency half the manifest alone cannot
+        give: the flush order is results-append THEN manifest-mark, so a
+        kill between the two leaves rows in the results file that the
+        manifest does not know about — a manifest-only resume would
+        re-score and DUPLICATE them. Seeding the union makes the done
+        set exactly "whatever reached the results artifact".
+
+        ``column_map`` maps manifest key fields to results-file column
+        names (the D6 workbook uses 'Model'/'Original Main Part'/... for
+        the manifest's 'model'/'original_main'/...). An unreadable or
+        torn prior file degrades to manifest-only seeding instead of
+        failing the resume — losing the seed only re-scores rows, never
+        loses or duplicates them (write-ahead order + this union)."""
         m = cls(manifest_path, key_fields)
-        if results_path is not None and Path(results_path).exists():
-            read = pd.read_excel if str(results_path).endswith(".xlsx") else pd.read_csv
-            df = read(results_path)
-            if all(f in df.columns for f in key_fields):
-                m.mark_done_many(
-                    {f: row[f] for f in key_fields} for _, row in df.iterrows()
-                )
+        if results_path is None or not Path(results_path).exists():
+            return m
+        cols = {f: (column_map or {}).get(f, f) for f in key_fields}
+        try:
+            if str(results_path).endswith(".xlsx"):
+                df = pd.read_excel(results_path)
+            else:
+                df = pd.read_csv(results_path, on_bad_lines="skip")
+        except Exception:
+            return m
+        if all(c in df.columns for c in cols.values()):
+            df = df.dropna(subset=list(cols.values()))
+            m.mark_done_many(
+                {f: row[c] for f, c in cols.items()}
+                for _, row in df.iterrows()
+            )
         return m
+
+
+def _fsync_dir(path: Path) -> None:
+    """fsync a directory so a just-created file's entry is durable (a
+    crash after file-fsync but before dir-fsync can lose the whole
+    file on some filesystems). Best-effort: not every platform allows
+    opening directories."""
+    try:
+        fd = os.open(str(path), os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def atomic_write_text(path: Path, text: str) -> None:

@@ -407,6 +407,87 @@ class ServeStats:
         return out
 
 
+@dataclasses.dataclass
+class FaultStats:
+    """Fault-injection / self-healing counters (lir_tpu/faults): what the
+    failure path did, with the same one-look intent as ServeStats for the
+    hot path. Thread-safe — injection sites, the supervisor loop, and the
+    sweep's dispatch recovery all mutate it concurrently.
+
+    Definitions (reported by ``summary()``, bench.py's "chaos" key, and
+    ``make chaos-smoke``):
+
+    - ``injected``: per-site injected-fault counts (FaultPlan.check) —
+      the chaos schedule's ground truth, so "recovered" can be read
+      against "thrown at".
+    - ``recovered_dispatches``: dispatches that failed at least once
+      (device error, injected fault) and still resolved rows — via the
+      retry policy, the AOT->lazy fallback, or the bisection ladder.
+    - ``degraded_dispatches``: dispatches that entered the degradation
+      ladder (retries exhausted on the full batch).
+    - ``degraded_rows``: rows the ladder resolved as error results after
+      isolating them as poison — the price of not failing their batch.
+    - breaker counters + ``transitions``: every circuit-breaker state
+      change in order ((from, to) pairs) — the serve recovery story is
+      readable from this list alone (closed->open->half_open->closed).
+    """
+
+    injected: Dict[str, int] = dataclasses.field(default_factory=dict)
+    recovered_dispatches: int = 0
+    degraded_dispatches: int = 0
+    degraded_rows: int = 0
+    preemptions: int = 0
+    breaker_opens: int = 0
+    breaker_probes: int = 0
+    breaker_closes: int = 0
+    transitions: list = dataclasses.field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def inject(self, site: str, preemption: bool = False) -> None:
+        with self._lock:
+            self.injected[site] = self.injected.get(site, 0) + 1
+            if preemption:
+                self.preemptions += 1
+
+    @property
+    def injected_total(self) -> int:
+        return sum(self.injected.values())
+
+    def transition(self, frm: str, to: str) -> None:
+        with self._lock:
+            self.transitions.append((frm, to))
+            if to == "open":
+                self.breaker_opens += 1
+            elif to == "half_open":
+                self.breaker_probes += 1
+            elif to == "closed":
+                self.breaker_closes += 1
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "injected": dict(self.injected),
+                "injected_total": sum(self.injected.values()),
+                "recovered_dispatches": self.recovered_dispatches,
+                "degraded_dispatches": self.degraded_dispatches,
+                "degraded_rows": self.degraded_rows,
+                "preemptions": self.preemptions,
+                "breaker_opens": self.breaker_opens,
+                "breaker_probes": self.breaker_probes,
+                "breaker_closes": self.breaker_closes,
+                "breaker_transitions": [f"{a}->{b}"
+                                        for a, b in self.transitions],
+            }
+
+
 # Published peak dense-matmul throughput per chip (bf16 FLOPS). Weight-only
 # int8 still computes in bf16 on the MXU, so bf16 peak is the MFU denominator
 # there; dynamic int8 (s8 x s8 -> s32 dots) gets 2x this on every listed

@@ -14,6 +14,12 @@ parity: 10 retries, 60 s initial delay capped at 300 s, x1.5 backoff, uniform
   deadline (10 retries at 300 s is 50 minutes); with the cap, once another
   sleep would cross it the last failure re-raises immediately, so a retried
   call composes with the serving layer's per-request deadlines.
+
+KeyboardInterrupt and SystemExit are NEVER retried, even when a caller
+passes a broad ``retry_on`` tuple (``(Exception,)`` is common and
+``(BaseException,)`` has appeared in chaos wrappers): Ctrl-C during a
+300 s backoff sleep must exit promptly, not be logged as "attempt 3
+failed (KeyboardInterrupt)" and slept through seven more times.
 """
 
 from __future__ import annotations
@@ -41,6 +47,8 @@ def retry_with_exponential_backoff(
         try:
             return fn()
         except retry_on as exc:
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                raise  # shutdown signals are not transient failures
             if attempt == config.max_retries:
                 raise
             if config.full_jitter:

@@ -68,6 +68,57 @@ def test_queue_admission_and_shed_ordering():
     assert stats.queue_depth_peak == 2
 
 
+def test_queue_concurrent_shed_keeps_most_urgent_set():
+    """The latest-deadline-shed invariant under CONCURRENT submitters
+    (it was only pinned single-threaded before): with every offer
+    serialized through the queue lock, the greedy policy keeps exactly
+    the maxlen most-urgent requests seen so far — so after N threads
+    race 200 distinct-deadline offers into a depth-16 queue, the
+    survivors must be precisely the 16 earliest deadlines, every loser
+    must hold a resolved shed future, and the books must balance."""
+    import threading
+
+    depth, n_threads, per_thread = 16, 8, 25
+    stats = ServeStats()
+    q = RequestQueue(depth, stats, clock=lambda: 0.0)
+    # Distinct deadlines, dealt round-robin so every thread holds a mix
+    # of urgent and lazy requests (maximizing eviction interleavings).
+    deadlines = [float(d) for d in
+                 np.random.default_rng(0).permutation(
+                     n_threads * per_thread)]
+    pendings = [_pending(d, str(i)) for i, d in enumerate(deadlines)]
+    start = threading.Barrier(n_threads)
+
+    def submitter(tid):
+        start.wait()
+        for p in pendings[tid::n_threads]:
+            q.offer(p)
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    survivors = q.drain()
+    assert len(survivors) == depth
+    want = sorted(deadlines)[:depth]
+    assert sorted(p.t_deadline for p in survivors) == want
+    # Every non-survivor was resolved shed — no future leaks.
+    kept = {id(p) for p in survivors}
+    for p in pendings:
+        if id(p) in kept:
+            assert not p.future.done()
+        else:
+            assert p.future.result(0).status == "shed"
+    assert stats.shed == len(pendings) - depth
+    # admitted counts every entry that EVER joined the queue (evicted
+    # ones included), so it must at least cover the survivors and never
+    # exceed the offers.
+    assert depth <= stats.admitted <= len(pendings)
+
+
 def test_queue_flush_resolves_everything():
     q = RequestQueue(8, ServeStats(), clock=lambda: 0.0)
     ps = [_pending(9.0, str(i)) for i in range(3)]
