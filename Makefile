@@ -27,8 +27,12 @@ serve-smoke:
 # resume bitwise-identical (zero lost/duplicated rows); the serve
 # circuit breaker must trip and recover via its half-open probe; the
 # degradation ladder must isolate a poison row; a SIGTERM-style state
-# checkpoint must hand every pending request to a fresh server
-# (tools/chaos_smoke.py).
+# checkpoint must hand every pending request to a fresh server; an
+# injected HANG must be stalled-out by the watchdog within its deadline
+# and recovered via the ladder; injected-NaN rows must quarantine as
+# error:numerics with every clean row bitwise-identical (zero corrupted
+# rows); a simulated dead peer must raise HostDesyncError within the
+# liveness timeout instead of hanging (tools/chaos_smoke.py).
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 

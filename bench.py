@@ -202,10 +202,12 @@ def main() -> None:
                          "Poisson load driver over the continuous "
                          "batcher vs the offline sweep on one grid)")
     ap.add_argument("--chaos", action="store_true",
-                    help="also measure goodput UNDER a seeded transient "
-                         "fault schedule (lir_tpu/faults) vs fault-free "
-                         "on the same grid — recovered_dispatches, "
-                         "degraded_rows, and the goodput ratio land "
+                    help="also measure goodput UNDER a seeded fault "
+                         "schedule (lir_tpu/faults: transient errors + "
+                         "an injected hang + an injected-NaN row) vs "
+                         "fault-free on the same grid — recovered_"
+                         "dispatches, degraded_rows, stalls_detected, "
+                         "rows_quarantined, and the goodput ratio land "
                          "under the headline JSON's \"chaos\" key (the "
                          "robustness cost, tracked like perf)")
     ap.add_argument("--compile-cache-dir", default=None,
@@ -1063,9 +1065,15 @@ def _chaos_bench(params, cfg, on_accel: bool, tokenizer=None,
                                  tokenizer if tokenizer is not None
                                  else FakeTokenizer(),
                                  RuntimeConfig(batch_size=batch,
-                                               max_seq_len=512))
+                                               max_seq_len=512,
+                                               # Tight watchdog so the
+                                               # injected hang below is
+                                               # stalled-out in bench
+                                               # time, not 30s floors.
+                                               watchdog_multiple=4.0,
+                                               watchdog_floor_s=0.5))
 
-        def one_session(schedules):
+        def one_session(schedules, guard_schedules=None):
             server = ScoringServer(make_engine(), "bench-chaos",
                                    serve_cfg)
             if schedules is not None:
@@ -1074,6 +1082,13 @@ def _chaos_bench(params, cfg, on_accel: bool, tokenizer=None,
                 plan = faults.FaultPlan(seed=17, schedules=schedules,
                                         stats=server.faults)
                 faults.wrap_server(server, plan)
+            if guard_schedules is not None:
+                # Silent-failure injections (hang/nan) ride a second
+                # wrap so they compose with the transient schedule.
+                gplan = faults.FaultPlan(seed=19,
+                                         schedules=guard_schedules,
+                                         stats=server.faults)
+                faults.wrap_server(server, gplan)
             server.start()
             # warm pass: compile every shape outside the timed window
             warm = [server.submit(request(i, f"w{i}"))
@@ -1090,20 +1105,46 @@ def _chaos_bench(params, cfg, on_accel: bool, tokenizer=None,
 
         try:
             _, clean_out, clean_dt = one_session(None)
-            server, fault_out, fault_dt = one_session({
-                "dispatch": faults.SiteSchedule(
-                    rate=0.25, max_failures=max(2, cells // 8))})
+            server, fault_out, fault_dt = one_session(
+                {"dispatch": faults.SiteSchedule(
+                    rate=0.25, max_failures=max(2, cells // 8))},
+                # Silent faults for the guard layer: one hang the
+                # watchdog must stall-out (the warm pass calibrates it)
+                # and one NaN row the numerics guard must quarantine.
+                guard_schedules={"dispatch": faults.SiteSchedule(
+                    fail_calls=(3,), kind="hang", hang_s=30.0)})
+            # The NaN injection runs in its own short session so the
+            # quarantined request is identifiable (recovery cannot —
+            # and must not — resurrect a corrupted row to "ok").
+            nan_server = ScoringServer(make_engine(), "bench-chaos",
+                                       serve_cfg)
+            faults.wrap_server(nan_server, faults.FaultPlan(
+                seed=23, schedules={"dispatch":
+                                    faults.SiteSchedule.nan_at(
+                                        0, rows=(0,))},
+                stats=nan_server.faults))
+            nan_server.start()
+            nan_out = [f.result(timeout=600) for f in
+                       [nan_server.submit(request(i % cells, f"q{i}"))
+                        for i in range(batch)]]
+            nan_server.stop()
         except Exception as err:  # noqa: BLE001 — OOM falls back
             if _is_oom(err):
                 last_oom = err
                 continue
             raise
+        quarantined = [r.request_id for r in nan_out
+                       if r.status == "error" and "numerics" in r.note]
         bad = [r.request_id for r in clean_out + fault_out
                if r.status != "ok"]
+        bad += [r.request_id for r in nan_out
+                if r.status != "ok" and r.request_id not in quarantined]
         if bad:
             print(f"# chaos bench: requests not recovered to ok: {bad}",
                   file=sys.stderr)
         fstats = server.faults
+        gstats = server.engine.guard_stats
+        nstats = nan_server.engine.guard_stats
         out = {
             "cells": cells, "batch": batch,
             "injected_faults": fstats.injected_total,
@@ -1111,6 +1152,11 @@ def _chaos_bench(params, cfg, on_accel: bool, tokenizer=None,
             "degraded_dispatches": fstats.degraded_dispatches,
             "degraded_rows": fstats.degraded_rows,
             "breaker_opens": fstats.breaker_opens,
+            "stalls_detected": gstats.stalls_total + nstats.stalls_total,
+            "rows_quarantined": (gstats.quarantined_total
+                                 + nstats.quarantined_total),
+            "inflight_cancelled": (gstats.inflight_cancelled
+                                   + nstats.inflight_cancelled),
             "unrecovered_requests": len(bad),
             "goodput_clean_p_s": round(cells / clean_dt, 3),
             "goodput_faults_p_s": round(cells / fault_dt, 3),
@@ -1121,7 +1167,9 @@ def _chaos_bench(params, cfg, on_accel: bool, tokenizer=None,
               f"p/s under faults vs {out['goodput_clean_p_s']:.3f} clean "
               f"({out['goodput_vs_clean']:.2f}x), recovered "
               f"{fstats.recovered_dispatches} dispatches, degraded "
-              f"{fstats.degraded_rows} rows", file=sys.stderr)
+              f"{fstats.degraded_rows} rows, stalled-out "
+              f"{out['stalls_detected']}, quarantined "
+              f"{out['rows_quarantined']}", file=sys.stderr)
         return out
     print(f"# chaos mode: every batch candidate OOMed; last: {last_oom}",
           file=sys.stderr)

@@ -130,7 +130,32 @@ def _add_perturb(sub) -> None:
                         "decode the full budgets (stops change no "
                         "recorded value — PARITY.md; this flag exists "
                         "for measurement, not correctness)")
+    _add_guard_flags(p)
+    p.add_argument("--barrier-timeout", type=float, default=None,
+                   help="multihost liveness bound in seconds: a shard-"
+                        "boundary barrier a peer never reaches raises "
+                        "HostDesyncError (resumable exit) instead of "
+                        "hanging forever (default 900; <= 0 restores "
+                        "unbounded barriers)")
     _add_multihost_flag(p)
+
+
+def _add_guard_flags(p) -> None:
+    """Guard-layer knobs (lir_tpu/guard) shared by perturb and serve."""
+    p.add_argument("--watchdog-multiple", type=float, default=None,
+                   help="dispatch watchdog deadline = floor + multiple x "
+                        "predicted dispatch seconds (bucket_cost-priced, "
+                        "self-calibrated; default 20). <= 0 disables "
+                        "stall detection")
+    p.add_argument("--watchdog-floor", type=float, default=None,
+                   help="hard minimum watchdog deadline in seconds "
+                        "(default 30) — the safety margin a noisy "
+                        "calibration can never undercut")
+    p.add_argument("--no-numerics-guard", action="store_true",
+                   help="disable the score-extraction numerics guard "
+                        "(NaN/Inf/out-of-range rows are then written "
+                        "verbatim instead of quarantined as "
+                        "error:numerics — measurement only)")
 
 
 def _add_precompile(sub) -> None:
@@ -213,6 +238,7 @@ def _add_serve(sub) -> None:
                         "unresolved request here; on boot, an existing "
                         "file is re-submitted (dedup-deduplicated "
                         "against anything already served)")
+    _add_guard_flags(p)
 
 
 def _add_rephrase(sub) -> None:
@@ -308,6 +334,16 @@ def cmd_sweep(args) -> None:
     )
 
 
+def _guard_rt_kw(args, rt_kw: dict) -> None:
+    """Fold the guard-layer flags into a RuntimeConfig kwargs dict."""
+    if getattr(args, "watchdog_multiple", None) is not None:
+        rt_kw["watchdog_multiple"] = args.watchdog_multiple
+    if getattr(args, "watchdog_floor", None) is not None:
+        rt_kw["watchdog_floor_s"] = args.watchdog_floor
+    if getattr(args, "no_numerics_guard", False):
+        rt_kw["numerics_guard"] = False
+
+
 def cmd_perturb(args) -> None:
     _maybe_init_multihost(args)
     from .config import RuntimeConfig
@@ -329,6 +365,9 @@ def cmd_perturb(args) -> None:
         rt_kw["sweep_decode_tokens"] = args.sweep_decode_tokens
     if args.sweep_confidence_tokens is not None:
         rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
+    _guard_rt_kw(args, rt_kw)
+    if args.barrier_timeout is not None:
+        rt_kw["barrier_timeout_s"] = args.barrier_timeout
     factory = engine_factory(
         args.checkpoints,
         RuntimeConfig(**rt_kw),
@@ -361,6 +400,7 @@ def cmd_serve(args) -> None:
         rt_kw["sweep_decode_tokens"] = args.sweep_decode_tokens
     if args.sweep_confidence_tokens is not None:
         rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
+    _guard_rt_kw(args, rt_kw)
     classes = dict(ServeConfig().classes)
     for spec in args.deadline or ():
         name, sep, secs = spec.partition("=")

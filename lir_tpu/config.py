@@ -116,6 +116,36 @@ class RuntimeConfig:
     # and bench enable it by default (--no-compile-cache opts out).
     compile_cache_dir: Optional[str] = None
 
+    # Guard layer (lir_tpu/guard): silent-failure detection.
+    # Dispatch watchdog — every device dispatch runs on a watched
+    # executor whose deadline is floor + multiple * predicted seconds,
+    # where "predicted" comes from the scheduler.bucket_cost() price
+    # model calibrated against this engine's own observed dispatch rate
+    # (guard/watchdog.py). A dispatch that outlives its deadline is
+    # abandoned with a full thread-stack dump and surfaces
+    # DispatchStalled into the ordinary recovery machinery (ladder
+    # retry -> breaker), so a wedged runtime call costs one deadline
+    # instead of the run. multiple <= 0 disables; the floor is a hard
+    # minimum so a fast calibration can never produce a hair-trigger
+    # deadline. The first (uncalibrated) dispatch is observe-only — a
+    # legitimate cold compile must never be shot. The same deadline
+    # (floor * multiple) bounds how long a dispatch waits on a
+    # background AOT compile before falling back to lazy jit.
+    watchdog_multiple: float = 20.0
+    watchdog_floor_s: float = 30.0
+    # Numerics guard — validate every row's readouts at score-extraction
+    # time (probs finite and in [0,1], P(Yes)+P(No) <= 1, weighted
+    # confidence in [0,100], logprob map NaN-free) and quarantine
+    # offenders as error:numerics instead of writing garbage
+    # (guard/numerics.py).
+    numerics_guard: bool = True
+    # Multihost liveness — sweep shard boundaries run a heartbeat
+    # allgather + barrier bounded by this timeout; a dead peer host
+    # then raises HostDesyncError on the survivors (manifest already
+    # flushed -> resumable) instead of parking them in ICI/DCN forever
+    # (parallel/multihost.py). <= 0 restores unbounded barriers.
+    barrier_timeout_s: float = 900.0
+
 
 @dataclasses.dataclass(frozen=True)
 class PerturbationConfig:

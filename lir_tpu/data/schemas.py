@@ -173,6 +173,10 @@ class PerturbationRow:
 
     @property
     def odds_ratio(self) -> float:
+        # Quarantined rows (guard/numerics: error:numerics) carry no
+        # token probabilities; their ratio is NaN, not a crash.
+        if self.token_1_prob is None or self.token_2_prob is None:
+            return math.nan
         if self.token_2_prob > 0:
             return self.token_1_prob / self.token_2_prob
         return math.inf

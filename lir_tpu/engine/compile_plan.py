@@ -34,6 +34,7 @@ import dataclasses
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..utils.logging import get_logger
@@ -239,9 +240,17 @@ class ExecutableRegistry:
     threads write results."""
 
     def __init__(self, manifest_key: str,
-                 stats: Optional[CompileStats] = None):
+                 stats: Optional[CompileStats] = None,
+                 compile_timeout_s: Optional[float] = None,
+                 guard_stats=None):
         self.manifest_key = manifest_key
         self.stats = stats if stats is not None else CompileStats()
+        # Watchdog bound on how long a dispatch may wait for a still-
+        # compiling executable (guard layer): a wedged compile thread
+        # then costs one lazy-jit fallback, not the sweep. None = wait
+        # unbounded (legacy).
+        self.compile_timeout_s = compile_timeout_s
+        self.guard_stats = guard_stats
         self._futures: Dict[ShapeSpec, "Future"] = {}
         self._lock = threading.Lock()
         self._warned = False
@@ -280,7 +289,18 @@ class ExecutableRegistry:
             self.stats.lazy_misses += 1
             return None
         try:
-            compiled = fut.result()
+            compiled = fut.result(timeout=self.compile_timeout_s)
+        except FuturesTimeout:
+            # Stalled compile: abandon the wait (the pool thread keeps
+            # the future; a late success still lands in _EXEC_CACHE for
+            # the next sweep) and dispatch lazily.
+            if self.guard_stats is not None:
+                self.guard_stats.site("stalls", "compile")
+            log.warning("AOT compile for %s exceeded its %.1fs watchdog "
+                        "deadline; falling back to lazy jit for this "
+                        "dispatch", spec.label, self.compile_timeout_s)
+            self.stats.lazy_misses += 1
+            return None
         except Exception as err:  # noqa: BLE001 — fall back to lazy jit
             if not self._warned:
                 self._warned = True
@@ -314,7 +334,18 @@ def precompile_async(engine, specs: Sequence[ShapeSpec],
     while later buckets' executables compile concurrently. The pool's
     threads outlive this call; registry futures own the results."""
     stats = getattr(engine, "compile_stats", None) or CompileStats()
-    registry = ExecutableRegistry(engine.cache_manifest_key, stats)
+    rt = getattr(engine, "rt", None)
+    timeout = None
+    if rt is not None and getattr(rt, "watchdog_multiple", 0) > 0:
+        # The compile deadline mirrors the dispatch watchdog's shape:
+        # floor * multiple — generous enough for a real 7B executable,
+        # bounded enough that a wedged compiler thread costs one lazy
+        # fallback instead of parking the dispatch loop forever.
+        timeout = rt.watchdog_floor_s * max(rt.watchdog_multiple, 1.0)
+    registry = ExecutableRegistry(engine.cache_manifest_key, stats,
+                                  compile_timeout_s=timeout,
+                                  guard_stats=getattr(engine,
+                                                      "guard_stats", None))
     if not specs:
         return registry
     from ..utils import compile_cache

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import RuntimeConfig
-from ..utils.profiling import CompileStats, FaultStats
+from ..guard.watchdog import DispatchWatchdog
+from ..utils.profiling import CompileStats, FaultStats, GuardStats
 from . import compile_plan, generate, score, tokens as tok
 
 
@@ -185,6 +186,14 @@ class ScoringEngine:
         # Failure-path accounting (lir_tpu/faults): the sweep's dispatch
         # recovery and any wrapping FaultPlan count into this.
         self.fault_stats = FaultStats()
+        # Guard layer (lir_tpu/guard): the dispatch watchdog (stall
+        # detection priced by scheduler.bucket_cost, calibrated against
+        # this engine's own dispatch rate) and the counters it shares
+        # with the numerics guard and the multihost liveness barrier.
+        self.guard_stats = GuardStats()
+        self.watchdog = DispatchWatchdog(
+            multiple=self.rt.watchdog_multiple,
+            floor_s=self.rt.watchdog_floor_s, stats=self.guard_stats)
         self._seq_mesh_note = (
             None if seq_mesh is None
             else (repr(getattr(seq_mesh, "shape", seq_mesh)), seq_impl))

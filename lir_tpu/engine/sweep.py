@@ -24,6 +24,7 @@ import numpy as np
 
 from ..config import RetryConfig
 from ..data import schemas
+from ..guard import numerics
 from ..data.prompts import LegalPrompt
 from ..utils.logging import get_logger
 from ..utils.manifest import SweepManifest
@@ -52,15 +53,28 @@ DISPATCH_RETRY = RetryConfig(max_retries=3, initial_delay=0.05,
                              full_jitter=True, max_elapsed=30.0)
 
 
-def _dispatch_with_recovery(engine, call):
+def _dispatch_with_recovery(engine, call, cost=None):
     """Run one device dispatch with the sweep's self-healing ladder: on
     failure, degrade the AOT registry to lazy jit (a corrupt precompiled
     executable is the first suspect — runner.degrade_to_lazy also resets
     the donation chain the failed dispatch may have consumed) and retry
     under DISPATCH_RETRY. KeyboardInterrupt/SystemExit and simulated
     preemptions (BaseException) always propagate — recovery outlives
-    faults, not kills."""
+    faults, not kills.
+
+    The call runs under the engine's dispatch WATCHDOG (guard/watchdog):
+    ``cost`` is the dispatch's scheduler.bucket_cost() price, and a call
+    that outlives floor + multiple * predicted seconds is abandoned with
+    a thread-stack dump and surfaces DispatchStalled — an ordinary
+    Exception, so a HANG flows through exactly this recovery path (one
+    deadline lost, then degrade + retry) instead of parking the sweep
+    forever."""
     from ..utils.profiling import is_oom_error
+
+    wd = getattr(engine, "watchdog", None)
+    if wd is not None and wd.enabled:
+        inner = call
+        call = lambda: wd.watch(inner, cost=cost, site="sweep")  # noqa: E731
 
     try:
         return call()
@@ -107,6 +121,11 @@ def _parse_confidence(text: str, complete: bool = True) -> Optional[int]:
     cut mid-number ("...about 85" truncated to "...about 8"), so it is
     rejected (None) rather than silently recorded wrong. An integer followed
     by more text is always safe.
+
+    The prompt asks for a confidence in [0, 100]; an integer outside that
+    range ("confidence: 250", a year, a policy number) is model noise, not
+    a confidence, and recording it verbatim poisons every downstream
+    confidence statistic — rejected (None), same as no integer at all.
     """
     m = re.search(r"\b(\d+)\b", text)
     if m is None:
@@ -114,9 +133,12 @@ def _parse_confidence(text: str, complete: bool = True) -> Optional[int]:
     if not complete and m.end() == len(text.rstrip()):
         return None
     try:
-        return int(m.group(1))
+        val = int(m.group(1))
     except ValueError:
         return None
+    if not 0 <= val <= 100:
+        return None
+    return val
 
 
 def _decode_complete(generated_row: np.ndarray, eos_id) -> bool:
@@ -258,7 +280,15 @@ def run_perturbation_sweep(
             schemas.write_perturbation_results([], results_path)
         # Fence so no host's caller reads partial peers; per-host workbooks
         # concatenate row-wise (the D6 schema has no cross-row state).
-        multihost.barrier("perturbation-sweep-done")
+        # LIVENESS-GUARDED (parallel/multihost.py): a heartbeat allgather
+        # + timeout-bounded barrier, so a dead peer host raises
+        # HostDesyncError on the survivors — whose shard artifacts and
+        # manifests are already flushed, hence resumable — instead of
+        # parking every live host inside the collective forever.
+        multihost.liveness_barrier(
+            "perturbation-sweep-done",
+            timeout_s=engine.rt.barrier_timeout_s,
+            payload=len(rows), stats=engine.guard_stats)
         if __import__("jax").process_index() == 0:
             # Gather step on a shared filesystem: merge every visible
             # .hostN shard (+ manifests) into the final artifact — the
@@ -281,8 +311,12 @@ def run_perturbation_sweep(
                     base_results_path.stem)
         # Second fence: peers must not return (and possibly let their
         # launcher read the final artifact) while host 0 is still
-        # mid-merge.
-        multihost.barrier("perturbation-merge-done")
+        # mid-merge. Same liveness bound — host 0 dying mid-merge must
+        # not hang its peers.
+        multihost.liveness_barrier(
+            "perturbation-merge-done",
+            timeout_s=engine.rt.barrier_timeout_s,
+            payload=len(rows), stats=engine.guard_stats)
     return rows
 
 
@@ -412,6 +446,45 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                     _steps_used(cgen_host[j], engine.eos_id),
                     int(cgen_host.shape[1]))
         for j, cell in enumerate(batch):
+            t1p = float(res_h.yes_prob[j])
+            t2p = float(res_h.no_prob[j])
+            wc = float(wconf[j])
+            # Numerics guard (lir_tpu/guard): validate the device-derived
+            # readouts BEFORE they become a row. Corrupt rows (NaN/Inf
+            # logits, insane renormalization) are quarantined with their
+            # cell identity and every measurement field nulled — the same
+            # row-local isolation the degradation ladder gives poison
+            # rows — instead of landing in results.csv as plausible-
+            # looking confidences. Neighbors are untouched.
+            reason = None
+            if engine.rt.numerics_guard:
+                engine.guard_stats.site("checked", "sweep")
+                reason = numerics.check_values(t1p, t2p, wc, lp_vals[j])
+            if reason is not None:
+                engine.guard_stats.quarantine("sweep", reason)
+                log.warning("numerics guard: quarantined cell %r (%s)",
+                            cell.rephrased_main[:40], reason)
+                row = schemas.PerturbationRow(
+                    model=model_name,
+                    original_main=cell.original_main,
+                    response_format=cell.response_format,
+                    confidence_format=cell.confidence_format,
+                    rephrased_main=cell.rephrased_main,
+                    full_rephrased_prompt=cell.binary_prompt,
+                    full_confidence_prompt=cell.confidence_prompt,
+                    model_response=numerics.NUMERICS_ERROR,
+                    model_confidence_response=(
+                        f"{numerics.NUMERICS_ERROR} — {reason} "
+                        f"(row quarantined by the numerics guard)"),
+                    log_probabilities="",
+                    token_1_prob=None,
+                    token_2_prob=None,
+                    confidence_value=None,
+                    weighted_confidence=None,
+                )
+                rows.append(row)
+                pending_rows.append(row)
+                continue
             completion = engine.decode_completion(gen_host[j])
             conf_text = engine.decode_completion(cgen_host[j])
             # A short confidence decode that never reached EOS may have cut
@@ -433,10 +506,10 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 model_response=completion,
                 model_confidence_response=conf_text,
                 log_probabilities=json.dumps(logprob_map),
-                token_1_prob=float(res_h.yes_prob[j]),
-                token_2_prob=float(res_h.no_prob[j]),
+                token_1_prob=t1p,
+                token_2_prob=t2p,
                 confidence_value=_parse_confidence(conf_text, conf_complete),
-                weighted_confidence=float(wconf[j]),
+                weighted_confidence=wc,
             )
             rows.append(row)
             pending_rows.append(row)
@@ -484,7 +557,12 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                     [c.binary_prompt for c in full],
                     [c.confidence_prompt for c in full],
                     t1, t2, new_tokens=new_tokens, conf_tokens=conf_tokens,
-                    early_stop=early_stop))
+                    early_stop=early_stop),
+                # Legacy batches pick their bucket inside the engine;
+                # price at the ladder's widest edge (a generous deadline
+                # beats a hair-trigger one).
+                cost=sched_mod.bucket_cost(bsz, max(engine.buckets), B,
+                                           new_tokens + conf_tokens))
             res = score_mod.readout_from_fused(
                 fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
             work_q.put((batch, fused, res, cfused))
@@ -514,7 +592,9 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                         pretokenized_b=[it.conf_ids for it in full_items],
                         bucket=d.bucket,
                         sfx_buckets_ab=(d.sfx_bucket_a, d.sfx_bucket_b),
-                        reuse_cache=True))
+                        reuse_cache=True),
+                    cost=sched_mod.bucket_cost(
+                        n, d.bucket, B, new_tokens + conf_tokens))
                 res = score_mod.readout_from_fused(
                     fused, jnp.asarray(t1), jnp.asarray(t2),
                     scan_positions=1)
@@ -530,7 +610,11 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                         d.groups, t1, t2, new_tokens, conf_tokens,
                         early_stop, d.bucket,
                         max(d.sfx_bucket_a, d.sfx_bucket_b),
-                        reuse_cache=True))
+                        reuse_cache=True),
+                    # Grouped dispatches run [bin, conf] member rows per
+                    # cell — price the doubled row count.
+                    cost=sched_mod.bucket_cost(
+                        2 * n, d.bucket, B, new_tokens + conf_tokens))
                 # Member rows are [bin, conf] per cell: even rows carry
                 # the binary readout, odd rows the confidence one. Both
                 # ran the shared max(new, conf) budget, so each branch

@@ -15,20 +15,28 @@ proves out —
   (utils/manifest.py), results-seeded done-sets (engine/sweep.py), and
   the serve SIGTERM state checkpoint (server.shutdown_checkpoint).
 
+Silent failure kinds (``SiteSchedule.hang_at`` / ``nan_at``) exercise
+the third reliability layer, lir_tpu/guard: the dispatch watchdog must
+stall-out an injected hang into THESE recovery mechanisms, and the
+numerics guard must quarantine injected-NaN rows as error:numerics.
+
 Chaos drivers: ``make chaos-smoke`` (tools/chaos_smoke.py) and
 ``python bench.py --chaos`` run sweeps and serve sessions under seeded
-kill/fault schedules and assert zero lost / zero duplicated rows vs a
-fault-free run; counters land in profiling.FaultStats.
+kill/fault schedules and assert zero lost / zero duplicated / zero
+corrupted rows vs a fault-free run; counters land in
+profiling.FaultStats and profiling.GuardStats.
 """
 
 from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from .ladder import degrade_dispatch
-from .plan import (SITES, FaultPlan, InjectedFault, InjectedPreemption,
-                   SiteSchedule, tear_jsonl_tail, wrap_engine, wrap_server)
+from .plan import (KINDS, SITES, FaultPlan, InjectedFault,
+                   InjectedPreemption, SiteSchedule, corrupt_result_nan,
+                   tear_jsonl_tail, wrap_engine, wrap_server)
 
 __all__ = [
     "FaultPlan", "SiteSchedule", "InjectedFault", "InjectedPreemption",
-    "SITES", "wrap_engine", "wrap_server", "tear_jsonl_tail",
+    "SITES", "KINDS", "wrap_engine", "wrap_server", "tear_jsonl_tail",
+    "corrupt_result_nan",
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "degrade_dispatch",
 ]

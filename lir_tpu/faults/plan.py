@@ -24,6 +24,23 @@ boundaries the recovery machinery wraps:
 SIGKILL does not flow through ``except Exception`` recovery paths, so
 neither may its simulation — it must unwind all the way out, exactly
 like the writer-thread re-raise contract in engine/sweep.py expects.
+
+Beyond raise-style faults, two SILENT failure kinds exercise the guard
+layer (lir_tpu/guard):
+
+- ``kind="hang"`` — the wrapped call sleeps ``hang_s`` seconds (a stall
+  the dispatch watchdog must detect and abandon within its deadline),
+  then raises InjectedFault on release. The sleep happens BEFORE the
+  real call runs, and release raises instead of proceeding, so an
+  abandoned worker thread never mutates engine state (KV-cache
+  donation chain) behind a live retry — which is also how a real stuck
+  collective ends: aborted, not completed.
+- ``kind="nan"`` — the real call runs, then its RESULT is corrupted:
+  NaN written into the probability/logprob/confidence fields of the
+  rows named by ``nan_rows`` (FusedDecodeOut tuples from the engine's
+  fused decodes, or serve payload dicts from batcher.score). The
+  numerics guard must quarantine exactly those rows while their
+  neighbors score bitwise identical to a clean run.
 """
 
 from __future__ import annotations
@@ -31,12 +48,15 @@ from __future__ import annotations
 import dataclasses
 import random
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple
 
 from ..utils.profiling import FaultStats
 
 SITES = ("dispatch", "compile", "tokenize", "manifest_write",
          "checkpoint_write", "preempt")
+
+KINDS = ("fault", "preempt", "hang", "nan")
 
 
 class InjectedFault(RuntimeError):
@@ -61,13 +81,18 @@ class SiteSchedule:
       rate-based schedule then models a TRANSIENT outage the recovery
       machinery must outlast, not a permanently broken device).
     - ``kind``: "fault" raises InjectedFault, "preempt" raises
-      InjectedPreemption.
+      InjectedPreemption, "hang" sleeps ``hang_s`` then raises
+      InjectedFault (a stall for the watchdog), "nan" corrupts the
+      wrapped call's RESULT rows ``nan_rows`` (for the numerics guard;
+      only meaningful through :meth:`FaultPlan.wrap`).
     """
 
     fail_calls: Tuple[int, ...] = ()
     rate: float = 0.0
     max_failures: Optional[int] = None
     kind: str = "fault"
+    hang_s: float = 30.0
+    nan_rows: Tuple[int, ...] = (0,)
 
     @classmethod
     def outage(cls, start: int, length: int) -> "SiteSchedule":
@@ -78,6 +103,21 @@ class SiteSchedule:
     def kill_at(cls, call: int) -> "SiteSchedule":
         """Simulated preemption at one call index."""
         return cls(fail_calls=(call,), kind="preempt")
+
+    @classmethod
+    def hang_at(cls, call: int, seconds: float = 30.0) -> "SiteSchedule":
+        """Simulated stall at one call index: sleep ``seconds`` before
+        the real call would run, then raise on release. Pick ``seconds``
+        well past the watchdog deadline under test — the watchdog should
+        abandon the call long before the sleep ends."""
+        return cls(fail_calls=(call,), kind="hang", hang_s=seconds)
+
+    @classmethod
+    def nan_at(cls, call: int,
+               rows: Tuple[int, ...] = (0,)) -> "SiteSchedule":
+        """Simulated numerics corruption (SDC stand-in) at one call
+        index: NaN into the named result rows' measurement fields."""
+        return cls(fail_calls=(call,), kind="nan", nan_rows=rows)
 
 
 class FaultPlan:
@@ -134,27 +174,46 @@ class FaultPlan:
             self._injected[site] = done + 1
         return sched
 
-    def check(self, site: str) -> None:
-        """The injection point: raise when the schedule says this call
-        fails, else return. Every wrapped boundary calls this first."""
-        sched = self._decide(site)
-        if sched is None:
-            return
+    def _fire(self, sched: SiteSchedule, site: str) -> None:
+        """Raise the scheduled raise-style failure (fault / preempt /
+        hang). "nan" is result corruption and cannot fire here — only
+        :meth:`wrap` (which owns the call's result) handles it."""
+        idx = self.calls(site) - 1
         if sched.kind == "preempt":
             self.stats.inject(site, preemption=True)
             raise InjectedPreemption(
-                f"injected preemption at {site} call "
-                f"{self.calls(site) - 1}")
+                f"injected preemption at {site} call {idx}")
         self.stats.inject(site)
-        raise InjectedFault(
-            f"injected fault at {site} call {self.calls(site) - 1}")
+        if sched.kind == "hang":
+            time.sleep(sched.hang_s)
+            raise InjectedFault(
+                f"injected hang at {site} call {idx} released after "
+                f"{sched.hang_s:.2f}s")
+        raise InjectedFault(f"injected fault at {site} call {idx}")
+
+    def check(self, site: str) -> None:
+        """The injection point: raise when the schedule says this call
+        fails, else return. Every wrapped boundary calls this first.
+        A scheduled "nan" corruption is a no-op here (no result to
+        corrupt) — use :meth:`wrap` for nan sites."""
+        sched = self._decide(site)
+        if sched is None or sched.kind == "nan":
+            return
+        self._fire(sched, site)
 
     def wrap(self, site: str, fn: Callable) -> Callable:
-        """``fn`` with a fault check in front (schedule indexed by call
-        count at ``site``, not by wrapper)."""
+        """``fn`` under the site's schedule (indexed by call count at
+        ``site``, not by wrapper): raise-style kinds fire BEFORE the
+        call; "nan" runs the call and corrupts its result rows."""
 
         def wrapped(*args, **kwargs):
-            self.check(site)
+            sched = self._decide(site)
+            if sched is not None:
+                if sched.kind == "nan":
+                    self.stats.inject(site)
+                    return corrupt_result_nan(fn(*args, **kwargs),
+                                              sched.nan_rows)
+                self._fire(sched, site)
             return fn(*args, **kwargs)
 
         wrapped.__wrapped__ = fn  # type: ignore[attr-defined]
@@ -178,6 +237,46 @@ def wrap_server(server, plan: FaultPlan):
     policy, so recovery is exercised, not bypassed)."""
     server.batcher.score = plan.wrap("dispatch", server.batcher.score)
     return server
+
+
+def corrupt_result_nan(result, rows: Tuple[int, ...]):
+    """NaN-corrupt the measurement fields of ``rows`` in a dispatch
+    result — the simulated silent-data-corruption the numerics guard
+    exists to catch. Handles the engine's fused-decode results (tuples
+    of FusedDecodeOut: NaN into p_yes/p_no/topk_logprobs/weighted_
+    confidence at the given batch rows) and serve payload lists (NaN
+    into the per-row measurement dict). Anything else passes through
+    untouched (e.g. the grouped dispatch's member-count int)."""
+    if isinstance(result, tuple):
+        return tuple(corrupt_result_nan(r, rows) for r in result)
+    if isinstance(result, list):
+        out = list(result)
+        for r in rows:
+            if 0 <= r < len(out) and isinstance(out[r], dict):
+                p = dict(out[r])
+                nan = float("nan")
+                p["token_1_prob"] = nan
+                p["token_2_prob"] = nan
+                p["weighted_confidence"] = nan
+                out[r] = p
+        return out
+    if dataclasses.is_dataclass(result) and hasattr(result, "p_yes"):
+        import jax.numpy as jnp
+
+        nan = jnp.float32(float("nan"))
+        p_yes, p_no = result.p_yes, result.p_no
+        topk, wconf = result.topk_logprobs, result.weighted_confidence
+        for r in rows:
+            if not 0 <= r < int(p_yes.shape[0]):
+                continue
+            p_yes = p_yes.at[r].set(nan)
+            p_no = p_no.at[r].set(nan)
+            topk = topk.at[r].set(nan)
+            wconf = wconf.at[r].set(nan)
+        return dataclasses.replace(result, p_yes=p_yes, p_no=p_no,
+                                   topk_logprobs=topk,
+                                   weighted_confidence=wconf)
+    return result
 
 
 def tear_jsonl_tail(path, fragment: str = '{"model": "m", "orig') -> None:

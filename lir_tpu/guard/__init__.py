@@ -1,0 +1,43 @@
+"""Guard layer: catching the failures that never raise.
+
+PR 4's recovery machinery (retry -> AOT->lazy degrade -> bisection
+ladder -> circuit breaker) triggers on an EXCEPTION. The failure modes
+that actually dominate long TPU-pod runs are silent:
+
+- a dispatch or collective that hangs forever (a dead peer host parks
+  every live host inside ``process_allgather``; a wedged runtime parks
+  the dispatch thread in C++) — nothing raises, the run just stops;
+- numerics corruption — NaN/Inf logits flowing through the score
+  readouts land in results.csv as plausible-looking confidences, the
+  exact reliability artifact the paper measures.
+
+Two guards close the gap:
+
+- watchdog.DispatchWatchdog: every device dispatch runs on a watched
+  executor whose deadline derives from the SAME ``scheduler.
+  bucket_cost()`` price model the planners use (calibrated multiple +
+  floor, ``RuntimeConfig.watchdog_multiple``/``watchdog_floor_s``).
+  On expiry it dumps every thread stack, abandons the dispatch, and
+  surfaces a synthetic :class:`DispatchStalled` into the EXISTING
+  recovery machinery (ladder retry -> breaker) — a hang costs one
+  deadline instead of the run.
+- numerics.check_values: a validation boundary at score-extraction
+  time (logits finite, P(Yes)+P(No) renormalization sane, confidence
+  in range) that quarantines offending rows as ``error:numerics``,
+  mirroring the ladder's poison-row isolation, instead of writing
+  garbage. Counters land in profiling.GuardStats per site.
+
+The multihost liveness guard (timeout-bounded barrier + per-host
+heartbeat allgather) lives in parallel/multihost.py and reuses
+watchdog.watch_call to bound the collectives.
+"""
+
+from .numerics import NUMERICS_ERROR, check_payload, check_values
+from .watchdog import (DispatchStalled, DispatchWatchdog,
+                       dump_thread_stacks, watch_call)
+
+__all__ = [
+    "DispatchStalled", "DispatchWatchdog", "watch_call",
+    "dump_thread_stacks",
+    "NUMERICS_ERROR", "check_values", "check_payload",
+]

@@ -1,4 +1,4 @@
-"""Multi-host result gathering and process coordination.
+"""Multi-host result gathering, process coordination, and liveness.
 
 SURVEY.md §5 names the mechanism for collecting sweep results across hosts:
 ``jax.experimental.multihost_utils.process_allgather`` over ICI/DCN — the
@@ -6,9 +6,22 @@ TPU-native replacement for the reference's "download the batch output file"
 step (perturb_prompts.py:332-345). On a single-process run (one host, any
 number of chips) every helper degrades to the identity, so sweep drivers
 call them unconditionally.
+
+LIVENESS (lir_tpu/guard): a collective is also the pod's deadliest
+failure mode — one dead or wedged peer parks every LIVE host inside
+``process_allgather``/``sync_global_devices`` forever, with no exception
+for the recovery machinery to catch. :func:`barrier` therefore accepts a
+timeout (the collective runs on a watched thread — guard/watchdog) and
+:func:`liveness_barrier` fronts it with a per-host heartbeat allgather,
+so at every sweep shard boundary the survivors learn which peers are
+alive, how far each got, and — when a peer is gone — exit with
+:class:`HostDesyncError` while their shard artifacts and manifests are
+already flushed (resumable), rather than hanging in ICI/DCN.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import numpy as np
@@ -16,6 +29,13 @@ import numpy as np
 from ..utils.logging import get_logger
 
 log = get_logger(__name__)
+
+
+class HostDesyncError(RuntimeError):
+    """A multihost collective outlived its liveness timeout: a peer host
+    is presumed dead or wedged. Raised on the SURVIVORS — their shard
+    results and manifests are flushed before every guarded barrier, so
+    the correct response is to exit and resume, not to wait."""
 
 
 def initialize(coordinator_address: str | None = None,
@@ -116,14 +136,84 @@ def gather_rows(local_rows: np.ndarray) -> np.ndarray:
     return np.reshape(gathered, (-1,) + np.asarray(local_rows).shape[1:])
 
 
-def barrier(name: str) -> None:
+def _bounded(fn, name: str, timeout_s: float):
+    """Run one collective on a watched thread (guard/watchdog.watch_call)
+    with a hard deadline. On expiry the collective is abandoned (the
+    worker thread stays parked in the C++ call — the process is exiting
+    anyway) and HostDesyncError carries the diagnosis."""
+    from ..guard.watchdog import DispatchStalled, watch_call
+
+    try:
+        return watch_call(fn, timeout_s, label=f"multihost:{name}")
+    except DispatchStalled as err:
+        raise HostDesyncError(
+            f"multihost collective {name!r} did not complete within "
+            f"{timeout_s:.0f}s — a peer host is presumed dead or wedged "
+            f"(process {jax.process_index()} of {jax.process_count()} "
+            f"reporting). This host's shard artifacts and manifest are "
+            f"already flushed; exit and re-launch to resume.") from err
+
+
+def barrier(name: str, timeout_s: Optional[float] = None) -> None:
     """Synchronize hosts at a named point (e.g. before a manifest flush so
-    one host's resume view can't run ahead of another's writes)."""
+    one host's resume view can't run ahead of another's writes).
+    ``timeout_s`` bounds the wait: a barrier a peer never reaches raises
+    HostDesyncError instead of hanging forever (None/<=0 keeps the
+    legacy unbounded wait)."""
     if not is_multiprocess():
         return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(name)
+    if timeout_s is None or timeout_s <= 0:
+        multihost_utils.sync_global_devices(name)
+        return
+    _bounded(lambda: multihost_utils.sync_global_devices(name), name,
+             timeout_s)
+
+
+def heartbeat(name: str, payload: int = 0,
+              timeout_s: Optional[float] = None) -> np.ndarray:
+    """All-gather one ``(process_index, payload)`` beat per host —
+    liveness plus progress (the sweep sends its flushed row count) in a
+    single cheap collective. Returns the (n_hosts, 2) table, int64, in
+    process order. Single-process: the identity (this host's beat)."""
+    beat = np.asarray([[jax.process_index(), int(payload)]], np.int64)
+    if not is_multiprocess():
+        return beat
+    from jax.experimental import multihost_utils
+
+    fn = lambda: multihost_utils.process_allgather(beat)  # noqa: E731
+    gathered = (fn() if timeout_s is None or timeout_s <= 0
+                else _bounded(fn, f"heartbeat:{name}", timeout_s))
+    return np.reshape(np.asarray(gathered), (-1, 2))
+
+
+def liveness_barrier(name: str, timeout_s: Optional[float] = None,
+                     payload: int = 0, stats=None):
+    """The guarded shard-boundary fence: heartbeat allgather (who is
+    alive, how far each host got) then a timeout-bounded barrier. Either
+    step expiring raises HostDesyncError on the survivors; the heartbeat
+    table is logged first so the operator can see WHICH peer went dark
+    on the next boundary. ``stats`` (profiling.GuardStats) counts
+    heartbeats and barrier timeouts. Single-process: identity, returns
+    this host's beat."""
+    if not is_multiprocess():
+        return heartbeat(name, payload)
+    try:
+        beats = heartbeat(name, payload, timeout_s)
+        if stats is not None:
+            stats.count("heartbeats")
+        log.info("liveness %s: %d/%d hosts beating — %s", name,
+                 beats.shape[0], jax.process_count(),
+                 "; ".join(f"host{int(h)}={int(p)}" for h, p in beats))
+        barrier(name, timeout_s)
+        return beats
+    except HostDesyncError:
+        if stats is not None:
+            stats.count("barrier_timeouts")
+        log.error("liveness %s: collective timed out — exiting resumable "
+                  "rather than hanging on a dead peer", name)
+        raise
 
 
 def host_shard(items, process_index: int | None = None,

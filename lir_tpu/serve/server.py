@@ -44,16 +44,19 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..config import ServeConfig
 from ..engine import compile_plan
+from ..engine import scheduler as sched_mod
 from ..engine import tokens as tok
 from ..faults import CLOSED, HALF_OPEN, CircuitBreaker, degrade_dispatch
+from ..guard import numerics
 from ..utils.logging import get_logger
 from ..utils.manifest import atomic_write_json
 from ..utils.profiling import FaultStats, ServeStats
 from ..utils.retry import retry_with_exponential_backoff
 from .batcher import ContinuousBatcher
 from .cache import ResultCache, content_key
-from .queue import (STATUS_ERROR, STATUS_OK, STATUS_SHED, Pending,
-                    RequestQueue, ServeFuture, ServeRequest, ServeResult)
+from .queue import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK, STATUS_SHED,
+                    Pending, RequestQueue, ServeFuture, ServeRequest,
+                    ServeResult)
 
 log = get_logger(__name__)
 
@@ -223,6 +226,53 @@ class ScoringServer:
             request_id=p.request.request_id, status=STATUS_OK,
             latency_s=latency, **payload))
 
+    def _resolve_payload(self, p: Pending, payload: Dict,
+                         now: float) -> None:
+        """One scored row crosses the guard boundary: numerics-invalid
+        payloads are QUARANTINED as error:numerics (the ladder's poison-
+        row semantics — neighbors untouched, only the corrupt row is
+        withheld); rows whose future already resolved (deadline passed
+        mid-dispatch — see :meth:`_cancel_expired_inflight`) drop their
+        payload; everything else resolves ok."""
+        reason = None
+        if self.engine.rt.numerics_guard:
+            self.engine.guard_stats.site("checked", "serve")
+            reason = numerics.check_payload(payload)
+        if reason is not None:
+            self.engine.guard_stats.quarantine("serve", reason)
+            self.stats.count("errors")
+            log.warning("numerics guard: quarantined request %s (%s)",
+                        p.request.request_id, reason)
+            p.future.resolve(ServeResult(
+                request_id=p.request.request_id, status=STATUS_ERROR,
+                note=f"{numerics.NUMERICS_ERROR} — {reason} "
+                     f"(row quarantined by the numerics guard)",
+                latency_s=now - p.t_submit))
+            return
+        if p.future.done():
+            return          # expired mid-dispatch; partial already sent
+        self._resolve_ok(p, payload, now)
+
+    def _cancel_expired_inflight(self) -> None:
+        """Watchdog tick callback, run on the supervisor thread while a
+        WATCHED dispatch is on the device: a request whose deadline
+        passes mid-dispatch resolves its partial (confidence-free)
+        result IMMEDIATELY instead of waiting out the device call — the
+        deadline is now enforced against wall time, not against
+        whenever the dispatch happens to return."""
+        now = self.clock()
+        for p in self._inflight:
+            if not p.future.done() and now >= p.t_deadline:
+                self.stats.count("expired")
+                self.engine.guard_stats.count("inflight_cancelled")
+                p.future.resolve(ServeResult(
+                    request_id=p.request.request_id,
+                    status=STATUS_EXPIRED,
+                    note=f"deadline passed mid-dispatch (waited "
+                         f"{now - p.t_submit:.3f}s; dispatch watched, "
+                         f"partial resolved without waiting it out)",
+                    latency_s=now - p.t_submit))
+
     def _dispatch(self, bucket: int, rows) -> None:
         probing = self.breaker.state == HALF_OPEN
         attempts = {"n": 0}
@@ -231,11 +281,28 @@ class ScoringServer:
             attempts["n"] += 1
             return self.batcher.score(bucket, rows)
 
+        # Watched executor (guard/watchdog): the dispatch runs on a
+        # watched thread priced by the SAME bucket_cost model the
+        # batcher formed it with. A hang surfaces DispatchStalled into
+        # the retry -> ladder -> breaker path below, and the tick
+        # callback resolves deadline-expired rows partial mid-dispatch.
+        wd = getattr(self.engine, "watchdog", None)
+        if wd is not None and wd.enabled:
+            cost = sched_mod.bucket_cost(
+                len(rows), bucket, self.engine.rt.batch_size,
+                self.batcher.decode_cost)
+            dispatch_call = lambda: wd.watch(  # noqa: E731
+                call, cost=cost, site="serve",
+                on_tick=self._cancel_expired_inflight)
+        else:
+            dispatch_call = call
+
         self._inflight = list(rows)
         try:
             try:
                 payloads = retry_with_exponential_backoff(
-                    call, retry_on=(Exception,), config=self.config.retry,
+                    dispatch_call, retry_on=(Exception,),
+                    config=self.config.retry,
                     log=lambda m: log.warning("serve dispatch retry: %s",
                                               m),
                     clock=self.clock)
@@ -250,7 +317,7 @@ class ScoringServer:
             self.breaker.record_success()
             now = self.clock()
             for p, payload in zip(rows, payloads):
-                self._resolve_ok(p, payload, now)
+                self._resolve_payload(p, payload, now)
         finally:
             self._inflight = []
 
@@ -289,7 +356,7 @@ class ScoringServer:
                                  f"ladder: {err!r}",
                             latency_s=now - p.t_submit))
                     else:
-                        self._resolve_ok(p, payload, now)
+                        self._resolve_payload(p, payload, now)
                 if n_poison:
                     self.faults.count("degraded_rows", n_poison)
                     log.warning("serve: degradation ladder isolated %d "

@@ -488,6 +488,89 @@ class FaultStats:
             }
 
 
+@dataclasses.dataclass
+class GuardStats:
+    """Guard-layer counters (lir_tpu/guard): what the silent-failure
+    path saw and did, per SITE ("sweep" / "serve" / "compile" /
+    "barrier"). Thread-safe — the sweep writer thread, the serve
+    supervisor, and compile-pool threads all mutate it concurrently.
+
+    Definitions (reported by ``summary()``, bench.py's "chaos" key, and
+    ``make chaos-smoke``):
+
+    - ``watched``: dispatches run under an enforced watchdog deadline
+      (uncalibrated observe-only runs are not counted — they cannot
+      fire).
+    - ``stalls``: watchdog expiries per site — each one is a dispatch
+      that would have hung the run and instead cost one deadline.
+      ``stall_dumps`` counts the all-thread stack dumps emitted.
+    - ``checked`` / ``quarantined``: numerics-guard rows validated and
+      rows withheld as ``error:numerics``; ``reasons`` histograms the
+      quarantine causes (NaN probs, out-of-range confidence, ...).
+    - ``inflight_cancelled``: serve rows resolved partial because their
+      deadline passed while the dispatch was still on the device (the
+      watched executor's tick callback).
+    - ``barrier_timeouts`` / ``heartbeats``: multihost liveness —
+      bounded collectives that expired (a peer presumed dead) and
+      heartbeat allgathers completed.
+    """
+
+    watched: Dict[str, int] = dataclasses.field(default_factory=dict)
+    stalls: Dict[str, int] = dataclasses.field(default_factory=dict)
+    checked: Dict[str, int] = dataclasses.field(default_factory=dict)
+    quarantined: Dict[str, int] = dataclasses.field(default_factory=dict)
+    reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
+    stall_dumps: int = 0
+    inflight_cancelled: int = 0
+    barrier_timeouts: int = 0
+    heartbeats: int = 0
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def site(self, field: str, site: str, n: int = 1) -> None:
+        with self._lock:
+            d = getattr(self, field)
+            d[site] = d.get(site, 0) + n
+
+    def quarantine(self, site: str, reason: str) -> None:
+        with self._lock:
+            self.quarantined[site] = self.quarantined.get(site, 0) + 1
+            self.reasons[reason] = self.reasons.get(reason, 0) + 1
+
+    @property
+    def stalls_total(self) -> int:
+        with self._lock:
+            return sum(self.stalls.values())
+
+    @property
+    def quarantined_total(self) -> int:
+        with self._lock:
+            return sum(self.quarantined.values())
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "watched": dict(self.watched),
+                "stalls": dict(self.stalls),
+                "stalls_total": sum(self.stalls.values()),
+                "stall_dumps": self.stall_dumps,
+                "checked": dict(self.checked),
+                "quarantined": dict(self.quarantined),
+                "quarantined_total": sum(self.quarantined.values()),
+                "quarantine_reasons": dict(self.reasons),
+                "inflight_cancelled": self.inflight_cancelled,
+                "barrier_timeouts": self.barrier_timeouts,
+                "heartbeats": self.heartbeats,
+            }
+
+
 # Published peak dense-matmul throughput per chip (bf16 FLOPS). Weight-only
 # int8 still computes in bf16 on the MXU, so bf16 peak is the MFU denominator
 # there; dynamic int8 (s8 x s8 -> s32 dots) gets 2x this on every listed

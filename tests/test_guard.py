@@ -1,0 +1,407 @@
+"""Guard layer tests (lir_tpu/guard): watchdog stall detection, numerics
+quarantine, and multihost liveness.
+
+Pins the robustness tentpole's contracts:
+- watch_call runs a callable on a watched thread: results and
+  exceptions (BaseException included) propagate; a call that outlives
+  its deadline is abandoned and raises DispatchStalled; on_tick runs on
+  the caller's thread while the call is in flight;
+- DispatchWatchdog calibrates seconds-per-bucket_cost-unit from
+  observed dispatches and enforces floor + multiple * predicted; the
+  first (uncalibrated) dispatch is observe-only;
+- an injected HANG in a sweep dispatch is detected within its deadline
+  and fed into the EXISTING recovery ladder: the sweep completes with
+  rows bitwise identical to a clean run, long before the hang releases;
+- injected NaN corruption quarantines exactly the corrupt rows as
+  error:numerics while their neighbors score bitwise identical to a
+  fault-free sweep, and GuardStats counters match the injected counts —
+  offline and serve;
+- _parse_confidence rejects out-of-range integers (satellite 2);
+- the multihost liveness barrier raises HostDesyncError within its
+  timeout instead of hanging on a dead peer, and degrades to the
+  identity single-process.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from lir_tpu import faults
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import RetryConfig, RuntimeConfig, ServeConfig
+from lir_tpu.data.prompts import LegalPrompt
+from lir_tpu.engine.runner import ScoringEngine
+from lir_tpu.engine.sweep import _parse_confidence, run_perturbation_sweep
+from lir_tpu.guard import numerics
+from lir_tpu.guard.watchdog import (DispatchStalled, DispatchWatchdog,
+                                    dump_thread_stacks, watch_call)
+from lir_tpu.parallel import multihost
+from lir_tpu.serve import ScoringServer, ServeRequest
+from lir_tpu.utils.profiling import GuardStats
+
+
+# ---------------------------------------------------------------------------
+# watch_call: the watched executor primitive
+# ---------------------------------------------------------------------------
+
+def test_watch_call_returns_result_and_ticks():
+    ticks = []
+    out = watch_call(lambda: (time.sleep(0.15), 42)[1], deadline_s=10.0,
+                     on_tick=lambda: ticks.append(1), tick_s=0.02)
+    assert out == 42
+    assert len(ticks) >= 2      # ticks fired while the call ran
+
+
+def test_watch_call_propagates_exceptions_and_base_exceptions():
+    with pytest.raises(ValueError, match="boom"):
+        watch_call(lambda: (_ for _ in ()).throw(ValueError("boom")),
+                   deadline_s=5.0)
+
+    def preempt():
+        raise faults.InjectedPreemption("kill")
+
+    # BaseException must unwind through the watched thread exactly as it
+    # would inline — recovery code catching Exception cannot survive it.
+    with pytest.raises(faults.InjectedPreemption):
+        watch_call(preempt, deadline_s=5.0)
+
+
+def test_watch_call_deadline_abandons_and_raises_stalled():
+    t0 = time.monotonic()
+    with pytest.raises(DispatchStalled, match="watchdog deadline"):
+        watch_call(lambda: time.sleep(30), deadline_s=0.2, label="hungcall",
+                   tick_s=0.02)
+    # Detected within ~one deadline, not after the 30s sleep.
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_watch_call_none_deadline_waits_out_the_call():
+    out = watch_call(lambda: (time.sleep(0.1), "done")[1], deadline_s=None,
+                     tick_s=0.02)
+    assert out == "done"
+
+
+def test_dump_thread_stacks_includes_this_thread():
+    text = dump_thread_stacks()
+    assert "test_dump_thread_stacks_includes_this_thread" in text
+
+
+# ---------------------------------------------------------------------------
+# DispatchWatchdog: calibration + deadline policy
+# ---------------------------------------------------------------------------
+
+def test_watchdog_uncalibrated_is_observe_only_then_enforces():
+    wd = DispatchWatchdog(multiple=2.0, floor_s=0.05)
+    assert wd.enabled and not wd.calibrated
+    assert wd.deadline_for(100) is None          # observe-only
+    assert wd.watch(lambda: "first") == "first"  # runs inline, calibrates
+    assert wd.calibrated
+    d = wd.deadline_for(100)
+    assert d is not None and d >= wd.floor_s
+    # Stats: the inline observe-only call is not counted as watched.
+    assert wd.stats.watched == {}
+
+
+def test_watchdog_disabled_by_nonpositive_multiple():
+    wd = DispatchWatchdog(multiple=0.0, floor_s=0.05)
+    assert not wd.enabled
+    assert wd.watch(lambda: "x") == "x"
+    assert wd.deadline_for(10) is None
+
+
+def test_watchdog_stall_counts_per_site():
+    wd = DispatchWatchdog(multiple=1.0, floor_s=0.1, tick_s=0.02)
+    wd.observe(cost=10, elapsed=0.01)            # calibrate: fast device
+    with pytest.raises(DispatchStalled):
+        wd.watch(lambda: time.sleep(30), cost=10, site="sweep")
+    assert wd.stats.stalls == {"sweep": 1}
+    assert wd.stats.stall_dumps == 1
+    assert wd.stats.watched == {"sweep": 1}
+
+
+def test_watchdog_deadline_scales_with_cost():
+    wd = DispatchWatchdog(multiple=10.0, floor_s=1.0)
+    wd.observe(cost=100, elapsed=0.5)            # 5 ms per unit
+    small, big = wd.deadline_for(100), wd.deadline_for(1000)
+    assert big > small > wd.floor_s
+
+
+# ---------------------------------------------------------------------------
+# Numerics guard: the validation boundary
+# ---------------------------------------------------------------------------
+
+def test_numerics_check_values_accepts_sane_rows():
+    assert numerics.check_values(0.4, 0.3, 55.0, [-1.2, -0.001], 85) is None
+    assert numerics.check_values(0.0, 1.0, 0.0, [], None) is None
+    # Float slop at the boundary is rounding, not corruption.
+    assert numerics.check_values(1.0 + 5e-5, 0.0, 100.0) is None
+
+
+@pytest.mark.parametrize("kw,frag", [
+    (dict(token_1_prob=float("nan"), token_2_prob=0.1), "not finite"),
+    (dict(token_1_prob=float("inf"), token_2_prob=0.1), "not finite"),
+    (dict(token_1_prob=1.5, token_2_prob=0.1), "outside [0,1]"),
+    (dict(token_1_prob=-0.2, token_2_prob=0.1), "outside [0,1]"),
+    (dict(token_1_prob=0.7, token_2_prob=0.7), "> 1"),
+    (dict(token_1_prob=0.4, token_2_prob=0.3,
+          weighted_confidence=float("nan")), "not finite"),
+    (dict(token_1_prob=0.4, token_2_prob=0.3,
+          weighted_confidence=250.0), "outside [0,100]"),
+    (dict(token_1_prob=0.4, token_2_prob=0.3,
+          logprob_values=[-1.0, float("nan")]), "NaN"),
+    (dict(token_1_prob=0.4, token_2_prob=0.3,
+          logprob_values=[0.5]), "positive"),
+    (dict(token_1_prob=0.4, token_2_prob=0.3,
+          confidence_value=250), "outside [0,100]"),
+])
+def test_numerics_check_values_rejects_corruption(kw, frag):
+    reason = numerics.check_values(**kw)
+    assert reason is not None and frag in reason
+
+
+def test_numerics_check_payload_reads_the_logprob_map():
+    ok = dict(token_1_prob=0.5, token_2_prob=0.2, weighted_confidence=50.0,
+              log_probabilities='{"7": -0.5, "9": -2.25}',
+              confidence_value=None)
+    assert numerics.check_payload(ok) is None
+    bad = dict(ok, log_probabilities='{"7": NaN}')
+    assert "NaN" in numerics.check_payload(bad)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: _parse_confidence rejects out-of-range integers
+# ---------------------------------------------------------------------------
+
+def test_parse_confidence_rejects_out_of_range_values():
+    assert _parse_confidence("confidence: 250") is None   # the bug case
+    assert _parse_confidence("in the year 1987 .") is None
+    assert _parse_confidence("confidence: 100") == 100
+    assert _parse_confidence("confidence: 0") == 0
+    assert _parse_confidence("I am 85% sure") == 85
+    # First-integer semantics preserved: an out-of-range FIRST integer
+    # rejects the row (the reference reads only the first integer; we
+    # never silently substitute a later one).
+    assert _parse_confidence("policy 250 , confidence 80") is None
+    # The truncation guard still composes with the range check.
+    assert _parse_confidence("about 85", complete=False) is None
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: injected hang + injected NaN on the fake backend
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(batch=2, seed=5, **rt_kw):
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="guard-t", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=1, n_heads=2,
+                      intermediate_size=64, max_seq_len=128)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(seed))
+    return ScoringEngine(params, cfg, FakeTokenizer(),
+                         RuntimeConfig(batch_size=batch, max_seq_len=128,
+                                       **rt_kw))
+
+
+def _tiny_grid(n_cells, seed=3):
+    rng = np.random.default_rng(seed)
+    words = "coverage policy flood water damage claim".split()
+
+    def text():
+        return " ".join(rng.choice(words) for _ in range(8)) + " ?"
+
+    lp = (LegalPrompt(main=text(), response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Number from 0 to 100 ."),)
+    return lp, ([text() for _ in range(n_cells - 1)],)
+
+
+def _values(r):
+    return (r.token_1_prob, r.token_2_prob, r.confidence_value,
+            r.weighted_confidence, r.model_response,
+            r.model_confidence_response, r.log_probabilities)
+
+
+def test_sweep_watchdog_detects_hang_and_ladder_recovers(tmp_path):
+    """An injected stall (sleep far past the deadline) is abandoned by
+    the watchdog within its deadline and fed into the sweep's recovery
+    ladder — rows bitwise identical to a clean run, wall time nowhere
+    near the hang duration."""
+    lp, perts = _tiny_grid(6)
+    # One engine for both runs: the clean sweep calibrates the watchdog
+    # (deadline ~ floor + 2x observed dispatch seconds), so the chaos
+    # sweep's deadlines are tight without hand-tuning.
+    engine = _tiny_engine(watchdog_multiple=2.0, watchdog_floor_s=0.2)
+    clean = run_perturbation_sweep(engine, "g", lp, perts,
+                                   tmp_path / "clean.csv",
+                                   checkpoint_every=100)
+    assert engine.watchdog.calibrated
+
+    hang_s = 60.0
+    plan = faults.FaultPlan(schedules={
+        "dispatch": faults.SiteSchedule.hang_at(1, seconds=hang_s)})
+    faults.wrap_engine(engine, plan)
+    t0 = time.monotonic()
+    rows = run_perturbation_sweep(engine, "g", lp, perts,
+                                  tmp_path / "chaos.csv",
+                                  checkpoint_every=100)
+    elapsed = time.monotonic() - t0
+    assert plan.stats.injected_total == 1
+    assert engine.guard_stats.stalls.get("sweep", 0) >= 1   # watchdog fired
+    assert engine.fault_stats.recovered_dispatches >= 1     # ladder recovered
+    # Recovered within ~one deadline, not by waiting out the hang.
+    assert elapsed < hang_s / 2, f"sweep waited out the hang ({elapsed:.1f}s)"
+    by_key = {r.rephrased_main: _values(r) for r in clean}
+    assert len(rows) == 6
+    for r in rows:
+        assert _values(r) == by_key[r.rephrased_main]       # bitwise
+
+
+def test_sweep_nan_rows_quarantined_neighbors_bitwise(tmp_path):
+    """Injected NaN corruption (SDC stand-in) quarantines exactly the
+    corrupt rows as error:numerics; every clean row is bitwise identical
+    to a fault-free sweep; GuardStats counters match the injection."""
+    lp, perts = _tiny_grid(6, seed=9)
+    clean = run_perturbation_sweep(_tiny_engine(), "g", lp, perts,
+                                   tmp_path / "clean.csv",
+                                   checkpoint_every=100)
+
+    engine = _tiny_engine()
+    plan = faults.FaultPlan(schedules={
+        "dispatch": faults.SiteSchedule.nan_at(1, rows=(0,))})
+    faults.wrap_engine(engine, plan)
+    rows = run_perturbation_sweep(engine, "g", lp, perts,
+                                  tmp_path / "chaos.csv",
+                                  checkpoint_every=100)
+    assert plan.stats.injected_total == 1
+    assert len(rows) == 6                                   # zero lost
+    quarantined = [r for r in rows
+                   if r.model_response == numerics.NUMERICS_ERROR]
+    assert len(quarantined) == 1                # exactly the corrupt row
+    assert engine.guard_stats.quarantined == {"sweep": 1}
+    assert engine.guard_stats.checked["sweep"] == 6
+    q = quarantined[0]
+    assert q.token_1_prob is None and q.token_2_prob is None
+    assert q.confidence_value is None and q.weighted_confidence is None
+    assert numerics.NUMERICS_ERROR in q.model_confidence_response
+    import math
+    assert math.isnan(q.odds_ratio)             # schema None-safety
+    by_key = {r.rephrased_main: _values(r) for r in clean}
+    for r in rows:
+        if r is q:
+            continue
+        assert _values(r) == by_key[r.rephrased_main]       # bitwise
+
+
+_FAST_RETRY = RetryConfig(max_retries=1, initial_delay=0.001,
+                          max_delay=0.002, full_jitter=True,
+                          max_elapsed=0.5)
+
+_SERVE_CFG = ServeConfig(queue_depth=32, classes=(("t", 600.0),),
+                         default_class="t", linger_s=0.0,
+                         max_consecutive_failures=3, retry=_FAST_RETRY)
+
+
+def _req(i, rid=None):
+    body = f"clause {i} covers hail damage under policy {i * 3}"
+    return ServeRequest(binary_prompt=f"{body} Answer Yes or No .",
+                        confidence_prompt=f"{body} Number 0 to 100 .",
+                        klass="t", request_id=rid or str(i))
+
+
+def test_serve_nan_payload_quarantined_neighbors_ok():
+    server = ScoringServer(_tiny_engine(batch=4), "g", _SERVE_CFG)
+    plan = faults.FaultPlan(schedules={
+        "dispatch": faults.SiteSchedule.nan_at(0, rows=(0,))})
+    faults.wrap_server(server, plan)
+    futs = [server.submit(_req(i)) for i in range(4)]
+    server.start()
+    try:
+        res = [f.result(timeout=60) for f in futs]
+    finally:
+        server.stop()
+    by_id = {r.request_id: r for r in res}
+    bad = by_id["0"]                    # row 0 of the first dispatch
+    assert bad.status == "error"
+    assert numerics.NUMERICS_ERROR in bad.note
+    assert all(by_id[str(i)].status == "ok" for i in range(1, 4))
+    g = server.engine.guard_stats
+    assert g.quarantined == {"serve": 1}
+    assert plan.stats.injected_total == 1
+    assert server.healthy               # row-local corruption, no breaker
+
+
+def test_serve_watchdog_detects_hang_and_recovers():
+    engine = _tiny_engine(batch=2, watchdog_multiple=3.0,
+                          watchdog_floor_s=0.3)
+    server = ScoringServer(engine, "g", _SERVE_CFG)
+    plan = faults.FaultPlan(schedules={
+        "dispatch": faults.SiteSchedule.hang_at(1, seconds=60.0)})
+    faults.wrap_server(server, plan)
+    server.start()
+    try:
+        # Dispatch 0: clean — calibrates the watchdog.
+        warm = server.submit(_req(0)).result(timeout=60)
+        assert warm.status == "ok"
+        # Dispatch 1: hangs; the watchdog must abandon it and the
+        # retry/ladder must score the rows long before the 60s release.
+        t0 = time.monotonic()
+        r = server.submit(_req(1)).result(timeout=60)
+        elapsed = time.monotonic() - t0
+    finally:
+        server.stop()
+    assert r.status == "ok"
+    assert elapsed < 30.0, f"serve waited out the hang ({elapsed:.1f}s)"
+    assert engine.guard_stats.stalls.get("serve", 0) >= 1
+    assert server.faults.recovered_dispatches >= 1
+    assert server.healthy
+
+
+# ---------------------------------------------------------------------------
+# Multihost liveness: timeout-bounded barrier + heartbeat
+# ---------------------------------------------------------------------------
+
+def test_multihost_single_process_is_identity():
+    # No distributed runtime: every liveness helper degrades to the
+    # identity, so sweep drivers call them unconditionally.
+    assert not multihost.is_multiprocess()
+    beat = multihost.liveness_barrier("t", timeout_s=0.1, payload=7)
+    assert beat.shape == (1, 2) and int(beat[0, 1]) == 7
+    multihost.barrier("t", timeout_s=0.1)       # no-op, no error
+
+
+def test_multihost_dead_peer_raises_desync_within_timeout(monkeypatch):
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+
+    def parked(*a, **k):            # a dead peer: the collective never
+        time.sleep(60)              # completes on the survivor
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", parked)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices", parked)
+    stats = GuardStats()
+    t0 = time.monotonic()
+    with pytest.raises(multihost.HostDesyncError, match="presumed dead"):
+        multihost.liveness_barrier("shard-done", timeout_s=0.3,
+                                   payload=12, stats=stats)
+    assert time.monotonic() - t0 < 10.0     # fail fast, not in 60s
+    assert stats.barrier_timeouts == 1
+
+
+def test_multihost_heartbeat_gathers_progress(monkeypatch):
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    # A live pod: echo both hosts' beats back.
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda x: np.stack([np.asarray([[0, 40]], np.int64),
+                            np.asarray(x)]))
+    beats = multihost.heartbeat("t", payload=41, timeout_s=1.0)
+    assert beats.shape == (2, 2)
+    assert beats.tolist() == [[0, 40], [1, 41]]

@@ -423,6 +423,58 @@ def test_deadline_expired_rows_return_partial_without_failing_batch():
     assert server.stats.completed == len(results)
 
 
+def test_expired_request_resolves_partial_during_watched_dispatch():
+    """Satellite pin (guard layer): deadline enforcement actually
+    CANCELS. A request whose deadline passes while its dispatch is on
+    the device resolves its partial result immediately — the watched
+    executor's tick callback — instead of waiting out the device call.
+    Pre-guard behavior was to block until the dispatch returned, which
+    made deadlines advisory whenever the device was slow or hung."""
+    import time as _time
+
+    make_engine = _tiny_setup()
+    lp, perts = _grid(4, seed=7)
+    from lir_tpu.engine import grid as grid_mod
+
+    cells = grid_mod.build_grid("serve-t", lp, perts)
+    server = ScoringServer(make_engine(), "serve-t", _SERVE_CFG)
+    real_score = server.batcher.score
+    slow_s = 1.5
+
+    def slow_score(bucket, rows):
+        _time.sleep(slow_s)         # a slow (not hung) device call
+        return real_score(bucket, rows)
+
+    server.batcher.score = slow_score
+    doomed = server.submit(ServeRequest(
+        binary_prompt=cells[0].binary_prompt,
+        confidence_prompt=cells[0].confidence_prompt,
+        deadline_s=0.2, request_id="doomed"))
+    live = [server.submit(_request_for(c, str(i)))
+            for i, c in enumerate(cells)]
+    server.start()
+    try:
+        t0 = _time.monotonic()
+        d = doomed.result(timeout=60)
+        waited = _time.monotonic() - t0
+        results = [f.result(timeout=300) for f in live]
+    finally:
+        server.stop()
+    assert d.status == "deadline_exceeded"
+    assert d.token_1_prob is None and d.weighted_confidence is None
+    assert "mid-dispatch" in d.note
+    # The whole point: resolved BEFORE the device call finished.
+    assert waited < slow_s, (
+        f"expired request waited out the {slow_s}s dispatch "
+        f"({waited:.2f}s)")
+    # Its batch still completed for every live neighbor, and the late
+    # payload for the cancelled row was dropped, not double-resolved.
+    assert all(r.status == "ok" for r in results)
+    eng_stats = server.engine.guard_stats
+    assert eng_stats.inflight_cancelled >= 1
+    assert server.stats.expired >= 1
+
+
 def test_repeated_device_errors_drain_queue_and_flip_health():
     make_engine = _tiny_setup()
     cfg = ServeConfig(

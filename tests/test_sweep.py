@@ -250,6 +250,8 @@ def test_perturbation_sweep_multihost_shards(tmp_path, monkeypatch):
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     # A real barrier would block: this simulation has one actual process.
     monkeypatch.setattr(multihost, "barrier", lambda name: None)
+    monkeypatch.setattr(multihost, "liveness_barrier",
+                        lambda name, **kw: None)
     seen = []
     for proc in (0, 1):
         monkeypatch.setattr(jax, "process_index", lambda p=proc: p)
@@ -331,6 +333,8 @@ def test_multihost_shard_concat_and_merged_resume(tmp_path, monkeypatch):
 
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     monkeypatch.setattr(multihost, "barrier", lambda name: None)
+    monkeypatch.setattr(multihost, "liveness_barrier",
+                        lambda name, **kw: None)
     # Host 1 first, then host 0 (whose tail runs the merge).
     for proc in (1, 0):
         monkeypatch.setattr(jax, "process_index", lambda p=proc: p)
@@ -385,6 +389,8 @@ def test_multihost_empty_host_still_merges(tmp_path, monkeypatch):
 
     monkeypatch.setattr(jax, "process_count", lambda: 3)
     monkeypatch.setattr(multihost, "barrier", lambda name: None)
+    monkeypatch.setattr(multihost, "liveness_barrier",
+                        lambda name, **kw: None)
     for proc in (2, 1, 0):
         monkeypatch.setattr(jax, "process_index", lambda p=proc: p)
         run_perturbation_sweep(eng, "mhe-model", lp, perts,

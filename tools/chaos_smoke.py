@@ -19,8 +19,25 @@ robustness PR ships:
    style shutdown checkpoint must hand every unresolved request to a
    fresh server with zero lost and zero double-served.
 
+The guard layer (lir_tpu/guard) adds the SILENT failure modes:
+
+4. WATCHDOG vs HANG — a sweep dispatch that sleeps far past its
+   watchdog deadline must be detected within ~one deadline, abandoned,
+   and recovered through the ladder: zero lost/duplicated rows, output
+   bitwise identical to a fault-free run, wall time nowhere near the
+   hang duration.
+5. NUMERICS GUARD vs NaN — injected NaN logits (SDC stand-in) must
+   quarantine exactly the corrupt rows as error:numerics while every
+   clean row stays bitwise identical to the fault-free run — zero
+   corrupted rows recorded; GuardStats counters match the injections.
+   Same contract online: the serve request carrying the corrupt row
+   resolves "error" with a numerics note, its neighbors "ok".
+6. MULTIHOST LIVENESS — a simulated dead peer (collectives that never
+   complete) must raise HostDesyncError on the survivor within the
+   liveness timeout (resumable exit) instead of hanging forever.
+
 Runs hermetically on CPU (FakeTokenizer + tiny random decoder); prints
-the FaultStats summaries as JSON on success.
+the FaultStats/GuardStats summaries as JSON on success.
 """
 
 from __future__ import annotations
@@ -305,19 +322,254 @@ def serve_chaos(failures):
             "ladder": server2.faults.summary()}
 
 
+def guard_chaos(failures):
+    """Mechanisms 4+5 offline: one sweep under an injected HANG (call 1)
+    and injected NaN corruption (a later dispatch) — the stall must be
+    detected within ~one watchdog deadline and recovered by the ladder,
+    the NaN row quarantined as error:numerics, everything else bitwise
+    identical to a fault-free run. Zero lost, zero dup, zero corrupted
+    rows recorded."""
+    import tempfile
+
+    from lir_tpu import faults
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data import schemas
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.guard import NUMERICS_ERROR
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    import jax
+
+    cfg = ModelConfig(name="guard-smoke", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=1, n_heads=2,
+                      intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(11))
+    # One engine for both passes: the clean sweep calibrates the
+    # watchdog, so the chaos pass runs under tight, price-model-derived
+    # deadlines with no hand tuning.
+    engine = ScoringEngine(params, cfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=BATCH, max_seq_len=256,
+                                         watchdog_multiple=2.0,
+                                         watchdog_floor_s=0.2))
+    lp, perts = _grid(N_CELLS)
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        clean = run_perturbation_sweep(engine, "guard", lp, perts,
+                                       td / "clean.csv",
+                                       checkpoint_every=100)
+        if not engine.watchdog.calibrated:
+            failures.append("watchdog did not calibrate on the clean sweep")
+        clean_by_key = {r.rephrased_main: (
+            r.token_1_prob, r.token_2_prob, r.confidence_value,
+            r.weighted_confidence, r.model_response,
+            r.model_confidence_response, r.log_probabilities)
+            for r in clean}
+
+        hang_s = 60.0
+        plan_hang = faults.FaultPlan(seed=5, schedules={
+            "dispatch": faults.SiteSchedule.hang_at(1, seconds=hang_s)})
+        plan_nan = faults.FaultPlan(seed=6, schedules={
+            # Call index on the OUTER wrap: 0 clean, 1 hang->stall,
+            # 2 the stalled dispatch's retry, 3 the NaN dispatch.
+            "dispatch": faults.SiteSchedule.nan_at(3, rows=(0,))})
+        faults.wrap_engine(engine, plan_hang)
+        faults.wrap_engine(engine, plan_nan)
+        t0 = time.monotonic()
+        rows = run_perturbation_sweep(engine, "guard", lp, perts,
+                                      td / "chaos.csv",
+                                      checkpoint_every=100)
+        elapsed = time.monotonic() - t0
+
+        if plan_hang.stats.injected.get("dispatch", 0) != 1:
+            failures.append("scheduled hang never fired")
+        if engine.guard_stats.stalls.get("sweep", 0) < 1:
+            failures.append("watchdog never detected the injected hang")
+        if engine.fault_stats.recovered_dispatches < 1:
+            failures.append("stalled dispatch was not recovered")
+        if elapsed > hang_s / 2:
+            failures.append(
+                f"stall recovery took {elapsed:.1f}s — the sweep waited "
+                f"out the hang instead of abandoning at its deadline")
+        keys = [r.rephrased_main for r in rows]
+        if len(keys) != N_CELLS or len(set(keys)) != N_CELLS:
+            failures.append(
+                f"hang+nan sweep lost/duplicated rows ({len(keys)} rows, "
+                f"{len(set(keys))} unique, expected {N_CELLS})")
+        quarantined = [r for r in rows if r.model_response == NUMERICS_ERROR]
+        if len(quarantined) != 1:
+            failures.append(
+                f"{len(quarantined)} rows quarantined, expected exactly "
+                f"the injected-NaN row")
+        if engine.guard_stats.quarantined.get("sweep", 0) != 1:
+            failures.append("GuardStats quarantine counter != 1 injection")
+        for r in rows:
+            if r.model_response == NUMERICS_ERROR:
+                if r.token_1_prob is not None or r.confidence_value is not None:
+                    failures.append("quarantined row still carries values")
+                continue
+            got = (r.token_1_prob, r.token_2_prob, r.confidence_value,
+                   r.weighted_confidence, r.model_response,
+                   r.model_confidence_response, r.log_probabilities)
+            if got != clean_by_key.get(r.rephrased_main):
+                failures.append(
+                    f"clean row differs from fault-free run under "
+                    f"hang+nan chaos: {r.rephrased_main[:40]}")
+    return {"guard": engine.guard_stats.summary(),
+            "recovered": engine.fault_stats.summary(),
+            "stall_recovery_s": round(elapsed, 2)}
+
+
+def serve_guard_chaos(failures):
+    """Mechanism 5 online: the serve request whose dispatch row was
+    NaN-corrupted resolves error:numerics; neighbors ok; an injected
+    serve hang is stalled-out and recovered to ok."""
+    import dataclasses
+
+    from lir_tpu import faults
+    from lir_tpu.config import RetryConfig, RuntimeConfig, ServeConfig
+    from lir_tpu.serve import ScoringServer, ServeRequest
+
+    def request(i, rid=None):
+        body = f"clause {i} covers wind damage under policy {i * 7}"
+        return ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="smoke", request_id=rid or str(i))
+
+    cfg = ServeConfig(
+        queue_depth=64, classes=(("smoke", 600.0),),
+        default_class="smoke", linger_s=0.0,
+        max_consecutive_failures=3,
+        retry=RetryConfig(max_retries=1, initial_delay=0.001,
+                          max_delay=0.002, full_jitter=True,
+                          max_elapsed=0.5))
+    import jax
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    mcfg = ModelConfig(name="guard-serve", vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=32, n_layers=1, n_heads=2,
+                       intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(mcfg, jax.random.PRNGKey(13))
+    engine = ScoringEngine(params, mcfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=BATCH, max_seq_len=256,
+                                         watchdog_multiple=3.0,
+                                         watchdog_floor_s=0.3))
+    server = ScoringServer(engine, "guard-serve", cfg)
+    plan = faults.FaultPlan(seed=9, schedules={
+        "dispatch": faults.SiteSchedule(fail_calls=(1,), kind="hang",
+                                        hang_s=60.0)})
+    plan_nan = faults.FaultPlan(seed=10, schedules={
+        # Outer wrap call index: 0 warm, 1 hang, 2 its retry, 3 nan.
+        "dispatch": faults.SiteSchedule.nan_at(3, rows=(0,))})
+    faults.wrap_server(server, plan)
+    faults.wrap_server(server, plan_nan)
+    server.start()
+    try:
+        warm = [server.submit(request(i, f"w{i}")) for i in range(BATCH)]
+        if not all(f.result(timeout=60).status == "ok" for f in warm):
+            failures.append("serve warm requests did not all serve ok")
+        t0 = time.monotonic()
+        hung = [server.submit(request(100 + i, f"h{i}"))
+                for i in range(BATCH)]
+        hres = [f.result(timeout=60) for f in hung]
+        stall_t = time.monotonic() - t0
+        if not all(r.status == "ok" for r in hres):
+            failures.append(
+                f"hung serve dispatch not recovered: "
+                f"{[r.status for r in hres]}")
+        if stall_t > 30.0:
+            failures.append(f"serve stall recovery took {stall_t:.1f}s")
+        if engine.guard_stats.stalls.get("serve", 0) < 1:
+            failures.append("serve watchdog never detected the hang")
+        nfut = [server.submit(request(200 + i, f"n{i}"))
+                for i in range(BATCH)]
+        nres = [f.result(timeout=60) for f in nfut]
+        quarantined = [r for r in nres
+                       if r.status == "error" and "numerics" in r.note]
+        if len(quarantined) != 1:
+            failures.append(
+                f"{len(quarantined)} serve rows quarantined, expected "
+                f"exactly the NaN row")
+        if sum(r.status == "ok" for r in nres) != BATCH - 1:
+            failures.append("NaN row took serve neighbors down")
+        if not server.healthy:
+            failures.append("row-local NaN tripped the serve breaker")
+    finally:
+        server.stop()
+    return {"serve_guard": engine.guard_stats.summary()}
+
+
+def multihost_chaos(failures):
+    """Mechanism 6: a dead peer (collectives that never complete) must
+    fail the survivor fast with HostDesyncError — resumable exit — not
+    park it in the collective forever. Simulated by patching the jax
+    multihost utils; restored before returning."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    from lir_tpu.parallel import multihost
+
+    saved = (jax.process_count, jax.process_index,
+             multihost_utils.sync_global_devices,
+             multihost_utils.process_allgather)
+
+    def parked(*a, **k):
+        time.sleep(60)
+
+    jax.process_count = lambda: 2
+    jax.process_index = lambda: 0
+    multihost_utils.sync_global_devices = parked
+    multihost_utils.process_allgather = parked
+    try:
+        t0 = time.monotonic()
+        try:
+            multihost.liveness_barrier("chaos-shard-done", timeout_s=0.5,
+                                       payload=3)
+            failures.append("dead-peer barrier returned instead of "
+                            "raising HostDesyncError")
+        except multihost.HostDesyncError:
+            pass
+        elapsed = time.monotonic() - t0
+        if elapsed > 10.0:
+            failures.append(
+                f"dead-peer detection took {elapsed:.1f}s — survivor "
+                f"nearly hung")
+    finally:
+        (jax.process_count, jax.process_index,
+         multihost_utils.sync_global_devices,
+         multihost_utils.process_allgather) = saved
+    return {"desync_detect_s": round(elapsed, 2)}
+
+
 def main() -> int:
     failures = []
     sweep_summary = sweep_chaos(failures)
     serve_summary = serve_chaos(failures)
+    guard_summary = guard_chaos(failures)
+    serve_guard_summary = serve_guard_chaos(failures)
+    mh_summary = multihost_chaos(failures)
     if failures:
         for f in failures:
             print(f"CHAOS-SMOKE FAIL: {f}")
         return 1
-    print(json.dumps({"sweep": sweep_summary, "serve": serve_summary}))
+    print(json.dumps({"sweep": sweep_summary, "serve": serve_summary,
+                      "guard": guard_summary,
+                      "serve_guard": serve_guard_summary,
+                      "multihost": mh_summary}))
     print("chaos smoke: OK (sweep resumed bitwise-identical after "
           "injected kill + torn manifest; breaker tripped and recovered "
           "via half-open probe; poison row isolated; checkpoint resume "
-          "lost nothing)")
+          "lost nothing; injected hang stalled-out within its deadline "
+          "and recovered; NaN rows quarantined as error:numerics with "
+          "clean rows bitwise-identical; dead peer detected within the "
+          "liveness timeout)")
     return 0
 
 
