@@ -2,7 +2,7 @@
 # ROADMAP tier-1 suite and fails if the pass count drops below the
 # recorded floor (tools/check_tier1.py — the floor lives there).
 
-.PHONY: verify test bench serve-smoke chaos-smoke install-hooks
+.PHONY: verify test bench serve-smoke prefix-smoke chaos-smoke install-hooks
 
 verify:
 	python tools/check_tier1.py
@@ -21,6 +21,14 @@ bench:
 # hit rate + all-ok (tools/serve_smoke.py).
 serve-smoke:
 	JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
+# Prefix-cache smoke: serve the shared-prefix workload (variations of 5
+# long bases) on the fake backend with the cross-request radix prefix
+# cache ON vs OFF — assert nonzero prefill-tokens-avoided on the warm
+# pass, per-request payloads bitwise-identical to the unpaged path, and
+# page refcounts sane after drain (tools/prefix_smoke.py).
+prefix-smoke:
+	JAX_PLATFORMS=cpu python tools/prefix_smoke.py
 
 # Chaos smoke: seeded fault schedule on the fake backend — a sweep under
 # injected device errors + a mid-sweep kill + a torn manifest tail must

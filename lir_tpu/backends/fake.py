@@ -25,6 +25,14 @@ class FakeTokenizer:
 
     Ids are stable across runs/processes (md5, not Python hash). ' Yes' and
     ' No' map to dedicated reserved ids so yes/no readout tests are exact.
+
+    ``vocab`` MUST cover the model config it is paired with
+    (``vocab <= cfg.vocab_size``): an out-of-vocab id reads an
+    out-of-range embedding row, whose NaN readouts the numerics guard
+    quarantines as error:numerics (the historical
+    __graft_entry__.dryrun_multichip harness bug — default 1000 vs the
+    tiny flagship's 512). Pass ``vocab=cfg.vocab_size`` whenever the
+    model's vocab is smaller than the default.
     """
 
     VOCAB = 1000
@@ -33,6 +41,12 @@ class FakeTokenizer:
 
     pad_token_id = PAD
     eos_token_id = PAD
+
+    def __init__(self, vocab: int = VOCAB):
+        if vocab <= self._RESERVED:
+            raise ValueError(f"FakeTokenizer vocab {vocab} leaves no room "
+                             f"past the {self._RESERVED} reserved ids")
+        self.VOCAB = int(vocab)   # instance override; class default kept
 
     def _word_id(self, w: str) -> int:
         if w == "Yes":

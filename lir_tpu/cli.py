@@ -130,6 +130,13 @@ def _add_perturb(sub) -> None:
                         "decode the full budgets (stops change no "
                         "recorded value — PARITY.md; this flag exists "
                         "for measurement, not correctness)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="enable the cross-request radix prefix cache for "
+                        "the OFFLINE sweep (paged KV pool + radix tree; "
+                        "serving enables it by default): repeated grids "
+                        "on one engine resume shared prefixes from the "
+                        "page pool, bitwise-identical results")
+    _add_prefix_pool_flags(p)
     _add_guard_flags(p)
     p.add_argument("--barrier-timeout", type=float, default=None,
                    help="multihost liveness bound in seconds: a shard-"
@@ -138,6 +145,30 @@ def _add_perturb(sub) -> None:
                         "hanging forever (default 900; <= 0 restores "
                         "unbounded barriers)")
     _add_multihost_flag(p)
+
+
+def _add_prefix_pool_flags(p) -> None:
+    """Page-pool sizing knobs for the cross-request prefix cache
+    (models/paged.py + engine/prefix_tree.py), shared by perturb and
+    serve."""
+    p.add_argument("--prefix-cache-pages", type=_positive_int, default=None,
+                   help="KV page pool size in pages (default 512; each "
+                        "page holds --prefix-page-size token positions "
+                        "and costs models/paged.kv_page_bytes of HBM — "
+                        "DEPLOY.md §1g sizing arithmetic)")
+    p.add_argument("--prefix-page-size", type=_positive_int, default=None,
+                   help="token positions per KV page (default 16; also "
+                        "the radix tree's edge granularity — prefixes "
+                        "cache in full pages, tails recompute)")
+
+
+def _prefix_rt_kw(args, rt_kw: dict) -> None:
+    if getattr(args, "prefix_cache", False):
+        rt_kw["prefix_cache"] = True
+    if getattr(args, "prefix_cache_pages", None) is not None:
+        rt_kw["prefix_cache_pages"] = args.prefix_cache_pages
+    if getattr(args, "prefix_page_size", None) is not None:
+        rt_kw["prefix_page_size"] = args.prefix_page_size
 
 
 def _add_guard_flags(p) -> None:
@@ -238,6 +269,13 @@ def _add_serve(sub) -> None:
                         "unresolved request here; on boot, an existing "
                         "file is re-submitted (dedup-deduplicated "
                         "against anything already served)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable the cross-request radix prefix cache "
+                        "(serving default ON: arriving requests pay "
+                        "prefill only for their unshared suffix, results "
+                        "bitwise-identical; OFF restores PR-3 exact-"
+                        "match dedup only)")
+    _add_prefix_pool_flags(p)
     _add_guard_flags(p)
 
 
@@ -366,6 +404,7 @@ def cmd_perturb(args) -> None:
     if args.sweep_confidence_tokens is not None:
         rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
     _guard_rt_kw(args, rt_kw)
+    _prefix_rt_kw(args, rt_kw)
     if args.barrier_timeout is not None:
         rt_kw["barrier_timeout_s"] = args.barrier_timeout
     factory = engine_factory(
@@ -401,6 +440,7 @@ def cmd_serve(args) -> None:
     if args.sweep_confidence_tokens is not None:
         rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
     _guard_rt_kw(args, rt_kw)
+    _prefix_rt_kw(args, rt_kw)
     classes = dict(ServeConfig().classes)
     for spec in args.deadline or ():
         name, sep, secs = spec.partition("=")
@@ -414,7 +454,8 @@ def cmd_serve(args) -> None:
         queue_depth=args.queue_depth, classes=tuple(classes.items()),
         linger_s=args.linger_ms / 1000.0,
         cache_entries=args.cache_entries,
-        breaker_cooldown_s=args.breaker_cooldown)
+        breaker_cooldown_s=args.breaker_cooldown,
+        prefix_cache=not args.no_prefix_cache)
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
         cache_root=args.param_cache, quantize_int8=args.int8,
@@ -476,6 +517,9 @@ def cmd_serve(args) -> None:
     if args.state_checkpoint is not None and args.state_checkpoint.exists():
         args.state_checkpoint.unlink()   # clean drain: nothing pending
     log.info("serve stats: %s", json.dumps(server.stats.summary()))
+    if engine.prefix_cache is not None:
+        log.info("serve prefix cache: %s",
+                 json.dumps(engine.prefix_stats.summary()))
     log.info("serve faults: %s", json.dumps(server.faults.summary()))
     if not server.healthy:
         sys.exit(1)

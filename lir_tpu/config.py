@@ -116,6 +116,27 @@ class RuntimeConfig:
     # and bench enable it by default (--no-compile-cache opts out).
     compile_cache_dir: Optional[str] = None
 
+    # Cross-request radix prefix cache over the paged KV allocator
+    # (models/paged.py + engine/prefix_tree.py). ON: the engine keeps a
+    # device-resident pool of `prefix_cache_pages` KV pages of
+    # `prefix_page_size` token positions each, indexed by a per-bucket
+    # radix tree over tokenized prefixes; a warm dispatch gathers its
+    # rows' cached prefix pages into the exact slots the left-padded
+    # prefill would fill and recomputes only a small remainder window
+    # (across requests AND across batches — the production workload
+    # re-asks variations of ~5 legal prompts, so warm traffic prefills
+    # suffixes only). Results are bitwise-identical to the unpaged path
+    # (pinned by tests/test_prefix_cache.py). Pool HBM = pages x
+    # models/paged.kv_page_bytes (512 pages x 16 tokens covers the 5
+    # legal prompts at ~700 tokens several times over; DEPLOY.md §1g).
+    # Offline sweeps default OFF (the ragged scheduler's prefix groups
+    # already share within a plan; opt in for repeated grids on one
+    # engine via --prefix-cache); serving defaults ON
+    # (ServeConfig.prefix_cache).
+    prefix_cache: bool = False
+    prefix_cache_pages: int = 512
+    prefix_page_size: int = 16
+
     # Guard layer (lir_tpu/guard): silent-failure detection.
     # Dispatch watchdog — every device dispatch runs on a watched
     # executor whose deadline is floor + multiple * predicted seconds,
@@ -239,6 +260,14 @@ class ServeConfig:
     """
 
     queue_depth: int = 256
+    # Cross-request radix prefix cache (engine/prefix_tree.py over
+    # models/paged.py): ON by default for serving — an arriving request
+    # whose tokenized prefix is already resident pays prefill only for
+    # its unshared suffix, across requests and across batches. The pool
+    # is sized by RuntimeConfig.prefix_cache_pages; results stay
+    # bitwise-identical to the unpaged path. OFF restores the PR-3
+    # behavior (exact-match dedup only).
+    prefix_cache: bool = True
     classes: Tuple[Tuple[str, float], ...] = (
         ("interactive", 10.0), ("batch", 300.0))
     default_class: str = "batch"

@@ -49,16 +49,22 @@ TOPK = 20  # the D6 top-20 logprob map — fixed across every sweep caller
 class ShapeSpec:
     """Everything that selects one compiled executable, shape-wise.
 
-    ``kind`` is "shared" (decode_fused_shared) or "grouped"
-    (decode_fused_grouped). ``batch`` is the PADDED member-row count the
-    runner will dispatch (shared: the padded batch; grouped: m_pad);
-    ``groups`` the padded prefill-row count (grouped only, else 0).
-    ``sfx_a``/``sfx_b`` are the right-pad suffix bucket edges (grouped
-    uses a single merged edge in ``sfx_a``). ``stops_armed`` records
-    whether the stop-mask arguments are arrays or None — that changes the
-    traced pytree, hence the executable. ``scratch`` selects the
-    donated-KV-cache variant (every dispatch after the first of a bucket
-    queue donates the previous cache — runner._CacheHandoff)."""
+    ``kind`` is "shared" (decode_fused_shared), "grouped"
+    (decode_fused_grouped), or their prefix-cache-resume variants
+    "shared_paged"/"grouped_paged" (generate.*_paged — the block-table
+    executables, selected additionally by ``window``, the remainder-
+    window edge each row recomputes while the rest of its prefix
+    gathers from the page pool). ``batch`` is the PADDED member-row
+    count the runner will dispatch (shared: the padded batch; grouped:
+    m_pad); ``groups`` the padded prefill-row count (grouped only, else
+    0). ``sfx_a``/``sfx_b`` are the right-pad suffix bucket edges
+    (grouped uses a single merged edge in ``sfx_a``). ``stops_armed``
+    records whether the stop-mask arguments are arrays or None — that
+    changes the traced pytree, hence the executable. ``scratch``
+    selects the donated-KV-cache variant (every dispatch after the
+    first of a bucket queue donates the previous cache —
+    runner._CacheHandoff; paged and unpaged variants of one shape
+    return the same cache aval, so the chain crosses them freely)."""
 
     kind: str
     bucket: int
@@ -70,14 +76,16 @@ class ShapeSpec:
     conf_tokens: int
     stops_armed: bool
     scratch: bool
+    window: int = 0
 
     @property
     def label(self) -> str:
-        sfx = (f"{self.sfx_a}+{self.sfx_b}" if self.kind == "shared"
+        sfx = (f"{self.sfx_a}+{self.sfx_b}" if self.kind.startswith("shared")
                else str(self.sfx_a))
         var = "donated" if self.scratch else "fresh"
+        win = f"/win{self.window}" if self.window else ""
         return (f"{self.kind}/b{self.bucket}x{self.batch}/sfx{sfx}"
-                f"/new{self.new_tokens}-{self.conf_tokens}/{var}")
+                f"/new{self.new_tokens}-{self.conf_tokens}{win}/{var}")
 
 
 def shared_spec(bucket: int, batch: int, sfx_a: int, sfx_b: int,
@@ -96,35 +104,78 @@ def grouped_spec(bucket: int, groups: int, batch: int, sfx: int,
                      bool(scratch))
 
 
+def shared_paged_spec(bucket: int, batch: int, window: int, sfx_a: int,
+                      sfx_b: int, new_tokens: int, conf_tokens: int,
+                      stops_armed: bool, scratch: bool) -> ShapeSpec:
+    return ShapeSpec("shared_paged", int(bucket), int(batch), 0,
+                     int(sfx_a), int(sfx_b), int(new_tokens),
+                     int(conf_tokens), bool(stops_armed), bool(scratch),
+                     int(window))
+
+
+def grouped_paged_spec(bucket: int, groups: int, batch: int, window: int,
+                       sfx: int, max_new: int, stops_armed: bool,
+                       scratch: bool) -> ShapeSpec:
+    return ShapeSpec("grouped_paged", int(bucket), int(batch), int(groups),
+                     int(sfx), 0, int(max_new), 0, bool(stops_armed),
+                     bool(scratch), int(window))
+
+
 def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
-               conf_tokens: int, stops_armed: bool) -> List[ShapeSpec]:
+               conf_tokens: int, stops_armed: bool,
+               prefix_page_size: int = 0) -> List[ShapeSpec]:
     """Distinct executables a dispatch plan will call, in first-use order
     (the precompile pool works the list front-to-back, so the first
     bucket's executable compiles first and the dispatch loop rarely
     waits). Mirrors the runner's padding/handoff behavior exactly:
     the first dispatch of each handoff key runs the scratchless variant,
-    every consecutive same-key dispatch the donated one."""
+    every consecutive same-key dispatch the donated one.
+
+    ``prefix_page_size`` > 0 (an engine whose cross-request prefix cache
+    is enabled) additionally plans the block-table executables: for each
+    dispatch shape, one paged variant per remainder-window edge the
+    runner may pick (models/paged.window_edges) — which window a warm
+    dispatch runs depends on what the radix tree holds at dispatch
+    time, so the plan covers them all."""
+    from ..models import paged as paged_mod
+
     specs: List[ShapeSpec] = []
     seen = set()
     prev_key: Optional[Tuple] = None
+
+    def add(spec: ShapeSpec) -> None:
+        if spec not in seen:
+            seen.add(spec)
+            specs.append(spec)
+
     for d in dispatches:
         g_pad, m_pad = d.padded_rows(batch_size)
         if d.kind == "shared":
             key = ("shared", d.bucket, m_pad, d.sfx_bucket_a,
                    d.sfx_bucket_b, new_tokens, conf_tokens)
-            spec = shared_spec(d.bucket, m_pad, d.sfx_bucket_a,
-                               d.sfx_bucket_b, new_tokens, conf_tokens,
-                               stops_armed, scratch=(key == prev_key))
+            scratch = key == prev_key
+            add(shared_spec(d.bucket, m_pad, d.sfx_bucket_a,
+                            d.sfx_bucket_b, new_tokens, conf_tokens,
+                            stops_armed, scratch=scratch))
+            if prefix_page_size:
+                for w in paged_mod.window_edges(d.bucket, prefix_page_size):
+                    add(shared_paged_spec(
+                        d.bucket, m_pad, w, d.sfx_bucket_a, d.sfx_bucket_b,
+                        new_tokens, conf_tokens, stops_armed,
+                        scratch=scratch))
         else:
             sfx = max(d.sfx_bucket_a, d.sfx_bucket_b)
             max_new = max(new_tokens, conf_tokens)
             key = ("grouped", d.bucket, g_pad, m_pad, sfx, max_new)
-            spec = grouped_spec(d.bucket, g_pad, m_pad, sfx, max_new,
-                                stops_armed, scratch=(key == prev_key))
+            scratch = key == prev_key
+            add(grouped_spec(d.bucket, g_pad, m_pad, sfx, max_new,
+                             stops_armed, scratch=scratch))
+            if prefix_page_size:
+                for w in paged_mod.window_edges(d.bucket, prefix_page_size):
+                    add(grouped_paged_spec(
+                        d.bucket, g_pad, m_pad, w, sfx, max_new,
+                        stops_armed, scratch=scratch))
         prev_key = key
-        if spec not in seen:
-            seen.add(spec)
-            specs.append(spec)
     return specs
 
 
@@ -184,6 +235,71 @@ def _avals_grouped(engine, spec: ShapeSpec):
     return args, kwargs, statics
 
 
+def _pool_avals(engine):
+    """ShapeDtypeStruct tree of the engine's page-pool leaves (the paged
+    executables bind the pool as an ordinary pytree argument)."""
+    import jax
+
+    pool = engine.prefix_cache.pool
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype),
+        pool.leaves)
+
+
+def _avals_shared_paged(engine, spec: ShapeSpec):
+    """Avals for runner.decode_fused_shared's PAGED call into
+    generate.greedy_decode_fused_shared_paged (prefix-cache resume):
+    (params, pool, slot_src, win_start, prefix_mask, rem, rem_mask,
+    sfx..x4, yes, no, digit_ids, digit_vals)."""
+    import jax
+    import jax.numpy as jnp
+
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+    B, W = spec.batch, spec.window
+    digit_ids, digit_vals = engine.digit_table
+    args = (engine.params, _pool_avals(engine),
+            i32(B, spec.bucket), i32(), i32(B, spec.bucket),
+            i32(B, W), i32(B, W),
+            i32(B, spec.sfx_a), i32(B, spec.sfx_a),
+            i32(B, spec.sfx_b), i32(B, spec.sfx_b),
+            i32(B), i32(B), i32(len(digit_ids)), f32(len(digit_vals)))
+    V = engine.cfg.vocab_size
+    kwargs = dict(
+        stop_mask_a=(i32(V) if spec.stops_armed else None),
+        stop_mask_b=(i32(V) if spec.stops_armed else None),
+        eos_id=(i32() if spec.stops_armed else None),
+    )
+    statics = dict(max_new_a=spec.new_tokens, max_new_b=spec.conf_tokens,
+                   topk=TOPK, return_cache=True)
+    return args, kwargs, statics
+
+
+def _avals_grouped_paged(engine, spec: ShapeSpec):
+    import jax
+    import jax.numpy as jnp
+
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+    G, M, W = spec.groups, spec.batch, spec.window
+    digit_ids, digit_vals = engine.digit_table
+    args = (engine.params, _pool_avals(engine),
+            i32(G, spec.bucket), i32(), i32(G, spec.bucket),
+            i32(G, W), i32(G, W),
+            i32(M, spec.sfx_a), i32(M, spec.sfx_a), i32(M),
+            i32(M), i32(M), i32(len(digit_ids)), f32(len(digit_vals)))
+    V = engine.cfg.vocab_size
+    armed = spec.stops_armed
+    kwargs = dict(
+        stop_mask=(i32(V) if armed else None),
+        stop_mask2=(i32(V) if armed else None),
+        stop_sel=(jax.ShapeDtypeStruct((M,), jnp.bool_) if armed else None),
+        eos_id=(i32() if armed else None),
+    )
+    statics = dict(max_new=spec.new_tokens, topk=TOPK, return_cache=True)
+    return args, kwargs, statics
+
+
 def _lower_compile(engine, spec: ShapeSpec):
     """Lower + compile one spec; returns the jax Compiled executable.
 
@@ -195,6 +311,12 @@ def _lower_compile(engine, spec: ShapeSpec):
     if spec.kind == "shared":
         fn = generate.greedy_decode_fused_shared
         args, kwargs, statics = _avals_shared(engine, spec)
+    elif spec.kind == "shared_paged":
+        fn = generate.greedy_decode_fused_shared_paged
+        args, kwargs, statics = _avals_shared_paged(engine, spec)
+    elif spec.kind == "grouped_paged":
+        fn = generate.greedy_decode_fused_grouped_paged
+        args, kwargs, statics = _avals_grouped_paged(engine, spec)
     else:
         fn = generate.greedy_decode_fused_grouped
         args, kwargs, statics = _avals_grouped(engine, spec)
@@ -399,6 +521,12 @@ def sweep_specs_for_ladder(engine, sfx_buckets: Sequence[int] = (8, 16),
                    else min(rt.sweep_confidence_tokens, rt.max_new_tokens))
     stops_armed = (rt.sweep_early_stop and not rt.sweep_full_completions
                    and engine.digit_stop_mask is not None)
+    windows = ()
+    if getattr(engine, "prefix_cache", None) is not None:
+        from ..models import paged as paged_mod
+
+        windows = lambda b: paged_mod.window_edges(  # noqa: E731
+            b, engine.prefix_cache.page_size)
     specs = []
     for bucket in engine.buckets:
         for sfx in sfx_buckets:
@@ -408,6 +536,14 @@ def sweep_specs_for_ladder(engine, sfx_buckets: Sequence[int] = (8, 16),
                     specs.append(shared_spec(
                         bucket, batch, sfx, sfx, new_tokens,
                         conf_tokens, stops_armed, scratch))
+                    if windows:
+                        # Block-table variants: one per remainder-window
+                        # edge, so a warm serve dispatch resuming from
+                        # the radix cache never pays a trace either.
+                        for w in windows(bucket):
+                            specs.append(shared_paged_spec(
+                                bucket, batch, w, sfx, sfx, new_tokens,
+                                conf_tokens, stops_armed, scratch))
     return specs
 
 

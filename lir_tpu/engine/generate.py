@@ -239,8 +239,9 @@ def greedy_decode_fused_grouped(params, cfg: ModelConfig, prefix: jax.Array,
     whose tokenized prompts agree on a long prefix (all the sweep formats x
     rephrasings of one base prompt, when the rephrasings preserve the
     opening tokens), prefills each distinct prefix once as a (G, S)
-    LEFT-padded batch, and ``group_idx`` (M,) maps each member row to its
-    prefix. The member suffixes (M, S2) RIGHT-padded then run one chunked
+    RIGHT-padded batch (the canonical slot == position layout — see
+    greedy_decode_fused_shared), and ``group_idx`` (M,) maps each member
+    row to its prefix. The member suffixes (M, S2) RIGHT-padded then run one chunked
     teacher-forced extension over the row-gathered cache, followed by the
     fused scan. Prefill FLOPs drop by the group fan-out M/G; the gathered
     M-row cache is the same size the ungrouped path allocates.
@@ -285,6 +286,177 @@ def greedy_decode_fused_grouped(params, cfg: ModelConfig, prefix: jax.Array,
     return out
 
 
+def _paged_prefix(params, cfg: ModelConfig, pool, slot_src: jax.Array,
+                  win_start: jax.Array, prefix_mask: jax.Array,
+                  rem: jax.Array, rem_mask: jax.Array, total_len: int):
+    """The paged replacement for the shared-prefill step, EXACT-LAYOUT:
+    assemble the cached prefix KV from the page pool (models/paged.
+    gather_slots over ``slot_src`` (B, S)) and teacher-force the
+    recompute WINDOW — slots [w0, w0 + R), each row's prefix tokens in
+    that range RIGHT-padded into ``rem``/``rem_mask`` (B, R) — via one
+    chunked extension over the S-slot cache view (decoder.extend at
+    start_index = ``win_start``, a TRACED scalar: the window is anchored
+    at the dispatch's longest real row, not the bucket edge, so rows
+    shorter than the bucket never pay recompute FLOPs for pad slots —
+    and the anchor varies per dispatch without retracing). A dispatch
+    then pays prefill FLOPs for R tokens per row instead of the whole
+    bucket.
+
+    The layout discipline is what buys bitwise parity with the unpaged
+    path (pinned by tests/test_prefix_cache.py):
+
+    - the shared-prefix paths RIGHT-pad their prefixes (slot == token
+      position, runner.decode_fused_shared), so a token's slot — and
+      hence the reduction layout that computes its KV — is independent
+      of its row's length: pages produced under any row back any later
+      row sharing the prefix bitwise;
+    - the window extension runs over an S-slot cache view — the exact
+      attention extent the prefill's quadratic pass reduces over — and
+      only afterwards is the cache padded out to ``total_len`` with
+      zeros, exactly as prefill pads;
+    - unfilled slots (a short row's tail, slots a cold row has no pages
+      for) read the trash page's exact zeros; the unpaged prefill holds
+      garbage pad-token k/v there instead, but both contribute exact
+      0.0 through the masked softmax, so the difference is invisible.
+
+    ``prefix_mask`` is the standard right-pad mask (B, S) — the SAME
+    tensor the unpaged path computes. Returns the cache with
+    [0, total_len) allocated and [0, S) populated — the drop-in analogue
+    of ``prefill``'s cache output.
+    """
+    from ..models import paged as paged_mod
+
+    S = prefix_mask.shape[1]
+    cache = paged_mod.gather_slots(pool, slot_src)          # S-slot view
+    _, cache, _ = decoder.extend(params, cfg, cache, rem, rem_mask,
+                                 prefix_mask, win_start)
+
+    def pad_leaf(a):
+        pad = [(0, 0)] * a.ndim
+        pad[2] = (0, total_len - S)                         # time axis
+        return jnp.pad(a, pad)
+
+    return jax.tree.map(pad_leaf, cache)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_a", "max_new_b", "topk",
+                                    "return_cache"),
+                   donate_argnames=("scratch_cache",))
+def greedy_decode_fused_shared_paged(params, cfg: ModelConfig, pool,
+                                     slot_src: jax.Array,
+                                     win_start: jax.Array,
+                                     prefix_mask: jax.Array, rem: jax.Array,
+                                     rem_mask: jax.Array, sfx_a: jax.Array,
+                                     sfx_a_mask: jax.Array, sfx_b: jax.Array,
+                                     sfx_b_mask: jax.Array,
+                                     yes_ids: jax.Array, no_ids: jax.Array,
+                                     digit_ids: jax.Array,
+                                     digit_vals: jax.Array, max_new_a: int,
+                                     max_new_b: int, topk: int = 20,
+                                     stop_mask_b: jax.Array = None,
+                                     stop_mask_a: jax.Array = None,
+                                     eos_id: jax.Array = None,
+                                     return_cache: bool = False,
+                                     scratch_cache=None):
+    """:func:`greedy_decode_fused_shared` resuming from the cross-request
+    radix prefix cache: the quadratic prefill over each row's shared
+    binary/confidence prefix is replaced by a page-pool slot gather plus
+    one chunked extension over the per-row remainder window
+    (:func:`_paged_prefix`); the two format-suffix branches and the
+    fused scans are the unpaged path's own code at the unpaged path's
+    own shapes, which is what makes paged results BITWISE-identical to
+    the contiguous-cache path per request (pinned by
+    tests/test_prefix_cache.py). ``return_cache`` also returns the final
+    cache — callers feed it back into the pool (page insertion) and the
+    donation chain (its shape equals the unpaged path's, so cold and
+    warm dispatches share one donated buffer)."""
+    del scratch_cache  # donated scratch: memory reuse only, never read
+    B, S = prefix_mask.shape
+    S2a, S2b = sfx_a.shape[1], sfx_b.shape[1]
+    T0 = S + max(S2a + max_new_a, S2b + max_new_b)
+    cache = _paged_prefix(params, cfg, pool, slot_src, win_start,
+                          prefix_mask, rem, rem_mask, T0)
+
+    empty_ids = jnp.zeros((0,), jnp.int32)
+    empty_vals = jnp.zeros((0,), jnp.float32)
+
+    def branch(cache_in, sfx, sfx_mask, new_tokens, d_ids, d_vals,
+               stop_mask=None):
+        S2 = sfx.shape[1]
+        cm = jnp.concatenate(
+            [prefix_mask, sfx_mask,
+             jnp.zeros((B, T0 - S - S2), prefix_mask.dtype)], axis=1)
+        logits_l, cache2, pos = decoder.extend(
+            params, cfg, cache_in, sfx, sfx_mask, cm, S)
+        return _fused_tail(params, cfg, logits_l, cache2, cm, pos, S + S2,
+                           yes_ids, no_ids, d_ids, d_vals, new_tokens, topk,
+                           stop_mask=stop_mask, eos_id=eos_id)
+
+    out_a, cache_a = branch(cache, sfx_a, sfx_a_mask, max_new_a,
+                            empty_ids, empty_vals, stop_mask=stop_mask_a)
+    out_b, cache_b = branch(cache_a, sfx_b, sfx_b_mask, max_new_b,
+                            digit_ids, digit_vals, stop_mask=stop_mask_b)
+    if return_cache:
+        return out_a, out_b, cache_b
+    return out_a, out_b
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new", "topk", "return_cache"),
+                   donate_argnames=("scratch_cache",))
+def greedy_decode_fused_grouped_paged(params, cfg: ModelConfig, pool,
+                                      slot_src: jax.Array,
+                                      win_start: jax.Array,
+                                      prefix_mask: jax.Array,
+                                      rem: jax.Array, rem_mask: jax.Array,
+                                      sfx: jax.Array, sfx_mask: jax.Array,
+                                      group_idx: jax.Array,
+                                      yes_ids: jax.Array, no_ids: jax.Array,
+                                      digit_ids: jax.Array,
+                                      digit_vals: jax.Array, max_new: int,
+                                      topk: int = 20,
+                                      stop_mask: jax.Array = None,
+                                      stop_mask2: jax.Array = None,
+                                      stop_sel: jax.Array = None,
+                                      eos_id: jax.Array = None,
+                                      return_cache: bool = False,
+                                      scratch_cache=None):
+    """:func:`greedy_decode_fused_grouped` resuming group prefixes from
+    the radix prefix cache: the (G, S) group prefill becomes a page-pool
+    slot gather plus one remainder-window extension
+    (:func:`_paged_prefix` at G rows, same exact-layout discipline as
+    the shared variant), then the member-row gather
+    (models/cache.gather_rows), suffix extension, and fused scan run as
+    the unpaged grouped path's own code at its own shapes. A sweep whose
+    prefix groups recur across dispatches (one base prompt's rephrasings
+    split across bucket queues, or a re-run grid on a warm engine) then
+    prefills each group prefix ONCE, not once per dispatch."""
+    del scratch_cache  # donated scratch: memory reuse only, never read
+    G, S = prefix_mask.shape
+    M, S2 = sfx.shape
+    T0 = S + S2 + max_new
+    gcache = _paged_prefix(params, cfg, pool, slot_src, win_start,
+                           prefix_mask, rem, rem_mask, T0)
+
+    from ..models import cache as cache_mod
+
+    cache = cache_mod.gather_rows(gcache, group_idx)
+    pm = jnp.take(prefix_mask, group_idx, axis=0)              # (M, S)
+    cm = jnp.concatenate(
+        [pm, sfx_mask, jnp.zeros((M, max_new), pm.dtype)], axis=1)
+    logits_l, cache2, pos = decoder.extend(
+        params, cfg, cache, sfx, sfx_mask, cm, S)
+    out, cache_f = _fused_tail(params, cfg, logits_l, cache2, cm, pos, S + S2,
+                               yes_ids, no_ids, digit_ids, digit_vals,
+                               max_new, topk, stop_mask=stop_mask,
+                               eos_id=eos_id, stop_mask2=stop_mask2,
+                               stop_sel=stop_sel)
+    if return_cache:
+        return out, cache_f
+    return out
+
+
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_new_a", "max_new_b", "topk",
                                     "prefill_fn", "return_cache"),
@@ -306,7 +478,10 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
     The perturbation sweep scores every grid cell under two formats whose
     prompts differ only in a short trailing instruction (the rephrased legal
     text is shared — perturb_prompts.py:728-734). The reference pays two
-    full forward passes per cell; here the shared prefix (B, S) LEFT-padded
+    full forward passes per cell; here the shared prefix (B, S) RIGHT-padded
+    (slot == token position — the canonical layout that lets the
+    cross-request prefix cache reuse this prefill's KV pages bitwise
+    across rows of different lengths; pads are masked no-ops either way)
     is prefilled once, then each format's suffix (B, S2*) RIGHT-padded is
     run through a teacher-forced chunked-prefill extension
     (decoder.extend) at ~S2/S of the prefill cost, followed by the fused

@@ -1,0 +1,254 @@
+"""Radix tree over tokenized prefixes -> KV page runs (cross-request
+prefix cache).
+
+SGLang's RadixAttention observation (Zheng et al., 2023) applied to this
+engine: the production workload — millions of users scoring variations
+of the same ~5 legal prompts — re-asks prompts whose tokenized prefixes
+agree for hundreds of tokens, so the KV of a prefix computed once should
+back every later dispatch that shares it, across requests and across
+batches. The page pool (models/paged.KVPagePool) owns the device
+memory; this module owns the INDEX: which token sequence's KV lives in
+which pages, in LRU order, with hit/miss/eviction accounting
+(utils/profiling.PrefixCacheStats).
+
+Design notes:
+
+- **Page-granular edges.** Every tree edge covers exactly ``page_size``
+  consecutive token ids (one pool page of KV positions). That is a
+  radix tree specialized to fixed-length chunks: node splitting — the
+  fiddly half of a general radix tree — can never be needed, because
+  two sequences that diverge mid-page simply share all full pages
+  before the divergent one and recompute the partial page inside the
+  dispatch's remainder window.
+- **Per-bucket namespaces.** The tree is partitioned by the producing
+  dispatch's prefix-bucket edge. KV values are bitwise-reproducible
+  only across dispatches of the SAME bucket shape (the attention
+  reductions that compute them run at the bucket extent), so pages
+  produced at bucket 128 must never back a bucket-64 dispatch — the
+  partition makes the bitwise-parity guarantee hold by construction.
+  Sharing loss is small: rows sharing a tokenized prefix have
+  near-equal prefix lengths and land in the same bucket.
+- **Reference discipline.** The tree holds ONE pool reference per
+  cached page for as long as its node exists; :meth:`lookup` takes an
+  additional reference per matched page (the in-flight dispatch's pin),
+  dropped by :meth:`release` after the dispatch returns. Eviction frees
+  only leaf nodes whose page refcount is exactly the tree's own — a
+  page under an in-flight dispatch is unevictable by construction
+  (pinned by tests/test_prefix_cache.py).
+- **LRU by lookup clock.** Every lookup/insert stamps the touched path
+  with a monotonic clock; eviction removes the stalest evictable
+  leaves first, cascading into parents as they become leaves. The LRU
+  order is global across bucket namespaces (one pool, one clock).
+- **Single-threaded by contract.** Lookups, inserts, and evictions run
+  on the dispatch thread (the serve supervisor / the sweep's main
+  thread). Admission-time pricing uses :meth:`match_len`, a read-only
+  probe that takes no references and mutates nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import get_logger
+from ..utils.profiling import PrefixCacheStats
+
+log = get_logger(__name__)
+
+
+class _Node:
+    """One cached page: ``key`` is the page's token-id chunk (within the
+    parent's context), ``page`` its pool page id."""
+
+    __slots__ = ("key", "page", "children", "parent", "clock")
+
+    def __init__(self, key: Tuple[int, ...], page: int,
+                 parent: Optional["_Node"]):
+        self.key = key
+        self.page = page
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.parent = parent
+        self.clock = 0
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """Result of one pinned lookup: ``pages`` cover the first ``tokens``
+    ids of the probed sequence (tokens == len(pages) * page_size). Hand
+    back to :meth:`RadixPrefixCache.release` once the dispatch that
+    gathered these pages has returned."""
+
+    pages: Tuple[int, ...]
+    tokens: int
+
+
+class RadixPrefixCache:
+    """The radix index over one :class:`~lir_tpu.models.paged.KVPagePool`,
+    partitioned into per-bucket namespaces (module docstring)."""
+
+    def __init__(self, pool, stats: Optional[PrefixCacheStats] = None):
+        self.pool = pool
+        self.stats = stats if stats is not None else PrefixCacheStats()
+        self.page_size = pool.page_size
+        self._roots: Dict[int, _Node] = {}
+        self._clock = 0
+        self._nodes = 0
+        self.stats.gauge_pages(pool.pages_in_use, pool.n_pages - 1)
+
+    def __len__(self) -> int:
+        return self._nodes
+
+    def _root(self, bucket: int) -> _Node:
+        root = self._roots.get(int(bucket))
+        if root is None:
+            root = self._roots[int(bucket)] = _Node((), 0, None)
+        return root
+
+    # -- walking -------------------------------------------------------------
+
+    def _chunks(self, ids: Sequence[int]) -> List[Tuple[int, ...]]:
+        ps = self.page_size
+        n_full = len(ids) // ps
+        return [tuple(int(t) for t in ids[k * ps:(k + 1) * ps])
+                for k in range(n_full)]
+
+    def _walk(self, bucket: int, ids: Sequence[int],
+              touch: bool) -> List[_Node]:
+        path: List[_Node] = []
+        node = self._root(bucket)
+        for key in self._chunks(ids):
+            child = node.children.get(key)
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        if touch and path:
+            self._clock += 1
+            for n in path:
+                n.clock = self._clock
+        return path
+
+    # -- read side -----------------------------------------------------------
+
+    def match_len(self, bucket: int, ids: Sequence[int]) -> int:
+        """Cached leading tokens of ``ids`` in the ``bucket`` namespace
+        right now — the admission-time pricing probe
+        (scheduler.bucket_cost's ``cached_tokens``). Takes no
+        references; the answer is advisory (eviction between probe and
+        dispatch can only shrink it, and the dispatch re-looks up with a
+        pin)."""
+        return len(self._walk(bucket, ids, touch=False)) * self.page_size
+
+    def lookup(self, bucket: int, ids: Sequence[int],
+               record: bool = True) -> PrefixMatch:
+        """Deepest cached prefix of ``ids``, PINNED: every matched page
+        gains one pool reference so eviction cannot free it while the
+        dispatch that gathers it is in flight. Callers MUST
+        :meth:`release` the match after the dispatch returns.
+        ``record=False`` skips the hit/miss counters (batch-padding rows
+        duplicate a real row; their pins are needed, their stats are
+        noise)."""
+        path = self._walk(bucket, ids, touch=True)
+        pages = tuple(n.page for n in path)
+        self.pool.incref(pages)
+        if record:
+            self.stats.count("lookups")
+            if pages:
+                self.stats.count("hits")
+        return PrefixMatch(pages=pages, tokens=len(pages) * self.page_size)
+
+    def release(self, match: PrefixMatch) -> None:
+        """Drop a lookup's dispatch pin. The tree's own reference keeps
+        the pages cached; they merely become evictable again (a pinned
+        node can never leave the tree — :meth:`_evictable_leaves`)."""
+        self.pool.decref(match.pages)
+
+    # -- write side ----------------------------------------------------------
+
+    def plan_insert(self, bucket: int,
+                    ids: Sequence[int]) -> Tuple[int, List[int]]:
+        """Allocate tree nodes + pool pages for every full-page chunk of
+        ``ids`` not yet cached under ``bucket``. Returns (first uncached
+        token index, page ids in chunk order) — the caller scatters the
+        dispatch's freshly-computed KV into those pages
+        (models/paged.scatter_pages via KVPagePool.scatter) and the
+        pages are live for the NEXT lookup immediately (the scatter is
+        ordered before any later gather on the host side).
+
+        Allocation failure mid-run (pool exhausted, everything else
+        pinned) stops the insert early: the tree caches a shorter
+        prefix, never a torn one — a radix path is valid by
+        construction since nodes are added parent-first."""
+        chunks = self._chunks(ids)
+        path = self._walk(bucket, ids, touch=True)
+        node = path[-1] if path else self._root(bucket)
+        start = len(path)
+        new_pages: List[int] = []
+        self._clock += 1
+        for key in chunks[start:]:
+            page = self._alloc_with_evict()
+            if page is None:
+                break
+            child = _Node(key, page, node)
+            child.clock = self._clock
+            node.children[key] = child
+            self.pool.incref((page,))          # the tree's own reference
+            self._nodes += 1
+            new_pages.append(page)
+            node = child
+        if new_pages:
+            self.stats.count("inserted_pages", len(new_pages))
+        self.stats.gauge_pages(self.pool.pages_in_use,
+                               self.pool.n_pages - 1)
+        return start * self.page_size, new_pages
+
+    def _alloc_with_evict(self) -> Optional[int]:
+        page = self.pool.alloc()
+        if page is None and self.evict(1):
+            page = self.pool.alloc()
+        return page
+
+    # -- eviction ------------------------------------------------------------
+
+    def _evictable_leaves(self) -> List[_Node]:
+        """Leaf nodes (across every bucket namespace) whose page holds
+        exactly ONE reference (the tree's): no children depend on them
+        and no dispatch has them pinned."""
+        out: List[_Node] = []
+        stack = [n for root in self._roots.values()
+                 for n in root.children.values()]
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            elif self.pool.refcount[n.page] == 1:
+                out.append(n)
+        return out
+
+    def evict(self, n_pages: int) -> int:
+        """Free >= ``n_pages`` pool pages by removing the least-recently
+        -used evictable leaves, cascading into parents as they become
+        leaves. Returns how many pages were actually freed (less than
+        asked when everything else is pinned or interior)."""
+        freed = 0
+        candidates = sorted(self._evictable_leaves(), key=lambda n: n.clock)
+        while freed < n_pages and candidates:
+            node = candidates.pop(0)
+            parent = node.parent
+            del parent.children[node.key]
+            self._nodes -= 1
+            self.pool.decref((node.page,))
+            freed += 1
+            # The parent may have just become an evictable leaf that is
+            # staler than remaining candidates — keep LRU order exact.
+            # (Namespace roots carry key == () and are never evicted.)
+            if (parent is not None and parent.key != ()
+                    and not parent.children
+                    and self.pool.refcount[parent.page] == 1):
+                candidates.append(parent)
+                candidates.sort(key=lambda n: n.clock)
+        if freed:
+            self.stats.count("evicted_pages", freed)
+            self.stats.gauge_pages(self.pool.pages_in_use,
+                                   self.pool.n_pages - 1)
+        return freed

@@ -24,8 +24,10 @@ import numpy as np
 
 from ..config import RuntimeConfig
 from ..guard.watchdog import DispatchWatchdog
-from ..utils.profiling import CompileStats, FaultStats, GuardStats
-from . import compile_plan, generate, score, tokens as tok
+from ..models import decoder, paged
+from ..utils.profiling import (CompileStats, FaultStats, GuardStats,
+                               PrefixCacheStats)
+from . import compile_plan, generate, prefix_tree, score, tokens as tok
 
 
 def _tail_batch(n: int, cap: int) -> int:
@@ -36,36 +38,35 @@ def _tail_batch(n: int, cap: int) -> int:
     return min(b, cap)
 
 
-class _CacheHandoff:
-    """Cross-dispatch KV-cache buffer reuse via donation.
+# Cross-dispatch donation chain for the dense dispatch caches. The class
+# moved to models/paged.py so all three KV ownership schemes — the page
+# pool, the radix index, and the dispatch-scratch donation chain — live
+# under the one allocator module; this alias keeps the historical name.
+_CacheHandoff = paged.CacheHandoff
 
-    The fused decode entry points can return their final cache and accept
-    the previous dispatch's cache as a DONATED scratch argument
-    (generate: ``return_cache``/``scratch_cache``); XLA then writes the
-    new dispatch's cache into the donated buffer, so one HBM block serves
-    every same-shape dispatch of a bucket queue instead of an alloc/free
-    per dispatch. A key change drops the old buffer (freed once its last
-    dispatch completes) and the next shape bootstraps fresh. ``take()``
-    removes the cache BEFORE the call so a dispatch that raises (OOM
-    fallback) can never re-donate a consumed buffer.
 
-    ``key`` must determine every cache-shape input (kind, bucket, batch,
-    suffix buckets, decode budget) — the scheduler plans those per bucket
-    precisely so consecutive dispatches share a key.
-    """
+@dataclasses.dataclass
+class _PrefixPlan:
+    """One dispatch's radix-cache resume decision (engine-internal).
 
-    def __init__(self) -> None:
-        self._key = None
-        self._cache = None
+    ``window`` is the remainder-window edge the paged executable will
+    run (each row recomputes its last ``window`` real prefix tokens and
+    gathers everything earlier from the page pool), or None when nothing
+    useful is cached — the dispatch then runs the plain unpaged prefill
+    (whose executable already exists) and only INSERTS pages afterward.
+    ``matches`` hold the dispatch's page pins; every plan MUST pass
+    through ScoringEngine._finish_prefix_resume, which inserts the new
+    pages and releases the pins."""
 
-    def take(self, key: Tuple):
-        cache, k = self._cache, self._key
-        self._cache = self._key = None
-        return cache if k == key else None
-
-    def put(self, key: Tuple, cache) -> None:
-        self._key = key
-        self._cache = cache
+    bucket: int
+    prefix_ids: List[Sequence[int]]
+    matches: List[Any]
+    n_real: int
+    window: Optional[int] = None
+    w0: int = 0
+    slot_src: Optional[np.ndarray] = None
+    rem: Optional[np.ndarray] = None
+    rem_mask: Optional[np.ndarray] = None
 
 
 @dataclasses.dataclass
@@ -177,6 +178,17 @@ class ScoringEngine:
         # sweep.run_perturbation_sweep, read by bench.py.
         self._handoff = _CacheHandoff()
         self.occupancy = None
+        # Cross-request radix prefix cache (engine/prefix_tree.py) over
+        # the paged KV allocator (models/paged.py): a dispatch resumes
+        # each row's prefix from the deepest cached radix node and pays
+        # prefill only for the unshared remainder — across requests,
+        # batches, and sweeps. Built by enable_prefix_cache() (the serve
+        # layer turns it on by default, ServeConfig.prefix_cache;
+        # offline sweeps opt in via RuntimeConfig.prefix_cache).
+        self.prefix_cache: Optional[prefix_tree.RadixPrefixCache] = None
+        self.prefix_stats = PrefixCacheStats()
+        if self.rt.prefix_cache:
+            self.enable_prefix_cache()
         # Compile plan (engine/compile_plan.py): the sweep precompiles its
         # planned shapes into this registry; the decode entry points below
         # consult it and fall back to lazy jit on any miss. Stats record
@@ -207,6 +219,152 @@ class ScoringEngine:
         same two executables a warmup over the same shapes compiles, so
         steady-state timing never hits a fresh compile mid-stream."""
         self._handoff = _CacheHandoff()
+
+    def enable_prefix_cache(self) -> None:
+        """Build the paged KV pool + radix index (idempotent). The pool
+        leaves materialize immediately at their full configured size
+        (rt.prefix_cache_pages x rt.prefix_page_size token positions,
+        models/paged.kv_page_bytes each) so serving never allocates HBM
+        mid-traffic; disable by sizing prefix_cache_pages < 2.
+        Sequence-parallel engines keep the unpaged path (the paged
+        window extension is a dense chunked prefill — resharding it
+        through ring/Ulysses attention is not worth R tokens)."""
+        if (self.prefix_cache is not None or self.encoder_decoder
+                or self._prefill_fn is not None):
+            return
+        if self.rt.prefix_cache_pages < 2:
+            return
+        pool = paged.KVPagePool(self.rt.prefix_cache_pages,
+                                self.rt.prefix_page_size)
+        pool.ensure(self._cache_aval())
+        self.prefix_cache = prefix_tree.RadixPrefixCache(
+            pool, stats=self.prefix_stats)
+
+    def _cache_aval(self):
+        """ShapeDtypeStruct tree of this engine's decode cache (leaf
+        structure + dtypes — bf16 vs int8 payload+scale — exactly as
+        prefill produces them), the authoritative template the page
+        pool materializes from. Tracing only; no device work."""
+        tok_aval = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+        _, cache, _ = jax.eval_shape(
+            lambda p, t, m: decoder.prefill(p, self.cfg, t, m, 8),
+            self.params, tok_aval, tok_aval)
+        return cache
+
+    # -- cross-request prefix resume (engine/prefix_tree over models/paged) --
+
+    def _plan_prefix_resume(self, bucket: int,
+                            prefix_ids: List[Sequence[int]],
+                            n_real: int) -> "_PrefixPlan":
+        """Pin the deepest cached prefix of every row and decide the
+        dispatch's remainder window (the exact-layout scheme —
+        generate._paged_prefix): window = the smallest planned edge
+        covering every row's uncached tail, anchored at the dispatch's
+        longest real row; rows recompute the window's slice of their
+        prefix and gather the rest from the pool at the very slots the
+        right-padded prefill would use, so results stay bitwise
+        identical to the unpaged path. No coverable window means the
+        cache holds nothing useful — the plan degrades to the unpaged
+        prefill (still inserting pages afterward, which is how the
+        cache warms up in the first place)."""
+        tree = self.prefix_cache
+        ps = tree.page_size
+        matches = [tree.lookup(bucket, ids, record=(r < n_real))
+                   for r, ids in enumerate(prefix_ids)]
+        plan = _PrefixPlan(bucket=bucket, prefix_ids=list(prefix_ids),
+                           matches=matches, n_real=n_real)
+        for r in range(n_real):
+            self.prefix_stats.count("prefill_tokens_total",
+                                    len(prefix_ids[r]))
+        # The canonical layout is RIGHT-padded (slot = token position),
+        # so the recompute window is anchored at the dispatch's LONGEST
+        # REAL ROW: slots [w0, w0 + window) with w0 = max_n - window (a
+        # traced scalar into the paged executable, so the anchor moves
+        # per dispatch without retracing). Every row's uncached tail
+        # must start at or after w0 — the window covers the WORST row
+        # (a fully-paged row needs none) — and anchoring at max_n
+        # instead of the bucket edge means rows shorter than the bucket
+        # never recompute pad slots.
+        max_n = max(len(ids) for ids in prefix_ids)
+        needed = max(max((max_n - m.tokens
+                          for ids, m in zip(prefix_ids, matches)
+                          if m.tokens < len(ids)), default=1), 1)
+        window = paged.pick_window(needed, bucket, ps)
+        if window is None:
+            return plan                      # cold: unpaged prefill
+        w0 = max(max_n - window, 0)
+        B = len(prefix_ids)
+        slot_src = np.zeros((B, bucket), np.int32)
+        rem_ids = []
+        for r, (ids, m) in enumerate(zip(prefix_ids, matches)):
+            n = len(ids)
+            keep = min(m.tokens, w0, n)      # tokens resumed from pages
+            for t in range(keep):
+                page = m.pages[t // ps]
+                slot_src[r, t] = page * ps + t % ps
+            rem_ids.append(list(ids[w0:]))   # recompute [w0, n)
+            if r < n_real:
+                self.prefix_stats.count("hit_tokens", keep)
+        rem, rem_mask = tok.right_pad_ids(rem_ids, window,
+                                          tok.pad_token_id(self.tokenizer))
+        plan.window = window
+        plan.w0 = w0
+        plan.slot_src = slot_src
+        plan.rem = rem
+        plan.rem_mask = rem_mask
+        return plan
+
+    def _finish_prefix_resume(self, plan: "_PrefixPlan", cache,
+                              row_map: Optional[Sequence[int]] = None
+                              ) -> None:
+        """Insert every full, not-yet-cached prefix page of the dispatch
+        into the pool from the FINAL cache (prefix slots survive both
+        suffix branches untouched), then drop the dispatch's page pins.
+        ``row_map`` maps plan rows to cache rows (the grouped path's
+        final cache holds member rows; any member of a group carries the
+        group's prefix slots). Newly inserted pages are pinned until the
+        scatter lands so a tight pool can never evict-and-reallocate a
+        page between its tree insert and its data write."""
+        tree = self.prefix_cache
+        ps = tree.page_size
+        writes = []
+        fresh: List[int] = []
+        for r, ids in enumerate(plan.prefix_ids):
+            start, new_pages = tree.plan_insert(plan.bucket, ids)
+            if not new_pages:
+                continue
+            tree.pool.incref(new_pages)
+            fresh.extend(new_pages)
+            crow = r if row_map is None else row_map[r]
+            # Canonical right-padded layout: slot == token position, so
+            # page k's data sits at cache slots [start + k*ps, ...).
+            for j, pg in enumerate(new_pages):
+                writes.append((pg, crow, start + j * ps))
+        tree.pool.scatter(cache, writes)
+        tree.pool.decref(fresh)
+        for m in plan.matches:
+            tree.release(m)
+
+    def _abort_prefix_resume(self, plan: "_PrefixPlan") -> None:
+        """Dispatch failed: drop the plan's page pins without inserting
+        (there is no final cache to read pages from)."""
+        for m in plan.matches:
+            self.prefix_cache.release(m)
+
+    def _prefix_plan_or_none(self, bucket: int,
+                             prefix_ids: List[Sequence[int]],
+                             n_real: Optional[int], total: int,
+                             use_prefix_cache: Optional[bool]
+                             ) -> Optional["_PrefixPlan"]:
+        """Gate + build the prefix plan for one dispatch. None when the
+        cache is absent or the caller opted out (``use_prefix_cache``
+        False; None means 'use it iff enabled on this engine')."""
+        on = (use_prefix_cache if use_prefix_cache is not None
+              else self.prefix_cache is not None)
+        if not on or self.prefix_cache is None:
+            return None
+        return self._plan_prefix_resume(
+            bucket, prefix_ids, total if n_real is None else n_real)
 
     def degrade_to_lazy(self) -> None:
         """Degradation-ladder step one (lir_tpu/faults): drop the AOT
@@ -357,7 +515,9 @@ class ScoringEngine:
                             pretokenized_b: Optional[Sequence[Sequence[int]]] = None,
                             bucket: Optional[int] = None,
                             sfx_buckets_ab: Optional[Tuple[int, int]] = None,
-                            reuse_cache: bool = False):
+                            reuse_cache: bool = False,
+                            use_prefix_cache: Optional[bool] = None,
+                            n_real: Optional[int] = None):
         """Score BOTH sweep formats with ONE shared-prefix prefill.
 
         Each grid cell's binary and confidence prompts share the long
@@ -376,6 +536,19 @@ class ScoringEngine:
         bucket queue), and ``reuse_cache=True`` to thread the KV cache
         buffer through the dispatch chain via donation (_CacheHandoff).
         The fallback guards below still apply and win over the overrides.
+
+        With the cross-request prefix cache enabled (``use_prefix_cache``
+        True, or None on an engine whose :attr:`prefix_cache` is built),
+        a ``reuse_cache`` dispatch resumes every row's shared prefix from
+        the deepest cached radix node: cached pages gather from the page
+        pool into the exact slots the left-padded prefill would fill and
+        only the per-row remainder window is recomputed
+        (generate.greedy_decode_fused_shared_paged) — results BITWISE
+        identical to the unpaged path, prefill FLOPs paid only for the
+        unshared tail. Fresh full pages insert back into the pool after
+        the dispatch, so reuse spans requests, batches, and sweeps.
+        ``n_real`` bounds the rows counted in PrefixCacheStats (callers
+        pad dispatches by repeating the last row).
         """
         assert not self.encoder_decoder
         if pretokenized_a is not None:
@@ -453,7 +626,16 @@ class ScoringEngine:
                                        pretokenized=conf_ids,
                                        early_stop=early_stop)
             return fused, cfused
-        prefix, prefix_mask = tok.left_pad_ids(
+        # Prefix rows are RIGHT-padded — the canonical slot = position
+        # layout: a token's cache slot is independent of its row's
+        # length, so KV pages produced by any dispatch back any later
+        # row sharing the prefix BITWISE (masked tail slots contribute
+        # exact zeros either way; the plain decode_fused path keeps the
+        # left-padded convention, and the shared-vs-plain comparison
+        # was never bitwise). The suffix extensions read per-row
+        # boundaries from the mask, so a gap of masked slots between a
+        # short row's prefix end and the bucket edge is a no-op.
+        prefix, prefix_mask = tok.right_pad_ids(
             [a[:n] for a, n in zip(bin_ids, lcp)], bucket, pad_id)
         sfx_a, sfx_a_mask = tok.right_pad_ids(sfx_a_ids, ba, pad_id)
         sfx_b, sfx_b_mask = tok.right_pad_ids(sfx_b_ids, bb, pad_id)
@@ -466,32 +648,80 @@ class ScoringEngine:
             eos_id=(None if stop_mask is None
                     else jnp.int32(self.eos_id)))
         if reuse_cache:
+            plan = self._prefix_plan_or_none(
+                bucket, [a[:n] for a, n in zip(bin_ids, lcp)], n_real,
+                len(bin_ids), use_prefix_cache)
+            # Paged and unpaged dispatches of one shape return the same
+            # cache aval, so they share one handoff key — the donation
+            # chain runs unbroken across cold and warm dispatches.
             key = ("shared", bucket, len(bin_ids), ba, bb, new_tokens,
                    conf_tokens, early_stop)
             scratch = self._handoff.take(key)
-            dyn_args = (self.params, jnp.asarray(prefix),
-                        jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
-                        jnp.asarray(sfx_a_mask), jnp.asarray(sfx_b),
-                        jnp.asarray(sfx_b_mask),
-                        jnp.asarray(yes_ids, jnp.int32),
-                        jnp.asarray(no_ids, jnp.int32),
-                        jnp.asarray(digit_ids), jnp.asarray(digit_vals))
-            exe = None
-            if self.exec_registry is not None:
-                exe = self.exec_registry.get(compile_plan.shared_spec(
-                    bucket, len(bin_ids), ba, bb, new_tokens, conf_tokens,
-                    stops_armed=stop_mask is not None,
-                    scratch=scratch is not None))
-            if exe is not None:
-                stop_kwargs = {k: kwargs[k] for k in
-                               ("stop_mask_a", "stop_mask_b", "eos_id")}
-                fused, cfused, cache = compile_plan.registry_call(
-                    exe, dyn_args, stop_kwargs, scratch)
-            else:
-                fused, cfused, cache = generate.greedy_decode_fused_shared(
-                    dyn_args[0], self.cfg, *dyn_args[1:],
-                    return_cache=True, scratch_cache=scratch, **kwargs)
+            stop_kwargs = {k: kwargs[k] for k in
+                           ("stop_mask_a", "stop_mask_b", "eos_id")}
+            try:
+                if plan is not None and plan.window is not None:
+                    dyn_args = (self.params, self.prefix_cache.pool.leaves,
+                                jnp.asarray(plan.slot_src),
+                                jnp.int32(plan.w0),
+                                jnp.asarray(prefix_mask),
+                                jnp.asarray(plan.rem),
+                                jnp.asarray(plan.rem_mask),
+                                jnp.asarray(sfx_a), jnp.asarray(sfx_a_mask),
+                                jnp.asarray(sfx_b), jnp.asarray(sfx_b_mask),
+                                jnp.asarray(yes_ids, jnp.int32),
+                                jnp.asarray(no_ids, jnp.int32),
+                                jnp.asarray(digit_ids),
+                                jnp.asarray(digit_vals))
+                    exe = None
+                    if self.exec_registry is not None:
+                        exe = self.exec_registry.get(
+                            compile_plan.shared_paged_spec(
+                                bucket, len(bin_ids), plan.window, ba, bb,
+                                new_tokens, conf_tokens,
+                                stops_armed=stop_mask is not None,
+                                scratch=scratch is not None))
+                    if exe is not None:
+                        fused, cfused, cache = compile_plan.registry_call(
+                            exe, dyn_args, stop_kwargs, scratch)
+                    else:
+                        fused, cfused, cache = (
+                            generate.greedy_decode_fused_shared_paged(
+                                dyn_args[0], self.cfg, *dyn_args[1:],
+                                max_new_a=new_tokens, max_new_b=conf_tokens,
+                                return_cache=True, scratch_cache=scratch,
+                                **stop_kwargs))
+                else:
+                    dyn_args = (self.params, jnp.asarray(prefix),
+                                jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
+                                jnp.asarray(sfx_a_mask), jnp.asarray(sfx_b),
+                                jnp.asarray(sfx_b_mask),
+                                jnp.asarray(yes_ids, jnp.int32),
+                                jnp.asarray(no_ids, jnp.int32),
+                                jnp.asarray(digit_ids),
+                                jnp.asarray(digit_vals))
+                    exe = None
+                    if self.exec_registry is not None:
+                        exe = self.exec_registry.get(compile_plan.shared_spec(
+                            bucket, len(bin_ids), ba, bb, new_tokens,
+                            conf_tokens, stops_armed=stop_mask is not None,
+                            scratch=scratch is not None))
+                    if exe is not None:
+                        fused, cfused, cache = compile_plan.registry_call(
+                            exe, dyn_args, stop_kwargs, scratch)
+                    else:
+                        fused, cfused, cache = (
+                            generate.greedy_decode_fused_shared(
+                                dyn_args[0], self.cfg, *dyn_args[1:],
+                                return_cache=True, scratch_cache=scratch,
+                                **kwargs))
+            except BaseException:
+                if plan is not None:
+                    self._abort_prefix_resume(plan)
+                raise
             self._handoff.put(key, cache)
+            if plan is not None:
+                self._finish_prefix_resume(plan, cache)
             return fused, cfused
         return generate.greedy_decode_fused_shared(
             self.params, self.cfg, jnp.asarray(prefix),
@@ -505,7 +735,8 @@ class ScoringEngine:
                              no_ids: np.ndarray, new_tokens: int,
                              conf_tokens: int, early_stop: bool,
                              bucket: int, sfx_bucket: int,
-                             reuse_cache: bool = False):
+                             reuse_cache: bool = False,
+                             use_prefix_cache: Optional[bool] = None):
         """Cross-cell prefix reuse: score every member prompt of
         ``groups`` (scheduler.PrefixGroup-shaped: ``.items`` with
         ``.bin_ids``/``.conf_ids``, shared ``.plen``) with ONE prefill per
@@ -546,7 +777,10 @@ class ScoringEngine:
             raise ValueError("scheduler planned a grouped dispatch past the "
                              "learned-position table")
 
-        prefix, prefix_mask = tok.left_pad_ids(prefix_ids, bucket, pad_id)
+        # RIGHT-padded group prefixes — the canonical slot = position
+        # layout (see decode_fused_shared): group prefix KV pages are
+        # then bitwise-valid for any later dispatch sharing the trunk.
+        prefix, prefix_mask = tok.right_pad_ids(prefix_ids, bucket, pad_id)
         sfx, sfx_mask = tok.right_pad_ids(sfx_ids, sfx_bucket, pad_id)
         yes2 = np.repeat(np.asarray(yes_ids, np.int32), 2)
         no2 = np.repeat(np.asarray(no_ids, np.int32), 2)
@@ -569,26 +803,75 @@ class ScoringEngine:
                 jnp.asarray(yes2), jnp.asarray(no2),
                 jnp.asarray(digit_ids), jnp.asarray(digit_vals))
         if reuse_cache:
+            # Plan rows are the PADDED prefix rows; the final cache holds
+            # member rows, and any member of a group carries the group's
+            # prefix slots — row_map points each prefix row at its
+            # group's first member row for the page extraction.
+            first_member = []
+            acc = 0
+            for g in groups:
+                first_member.append(acc)
+                acc += 2 * len(g.items)
+            first_member += [first_member[-1]] * (g_pad - len(groups))
+            plan = self._prefix_plan_or_none(
+                bucket, prefix_ids, len(groups), g_pad, use_prefix_cache)
             key = ("grouped", bucket, g_pad, m_pad, sfx_bucket,
                    kwargs["max_new"], early_stop)
             scratch = self._handoff.take(key)
-            exe = None
-            if self.exec_registry is not None:
-                exe = self.exec_registry.get(compile_plan.grouped_spec(
-                    bucket, g_pad, m_pad, sfx_bucket, kwargs["max_new"],
-                    stops_armed=stop_mask is not None,
-                    scratch=scratch is not None))
-            if exe is not None:
-                stop_kwargs = {k: kwargs[k] for k in
-                               ("stop_mask", "stop_mask2", "stop_sel",
-                                "eos_id")}
-                out, cache = compile_plan.registry_call(
-                    exe, (args[0],) + args[2:], stop_kwargs, scratch)
-            else:
-                out, cache = generate.greedy_decode_fused_grouped(
-                    *args, return_cache=True, scratch_cache=scratch,
-                    **kwargs)
+            stop_kwargs = {k: kwargs[k] for k in
+                           ("stop_mask", "stop_mask2", "stop_sel",
+                            "eos_id")}
+            try:
+                if plan is not None and plan.window is not None:
+                    dyn_args = (self.params, self.prefix_cache.pool.leaves,
+                                jnp.asarray(plan.slot_src),
+                                jnp.int32(plan.w0),
+                                jnp.asarray(prefix_mask),
+                                jnp.asarray(plan.rem),
+                                jnp.asarray(plan.rem_mask),
+                                args[4], args[5], args[6], args[7],
+                                args[8], args[9], args[10])
+                    exe = None
+                    if self.exec_registry is not None:
+                        exe = self.exec_registry.get(
+                            compile_plan.grouped_paged_spec(
+                                bucket, g_pad, m_pad, plan.window,
+                                sfx_bucket, kwargs["max_new"],
+                                stops_armed=stop_mask is not None,
+                                scratch=scratch is not None))
+                    if exe is not None:
+                        out, cache = compile_plan.registry_call(
+                            exe, dyn_args, stop_kwargs, scratch)
+                    else:
+                        out, cache = (
+                            generate.greedy_decode_fused_grouped_paged(
+                                dyn_args[0], self.cfg, *dyn_args[1:],
+                                max_new=kwargs["max_new"],
+                                return_cache=True, scratch_cache=scratch,
+                                **stop_kwargs))
+                else:
+                    exe = None
+                    if self.exec_registry is not None:
+                        exe = self.exec_registry.get(compile_plan.grouped_spec(
+                            bucket, g_pad, m_pad, sfx_bucket,
+                            kwargs["max_new"],
+                            stops_armed=stop_mask is not None,
+                            scratch=scratch is not None))
+                    if exe is not None:
+                        out, cache = compile_plan.registry_call(
+                            exe, (args[0],) + args[2:], stop_kwargs, scratch)
+                    else:
+                        out, cache = generate.greedy_decode_fused_grouped(
+                            *args, return_cache=True, scratch_cache=scratch,
+                            **kwargs)
+            except BaseException:
+                if plan is not None:
+                    self._abort_prefix_resume(plan)
+                raise
             self._handoff.put(key, cache)
+            if plan is not None:
+                self._finish_prefix_resume(plan, cache,
+                                           row_map=first_member)
         else:
             out = generate.greedy_decode_fused_grouped(*args, **kwargs)
         return out, m

@@ -59,7 +59,7 @@ def _tail_batch(n: int, cap: int) -> int:
 
 
 def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
-                decode_cost: int) -> int:
+                decode_cost: int, cached_tokens: int = 0) -> int:
     """Row-token cost of dispatching ``n_rows`` cells at ``bucket_edge``:
     a padded power-of-two batch prefilled at the edge, plus the fixed
     decode scan (``decode_cost`` tokens per slot — the steps run whether
@@ -71,8 +71,16 @@ def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
     (:meth:`RaggedScheduler._plan_shared`) and the online continuous
     batcher's bucket-selection policy (serve/batcher.py) price dispatches
     through this one helper so the two can't drift apart.
-    """
-    return _tail_batch(n_rows, batch_size) * (bucket_edge + decode_cost)
+
+    ``cached_tokens`` are prefix tokens the cross-request radix cache
+    (engine/prefix_tree.py) already holds for the candidate rows —
+    FREE prefill: a paged dispatch gathers them from the page pool
+    instead of recomputing, so they come off the prefill term. The
+    decode scan is the floor: cached prefill can never make a dispatch
+    cheaper than its decode steps."""
+    slots = _tail_batch(n_rows, batch_size)
+    prefill = max(slots * bucket_edge - int(cached_tokens), 0)
+    return prefill + slots * decode_cost
 
 
 @dataclasses.dataclass(frozen=True)
@@ -177,6 +185,12 @@ class RaggedScheduler:
         tokens AND on at least half of each member's prefill — shorter
         shared prefixes don't amortize the extra suffix-extension FLOPs.
     group_cells: 0 disables cross-cell grouping entirely.
+    cached_probe: optional ``(item, bucket_edge) -> cached tokens`` hook
+        into the cross-request radix prefix cache (engine/prefix_tree.
+        match_len). The slot-refill rule then prices cached-prefix
+        tokens as FREE prefill — and since the radix namespaces are
+        per-bucket, promoting a tail into the next bucket honestly
+        loses this bucket's cached pages, which the probe reflects.
     """
 
     def __init__(self, buckets: Sequence[int], batch_size: int, *,
@@ -185,6 +199,7 @@ class RaggedScheduler:
                  max_extent: Optional[int] = None,
                  min_group_prefix: int = 16, min_group_cells: int = 4,
                  group_cells: bool = True,
+                 cached_probe=None,
                  stats: Optional[OccupancyStats] = None):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.batch = int(batch_size)
@@ -196,7 +211,16 @@ class RaggedScheduler:
         self.min_group_prefix = int(min_group_prefix)
         self.min_group_cells = int(min_group_cells)
         self.group_cells = group_cells
+        self.cached_probe = cached_probe
         self.stats = stats if stats is not None else OccupancyStats()
+
+    def _cached_tokens(self, items: Sequence[Tuple[SweepItem, bool]],
+                       edge: int) -> int:
+        """Radix-cached prefix tokens across ``items`` at ``edge``'s
+        namespace (0 without a probe — the legacy price)."""
+        if self.cached_probe is None:
+            return 0
+        return sum(self.cached_probe(it, edge) for it, _ in items)
 
     # -- cross-cell prefix grouping -----------------------------------------
 
@@ -295,9 +319,16 @@ class RaggedScheduler:
             # power-of-two batch prefilled at this edge plus its fixed
             # decode scan. Promoting pays len(tail) rows at the next
             # edge, where they fill slots of dispatches that run anyway
-            # (and cascade upward the same way).
-            if (nxt is not None and len(q) * nxt
-                    < bucket_cost(len(q), edge, B, self.decode_cost)):
+            # (and cascade upward the same way). With a prefix-cache
+            # probe, cached tokens discount each side: a tail whose
+            # prefixes are warm in THIS bucket's radix namespace is
+            # cheap to keep and expensive to promote (the next bucket's
+            # namespace holds different pages).
+            if (nxt is not None
+                    and len(q) * nxt - self._cached_tokens(q, nxt)
+                    < bucket_cost(len(q), edge, B, self.decode_cost,
+                                  cached_tokens=self._cached_tokens(q,
+                                                                    edge))):
                 queues[nxt] = [(it, True) for it, _ in q] + queues[nxt]
             else:
                 out.append(Dispatch(

@@ -265,6 +265,9 @@ def run_perturbation_sweep(
         engine.compile_stats.finish_persistent()
         log.info("compile plan: %s",
                  json.dumps(engine.compile_stats.summary()))
+        if engine.prefix_cache is not None:
+            log.info("prefix cache: %s",
+                     json.dumps(engine.prefix_stats.summary()))
         if engine.fault_stats.recovered_dispatches:
             log.info("fault recovery: %s",
                      json.dumps(engine.fault_stats.summary()))
@@ -342,6 +345,14 @@ def _plan_ragged(engine, todo, new_tokens, conf_tokens):
     max_extent = (engine.cfg.max_seq_len
                   if getattr(engine.cfg, "pos_embedding", None) == "learned"
                   else None)
+    # Prefix-aware slot-refill pricing: with the cross-request radix
+    # cache enabled, cached-prefix tokens are free prefill and the
+    # promotion rule accounts for the per-bucket namespaces (a promoted
+    # tail abandons this bucket's cached pages).
+    cached_probe = None
+    if engine.prefix_cache is not None:
+        cached_probe = (lambda it, b: engine.prefix_cache.match_len(
+            b, it.bin_ids[:it.lcp]))
     planner = sched_mod.RaggedScheduler(
         engine.buckets, engine.rt.batch_size,
         new_budget=max(new_tokens, conf_tokens),
@@ -349,6 +360,7 @@ def _plan_ragged(engine, todo, new_tokens, conf_tokens):
         min_group_prefix=engine.rt.sweep_group_min_prefix,
         min_group_cells=engine.rt.sweep_group_min_cells,
         group_cells=engine.rt.sweep_group_min_cells > 0,
+        cached_probe=cached_probe,
         stats=stats)
     dispatches = planner.schedule(items)
     engine.occupancy = stats
@@ -423,7 +435,10 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
         engine.exec_registry = None
         if engine.rt.aot_precompile:
             specs = compile_plan.plan_specs(
-                dispatches, B, new_tokens, conf_tokens, stop_armed)
+                dispatches, B, new_tokens, conf_tokens, stop_armed,
+                prefix_page_size=(engine.prefix_cache.page_size
+                                  if engine.prefix_cache is not None
+                                  else 0))
             engine.exec_registry = compile_plan.precompile_async(
                 engine, specs, max_workers=engine.rt.precompile_workers)
             log.info("compile plan: precompiling %d executable shapes "
@@ -592,7 +607,7 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                         pretokenized_b=[it.conf_ids for it in full_items],
                         bucket=d.bucket,
                         sfx_buckets_ab=(d.sfx_bucket_a, d.sfx_bucket_b),
-                        reuse_cache=True),
+                        reuse_cache=True, n_real=n),
                     cost=sched_mod.bucket_cost(
                         n, d.bucket, B, new_tokens + conf_tokens))
                 res = score_mod.readout_from_fused(

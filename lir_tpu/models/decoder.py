@@ -487,6 +487,11 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jax.Array,
     end for every row — mirrors the reference's unpadded single-prompt calls).
     Returns (logits_last (B, V) fp32, cache, next_positions (B,)).
 
+    Masked padding is a positional no-op, so RIGHT-padded callers (the
+    shared-prefix paths' canonical slot == position layout,
+    engine/generate.py) are equally valid — they must simply ignore the
+    returned logits/next_positions, which read slot S-1 (a pad there).
+
     ``attn_impl`` routes the prompt pass through sequence-parallel attention
     (parallel/seq_forward): the quadratic phase runs seq-sharded, and the
     returned cache holds the same per-layer k/v for ordinary decode.

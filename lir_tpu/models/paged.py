@@ -1,0 +1,282 @@
+"""Paged KV allocator: one block-granular page pool under every KV path.
+
+vLLM's PagedAttention observation (Kwon et al., 2023) applied to this
+engine's cache layout: KV for a token prefix is stored in fixed-size
+PAGES of ``page_size`` positions, device-resident in one pool tensor per
+cache leaf, so a prefix computed once can back any later dispatch that
+shares it — across requests, batches, and (offline) bucket queues. The
+radix index over which token sequence owns which pages lives in
+engine/prefix_tree.py; this module is the allocator itself:
+
+- **Pool layout.** The decode cache is a pytree of (L, K, T, B, hd)
+  leaves (int8 flavor adds (L, K, T, B) scales) — models/cache.py. The
+  pool stores the same leaves with the (T, B) plane replaced by
+  (n_pages, page_size): page p holds ``page_size`` consecutive token
+  POSITIONS of one cached prefix, in canonical position space (position
+  0 = the prefix's first token), so reuse is independent of which
+  dispatch happened to produce the KV.
+- **Gather/scatter.** :func:`gather_slots` assembles a dense dispatch
+  cache from a per-(row, slot) source table — SLOT granular, so cached
+  pages land at exactly the slots the unpaged left-padded prefill would
+  have written them to (that exact-layout discipline is what makes paged
+  results BITWISE-identical to the contiguous-cache path; see
+  generate._paged_prefix). :func:`scatter_pages` extracts full pages out
+  of a dispatch's final cache into the pool, with the pool DONATED so
+  the update aliases in place — one persistent HBM block for the whole
+  session, the same donation discipline the dispatch cache chain uses.
+- **Refcounts.** Host-side per-page refcounts (never negative — pinned
+  by tests): the radix tree holds one reference per cached page, every
+  in-flight dispatch holds one more per page it gathered, and eviction
+  (LRU, driven by the tree) may only free pages whose sole reference is
+  the tree's — a page under an in-flight dispatch is unevictable by
+  construction.
+- **Handoff.** :class:`CacheHandoff` (moved here from engine/runner.py)
+  is the cross-dispatch donation chain for the dense dispatch caches —
+  the third KV ownership scheme, now co-owned by the one allocator
+  module so pool pages and dispatch scratch follow the same rules.
+
+Page 0 is reserved as a trash page: slot-table entries that carry no
+cached KV point at its (all-zero) positions — the gathered slots are
+masked, and masked attention contributions are exact zeros, the same
+exact zeros the left-padded prefill's masked pad slots contribute — and
+scatter padding writes land there too.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# Cache-leaf axis convention (models/cache.py): (L, K, T, B, hd) payloads,
+# (L, K, T, B) int8 scales — time axis 2, batch axis 3 in both flavors.
+TIME_AXIS = 2
+DEFAULT_PAGE_SIZE = 16
+
+
+def kv_page_bytes(cfg, page_size: int = DEFAULT_PAGE_SIZE,
+                  dtype_bytes: int = 2) -> int:
+    """HBM bytes of ONE pool page — the unit DEPLOY.md §1g's pool-sizing
+    arithmetic multiplies by ``n_pages``. Mirrors models/cache.
+    kv_cache_bytes at (batch=1, max_len=page_size)."""
+    per_side = cfg.n_layers * cfg.n_kv_heads * page_size
+    if getattr(cfg, "kv_cache_int8", False):
+        return 2 * (per_side * cfg.head_dim + per_side * 4)
+    return 2 * per_side * cfg.head_dim * dtype_bytes
+
+
+def window_edges(bucket: int, page_size: int = DEFAULT_PAGE_SIZE
+                 ) -> Tuple[int, ...]:
+    """Remainder-window shapes a paged dispatch at ``bucket`` may run:
+    powers of two from one page up to (exclusive) the bucket itself.
+    Every warm dispatch recomputes a ``window``-wide slice of its rows'
+    prefixes, anchored at the dispatch's LONGEST REAL ROW (the uncached
+    tails, plus however much of the cached prefix the window overlaps;
+    the anchor is a traced scalar, so it costs no extra executables),
+    and gathers everything before the window from the pool; a needed
+    window >= bucket means nothing useful is cached and the dispatch
+    runs the plain unpaged prefill instead."""
+    out = []
+    w = max(int(page_size), 8)
+    while w < bucket:
+        out.append(w)
+        w *= 2
+    return tuple(out)
+
+
+def pick_window(needed: int, bucket: int,
+                page_size: int = DEFAULT_PAGE_SIZE) -> Optional[int]:
+    """Smallest window edge covering ``needed`` recompute tokens, or None
+    when only the full-bucket (unpaged) prefill covers it."""
+    for w in window_edges(bucket, page_size):
+        if w >= needed:
+            return w
+    return None
+
+
+def _pool_leaf_shape(leaf_shape: Tuple[int, ...], n_pages: int,
+                     page_size: int) -> Tuple[int, ...]:
+    """Cache leaf (L, K, T, B[, hd]) -> pool leaf (L, K, P, ps[, hd])."""
+    return leaf_shape[:2] + (n_pages, page_size) + leaf_shape[4:]
+
+
+def gather_slots(pool: Any, slot_src) -> Any:
+    """Assemble a dense decode cache from the pool at SLOT granularity:
+    ``slot_src`` (B, S) int32 indexes the pool's flattened
+    (n_pages * page_size) position axis — entry (r, s) says which pool
+    position fills cache slot ``s`` of row ``r``. Unfilled slots point
+    at the reserved trash page 0 (exact zeros; they are masked anyway).
+    Returns (L, K, S, B[, hd]) leaves — the dense cache layout at
+    ``S`` slots. Traced inline by the paged decode entry points
+    (engine/generate.py), so XLA fuses the gather with the first
+    consumer."""
+    import jax.numpy as jnp
+
+    def leaf(p):
+        ps = p.shape[3]
+        flat = p.reshape(p.shape[:2] + (p.shape[2] * ps,) + p.shape[4:])
+        x = flat[:, :, slot_src]                    # (L, K, B, S[, hd])
+        return jnp.moveaxis(x, 2, 3)                # (L, K, S, B[, hd])
+
+    return jax.tree.map(leaf, pool)
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def scatter_pages(pool: Any, cache: Any, page_ids, rows, slot_idx) -> Any:
+    """Write full pages extracted from a dispatch's final cache into the
+    pool: page ``page_ids[j]`` receives cache slots ``slot_idx[j]`` of
+    batch row ``rows[j]``, for every leaf. The pool is DONATED so XLA
+    updates the one resident buffer in place. Padding entries (the
+    caller pads the write list to a stable power-of-two shape) all
+    target the reserved trash page 0."""
+    def leaf(p, c):
+        blocks = c[:, :, slot_idx, rows[:, None]]   # (L, K, N, ps[, hd])
+        return p.at[:, :, page_ids].set(blocks)
+
+    return jax.tree.map(leaf, pool, cache)
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class KVPagePool:
+    """Device-resident page pool + host-side free list and refcounts.
+
+    The device pytree (``leaves``) materializes lazily from the first
+    cache tree (or aval tree) it sees — that is the one place the leaf
+    structure/dtypes (bf16 vs int8 payload+scale) are authoritative, so
+    the pool can never disagree with the engine's actual cache flavor.
+    """
+
+    def __init__(self, n_pages: int, page_size: int = DEFAULT_PAGE_SIZE,
+                 stats=None):
+        if n_pages < 2:
+            raise ValueError("KVPagePool needs >= 2 pages (page 0 is the "
+                             "reserved trash page)")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.stats = stats
+        self.leaves: Optional[Any] = None
+        self.refcount = np.zeros(self.n_pages, np.int64)
+        self.refcount[0] = 1            # trash page: never allocated/freed
+        self._free: List[int] = list(range(self.n_pages - 1, 0, -1))
+
+    # -- device side ---------------------------------------------------------
+
+    def ensure(self, cache_like: Any) -> None:
+        """Materialize the pool leaves to match ``cache_like`` (a cache
+        pytree OR a ShapeDtypeStruct tree of one). Idempotent."""
+        if self.leaves is not None:
+            return
+        import jax.numpy as jnp
+
+        self.leaves = jax.tree.map(
+            lambda a: jnp.zeros(
+                _pool_leaf_shape(tuple(a.shape), self.n_pages,
+                                 self.page_size), a.dtype),
+            cache_like)
+        log.info("KV page pool materialized: %d pages x %d tokens",
+                 self.n_pages, self.page_size)
+
+    def scatter(self, cache: Any, writes: Sequence[Tuple[int, int, int]]
+                ) -> None:
+        """Apply ``writes`` = [(page_id, batch_row, start_slot), ...]:
+        page_id <- cache[:, :, start_slot : start_slot + page_size, row].
+        Pads the list to a power of two (trash-page writes) so the jitted
+        scatter keeps a small, stable set of shapes."""
+        if not writes:
+            return
+        self.ensure(cache)
+        n = _pow2(len(writes))
+        pages = np.zeros((n,), np.int32)
+        rows = np.zeros((n,), np.int32)
+        starts = np.zeros((n,), np.int32)
+        for j, (pg, row, start) in enumerate(writes):
+            pages[j], rows[j], starts[j] = pg, row, start
+        slot_idx = starts[:, None] + np.arange(self.page_size,
+                                               dtype=np.int32)[None, :]
+        import jax.numpy as jnp
+
+        self.leaves = scatter_pages(self.leaves, cache, jnp.asarray(pages),
+                                    jnp.asarray(rows), jnp.asarray(slot_idx))
+
+    # -- host-side allocator -------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.n_pages - 1 - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """One free page id, or None when exhausted (the caller evicts
+        through the radix tree and retries — the pool itself has no idea
+        which pages are coldest)."""
+        if not self._free:
+            return None
+        page = self._free.pop()
+        assert self.refcount[page] == 0, "allocated a referenced page"
+        return page
+
+    def incref(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            self.refcount[p] += 1
+
+    def decref(self, pages: Sequence[int]) -> None:
+        """Drop one reference per page; a page reaching zero returns to
+        the free list. The count can never go negative — that would mean
+        a double free, which is a bug worth crashing on."""
+        for p in pages:
+            self.refcount[p] -= 1
+            assert self.refcount[p] >= 0, f"page {p} refcount went negative"
+            if self.refcount[p] == 0:
+                self._free.append(int(p))
+
+
+class CacheHandoff:
+    """Cross-dispatch KV-cache buffer reuse via donation (the dense
+    dispatch caches, as opposed to the pool's cached-prefix pages).
+
+    The fused decode entry points can return their final cache and accept
+    the previous dispatch's cache as a DONATED scratch argument
+    (generate: ``return_cache``/``scratch_cache``); XLA then writes the
+    new dispatch's cache into the donated buffer, so one HBM block serves
+    every same-shape dispatch of a bucket queue instead of an alloc/free
+    per dispatch. A key change drops the old buffer (freed once its last
+    dispatch completes) and the next shape bootstraps fresh. ``take()``
+    removes the cache BEFORE the call so a dispatch that raises (OOM
+    fallback) can never re-donate a consumed buffer.
+
+    ``key`` must determine every cache-shape input (kind, bucket, batch,
+    suffix buckets, decode budget) — the scheduler plans those per bucket
+    precisely so consecutive dispatches share a key. Paged and unpaged
+    dispatches of one (bucket, batch) share a key ON PURPOSE: the
+    exact-layout paged path returns a cache of the identical shape, so
+    the donation chain runs unbroken across cold (unpaged) and warm
+    (paged) dispatches of a bucket queue.
+    """
+
+    def __init__(self) -> None:
+        self._key = None
+        self._cache = None
+
+    def take(self, key: Tuple):
+        cache, k = self._cache, self._key
+        self._cache = self._key = None
+        return cache if k == key else None
+
+    def put(self, key: Tuple, cache) -> None:
+        self._key = key
+        self._cache = cache

@@ -59,12 +59,19 @@ class ContinuousBatcher:
 
     def __init__(self, engine, stats: ServeStats, linger_s: float,
                  clock: Callable[[], float] = time.monotonic,
-                 pad_full: bool = True):
+                 pad_full: bool = True, prefix_cache: bool = True):
         self.engine = engine
         self.stats = stats
         self.linger_s = float(linger_s)
         self.clock = clock
         self.pad_full = pad_full
+        # Cross-request radix prefix cache (ServeConfig.prefix_cache):
+        # dispatches resume shared prefixes from the engine's page pool
+        # and insert fresh pages after — reuse across requests AND
+        # batches is the serving default. False restores the PR-3
+        # behavior (exact-match dedup only).
+        self.prefix_cache = bool(prefix_cache
+                                 and engine.prefix_cache is not None)
         self.batch = engine.rt.batch_size
         rt = engine.rt
         # Decode budgets: exactly the sweep's derivation (engine/sweep.py)
@@ -131,11 +138,17 @@ class ContinuousBatcher:
                 return None
 
             def price(edge: int) -> Tuple[float, float]:
-                n = min(len(self._queues[edge]), self.batch)
+                q = self._queues[edge]
+                n = min(len(q), self.batch)
+                # Prefix-aware pricing: radix-cached prefix tokens of
+                # the rows this dispatch would take are free prefill
+                # (advisory submit-time hints; scheduler.bucket_cost).
+                cached = (sum(q[i].cached_hint for i in range(n))
+                          if self.prefix_cache else 0)
                 per_row = sched_mod.bucket_cost(
                     self._dispatch_rows(n), edge, self.batch,
-                    self.decode_cost) / n
-                return per_row, self._queues[edge][0].t_submit
+                    self.decode_cost, cached_tokens=cached) / n
+                return per_row, q[0].t_submit
 
             edge = min(ripe, key=price)
             q = self._queues[edge]
@@ -204,7 +217,8 @@ class ContinuousBatcher:
             conf_tokens=self.conf_tokens, early_stop=self.early_stop,
             pretokenized_a=[list(p.bin_ids) for p in full],
             pretokenized_b=[list(p.conf_ids) for p in full],
-            bucket=bucket, sfx_buckets_ab=(ba, bb), reuse_cache=True)
+            bucket=bucket, sfx_buckets_ab=(ba, bb), reuse_cache=True,
+            use_prefix_cache=self.prefix_cache, n_real=n)
         res = score_mod.readout_from_fused(
             fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
         res_h, lp_vals, lp_ids, gen_host = jax.device_get(

@@ -127,6 +127,10 @@ class Pending:
     t1: int = 0
     t2: int = 0
     cache_key: str = ""
+    # Radix-cached prefix tokens at submit time (engine/prefix_tree.
+    # match_len) — ADVISORY: feeds the batcher's prefix-aware
+    # bucket_cost pricing; the dispatch re-looks up with a pin.
+    cached_hint: int = 0
 
     @property
     def prefix_len(self) -> int:

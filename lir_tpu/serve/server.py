@@ -85,9 +85,17 @@ class ScoringServer:
         self.queue = RequestQueue(self.config.queue_depth, self.stats,
                                   clock)
         self.cache = ResultCache(self.config.cache_entries, self.stats)
+        # Cross-request radix prefix cache (ServeConfig.prefix_cache, ON
+        # by default): build the engine's page pool + radix index before
+        # the batcher snapshots it; every dispatch then pays prefill
+        # only for its rows' unshared suffixes, across requests and
+        # batches, with results bitwise-identical to the unpaged path.
+        if self.config.prefix_cache:
+            engine.enable_prefix_cache()
         self.batcher = ContinuousBatcher(engine, self.stats,
                                          self.config.linger_s, clock,
-                                         pad_full=self.config.pad_full)
+                                         pad_full=self.config.pad_full,
+                                         prefix_cache=self.config.prefix_cache)
         self.faults = FaultStats()
         self.breaker = CircuitBreaker(
             failure_threshold=self.config.max_consecutive_failures,
@@ -169,12 +177,20 @@ class ScoringServer:
         t1, t2 = self._target_ids(tuple(request.targets))
         deadline = (request.deadline_s if request.deadline_s is not None
                     else self.config.deadline_for(request.klass))
+        bucket = tok.assign_bucket(max(lcp, 1), self.engine.buckets)
+        # Admission-time radix probe (read-only, no pins): how much of
+        # this request's shared prefix is already resident — feeds the
+        # batcher's prefix-aware bucket pricing; the dispatch re-looks
+        # up with a pin.
+        cached_hint = 0
+        if self.batcher.prefix_cache:
+            cached_hint = self.engine.prefix_cache.match_len(
+                bucket, bin_ids[:lcp])
         pending = Pending(
             request=request, future=fut, t_submit=now,
             t_deadline=now + deadline, bin_ids=bin_ids, conf_ids=conf_ids,
-            lcp=lcp,
-            bucket=tok.assign_bucket(max(lcp, 1), self.engine.buckets),
-            t1=t1, t2=t2, cache_key=key)
+            lcp=lcp, bucket=bucket,
+            t1=t1, t2=t2, cache_key=key, cached_hint=cached_hint)
         self.queue.offer(pending)
         return fut
 

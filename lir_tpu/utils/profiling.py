@@ -571,6 +571,82 @@ class GuardStats:
             }
 
 
+@dataclasses.dataclass
+class PrefixCacheStats:
+    """Cross-request prefix cache counters (engine/prefix_tree.py over
+    the models/paged.py page pool): the operator's one-look view of how
+    much prefill the radix tree is saving and how hard the pool is
+    churning. Thread-safe — serve admission probes and the dispatch
+    thread mutate it concurrently.
+
+    Definitions (reported by ``summary()``, logged per sweep, surfaced
+    in serve stats alongside ServeStats, and in bench.py's
+    "prefix_serve" key):
+
+    - ``lookups`` / ``hits``: dispatch-time radix probes and probes that
+      matched >= 1 cached page. radix hit rate = hits / lookups.
+    - ``hit_tokens``: prefix tokens resumed from the pool instead of
+      prefilled — THE perf number (prefill_tokens_avoided).
+      ``prefill_tokens_total`` counts every prefix token a dispatch
+      needed (cached + computed), so avoided_frac = hit / total.
+    - ``inserted_pages`` / ``evicted_pages``: pool churn. Sustained
+      eviction at low hit rates means the pool is undersized for the
+      working set (DEPLOY.md §1g sizing arithmetic).
+    - ``pages_in_use`` / ``pages_total``: pool occupancy gauge, updated
+      at every insert/evict.
+    """
+
+    lookups: int = 0
+    hits: int = 0
+    hit_tokens: int = 0
+    prefill_tokens_total: int = 0
+    inserted_pages: int = 0
+    evicted_pages: int = 0
+    pages_in_use: int = 0
+    pages_total: int = 0
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def gauge_pages(self, in_use: int, total: int) -> None:
+        with self._lock:
+            self.pages_in_use = in_use
+            self.pages_total = total
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    @property
+    def avoided_frac(self) -> float:
+        return (self.hit_tokens / self.prefill_tokens_total
+                if self.prefill_tokens_total else 0.0)
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "lookups": self.lookups,
+                "hits": self.hits,
+                "radix_hit_rate": round(self.hits / self.lookups, 4)
+                                  if self.lookups else 0.0,
+                "prefill_tokens_avoided": self.hit_tokens,
+                "prefill_tokens_total": self.prefill_tokens_total,
+                "avoided_frac": round(self.hit_tokens
+                                      / self.prefill_tokens_total, 4)
+                                if self.prefill_tokens_total else 0.0,
+                "inserted_pages": self.inserted_pages,
+                "evicted_pages": self.evicted_pages,
+                "pages_in_use": self.pages_in_use,
+                "pages_total": self.pages_total,
+            }
+
+
 # Published peak dense-matmul throughput per chip (bf16 FLOPS). Weight-only
 # int8 still computes in bf16 on the MXU, so bf16 peak is the MFU denominator
 # there; dynamic int8 (s8 x s8 -> s32 dots) gets 2x this on every listed
