@@ -2,7 +2,8 @@
 # ROADMAP tier-1 suite and fails if the pass count drops below the
 # recorded floor (tools/check_tier1.py — the floor lives there).
 
-.PHONY: verify test bench serve-smoke prefix-smoke chaos-smoke install-hooks
+.PHONY: verify test bench serve-smoke prefix-smoke chaos-smoke \
+	kernel-smoke install-hooks
 
 verify:
 	python tools/check_tier1.py
@@ -43,6 +44,15 @@ prefix-smoke:
 # liveness timeout instead of hanging (tools/chaos_smoke.py).
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
+# Kernel smoke: the PR-7 fused layer vs its references on CPU — the
+# Pallas flash-decode kernel under interpret mode must be greedy
+# argmax-identical to the dense decode path, the fused s8xs8 matmul must
+# match the dequantized reference (static + dynamic + shared-quant), and
+# a piggybacked dispatch chain must reproduce the sequential sweep's
+# rows exactly while its chain counters move (tools/kernel_smoke.py).
+kernel-smoke:
+	JAX_PLATFORMS=cpu python tools/kernel_smoke.py
 
 # Run the tier-1 guard automatically before every `git push`.
 install-hooks:

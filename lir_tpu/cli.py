@@ -138,6 +138,7 @@ def _add_perturb(sub) -> None:
                         "page pool, bitwise-identical results")
     _add_prefix_pool_flags(p)
     _add_guard_flags(p)
+    _add_kernel_flags(p)
     p.add_argument("--barrier-timeout", type=float, default=None,
                    help="multihost liveness bound in seconds: a shard-"
                         "boundary barrier a peer never reaches raises "
@@ -169,6 +170,27 @@ def _prefix_rt_kw(args, rt_kw: dict) -> None:
         rt_kw["prefix_cache_pages"] = args.prefix_cache_pages
     if getattr(args, "prefix_page_size", None) is not None:
         rt_kw["prefix_page_size"] = args.prefix_page_size
+
+
+def _add_kernel_flags(p) -> None:
+    """Fused-kernel knobs (ops/flash_decode + piggybacking), shared by
+    perturb and serve (precompile follows the serving defaults)."""
+    p.add_argument("--no-fused-decode", action="store_true",
+                   help="disable the fused Pallas flash-decode kernel and "
+                        "restore the dense decode-attention lowering "
+                        "exactly (the pre-PR7 path; greedy results are "
+                        "argmax-identical either way)")
+    p.add_argument("--no-piggyback", action="store_true",
+                   help="disable chunked prefill/decode piggybacking "
+                        "(each dispatch then runs its own prefill + "
+                        "decode call; results identical)")
+
+
+def _kernel_rt_kw(args, rt_kw: dict) -> None:
+    if getattr(args, "no_fused_decode", False):
+        rt_kw["fused_decode"] = False
+    if getattr(args, "no_piggyback", False):
+        rt_kw["piggyback_prefill"] = False
 
 
 def _add_guard_flags(p) -> None:
@@ -277,6 +299,7 @@ def _add_serve(sub) -> None:
                         "match dedup only)")
     _add_prefix_pool_flags(p)
     _add_guard_flags(p)
+    _add_kernel_flags(p)
 
 
 def _add_rephrase(sub) -> None:
@@ -404,6 +427,7 @@ def cmd_perturb(args) -> None:
     if args.sweep_confidence_tokens is not None:
         rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
     _guard_rt_kw(args, rt_kw)
+    _kernel_rt_kw(args, rt_kw)
     _prefix_rt_kw(args, rt_kw)
     if args.barrier_timeout is not None:
         rt_kw["barrier_timeout_s"] = args.barrier_timeout
@@ -440,6 +464,7 @@ def cmd_serve(args) -> None:
     if args.sweep_confidence_tokens is not None:
         rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
     _guard_rt_kw(args, rt_kw)
+    _kernel_rt_kw(args, rt_kw)
     _prefix_rt_kw(args, rt_kw)
     classes = dict(ServeConfig().classes)
     for spec in args.deadline or ():

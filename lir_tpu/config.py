@@ -137,6 +137,29 @@ class RuntimeConfig:
     prefix_cache_pages: int = 512
     prefix_page_size: int = 16
 
+    # Fused decode kernels (ops/flash_decode.py). ON: single-query decode
+    # steps run the Pallas flash-decode kernel — K-split online softmax
+    # over the cache with a log-sum-exp combine, so the score row, the
+    # fp32 softmax, and the probability row never round-trip HBM between
+    # XLA kernels. Greedy decode stays argmax-identical to the dense path
+    # (pinned by tests/test_kernels.py); OFF (--no-fused-decode) restores
+    # the dense decode lowering exactly. The engine threads this onto
+    # ModelConfig.fused_decode; CPU runs keep the dense path either way
+    # (Pallas lowers on TPU; the interpreter hook is test-only).
+    fused_decode: bool = True
+
+    # Chunked prefill/decode piggybacking (Sarathi-Serve-style): the
+    # ragged sweep fuses the pending decode scan of the in-flight
+    # dispatch into the NEXT same-shape dispatch's prefill call
+    # (engine/generate.py shared_piggyback_*), so the dispatch stream
+    # pays one device round-trip per dispatch instead of two and decode
+    # never waits on a host gap behind a full prefill. Results are
+    # identical per row to the sequential path (pinned by tests/
+    # test_kernels.py). Piggybacking keeps TWO dispatch caches live, so
+    # the engine engages it only when params + 2 caches fit the device
+    # memory budget; --no-piggyback opts out entirely.
+    piggyback_prefill: bool = True
+
     # Guard layer (lir_tpu/guard): silent-failure detection.
     # Dispatch watchdog — every device dispatch runs on a watched
     # executor whose deadline is floor + multiple * predicted seconds,

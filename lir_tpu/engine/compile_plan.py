@@ -80,7 +80,8 @@ class ShapeSpec:
 
     @property
     def label(self) -> str:
-        sfx = (f"{self.sfx_a}+{self.sfx_b}" if self.kind.startswith("shared")
+        sfx = (f"{self.sfx_a}+{self.sfx_b}"
+               if self.kind.startswith(("shared", "piggy"))
                else str(self.sfx_a))
         var = "donated" if self.scratch else "fresh"
         win = f"/win{self.window}" if self.window else ""
@@ -121,9 +122,41 @@ def grouped_paged_spec(bucket: int, groups: int, batch: int, window: int,
                      bool(scratch), int(window))
 
 
+def piggy_prefill_spec(bucket: int, batch: int, sfx_a: int, sfx_b: int,
+                       new_tokens: int, conf_tokens: int) -> ShapeSpec:
+    """Chain opener (generate.shared_piggyback_prefill): prefill + suffix
+    extensions into the disjoint-region carry, decode scans parked. Stop
+    tables don't appear until the scans run, so stops_armed is always
+    False here."""
+    return ShapeSpec("piggy_prefill", int(bucket), int(batch), 0,
+                     int(sfx_a), int(sfx_b), int(new_tokens),
+                     int(conf_tokens), False, False)
+
+
+def piggy_step_spec(bucket: int, batch: int, sfx_a: int, sfx_b: int,
+                    new_tokens: int, conf_tokens: int,
+                    stops_armed: bool) -> ShapeSpec:
+    """One piggybacked call: parked decode scans + the next dispatch's
+    prefill in one program (generate.shared_piggyback_step)."""
+    return ShapeSpec("piggy_step", int(bucket), int(batch), 0, int(sfx_a),
+                     int(sfx_b), int(new_tokens), int(conf_tokens),
+                     bool(stops_armed), False)
+
+
+def piggy_drain_spec(bucket: int, batch: int, sfx_a: int, sfx_b: int,
+                     new_tokens: int, conf_tokens: int,
+                     stops_armed: bool) -> ShapeSpec:
+    """Chain closer: the last parked dispatch's decode scans alone
+    (generate.shared_piggyback_drain)."""
+    return ShapeSpec("piggy_drain", int(bucket), int(batch), 0, int(sfx_a),
+                     int(sfx_b), int(new_tokens), int(conf_tokens),
+                     bool(stops_armed), False)
+
+
 def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
                conf_tokens: int, stops_armed: bool,
-               prefix_page_size: int = 0) -> List[ShapeSpec]:
+               prefix_page_size: int = 0,
+               piggyback: bool = False) -> List[ShapeSpec]:
     """Distinct executables a dispatch plan will call, in first-use order
     (the precompile pool works the list front-to-back, so the first
     bucket's executable compiles first and the dispatch loop rarely
@@ -136,7 +169,14 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
     dispatch shape, one paged variant per remainder-window edge the
     runner may pick (models/paged.window_edges) — which window a warm
     dispatch runs depends on what the radix tree holds at dispatch
-    time, so the plan covers them all."""
+    time, so the plan covers them all.
+
+    ``piggyback`` (an engine whose chunked prefill/decode piggybacking is
+    on) plans the chain executables for every run of CONSECUTIVE
+    same-shape shared dispatches — the exact chains the sweep forms:
+    opener (prefill-only), step (parked decode + next prefill), and
+    drain. Plain specs stay planned regardless (the runtime memory gate
+    may refuse a chain, and the recovery path re-dispatches plainly)."""
     from ..models import paged as paged_mod
 
     specs: List[ShapeSpec] = []
@@ -157,6 +197,18 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
             add(shared_spec(d.bucket, m_pad, d.sfx_bucket_a,
                             d.sfx_bucket_b, new_tokens, conf_tokens,
                             stops_armed, scratch=scratch))
+            if piggyback and scratch:
+                # A repeat of the previous shared shape — the sweep will
+                # chain these dispatches: plan all three chain stages.
+                add(piggy_prefill_spec(d.bucket, m_pad, d.sfx_bucket_a,
+                                       d.sfx_bucket_b, new_tokens,
+                                       conf_tokens))
+                add(piggy_step_spec(d.bucket, m_pad, d.sfx_bucket_a,
+                                    d.sfx_bucket_b, new_tokens,
+                                    conf_tokens, stops_armed))
+                add(piggy_drain_spec(d.bucket, m_pad, d.sfx_bucket_a,
+                                     d.sfx_bucket_b, new_tokens,
+                                     conf_tokens, stops_armed))
             if prefix_page_size:
                 for w in paged_mod.window_edges(d.bucket, prefix_page_size):
                     add(shared_paged_spec(
@@ -300,6 +352,47 @@ def _avals_grouped_paged(engine, spec: ShapeSpec):
     return args, kwargs, statics
 
 
+def _avals_piggy(engine, spec: ShapeSpec):
+    """Avals for the three piggyback-chain entry points. The step and
+    drain bind the CARRY aval — recovered from the opener via eval_shape
+    (tracing only, no device work)."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import generate
+
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+    B = spec.batch
+    dispatch_args = (i32(B, spec.bucket), i32(B, spec.bucket),
+                     i32(B, spec.sfx_a), i32(B, spec.sfx_a),
+                     i32(B, spec.sfx_b), i32(B, spec.sfx_b))
+    budgets = dict(max_new_a=spec.new_tokens, max_new_b=spec.conf_tokens)
+    if spec.kind == "piggy_prefill":
+        return dispatch_args, {}, dict(**budgets, prefill_fn=None)
+    carry = generate.shared_piggyback_prefill.eval_shape(
+        engine.params, engine.cfg, *dispatch_args, **budgets,
+        prefill_fn=None)
+    digit_ids, digit_vals = engine.digit_table
+    readout = (i32(B), i32(B), i32(len(digit_ids)), f32(len(digit_vals)))
+    V = engine.cfg.vocab_size
+    kwargs = dict(
+        stop_mask_a=(i32(V) if spec.stops_armed else None),
+        stop_mask_b=(i32(V) if spec.stops_armed else None),
+        eos_id=(i32() if spec.stops_armed else None),
+    )
+    if spec.kind == "piggy_step":
+        return ((carry,) + dispatch_args + readout, kwargs,
+                dict(**budgets, topk=TOPK, prefill_fn=None))
+    # piggy_drain: carry + readout args, slot offsets derived from the
+    # spec exactly as the runner derives them.
+    statics = dict(slot0_a=spec.bucket + spec.sfx_a,
+                   slot0_b=(spec.bucket + spec.sfx_a + spec.new_tokens
+                            + spec.sfx_b),
+                   **budgets, topk=TOPK)
+    return (carry,) + readout, kwargs, statics
+
+
 def _lower_compile(engine, spec: ShapeSpec):
     """Lower + compile one spec; returns the jax Compiled executable.
 
@@ -308,6 +401,13 @@ def _lower_compile(engine, spec: ShapeSpec):
     (tracing only, no device work)."""
     from . import generate
 
+    if spec.kind.startswith("piggy"):
+        fn = {"piggy_prefill": generate.shared_piggyback_prefill,
+              "piggy_step": generate.shared_piggyback_step,
+              "piggy_drain": generate.shared_piggyback_drain}[spec.kind]
+        args, kwargs, statics = _avals_piggy(engine, spec)
+        return fn.lower(engine.params, engine.cfg, *args, **kwargs,
+                        **statics).compile()
     if spec.kind == "shared":
         fn = generate.greedy_decode_fused_shared
         args, kwargs, statics = _avals_shared(engine, spec)

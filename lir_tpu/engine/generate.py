@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -455,6 +455,149 @@ def greedy_decode_fused_grouped_paged(params, cfg: ModelConfig, pool,
     if return_cache:
         return out, cache_f
     return out
+
+
+# ---------------------------------------------------------------------------
+# Chunked prefill/decode piggybacking (Sarathi-Serve-style)
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PiggybackCarry:
+    """One in-flight shared dispatch, parked between engine calls with its
+    decode scans still pending: the prefill + both suffix extensions have
+    run, and the NEXT piggybacked call fuses this dispatch's decode scans
+    into the same XLA program as its own prefill
+    (:func:`shared_piggyback_step`) — the dispatch stream then pays one
+    device round-trip per dispatch instead of a prefill call AND a decode
+    drain, and the host gap between a decode scan and the next prefill
+    disappears.
+
+    Unlike the sequential path (branch B's suffix overwrites branch A's
+    suffix slots after A's scan retires), a parked cache must keep BOTH
+    branches alive, so the piggyback layout gives each branch a disjoint
+    slot region: [S, S+S2a+max_new_a) for A, then B's suffix + decode
+    region after it. Slots are physical only — positions, masks, and
+    causality are all mask-aware — so per-row results are identical to
+    the sequential dispatch (pinned by tests/test_kernels.py).
+    """
+
+    logits_a: jax.Array   # (B, V) fp32 — branch A first-position logits
+    logits_b: jax.Array
+    cache: Any            # KV cache pytree, branch regions disjoint
+    cm_a: jax.Array       # (B, T) branch A cache mask (B region zeroed)
+    cm_b: jax.Array
+    pos_a: jax.Array      # (B,) next mask-aware decode positions
+    pos_b: jax.Array
+
+
+def _piggyback_extend(params, cfg: ModelConfig, prefix, prefix_mask,
+                      sfx_a, sfx_a_mask, sfx_b, sfx_b_mask,
+                      max_new_a: int, max_new_b: int,
+                      prefill_fn=None) -> PiggybackCarry:
+    """Prefill + both suffix extensions WITHOUT the decode scans, into the
+    disjoint-region piggyback cache layout (see PiggybackCarry)."""
+    B, S = prefix.shape
+    S2a, S2b = sfx_a.shape[1], sfx_b.shape[1]
+    T = S + S2a + max_new_a + S2b + max_new_b
+    pf = prefill_fn or decoder.prefill
+    _, cache, _ = pf(params, cfg, prefix, prefix_mask, T)
+    zeros = functools.partial(jnp.zeros, dtype=prefix_mask.dtype)
+    cm_a = jnp.concatenate(
+        [prefix_mask, sfx_a_mask, zeros((B, T - S - S2a))], axis=1)
+    logits_a, cache, pos_a = decoder.extend(
+        params, cfg, cache, sfx_a, sfx_a_mask, cm_a, S)
+    off_b = S + S2a + max_new_a
+    cm_b = jnp.concatenate(
+        [prefix_mask, zeros((B, S2a + max_new_a)), sfx_b_mask,
+         zeros((B, max_new_b))], axis=1)
+    logits_b, cache, pos_b = decoder.extend(
+        params, cfg, cache, sfx_b, sfx_b_mask, cm_b, off_b)
+    return PiggybackCarry(logits_a=logits_a, logits_b=logits_b, cache=cache,
+                          cm_a=cm_a, cm_b=cm_b, pos_a=pos_a, pos_b=pos_b)
+
+
+def _piggyback_scan(params, cfg: ModelConfig, carry: PiggybackCarry,
+                    yes_ids, no_ids, digit_ids, digit_vals,
+                    slot0_a: int, slot0_b: int, max_new_a: int,
+                    max_new_b: int, topk: int, stop_mask_a, stop_mask_b,
+                    eos_id) -> Tuple[FusedDecodeOut, FusedDecodeOut]:
+    """Run the parked dispatch's two fused decode scans (branch A then B
+    over the one carried cache buffer; B's mask excludes A's region, so
+    per-row results equal the sequential dispatch's)."""
+    empty_ids = jnp.zeros((0,), jnp.int32)
+    empty_vals = jnp.zeros((0,), jnp.float32)
+    out_a, cache_a = _fused_tail(params, cfg, carry.logits_a, carry.cache,
+                                 carry.cm_a, carry.pos_a, slot0_a,
+                                 yes_ids, no_ids, empty_ids, empty_vals,
+                                 max_new_a, topk, stop_mask=stop_mask_a,
+                                 eos_id=eos_id)
+    out_b, _ = _fused_tail(params, cfg, carry.logits_b, cache_a,
+                           carry.cm_b, carry.pos_b, slot0_b,
+                           yes_ids, no_ids, digit_ids, digit_vals,
+                           max_new_b, topk, stop_mask=stop_mask_b,
+                           eos_id=eos_id)
+    return out_a, out_b
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_a", "max_new_b",
+                                    "prefill_fn"))
+def shared_piggyback_prefill(params, cfg: ModelConfig, prefix, prefix_mask,
+                             sfx_a, sfx_a_mask, sfx_b, sfx_b_mask,
+                             max_new_a: int, max_new_b: int,
+                             prefill_fn=None) -> PiggybackCarry:
+    """Open a piggyback chain: dispatch the first shared batch's prefill +
+    suffix extensions and park its decode scans in the returned carry."""
+    return _piggyback_extend(params, cfg, prefix, prefix_mask, sfx_a,
+                             sfx_a_mask, sfx_b, sfx_b_mask, max_new_a,
+                             max_new_b, prefill_fn)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_a", "max_new_b", "topk",
+                                    "prefill_fn"),
+                   donate_argnames=("carry",))
+def shared_piggyback_step(params, cfg: ModelConfig, carry: PiggybackCarry,
+                          prefix, prefix_mask, sfx_a, sfx_a_mask, sfx_b,
+                          sfx_b_mask, yes_ids, no_ids, digit_ids,
+                          digit_vals, max_new_a: int, max_new_b: int,
+                          topk: int = 20, stop_mask_a=None,
+                          stop_mask_b=None, eos_id=None, prefill_fn=None):
+    """One piggybacked call: the PARKED dispatch's pending decode scans and
+    the NEXT dispatch's prefill + suffix extensions run in ONE XLA
+    program. ``yes_ids``/``no_ids`` (and the stop tables) belong to the
+    parked dispatch; the chain's shapes/budgets are identical by
+    construction (the scheduler only chains same-shape dispatches), so
+    the new carry reuses the donated old one's buffers. Returns
+    (parked binary out, parked confidence out, new carry)."""
+    B, S = prefix.shape
+    S2a, S2b = sfx_a.shape[1], sfx_b.shape[1]
+    out_a, out_b = _piggyback_scan(
+        params, cfg, carry, yes_ids, no_ids, digit_ids, digit_vals,
+        S + S2a, S + S2a + max_new_a + S2b, max_new_a, max_new_b, topk,
+        stop_mask_a, stop_mask_b, eos_id)
+    new_carry = _piggyback_extend(params, cfg, prefix, prefix_mask, sfx_a,
+                                  sfx_a_mask, sfx_b, sfx_b_mask, max_new_a,
+                                  max_new_b, prefill_fn)
+    return out_a, out_b, new_carry
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "slot0_a", "slot0_b", "max_new_a",
+                                    "max_new_b", "topk"),
+                   donate_argnames=("carry",))
+def shared_piggyback_drain(params, cfg: ModelConfig, carry: PiggybackCarry,
+                           yes_ids, no_ids, digit_ids, digit_vals,
+                           slot0_a: int, slot0_b: int, max_new_a: int,
+                           max_new_b: int, topk: int = 20,
+                           stop_mask_a=None, stop_mask_b=None, eos_id=None):
+    """Close a piggyback chain: run the last parked dispatch's decode scans
+    alone (no prefill rides along — the chain is over)."""
+    return _piggyback_scan(params, cfg, carry, yes_ids, no_ids, digit_ids,
+                           digit_vals, slot0_a, slot0_b, max_new_a,
+                           max_new_b, topk, stop_mask_a, stop_mask_b,
+                           eos_id)
 
 
 @functools.partial(jax.jit,

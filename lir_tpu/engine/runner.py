@@ -24,10 +24,17 @@ import numpy as np
 
 from ..config import RuntimeConfig
 from ..guard.watchdog import DispatchWatchdog
-from ..models import decoder, paged
+from ..models import decoder, paged, quant
 from ..utils.profiling import (CompileStats, FaultStats, GuardStats,
-                               PrefixCacheStats)
-from . import compile_plan, generate, prefix_tree, score, tokens as tok
+                               KernelStats, PrefixCacheStats)
+from . import (compile_plan, generate, prefix_tree,
+               scheduler as scheduler_mod, score, tokens as tok)
+
+
+class PiggybackIneligible(RuntimeError):
+    """A dispatch can't ride the piggyback chain (layout fallback, memory
+    headroom, learned-position ceiling) — the caller dispatches it through
+    the plain path instead. Deliberate control flow, never an error."""
 
 
 def _tail_batch(n: int, cap: int) -> int:
@@ -117,6 +124,17 @@ class ScoringEngine:
         self.tokenizer = tokenizer
         self.rt = runtime or RuntimeConfig()
         self.encoder_decoder = encoder_decoder
+        # Fused decode kernels are a RUNTIME choice surfaced through the
+        # static model config (the decode executables specialize on it):
+        # --no-fused-decode restores the dense decode lowering exactly,
+        # and the manifest key shifts with the cfg so a registry or
+        # warmed compile cache can never serve the other mode's
+        # executables.
+        if (not encoder_decoder
+                and getattr(cfg, "fused_decode", None) is not None
+                and cfg.fused_decode != self.rt.fused_decode):
+            self.cfg = cfg = dataclasses.replace(
+                cfg, fused_decode=self.rt.fused_decode)
         # Sequence-parallel prefill (long-context path): with a mesh whose
         # `seq` axis > 1, the quadratic prompt phase runs seq-sharded
         # through ring/Ulysses attention (parallel/seq_forward) and hands
@@ -178,6 +196,15 @@ class ScoringEngine:
         # sweep.run_perturbation_sweep, read by bench.py.
         self._handoff = _CacheHandoff()
         self.occupancy = None
+        # In-flight piggyback chain (chunked prefill/decode piggybacking):
+        # the parked dispatch whose decode scans ride the next same-shape
+        # dispatch's prefill call (generate.PiggybackCarry + the statics
+        # needed to drain it). One chain at a time by construction — the
+        # sweep drains before switching shapes.
+        self._piggy: Optional[dict] = None
+        # Per-phase kernel accounting + piggyback counters
+        # (profiling.KernelStats; bench.py fills the phase rows).
+        self.kernel_stats = KernelStats()
         # Cross-request radix prefix cache (engine/prefix_tree.py) over
         # the paged KV allocator (models/paged.py): a dispatch resumes
         # each row's prefix from the deepest cached radix node and pays
@@ -730,6 +757,189 @@ class ScoringEngine:
             jnp.asarray(sfx_b_mask),
             jnp.asarray(yes_ids, jnp.int32), jnp.asarray(no_ids, jnp.int32),
             jnp.asarray(digit_ids), jnp.asarray(digit_vals), **kwargs)
+
+    # -- chunked prefill/decode piggybacking --------------------------------
+
+    def piggyback_supported(self) -> bool:
+        """Engine-level gate for the piggyback chain: on by config, plain
+        decoder engines only (T5 and seq-parallel prefills keep their own
+        paths), unpaged dispatches only (the prefix-cache resume path owns
+        warm traffic), and never on a fault-wrapped engine — wrap_engine
+        shadows the plain entry points at the instance level, and the
+        chain must not bypass the injected dispatch sites."""
+        return (self.rt.piggyback_prefill
+                and not self.encoder_decoder
+                and self._prefill_fn is None
+                and self.prefix_cache is None
+                and "decode_fused_shared" not in self.__dict__)
+
+    def _piggyback_fits(self, bsz: int, total_len: int) -> bool:
+        """HBM headroom gate: a piggybacked pair keeps TWO dispatch caches
+        live (the parked carry + the riding dispatch's own), where the
+        sequential path holds one. Engage only when params + two caches
+        clear the device budget; backends without memory stats (CPU) are
+        governed by host RAM and always pass."""
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            limit = stats.get("bytes_limit")
+        except Exception:  # noqa: BLE001 — no stats, no gate
+            limit = None
+        if not limit:
+            return True
+        aval = self._cache_aval()  # built at batch 1, 8 slots
+        per_row_slot = sum(
+            leaf.size * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(aval)) / 8
+        cache_bytes = per_row_slot * bsz * total_len
+        return (quant.param_bytes(self.params) + 2.2 * cache_bytes
+                < 0.92 * limit)
+
+    def decode_fused_shared_piggy(
+            self, pretokenized_a: Sequence[Sequence[int]],
+            pretokenized_b: Sequence[Sequence[int]],
+            new_tokens: int, conf_tokens: int, early_stop: bool,
+            bucket: int, sfx_buckets_ab: Tuple[int, int],
+            prev_yes: Optional[np.ndarray] = None,
+            prev_no: Optional[np.ndarray] = None):
+        """Submit one shared dispatch into the piggyback chain.
+
+        First call of a chain runs the dispatch's prefill + suffix
+        extensions and PARKS its decode scans (returns None); every later
+        call fuses the parked dispatch's decode scans into its own
+        prefill program (ONE device call) and returns the parked
+        dispatch's (binary, confidence) outputs, scored against
+        ``prev_yes``/``prev_no`` — the target ids of the PARKED batch.
+        Shapes/budgets must match the parked dispatch exactly (the sweep
+        only chains same-shape dispatches; asserted here). Raises
+        :class:`PiggybackIneligible` when this dispatch needs the plain
+        path (layout fallback, learned-position ceiling at the piggyback
+        cache length, or no memory headroom for two live caches)."""
+        assert not self.encoder_decoder
+        bin_ids = [list(i) for i in pretokenized_a]
+        conf_ids = [list(i) for i in pretokenized_b]
+        lcp = [tok.shared_prefix_len(a, b)
+               for a, b in zip(bin_ids, conf_ids)]
+        pad_id = tok.pad_token_id(self.tokenizer)
+        sfx_a_ids = [a[n:] for a, n in zip(bin_ids, lcp)]
+        sfx_b_ids = [b[n:] for b, n in zip(conf_ids, lcp)]
+        max_sfx = max(len(s) for s in sfx_a_ids + sfx_b_ids)
+        max_total = max(len(r) for r in bin_ids + conf_ids)
+        sfx_buckets = scheduler_mod.SUFFIX_BUCKETS
+        ba, bb = sfx_buckets_ab
+        ba = max(ba, tok.pick_bucket([len(s) for s in sfx_a_ids],
+                                     sfx_buckets))
+        bb = max(bb, tok.pick_bucket([len(s) for s in sfx_b_ids],
+                                     sfx_buckets))
+        total_len = bucket + ba + new_tokens + bb + conf_tokens
+        if (max_sfx > max(sfx_buckets)
+                or max_total > max(self.buckets)
+                or bucket < max(max(n, 1) for n in lcp)):
+            raise PiggybackIneligible("shared-prefix layout fallback")
+        if (getattr(self.cfg, "pos_embedding", None) == "learned"
+                and total_len > self.cfg.max_seq_len):
+            # The piggyback cache is LONGER than the sequential one
+            # (disjoint branch regions), so its learned-position ceiling
+            # binds earlier than the plain path's.
+            raise PiggybackIneligible("learned-position table overrun")
+        if not self._piggyback_fits(len(bin_ids), total_len):
+            raise PiggybackIneligible("no HBM headroom for two caches")
+
+        prefix, prefix_mask = tok.right_pad_ids(
+            [a[:n] for a, n in zip(bin_ids, lcp)], bucket, pad_id)
+        sfx_a, sfx_a_mask = tok.right_pad_ids(sfx_a_ids, ba, pad_id)
+        sfx_b, sfx_b_mask = tok.right_pad_ids(sfx_b_ids, bb, pad_id)
+        stop_mask = self.digit_stop_mask if early_stop else None
+        armed = stop_mask is not None
+        key = (bucket, len(bin_ids), ba, bb, new_tokens, conf_tokens,
+               armed)
+        dispatch_args = (jnp.asarray(prefix), jnp.asarray(prefix_mask),
+                         jnp.asarray(sfx_a), jnp.asarray(sfx_a_mask),
+                         jnp.asarray(sfx_b), jnp.asarray(sfx_b_mask))
+        if self._piggy is None:
+            exe = None
+            if self.exec_registry is not None:
+                exe = self.exec_registry.get(compile_plan.piggy_prefill_spec(
+                    bucket, len(bin_ids), ba, bb, new_tokens, conf_tokens))
+            if exe is not None:
+                carry = exe(self.params, *dispatch_args)
+            else:
+                carry = generate.shared_piggyback_prefill(
+                    self.params, self.cfg, *dispatch_args,
+                    max_new_a=new_tokens, max_new_b=conf_tokens)
+            self._piggy = dict(key=key, carry=carry,
+                               slot0_a=bucket + ba,
+                               slot0_b=bucket + ba + new_tokens + bb,
+                               new_tokens=new_tokens,
+                               conf_tokens=conf_tokens, armed=armed)
+            self.kernel_stats.count("chains_opened")
+            return None
+        assert self._piggy["key"] == key, (
+            "piggyback chain shape mismatch — drain before switching "
+            f"shapes ({self._piggy['key']} vs {key})")
+        carry = self._piggy["carry"]
+        stop_kwargs = self._piggy_stop_kwargs()
+        digit_ids, digit_vals = self.digit_table
+        exe = None
+        if self.exec_registry is not None:
+            exe = self.exec_registry.get(compile_plan.piggy_step_spec(
+                bucket, len(bin_ids), ba, bb, new_tokens, conf_tokens,
+                stops_armed=armed))
+        dyn = (self.params, carry) + dispatch_args + (
+            jnp.asarray(prev_yes, jnp.int32), jnp.asarray(prev_no, jnp.int32),
+            jnp.asarray(digit_ids), jnp.asarray(digit_vals))
+        if exe is not None:
+            out_a, out_b, new_carry = exe(*dyn, **stop_kwargs)
+        else:
+            out_a, out_b, new_carry = generate.shared_piggyback_step(
+                dyn[0], self.cfg, *dyn[1:], max_new_a=new_tokens,
+                max_new_b=conf_tokens, **stop_kwargs)
+        self._piggy["carry"] = new_carry
+        self.kernel_stats.count("piggybacked_steps")
+        return out_a, out_b
+
+    def _piggy_stop_kwargs(self) -> dict:
+        if not self._piggy["armed"]:
+            return dict(stop_mask_a=None, stop_mask_b=None, eos_id=None)
+        return dict(stop_mask_a=self.eos_stop_mask,
+                    stop_mask_b=self.digit_stop_mask,
+                    eos_id=jnp.int32(self.eos_id))
+
+    def piggy_pending(self) -> bool:
+        return self._piggy is not None
+
+    def piggy_drain(self, prev_yes: np.ndarray, prev_no: np.ndarray):
+        """Close the chain: run the parked dispatch's decode scans alone
+        and return its (binary, confidence) outputs."""
+        st = self._piggy
+        assert st is not None, "no piggyback chain to drain"
+        digit_ids, digit_vals = self.digit_table
+        key = st["key"]
+        exe = None
+        if self.exec_registry is not None:
+            exe = self.exec_registry.get(compile_plan.piggy_drain_spec(
+                key[0], key[1], key[2], key[3], st["new_tokens"],
+                st["conf_tokens"], stops_armed=st["armed"]))
+        dyn = (self.params, st["carry"],
+               jnp.asarray(prev_yes, jnp.int32),
+               jnp.asarray(prev_no, jnp.int32),
+               jnp.asarray(digit_ids), jnp.asarray(digit_vals))
+        stop_kwargs = self._piggy_stop_kwargs()
+        self._piggy = None
+        self.kernel_stats.count("chains_drained")
+        if exe is not None:
+            return exe(*dyn, **stop_kwargs)
+        return generate.shared_piggyback_drain(
+            dyn[0], self.cfg, *dyn[1:], slot0_a=st["slot0_a"],
+            slot0_b=st["slot0_b"], max_new_a=st["new_tokens"],
+            max_new_b=st["conf_tokens"], **stop_kwargs)
+
+    def piggy_abort(self) -> None:
+        """Drop the chain (a failed piggyback call): the parked dispatch's
+        carry may have been consumed by donation — the caller re-runs both
+        dispatches through the plain path, which recomputes from scratch."""
+        if self._piggy is not None:
+            self.kernel_stats.count("chain_fallbacks")
+        self._piggy = None
 
     def decode_fused_grouped(self, groups, yes_ids: np.ndarray,
                              no_ids: np.ndarray, new_tokens: int,

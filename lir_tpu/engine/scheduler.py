@@ -49,6 +49,35 @@ from . import tokens as tok
 
 SUFFIX_BUCKETS = (8, 16, 32, 64, 128, 256)
 
+# Decode-floor price constants: how many prefill row-tokens one
+# decode-scan token is worth. Recalibrated against the PR-7 fused kernel
+# timings (flash-decode + int8 matmul fusion): with the fused kernels a
+# decode step's device time tracks one prefill row-token closely (the
+# score row, softmax, and probability row stay in VMEM), so the fused
+# price is 1.0 — which also keeps every pre-existing plan byte-identical.
+# The UNFUSED dense lowering pays ~3x that in HBM round-trips per step;
+# engines running --no-fused-decode price their decode floor (and hence
+# their watchdog deadlines) with the slower constant so the planner
+# doesn't over-promote tails and the watchdog doesn't shoot legitimate
+# dense decodes timed against a fused-kernel calibration.
+DECODE_TOKEN_COST_FUSED = 1.0
+DECODE_TOKEN_COST_UNFUSED = 3.0
+
+
+def decode_token_cost(fused_decode: bool = True) -> float:
+    """The decode-floor constant for a kernel mode (see above)."""
+    return (DECODE_TOKEN_COST_FUSED if fused_decode
+            else DECODE_TOKEN_COST_UNFUSED)
+
+
+def watchdog_seed_headroom() -> float:
+    """EWMA seed headroom for the dispatch watchdog (guard/watchdog.py):
+    the fused/unfused kernel spread. The watchdog's first calibration
+    sample is inflated by this ratio so a deadline calibrated on
+    fused-kernel dispatches never fires spuriously on a dispatch that
+    legitimately falls back to the slower dense decode path."""
+    return DECODE_TOKEN_COST_UNFUSED / DECODE_TOKEN_COST_FUSED
+
 
 def _tail_batch(n: int, cap: int) -> int:
     """Smallest power of two >= n, capped (mirrors runner._tail_batch)."""
@@ -58,19 +87,32 @@ def _tail_batch(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+def decode_floor(n_rows: int, batch_size: int, decode_cost: int,
+                 fused_decode: bool = True) -> float:
+    """The decode-scan floor of a dispatch's price: every padded slot runs
+    the full decode budget whether it carries work or padding, priced at
+    the kernel mode's decode-floor constant. Cached prefill can never
+    push a dispatch below this (bucket_cost); the piggyback path prices
+    a parked dispatch's pending scans with exactly this term."""
+    return (_tail_batch(n_rows, batch_size) * decode_cost
+            * decode_token_cost(fused_decode))
+
+
 def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
-                decode_cost: int, cached_tokens: int = 0) -> int:
+                decode_cost: int, cached_tokens: int = 0,
+                fused_decode: bool = True) -> float:
     """Row-token cost of dispatching ``n_rows`` cells at ``bucket_edge``:
     a padded power-of-two batch prefilled at the edge, plus the fixed
-    decode scan (``decode_cost`` tokens per slot — the steps run whether
-    the slots carry work or padding).
+    decode floor (:func:`decode_floor` — the steps run whether the slots
+    carry work or padding, priced per kernel mode).
 
     This is THE decode-cost price model (linear param term dominates at
     7B scale: prefill ~ bucket edge per row, each decode step ~ 1 token
-    per slot). Both the offline planner's slot-refill rule
-    (:meth:`RaggedScheduler._plan_shared`) and the online continuous
-    batcher's bucket-selection policy (serve/batcher.py) price dispatches
-    through this one helper so the two can't drift apart.
+    per slot under the fused kernels). The offline planner's slot-refill
+    rule (:meth:`RaggedScheduler._plan_shared`), the online continuous
+    batcher's bucket-selection policy (serve/batcher.py), AND the
+    dispatch watchdog's deadline predictions (guard/watchdog.py) price
+    dispatches through this one helper so the three can't drift apart.
 
     ``cached_tokens`` are prefix tokens the cross-request radix cache
     (engine/prefix_tree.py) already holds for the candidate rows —
@@ -80,7 +122,8 @@ def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
     cheaper than its decode steps."""
     slots = _tail_batch(n_rows, batch_size)
     prefill = max(slots * bucket_edge - int(cached_tokens), 0)
-    return prefill + slots * decode_cost
+    return prefill + decode_floor(n_rows, batch_size, decode_cost,
+                                  fused_decode)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -200,12 +243,14 @@ class RaggedScheduler:
                  min_group_prefix: int = 16, min_group_cells: int = 4,
                  group_cells: bool = True,
                  cached_probe=None,
+                 fused_decode: bool = True,
                  stats: Optional[OccupancyStats] = None):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.batch = int(batch_size)
         self.new_budget = int(new_budget)
         self.decode_cost = int(new_budget if decode_cost is None
                                else decode_cost)
+        self.fused_decode = bool(fused_decode)
         self.suffix_buckets = tuple(sorted(suffix_buckets))
         self.max_extent = max_extent
         self.min_group_prefix = int(min_group_prefix)
@@ -327,8 +372,8 @@ class RaggedScheduler:
             if (nxt is not None
                     and len(q) * nxt - self._cached_tokens(q, nxt)
                     < bucket_cost(len(q), edge, B, self.decode_cost,
-                                  cached_tokens=self._cached_tokens(q,
-                                                                    edge))):
+                                  cached_tokens=self._cached_tokens(q, edge),
+                                  fused_decode=self.fused_decode)):
                 queues[nxt] = [(it, True) for it, _ in q] + queues[nxt]
             else:
                 out.append(Dispatch(

@@ -36,7 +36,7 @@ from . import grid as grid_mod
 from . import scheduler as sched_mod
 from . import score as score_mod
 from . import tokens as tok
-from .runner import ScoringEngine, _tail_batch
+from .runner import PiggybackIneligible, ScoringEngine, _tail_batch
 
 log = get_logger(__name__)
 
@@ -271,6 +271,10 @@ def run_perturbation_sweep(
         if engine.fault_stats.recovered_dispatches:
             log.info("fault recovery: %s",
                      json.dumps(engine.fault_stats.summary()))
+        if getattr(engine, "kernel_stats", None) is not None \
+                and engine.kernel_stats.counters:
+            log.info("piggyback chains: %s",
+                     json.dumps(engine.kernel_stats.counters))
 
     if pending_rows:
         _flush(pending_rows, results_path, manifest)
@@ -361,6 +365,7 @@ def _plan_ragged(engine, todo, new_tokens, conf_tokens):
         min_group_cells=engine.rt.sweep_group_min_cells,
         group_cells=engine.rt.sweep_group_min_cells > 0,
         cached_probe=cached_probe,
+        fused_decode=engine.rt.fused_decode,
         stats=stats)
     dispatches = planner.schedule(items)
     engine.occupancy = stats
@@ -438,7 +443,8 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 dispatches, B, new_tokens, conf_tokens, stop_armed,
                 prefix_page_size=(engine.prefix_cache.page_size
                                   if engine.prefix_cache is not None
-                                  else 0))
+                                  else 0),
+                piggyback=engine.piggyback_supported())
             engine.exec_registry = compile_plan.precompile_async(
                 engine, specs, max_workers=engine.rt.precompile_workers)
             log.info("compile plan: precompiling %d executable shapes "
@@ -577,13 +583,96 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 # price at the ladder's widest edge (a generous deadline
                 # beats a hair-trigger one).
                 cost=sched_mod.bucket_cost(bsz, max(engine.buckets), B,
-                                           new_tokens + conf_tokens))
+                                           new_tokens + conf_tokens,
+                                           fused_decode=engine.rt.fused_decode))
             res = score_mod.readout_from_fused(
                 fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
             work_q.put((batch, fused, res, cfused))
 
-    def _dispatch_ragged():
+    # Chunked prefill/decode piggybacking: runs of CONSECUTIVE shared
+    # dispatches with one compiled shape (the common case — bucket queues
+    # drain same-shape batches back to back) chain through the engine's
+    # piggyback path: each dispatch's prefill call carries the PARKED
+    # decode scans of the previous dispatch (generate.shared_piggyback_
+    # step), so the stream pays one device round-trip per dispatch and
+    # decode never waits on a host gap behind a full prefill. Results are
+    # identical per row (tests/test_kernels.py); any failure falls back
+    # to the plain recovered path, which recomputes both dispatches.
+    use_piggy = (ragged
+                 and getattr(engine, "piggyback_supported",
+                             lambda: False)())
+    fused_dec = engine.rt.fused_decode
+    piggy_keys = []
+    if ragged:
         for d in dispatches:
+            if d.kind == "shared":
+                n = len(d.items)
+                piggy_keys.append(
+                    (d.bucket, B if n == B else _tail_batch(n, B),
+                     d.sfx_bucket_a, d.sfx_bucket_b))
+            else:
+                piggy_keys.append(None)
+    pending: List[Optional[dict]] = [None]   # the parked dispatch's meta
+
+    def _watched(call, cost):
+        wd = getattr(engine, "watchdog", None)
+        if wd is not None and wd.enabled:
+            return wd.watch(call, cost=cost, site="sweep")
+        return call()
+
+    def _emit(meta, fused, cfused):
+        res = score_mod.readout_from_fused(
+            fused, jnp.asarray(meta["t1"]), jnp.asarray(meta["t2"]),
+            scan_positions=1)
+        work_q.put((meta["batch"], fused, res, cfused))
+
+    def _plain_shared(meta):
+        full_items, t1, t2 = meta["full_items"], meta["t1"], meta["t2"]
+        fused, cfused = _dispatch_with_recovery(
+            engine, lambda: engine.decode_fused_shared(
+                [it.cell.binary_prompt for it in full_items],
+                [it.cell.confidence_prompt for it in full_items],
+                t1, t2, new_tokens=new_tokens,
+                conf_tokens=conf_tokens, early_stop=early_stop,
+                pretokenized_a=[it.bin_ids for it in full_items],
+                pretokenized_b=[it.conf_ids for it in full_items],
+                bucket=meta["bucket"], sfx_buckets_ab=meta["sfx_ab"],
+                reuse_cache=True, n_real=meta["n"]),
+            cost=sched_mod.bucket_cost(
+                meta["n"], meta["bucket"], B, new_tokens + conf_tokens,
+                fused_decode=fused_dec))
+        _emit(meta, fused, cfused)
+
+    def _redispatch_pending():
+        """Broken chain: the parked dispatch's carry is gone (possibly
+        consumed by donation) — recompute it through the plain recovered
+        path, which owes nothing to the chain."""
+        meta, pending[0] = pending[0], None
+        engine.piggy_abort()
+        _plain_shared(meta)
+
+    def _drain_pending():
+        if pending[0] is None:
+            return
+        meta = pending[0]
+        try:
+            fused, cfused = _watched(
+                lambda: engine.piggy_drain(meta["t1"], meta["t2"]),
+                cost=sched_mod.decode_floor(
+                    meta["n"], B, new_tokens + conf_tokens,
+                    fused_decode=fused_dec))
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as err:  # noqa: BLE001 — plain-path fallback
+            log.warning("piggyback drain failed (%r); re-dispatching the "
+                        "parked batch through the plain path", err)
+            _redispatch_pending()
+            return
+        pending[0] = None
+        _emit(meta, fused, cfused)
+
+    def _dispatch_ragged():
+        for i, d in enumerate(dispatches):
             if failed.is_set():
                 return
             batch = d.cells
@@ -597,23 +686,63 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 t2 = np.asarray(
                     [target_ids[it.cell.prompt_idx][1]
                      for it in full_items], np.int32)
-                fused, cfused = _dispatch_with_recovery(
-                    engine, lambda: engine.decode_fused_shared(
-                        [it.cell.binary_prompt for it in full_items],
-                        [it.cell.confidence_prompt for it in full_items],
-                        t1, t2, new_tokens=new_tokens,
-                        conf_tokens=conf_tokens, early_stop=early_stop,
-                        pretokenized_a=[it.bin_ids for it in full_items],
-                        pretokenized_b=[it.conf_ids for it in full_items],
-                        bucket=d.bucket,
-                        sfx_buckets_ab=(d.sfx_bucket_a, d.sfx_bucket_b),
-                        reuse_cache=True, n_real=n),
-                    cost=sched_mod.bucket_cost(
-                        n, d.bucket, B, new_tokens + conf_tokens))
-                res = score_mod.readout_from_fused(
-                    fused, jnp.asarray(t1), jnp.asarray(t2),
-                    scan_positions=1)
+                meta = dict(batch=batch, full_items=full_items, t1=t1,
+                            t2=t2, bucket=d.bucket, n=n, key=piggy_keys[i],
+                            sfx_ab=(d.sfx_bucket_a, d.sfx_bucket_b))
+                # Chain iff the parked dispatch shares this shape, or this
+                # dispatch opens a run the NEXT dispatch will ride.
+                chainable = use_piggy and (
+                    (pending[0] is not None
+                     and pending[0]["key"] == piggy_keys[i])
+                    or (pending[0] is None and i + 1 < len(dispatches)
+                        and piggy_keys[i + 1] == piggy_keys[i]))
+                if chainable:
+                    prev = pending[0]
+                    cost = sched_mod.bucket_cost(
+                        n, d.bucket, B, new_tokens + conf_tokens,
+                        fused_decode=fused_dec)
+                    if prev is not None:
+                        cost += sched_mod.decode_floor(
+                            prev["n"], B, new_tokens + conf_tokens,
+                            fused_decode=fused_dec)
+                    try:
+                        out = _watched(
+                            lambda: engine.decode_fused_shared_piggy(
+                                [it.bin_ids for it in full_items],
+                                [it.conf_ids for it in full_items],
+                                new_tokens, conf_tokens, early_stop,
+                                d.bucket,
+                                (d.sfx_bucket_a, d.sfx_bucket_b),
+                                prev_yes=(prev["t1"] if prev else None),
+                                prev_no=(prev["t2"] if prev else None)),
+                            cost)
+                    except PiggybackIneligible as err:
+                        log.info("piggyback ineligible (%s); dispatching "
+                                 "plainly", err)
+                        _drain_pending()
+                        _plain_shared(meta)
+                        continue
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except BaseException as err:  # noqa: BLE001
+                        log.warning(
+                            "piggyback step failed (%r); falling back to "
+                            "the plain path for both dispatches", err)
+                        if pending[0] is not None:
+                            _redispatch_pending()
+                        else:
+                            engine.piggy_abort()
+                        _plain_shared(meta)
+                        continue
+                    if out is not None:
+                        _emit(prev, *out)
+                    pending[0] = meta
+                    continue
+                _drain_pending()
+                _plain_shared(meta)
+                continue
             else:
+                _drain_pending()   # grouped shapes never ride the chain
                 t1 = np.asarray(
                     [target_ids[it.cell.prompt_idx][0]
                      for it in d.items], np.int32)
@@ -629,7 +758,8 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                     # Grouped dispatches run [bin, conf] member rows per
                     # cell — price the doubled row count.
                     cost=sched_mod.bucket_cost(
-                        2 * n, d.bucket, B, new_tokens + conf_tokens))
+                        2 * n, d.bucket, B, new_tokens + conf_tokens,
+                        fused_decode=fused_dec))
                 # Member rows are [bin, conf] per cell: even rows carry
                 # the binary readout, odd rows the confidence one. Both
                 # ran the shared max(new, conf) budget, so each branch
@@ -655,6 +785,7 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                     fused, jnp.asarray(t1), jnp.asarray(t2),
                     scan_positions=1)
             work_q.put((batch, fused, res, cfused))
+        _drain_pending()   # close the piggyback chain's last dispatch
 
     wt = threading.Thread(target=_writer, name="sweep-writer", daemon=True)
     wt.start()

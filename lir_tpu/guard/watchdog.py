@@ -139,11 +139,25 @@ class DispatchWatchdog:
 
     def __init__(self, multiple: float = 20.0, floor_s: float = 30.0,
                  stats: Optional[GuardStats] = None,
-                 tick_s: float = DEFAULT_TICK_S):
+                 tick_s: float = DEFAULT_TICK_S,
+                 seed_headroom: Optional[float] = None):
         self.multiple = float(multiple)
         self.floor_s = float(floor_s)
         self.stats = stats if stats is not None else GuardStats()
         self.tick_s = float(tick_s)
+        # EWMA seed headroom, read from the scheduler's decode-floor
+        # constants (scheduler.watchdog_seed_headroom — the fused/unfused
+        # kernel spread): the FIRST calibration sample is inflated by
+        # this ratio, so a deadline seeded on fast fused-kernel
+        # dispatches never fires spuriously when a later dispatch
+        # legitimately runs the slower dense decode path (a shape the
+        # kernel can't fuse, or --no-fused-decode mid-fleet). The EWMA
+        # tightens back within a few dispatches (0.7 decay).
+        if seed_headroom is None:
+            from ..engine import scheduler as _sched
+
+            seed_headroom = _sched.watchdog_seed_headroom()
+        self.seed_headroom = max(float(seed_headroom), 1.0)
         self._rate: Optional[float] = None      # EWMA s per cost unit
         self._flat: Optional[float] = None      # EWMA s per dispatch
         self._lock = threading.Lock()
@@ -179,9 +193,10 @@ class DispatchWatchdog:
         with self._lock:
             if cost is not None and cost > 0:
                 r = elapsed / max(float(cost), 1.0)
-                self._rate = (r if self._rate is None
+                self._rate = (r * self.seed_headroom if self._rate is None
                               else 0.7 * self._rate + 0.3 * r)
-            self._flat = (elapsed if self._flat is None
+            self._flat = (elapsed * self.seed_headroom
+                          if self._flat is None
                           else 0.7 * self._flat + 0.3 * elapsed)
 
     def watch(self, fn: Callable, cost: Optional[float] = None,

@@ -30,7 +30,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import ModelConfig
-from .quant import QuantTensor, dynamic_quant as _quant_kv, matmul as _mm
+from .quant import (QuantTensor, dynamic_quant as _quant_kv, matmul as _mm,
+                    shared_quant as _shared_quant)
 
 Params = Dict[str, Any]
 
@@ -39,6 +40,12 @@ Params = Dict[str, Any]
 # slopes/positions wiring) is testable without a chip. Production leaves
 # this False: CPU runs dense.
 FLASH_INTERPRET_ON_CPU = False
+
+# Same hook for the fused flash-decode kernel (ops/flash_decode): tier-1
+# exercises the decode-step routing under the Pallas interpreter on CPU;
+# production CPU runs dense, production TPU runs the kernel compiled
+# (cfg.fused_decode, default on; RuntimeConfig.fused_decode opts out).
+FUSED_DECODE_INTERPRET_ON_CPU = False
 
 
 # ---------------------------------------------------------------------------
@@ -248,6 +255,42 @@ def _attention_cached_int8(q: jax.Array, kq, ks, vq, vs,
     return out.astype(q.dtype).reshape(B, S, H * hd)
 
 
+def _fused_decode_ok(cfg: ModelConfig, S: int, fused_ctx) -> bool:
+    """Static routing decision for the fused flash-decode kernel: a single-
+    query decode step, a non-int8 cache, the flag on, and a backend that
+    lowers Pallas (TPU; CPU only under the interpreter test hook)."""
+    return (cfg.fused_decode
+            and not cfg.kv_cache_int8
+            and fused_ctx is not None
+            and S == 1
+            and (jax.default_backend() == "tpu"
+                 or FUSED_DECODE_INTERPRET_ON_CPU))
+
+
+def _attention_cached_flash(q: jax.Array, k: jax.Array, v: jax.Array,
+                            cfg: ModelConfig, fused_ctx) -> jax.Array:
+    """Decode-step attention through the fused Pallas flash-decode kernel
+    (ops/flash_decode): the (B, H, 1, T) score row, fp32 softmax, and
+    probability row stay in VMEM instead of round-tripping HBM between
+    three XLA kernels. Same cache layout (K, T, B, hd), same GQA/MQA
+    grouped contraction against the un-repeated cache, same masking
+    semantics as :func:`_attention_cached` (pinned by tests/
+    test_kernels.py); ALiBi rides per-head slopes + mask-aware key
+    positions exactly like the prefill flash kernel."""
+    from ..ops.flash_decode import flash_decode
+
+    B, S, H, hd = q.shape
+    q_pos, key_mask, key_positions = fused_ctx
+    interpret = (FUSED_DECODE_INTERPRET_ON_CPU
+                 and jax.default_backend() != "tpu")
+    slopes = (alibi_slopes(cfg.n_heads) if cfg.pos_embedding == "alibi"
+              else None)
+    out = flash_decode(q[:, 0], k, v, q_pos, key_mask,
+                       key_positions=key_positions, alibi_slopes=slopes,
+                       interpret=interpret)
+    return out.reshape(B, S, H * hd)
+
+
 def _attention_cached(q: jax.Array, k: jax.Array, v: jax.Array,
                       bias: jax.Array, cfg: ModelConfig) -> jax.Array:
     """Decode-step attention over the CACHE layout (K, T, B, hd).
@@ -276,20 +319,28 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
            bias: jax.Array, cache_kv: Optional[Tuple[jax.Array, jax.Array]],
            cache_index: Optional[jax.Array],
            key_mask: Optional[jax.Array] = None,
-           attn_impl=None):
+           attn_impl=None, fused_ctx=None):
     """One transformer block. Returns (new_x, (k_full, v_full)).
 
     ``attn_impl(q, k, v, key_mask) -> (B, S, H*hd)`` replaces dense
     attention when given (the sequence-parallel path, parallel/seq_forward);
     it owns causality/ALiBi itself, so ``bias`` may be None then.
+    ``fused_ctx`` — a (query positions (B,), cache mask (B, T), cache
+    key positions (B, T)) triple — arms the fused flash-decode route for
+    single-query cache steps (:func:`_fused_decode_ok`); the dense path
+    and its ``bias`` remain the fallback on every other shape/backend.
     """
     B, S, _ = x.shape
     H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h_attn_in = _norm(x, lp["ln1"], cfg)
-    q = _mm(h_attn_in, lp["wq"])
-    k = _mm(h_attn_in, lp["wk"])
-    v = _mm(h_attn_in, lp["wv"])
+    # Dynamic-int8 trees quantize the attention input ONCE for the whole
+    # q/k/v triple (quant.shared_quant) — bit-identical to per-matrix
+    # quantization, two fewer VPU amax/round passes per block.
+    h_qkv = _shared_quant(h_attn_in, lp["wq"], lp["wk"], lp["wv"])
+    q = _mm(h_qkv, lp["wq"])
+    k = _mm(h_qkv, lp["wk"])
+    v = _mm(h_qkv, lp["wv"])
     if cfg.qkv_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(B, S, H, hd)
@@ -321,7 +372,10 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
                                           (0, cache_index, 0, 0))
             cv = lax.dynamic_update_slice(cv, v_t.astype(cv.dtype),
                                           (0, cache_index, 0, 0))
-            attn = _attention_cached(q, ck, cv, bias, cfg)
+            if _fused_decode_ok(cfg, S, fused_ctx):
+                attn = _attention_cached_flash(q, ck, cv, cfg, fused_ctx)
+            else:
+                attn = _attention_cached(q, ck, cv, bias, cfg)
     elif attn_impl is not None:
         # Prefill/forward: hand back this layer's (post-rope) k/v so prefill
         # can fill the cache without re-projecting them.
@@ -340,11 +394,14 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
         x = x + attn
         mlp_in = _norm(x, lp["ln2"], cfg)
 
-    up = _mm(mlp_in, lp["w_up"])
+    # Gated MLPs share one quantized copy of mlp_in across w_up/w_gate.
+    mlp_q = (_shared_quant(mlp_in, lp["w_up"], lp["w_gate"])
+             if cfg.gated_mlp else mlp_in)
+    up = _mm(mlp_q, lp["w_up"])
     if cfg.mlp_bias:
         up = up + lp["b_up"]
     if cfg.gated_mlp:
-        gate = _mm(mlp_in, lp["w_gate"])
+        gate = _mm(mlp_q, lp["w_gate"])
         hidden = _act(gate, cfg.activation) * up
     else:
         hidden = _act(up, cfg.activation)
@@ -419,7 +476,8 @@ def mask_positions(attn_mask: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def _scan_blocks(params: Params, cfg: ModelConfig, x, sin, cos, bias,
-                 cache=None, cache_index=None, key_mask=None, attn_impl=None):
+                 cache=None, cache_index=None, key_mask=None, attn_impl=None,
+                 fused_ctx=None):
     """lax.scan over the stacked layer params."""
     def body(carry, xs):
         h = carry
@@ -429,7 +487,8 @@ def _scan_blocks(params: Params, cfg: ModelConfig, x, sin, cos, bias,
                           key_mask=key_mask, attn_impl=attn_impl)
             return h, None
         lp, (ck, cv) = xs
-        h, (nk, nv) = _block(h, lp, cfg, sin, cos, bias, (ck, cv), cache_index)
+        h, (nk, nv) = _block(h, lp, cfg, sin, cos, bias, (ck, cv),
+                             cache_index, fused_ctx=fused_ctx)
         return h, (nk, nv)
 
     xs = params["layers"] if cache is None else (params["layers"], cache)
@@ -590,7 +649,13 @@ def decode_step(params: Params, cfg: ModelConfig, cache, token: jax.Array,
     key_positions = mask_positions(prompt_mask)
     bias = _causal_bias(jnp.ones((B, 1), jnp.int32), position[:, None], cfg,
                         key_positions=key_positions, key_mask=prompt_mask)
+    # The fused flash-decode route consumes the mask/positions directly
+    # (the kernel owns causality + ALiBi); the bias tensor feeds only the
+    # dense/int8 fallback and is dead code XLA drops when the kernel
+    # engages.
     x, new_cache = _scan_blocks(params, cfg, x, sin, cos, bias,
-                                cache=cache, cache_index=step_index)
+                                cache=cache, cache_index=step_index,
+                                fused_ctx=(position, prompt_mask,
+                                           key_positions))
     logits = _unembed(params, cfg, x)[:, 0, :]
     return logits, new_cache

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import T5Config
-from .quant import QuantTensor, matmul as _mm
+from .quant import QuantTensor, matmul as _mm, shared_quant as _sq
 
 Params = Dict[str, Any]
 
@@ -75,7 +75,11 @@ def _proj(x, w):
 
 def _mlp(x, lp, cfg: T5Config):
     if cfg.gated_mlp:
-        h = jax.nn.gelu(_proj(x, lp["wi_0"]), approximate=True) * _proj(x, lp["wi_1"])
+        # One quantized activation feeds both gate matrices (dynamic int8
+        # trees; quant.shared_quant is a no-op otherwise).
+        xq = _sq(x, lp["wi_0"], lp["wi_1"])
+        h = (jax.nn.gelu(_proj(xq, lp["wi_0"]), approximate=True)
+             * _proj(xq, lp["wi_1"]))
     else:
         h = jax.nn.relu(_proj(x, lp["wi"]))
     return _proj(h, lp["wo_mlp"])
@@ -136,9 +140,10 @@ def encode(params: Params, cfg: T5Config, tokens: jax.Array,
     def body(h, lp):
         a_in = _rmsnorm(h, lp["ln_attn"], cfg.norm_eps)
         B, S, _ = a_in.shape
-        q = _proj(a_in, lp["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
-        kk = _proj(a_in, lp["wk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
-        vv = _proj(a_in, lp["wv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        aq = _sq(a_in, lp["wq"], lp["wk"], lp["wv"])
+        q = _proj(aq, lp["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        kk = _proj(aq, lp["wk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        vv = _proj(aq, lp["wv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
         h = h + _proj(_attn(q, kk, vv, bias), lp["wo"])
         m_in = _rmsnorm(h, lp["ln_mlp"], cfg.norm_eps)
         h = h + _mlp(m_in, lp, cfg)
@@ -169,16 +174,18 @@ def decode(params: Params, cfg: T5Config, enc_out: jax.Array,
 
     def body(h, lp):
         a_in = _rmsnorm(h, lp["ln_attn"], cfg.norm_eps)
-        q = _proj(a_in, lp["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
-        kk = _proj(a_in, lp["wk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
-        vv = _proj(a_in, lp["wv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        aq = _sq(a_in, lp["wq"], lp["wk"], lp["wv"])
+        q = _proj(aq, lp["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        kk = _proj(aq, lp["wk"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        vv = _proj(aq, lp["wv"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
         h = h + _proj(_attn(q, kk, vv, self_bias), lp["wo"])
 
         c_in = _rmsnorm(h, lp["ln_cross"], cfg.norm_eps)
         Te = enc_out.shape[1]
+        eq = _sq(enc_out, lp["ck"], lp["cv"])
         cq = _proj(c_in, lp["cq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
-        ck = _proj(enc_out, lp["ck"]).reshape(B, Te, cfg.n_heads, cfg.head_dim)
-        cv = _proj(enc_out, lp["cv"]).reshape(B, Te, cfg.n_heads, cfg.head_dim)
+        ck = _proj(eq, lp["ck"]).reshape(B, Te, cfg.n_heads, cfg.head_dim)
+        cv = _proj(eq, lp["cv"]).reshape(B, Te, cfg.n_heads, cfg.head_dim)
         h = h + _proj(_attn(cq, ck, cv, cross_bias), lp["co"])
 
         m_in = _rmsnorm(h, lp["ln_mlp"], cfg.norm_eps)

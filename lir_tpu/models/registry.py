@@ -68,6 +68,17 @@ class ModelConfig:
     # steps and non-block-divisible sequences fall back dense.
     use_flash_attention: bool = False
 
+    # Decode attention backend: route single-query KV-cached decode steps
+    # through the fused Pallas flash-decode kernel (ops/flash_decode.py —
+    # K-split online softmax + log-sum-exp combine, scores never leave
+    # VMEM) instead of the dense score-row lowering. Default ON; engages
+    # only where Pallas lowers (TPU; CPU keeps the dense path unless the
+    # interpreter test hook is set) and only for the non-int8 cache (the
+    # int8 cache has its own fused s8-dot path). RuntimeConfig.
+    # fused_decode / --no-fused-decode opt out, restoring the dense
+    # decode path exactly.
+    fused_decode: bool = True
+
     # KV-cache storage: int8 with per-(head, position, row) scales halves
     # cache HBM (the single-chip long-context limiter — a 7B's bf16 cache
     # plus XLA's while-loop copy OOMs v5e at seq 1024, SCALE.md) and

@@ -1,5 +1,30 @@
-"""Custom Pallas TPU kernels for ops where XLA's default lowering is
-memory-bound (SURVEY.md §2.5: none were *required* for reference parity;
-flash attention extends the framework's long-context ceiling)."""
+"""Custom kernels: the single entry point for every attention kernel in
+the framework.
 
-from .flash_attention import flash_attention  # noqa: F401
+- ``flash_attention`` (Pallas): prefill/full-sequence attention without
+  the (S, S) score matrix — O(S * hd) memory, online softmax.
+- ``flash_decode`` (Pallas): fused single-query decode attention over the
+  KV cache — K-split online softmax + log-sum-exp combine, the decode-
+  phase complement of ``flash_attention`` (ROADMAP item 2's MFU floor).
+- ``ring_attention`` / ``ulysses_attention`` (explicit collectives): the
+  multi-chip sequence-parallel kernels, re-exported from
+  parallel/ring_attention.py so kernel consumers import ONE surface;
+  ``reference_attention`` is the dense single-device ground truth every
+  kernel is pinned against in tests.
+
+SURVEY.md §2.5: none were *required* for reference parity; flash
+attention extends the long-context ceiling and flash decode attacks the
+decode-phase MFU plateau.
+"""
+
+from .flash_attention import (  # noqa: F401
+    DEFAULT_BLOCK_K,
+    DEFAULT_BLOCK_Q,
+    flash_attention,
+)
+from .flash_decode import flash_decode, pick_split  # noqa: F401
+from ..parallel.ring_attention import (  # noqa: F401
+    reference_attention,
+    ring_attention,
+    ulysses_attention,
+)

@@ -1,0 +1,179 @@
+"""Pallas fused flash-decode: single-query attention over the KV cache.
+
+The decode phase of the scoring step is where the 36% MFU plateau lives
+(BENCH_r02-r05): each greedy step attends ONE query per row over the whole
+cache, and XLA's dense lowering materializes the (B, H, 1, T) score row,
+the fp32 softmax, and the probability row as separate HBM round-trips
+between three kernels. This kernel is the Flash-Decoding treatment (Dao
+et al.): because the query axis is a single position, parallelism must
+come from the KEY axis — the cache's sequence dimension is split into
+blocks, each grid program reduces its block with an online softmax into a
+partial (o, m, l) triple, and the partials combine with one log-sum-exp
+reduction. Scores, exponentials, and probability-weighted sums never
+leave VMEM; HBM traffic drops to the cache read plus O(B*H*hd) partials.
+
+Layout contract matches the decode path exactly (models/decoder.
+_attention_cached): q is (B, H, hd) — one post-RoPE query per row — and
+k/v arrive in the CACHE layout (K, T, B, hd) (head-major/batch-minor, the
+order the decode while-loop carries), un-repeated for GQA/MQA: grouped
+query heads contract against their kv head inside the kernel, so the
+cache is never copied K -> H. Masking semantics equal the dense path's
+additive bias: a key is valid iff its mask bit is set AND its mask-aware
+position does not exceed the query's; ALiBi families add
+``slope_h * key_position`` exactly as ``decoder._causal_bias`` does.
+
+Block sizes align to the flash_attention edges (DEFAULT_BLOCK_K): the
+split width is the largest divisor of T no wider than the requested
+block (preferring sublane-aligned multiples of 8), falling back to a
+single full-width split — every cache extent the bucket ladder plans
+(bucket + suffix + decode budget) therefore lowers without padding or
+out-of-bounds tail blocks. ``interpret=True`` runs the kernel in the
+Pallas interpreter so tier-1 exercises it on CPU (tests/test_kernels.py);
+production CPU runs keep the dense path (models/decoder.FUSED_DECODE_
+INTERPRET_ON_CPU is the test hook, mirroring FLASH_INTERPRET_ON_CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import DEFAULT_BLOCK_K
+
+
+def pick_split(total: int, want: int = DEFAULT_BLOCK_K) -> int:
+    """Split width for a cache of ``total`` slots: the largest divisor of
+    ``total`` that is <= ``want``, preferring sublane-aligned multiples of
+    8; ``total`` itself (one split) when nothing smaller divides. Exact
+    division — never a padded or out-of-bounds tail block."""
+    want = min(int(want), int(total))
+    for b in range(want, 7, -1):
+        if total % b == 0 and b % 8 == 0:
+            return b
+    for b in range(want, 0, -1):
+        if total % b == 0:
+            return b
+    return int(total)
+
+
+def _decode_kernel(qpos_ref, slope_ref, mask_ref, kpos_ref, q_ref, k_ref,
+                   v_ref, o_ref, m_ref, l_ref, *, sm_scale: float,
+                   alibi: bool, n_groups: int):
+    b = pl.program_id(0)
+    kh = pl.program_id(1)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale        # (G, hd)
+    k = k_ref[0, :, 0, :].astype(jnp.float32)             # (bs, hd)
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (G, bs)
+    kmask = mask_ref[0, 0] > 0                            # (bs,)
+    kp = kpos_ref[0, 0]                                   # (bs,)
+    qp = qpos_ref[b, 0]
+    if alibi:
+        # Per-head slopes for this kv head's query group (h = kh*G + g).
+        slope = slope_ref[pl.ds(kh * n_groups, n_groups), 0]  # (G,)
+        s = s + slope[:, None] * kp.astype(jnp.float32)[None, :]
+    valid = (kmask & (kp <= qp))[None, :]                 # (1, bs)
+    s = jnp.where(valid, s, -jnp.inf)
+
+    m = s.max(axis=-1)                                    # (G,)
+    p = jnp.exp(s - m[:, None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)                # all-masked split
+    o_ref[0, 0, 0] = jnp.dot(p, v, preferred_element_type=jnp.float32)
+    m_ref[0, 0, 0] = m
+    l_ref[0, 0, 0] = p.sum(axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def flash_decode(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    key_mask: jnp.ndarray,
+    key_positions: jnp.ndarray | None = None,
+    alibi_slopes: jnp.ndarray | None = None,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """One decode step of attention, fused. Returns (B, H, hd) in q's dtype.
+
+    ``q``: (B, H, hd) single query per row (post-RoPE). ``k``/``v``:
+    (K, T, B, hd) cache layout, K the kv-head count (un-repeated GQA/MQA).
+    ``q_positions``: (B,) mask-aware query positions. ``key_mask``: (B, T)
+    {0,1} validity over cache slots (any pattern). ``key_positions``:
+    (B, T) mask-aware slot positions (decoder.mask_positions of the cache
+    mask); defaults to the mask's own cumsum. ``alibi_slopes``: optional
+    (H,) per-head slopes (bloom) added as ``slope * key_position``.
+
+    Grid is (B, K, T / split): each program owns one key split in VMEM and
+    emits a partial (o, m, l); the final output is the log-sum-exp
+    combination of the splits — exact attention, any split count.
+    """
+    B, H, hd = q.shape
+    K, T = k.shape[0], k.shape[1]
+    G = H // K
+    sm_scale = 1.0 / np.sqrt(hd)
+    alibi = alibi_slopes is not None
+    if key_positions is None:
+        key_positions = jnp.maximum(jnp.cumsum(key_mask, axis=-1) - 1, 0)
+    key_mask = jnp.asarray(key_mask, jnp.int32)
+    key_positions = jnp.asarray(key_positions, jnp.int32)
+    if alibi_slopes is None:
+        slopes = jnp.zeros((H, 1), jnp.float32)
+    else:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(H, 1)
+
+    split = pick_split(T, block_k)
+    n_splits = T // split
+    qg = q.reshape(B, K, G, hd)
+
+    kernel = functools.partial(_decode_kernel, sm_scale=sm_scale,
+                               alibi=alibi, n_groups=G)
+    f32 = jnp.float32
+    o_p, m_p, l_p = pl.pallas_call(
+        kernel,
+        grid=(B, K, n_splits),
+        in_specs=[
+            # Per-row query position: whole (B, 1) array in SMEM (TPU
+            # lowering wants full-array blocks for tiny scalars — same
+            # pattern as flash_attention's first-valid index).
+            pl.BlockSpec(index_map=lambda b, h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            # Per-head ALiBi slopes, whole (H, 1) array in SMEM.
+            pl.BlockSpec(index_map=lambda b, h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            # Key mask / positions as (B, 1, T): one split per program.
+            pl.BlockSpec((1, 1, split), lambda b, h, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, split), lambda b, h, j: (b, 0, j)),
+            # Query group (1, 1, G, hd); cache splits (1, split, 1, hd).
+            pl.BlockSpec((1, 1, G, hd), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, split, 1, hd), lambda b, h, j: (h, j, b, 0)),
+            pl.BlockSpec((1, split, 1, hd), lambda b, h, j: (h, j, b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, G, hd), lambda b, h, j: (b, h, j, 0, 0)),
+            pl.BlockSpec((1, 1, 1, G), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, 1, G), lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, K, n_splits, G, hd), f32),
+            jax.ShapeDtypeStruct((B, K, n_splits, G), f32),
+            jax.ShapeDtypeStruct((B, K, n_splits, G), f32),
+        ],
+        interpret=interpret,
+    )(q_positions[:, None].astype(jnp.int32), slopes,
+      key_mask[:, None, :], key_positions[:, None, :], qg, k, v)
+
+    # Log-sum-exp combine across splits: renormalize each partial by the
+    # global row max, then sum the weighted accumulators and weights. A
+    # fully-masked split carries m = -inf and weight exactly 0.
+    m = m_p.max(axis=2)                                   # (B, K, G)
+    w = jnp.where(jnp.isfinite(m_p),
+                  jnp.exp(m_p - m[:, :, None, :]), 0.0)   # (B, K, S, G)
+    l = (w * l_p).sum(axis=2)                             # (B, K, G)
+    o = (w[..., None] * o_p).sum(axis=2)                  # (B, K, G, hd)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(B, H, hd).astype(q.dtype)

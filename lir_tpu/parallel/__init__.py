@@ -4,6 +4,15 @@ No hand-written communication code on the tensor-parallel path — sharding
 annotations let XLA emit the ICI collectives (SURVEY.md §5). The explicit
 collectives live in ring_attention.py (ppermute ring, all_to_all Ulysses)
 where the schedule IS the algorithm.
+
+ATTENTION-KERNEL SURFACE: ``lir_tpu.ops`` is the single kernel entry
+point — it re-exports ``reference_attention`` / ``ring_attention`` /
+``ulysses_attention`` alongside the Pallas ``flash_attention`` and
+``flash_decode`` kernels. The re-exports below remain for backward
+compatibility with existing ``lir_tpu.parallel`` importers; new code
+should import kernels from ``lir_tpu.ops`` and keep this package for
+the mesh/sharding machinery (sharding, seq_forward, multihost,
+pipeline).
 """
 
 from . import sharding  # noqa: F401

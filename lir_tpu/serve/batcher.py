@@ -85,6 +85,10 @@ class ContinuousBatcher:
         self.early_stop = (rt.sweep_early_stop
                            and not rt.sweep_full_completions)
         self.decode_cost = self.new_tokens + self.conf_tokens
+        # Price dispatches with the engine's kernel mode: the decode
+        # floor constant differs between the fused flash-decode kernels
+        # and the dense fallback (scheduler.decode_token_cost).
+        self.fused_decode = bool(getattr(rt, "fused_decode", True))
         self._queues: Dict[int, Deque[Pending]] = {
             int(b): deque() for b in engine.buckets}
 
@@ -147,7 +151,8 @@ class ContinuousBatcher:
                           if self.prefix_cache else 0)
                 per_row = sched_mod.bucket_cost(
                     self._dispatch_rows(n), edge, self.batch,
-                    self.decode_cost, cached_tokens=cached) / n
+                    self.decode_cost, cached_tokens=cached,
+                    fused_decode=self.fused_decode) / n
                 return per_row, q[0].t_submit
 
             edge = min(ripe, key=price)
@@ -159,7 +164,8 @@ class ContinuousBatcher:
                 if (nxt is not None and self._queues[nxt]
                         and n * nxt < sched_mod.bucket_cost(
                             self._dispatch_rows(n), edge, self.batch,
-                            self.decode_cost)):
+                            self.decode_cost,
+                            fused_decode=self.fused_decode)):
                     promoted = [q.popleft() for _ in range(n)]
                     for p in reversed(promoted):
                         self._queues[nxt].appendleft(p)

@@ -306,7 +306,8 @@ class ScoringServer:
         if wd is not None and wd.enabled:
             cost = sched_mod.bucket_cost(
                 len(rows), bucket, self.engine.rt.batch_size,
-                self.batcher.decode_cost)
+                self.batcher.decode_cost,
+                fused_decode=self.batcher.fused_decode)
             dispatch_call = lambda: wd.watch(  # noqa: E731
                 call, cost=cost, site="serve",
                 on_tick=self._cancel_expired_inflight)

@@ -285,6 +285,55 @@ class CompileStats:
 
 
 @dataclasses.dataclass
+class KernelStats:
+    """Per-phase kernel accounting for the isolated scoring step, plus
+    the piggyback-chain counters (ROADMAP item 2: make the MFU plateau
+    measurable per COMPONENT, not just in aggregate).
+
+    ``phases`` — filled by bench.py's kernel mode: for each of
+    "prefill" (quadratic prompt pass), "decode" (KV-cached greedy scan),
+    and "readout" (lm_head + position-0 extras), the measured seconds,
+    the analytic matmul TFLOPs executed (scoring_step_flops_split), the
+    implied TFLOPS, and — when the chip's peak is known — the phase MFU
+    and its complement, the MXU-idle fraction. The decode row is where
+    the 36% plateau lived; the fused flash-decode kernel and int8
+    matmul fusion attack exactly that row.
+
+    ``counters`` — engine-side chunked-prefill/decode piggybacking:
+    chains opened, piggybacked steps (dispatches whose decode scans rode
+    the next prefill call), drains, and plain-path fallbacks.
+    """
+
+    phases: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
+    counters: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def record_phase(self, name: str, seconds: float, flops: float,
+                     peak: Optional[float] = None) -> None:
+        entry: Dict[str, float] = {
+            "seconds": round(seconds, 6),
+            "tflops_executed": round(flops / 1e12, 4),
+            "implied_tflops": (round(flops / seconds / 1e12, 3)
+                               if seconds > 0 else 0.0),
+        }
+        if peak and seconds > 0:
+            mfu = flops / seconds / peak
+            entry["mfu"] = round(mfu, 4)
+            entry["mxu_idle_frac"] = round(1.0 - mfu, 4)
+        self.phases[name] = entry
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def summary(self) -> Dict[str, object]:
+        out: Dict[str, object] = {k: dict(v) for k, v in
+                                  sorted(self.phases.items())}
+        if self.counters:
+            out["piggyback"] = dict(sorted(self.counters.items()))
+        return out
+
+
+@dataclasses.dataclass
 class ServeStats:
     """Online serving counters (lir_tpu/serve): the operator's one-look
     view of queue health, admission control, dedup effectiveness, and
@@ -693,22 +742,37 @@ def decoder_matmul_params(cfg) -> int:
     return cfg.n_layers * per_layer + D * cfg.vocab_size  # + lm_head
 
 
-def scoring_step_flops(cfg, batch: int, seq: int, new_tokens: int) -> float:
-    """Total matmul FLOPs (2 per MAC) of one fused scoring step: prefill of
-    (batch, seq) + `new_tokens` KV-cached greedy decode steps. The lm_head
-    runs once at the prefill's last position and once per decode step
-    (decoder.prefill/_unembed). Attention score/value matmuls included."""
+def scoring_step_flops_split(cfg, batch: int, seq: int,
+                             new_tokens: int) -> Dict[str, float]:
+    """Matmul FLOPs (2 per MAC) of one fused scoring step, itemized by
+    PHASE (the KernelStats breakdown): "prefill" — the quadratic prompt
+    pass through the layer stack; "decode" — `new_tokens` KV-cached
+    greedy steps through the layers (attention over the growing cache
+    included); "readout" — the lm_head at the prefill's last position
+    and once per decode step (decoder.prefill/_unembed). Sums to
+    :func:`scoring_step_flops` exactly."""
     D, hd = cfg.hidden_size, cfg.head_dim
     H, L, V = cfg.n_heads, cfg.n_layers, cfg.vocab_size
     p_layers = decoder_matmul_params(cfg) - D * V
     head = 2 * D * V * batch
-    prefill = 2 * p_layers * batch * seq + head
+    prefill = 2 * p_layers * batch * seq
     prefill += 4 * batch * H * seq * seq * hd * L      # scores + weighted sum
     decode = 0.0
     for t in range(new_tokens):
-        decode += 2 * p_layers * batch + head
+        decode += 2 * p_layers * batch
         decode += 4 * batch * H * (seq + t + 1) * hd * L
-    return float(prefill + decode)
+    return {"prefill": float(prefill), "decode": float(decode),
+            "readout": float(head * (1 + new_tokens))}
+
+
+def scoring_step_flops(cfg, batch: int, seq: int, new_tokens: int) -> float:
+    """Total matmul FLOPs (2 per MAC) of one fused scoring step: prefill of
+    (batch, seq) + `new_tokens` KV-cached greedy decode steps. The lm_head
+    runs once at the prefill's last position and once per decode step
+    (decoder.prefill/_unembed). Attention score/value matmuls included.
+    See :func:`scoring_step_flops_split` for the per-phase breakdown."""
+    return float(sum(scoring_step_flops_split(
+        cfg, batch, seq, new_tokens).values()))
 
 
 @contextlib.contextmanager

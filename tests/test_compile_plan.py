@@ -177,13 +177,15 @@ def _grid(n_cells, words_each=12, seed=5):
 
 
 def test_same_shape_dispatches_reuse_one_executable(tmp_path):
-    """12 equal-length cells at batch 4 = 3 dispatches of one shape: the
-    registry compiles exactly two executables (fresh + donated handoff
-    variants) and serves every dispatch — zero lazy misses."""
+    """12 equal-length cells at batch 4 = 3 dispatches of one shape: with
+    piggybacking OFF the registry compiles exactly two executables (fresh
+    + donated handoff variants) and serves every dispatch — zero lazy
+    misses."""
     from lir_tpu.engine.sweep import run_perturbation_sweep
 
     compile_plan.exec_cache_clear()  # order-independence: force compiles
-    engine = _tiny_engine(RuntimeConfig(batch_size=4, max_seq_len=256))
+    engine = _tiny_engine(RuntimeConfig(batch_size=4, max_seq_len=256,
+                                        piggyback_prefill=False))
     lp, perts = _grid(12)
     rows = run_perturbation_sweep(engine, "cp", lp, perts,
                                   tmp_path / "r.xlsx",
@@ -197,6 +199,32 @@ def test_same_shape_dispatches_reuse_one_executable(tmp_path):
     assert all(t > 0 for t in engine.compile_stats.shapes.values())
     # Registry is namespaced by the engine's manifest key.
     assert reg.manifest_key == engine.cache_manifest_key
+
+
+def test_piggyback_chain_runs_precompiled(tmp_path):
+    """With piggybacking ON (the default), the same 3-dispatch plan chains
+    through the piggyback executables: the plan additionally covers the
+    opener/step/drain stages, every chain call is served by the registry,
+    and nothing falls back to lazy jit."""
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+
+    compile_plan.exec_cache_clear()
+    engine = _tiny_engine(RuntimeConfig(batch_size=4, max_seq_len=256))
+    lp, perts = _grid(12)
+    rows = run_perturbation_sweep(engine, "cp-piggy", lp, perts,
+                                  tmp_path / "r.xlsx",
+                                  checkpoint_every=100)
+    assert len(rows) == 12
+    reg = engine.exec_registry
+    # 2 plain (fresh + donated, kept for the recovery fallback) + the
+    # piggyback chain's 3 stages.
+    assert reg is not None and len(reg) == 5
+    kinds = {s.kind for s in reg._futures}
+    assert {"piggy_prefill", "piggy_step", "piggy_drain"} <= kinds
+    # opener + 2 steps + drain, all registry-served.
+    assert engine.compile_stats.aot_hits == 4
+    assert engine.compile_stats.lazy_misses == 0
+    assert engine.kernel_stats.counters.get("piggybacked_steps") == 2
 
 
 def test_engines_with_different_configs_get_different_manifest_keys():

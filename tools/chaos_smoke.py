@@ -66,8 +66,16 @@ def _make_engine(batch=BATCH, seed=11):
                       hidden_size=32, n_layers=1, n_heads=2,
                       intermediate_size=64, max_seq_len=256)
     params = decoder.init_params(cfg, jax.random.PRNGKey(seed))
+    # piggyback OFF: chaos scenarios compare fault-injected passes
+    # BITWISE against fault-free passes, and fault wrapping disables the
+    # piggyback chain by design (it must not bypass the injected
+    # dispatch sites) — so both sides of every comparison here must run
+    # the plain path. Piggyback-vs-plain parity has its own gate
+    # (make kernel-smoke; float-tolerance, not bitwise — the chain's
+    # cache extent reassociates reductions by a few ulps).
     return ScoringEngine(params, cfg, FakeTokenizer(),
-                         RuntimeConfig(batch_size=batch, max_seq_len=256))
+                         RuntimeConfig(batch_size=batch, max_seq_len=256,
+                                       piggyback_prefill=False))
 
 
 def _grid(n_cells, seed=21):
@@ -350,10 +358,15 @@ def guard_chaos(failures):
     # One engine for both passes: the clean sweep calibrates the
     # watchdog, so the chaos pass runs under tight, price-model-derived
     # deadlines with no hand tuning.
+    # piggyback OFF: the clean pass must run the same (plain) path the
+    # fault-wrapped chaos pass runs, or the bitwise clean-vs-chaos
+    # comparison measures the chain's ulp-level reduction drift instead
+    # of recovery correctness (see _engine above).
     engine = ScoringEngine(params, cfg, FakeTokenizer(),
                            RuntimeConfig(batch_size=BATCH, max_seq_len=256,
                                          watchdog_multiple=2.0,
-                                         watchdog_floor_s=0.2))
+                                         watchdog_floor_s=0.2,
+                                         piggyback_prefill=False))
     lp, perts = _grid(N_CELLS)
     with tempfile.TemporaryDirectory() as td:
         td = Path(td)

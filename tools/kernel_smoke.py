@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Kernel smoke (`make kernel-smoke`): prove the PR-7 fused kernel layer
+on CPU, no chip needed.
+
+Asserts, under Pallas interpret mode where a kernel is involved:
+1. flash_decode == dense decode attention (argmax exact through a greedy
+   loop, logits within tolerance) across masked/padded rows, GQA, ALiBi,
+   and non-power-of-two cache extents;
+2. int8 fused matmul == the dequantized reference for static AND dynamic
+   QuantTensors, with quant.shared_quant bit-identical to per-matrix
+   activation quantization;
+3. a piggybacked dispatch chain == the sequential dispatches per row, and
+   an actual sweep on the fake backend chains (counters move) with rows
+   identical to the piggyback-off sweep.
+
+Exit 0 = all parity holds; any assertion failure is a real regression in
+the fused paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import jax                  # noqa: E402
+import jax.numpy as jnp     # noqa: E402
+import numpy as np          # noqa: E402
+
+
+def check_flash_decode() -> None:
+    from lir_tpu.engine import generate
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="ksmoke", vocab_size=256, hidden_size=32,
+                      n_layers=2, n_heads=4, n_kv_heads=2,
+                      intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(3, 256, (3, 14)), jnp.int32)
+    mask = np.ones((3, 14), np.int32)
+    mask[0, :6] = 0
+    mask = jnp.asarray(mask)
+    gen_d, lg_d = generate.greedy_decode(
+        params, dataclasses.replace(cfg, fused_decode=False), toks, mask,
+        max_new_tokens=6)
+    old = decoder.FUSED_DECODE_INTERPRET_ON_CPU
+    decoder.FUSED_DECODE_INTERPRET_ON_CPU = True
+    try:
+        gen_f, lg_f = generate.greedy_decode(params, cfg, toks, mask,
+                                             max_new_tokens=6)
+    finally:
+        decoder.FUSED_DECODE_INTERPRET_ON_CPU = old
+    assert (np.asarray(gen_d) == np.asarray(gen_f)).all(), \
+        "fused decode changed the greedy argmax"
+    err = float(jnp.abs(lg_d - lg_f).max())
+    assert err < 2e-5, f"fused decode logits drifted: {err}"
+    print(f"  flash-decode greedy parity: argmax exact, "
+          f"logits max err {err:.2e}")
+
+
+def check_int8_fusion() -> None:
+    from lir_tpu.models import quant
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(5, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 48)), jnp.float32)
+    qt = quant.quantize(w)
+    np.testing.assert_allclose(np.asarray(quant.matmul(x, qt)),
+                               np.asarray(x @ qt.dequant()),
+                               rtol=1e-5, atol=1e-5)
+    qd = dataclasses.replace(qt, dynamic=True)
+    xq, xs = quant.dynamic_quant(x)
+    ref = ((np.asarray(xq, np.float32) * np.asarray(xs)[:, None])
+           @ np.asarray(qd.dequant()))
+    np.testing.assert_allclose(np.asarray(quant.matmul(x, qd)), ref,
+                               rtol=1e-5, atol=1e-5)
+    shared = quant.shared_quant(x, qd, qd)
+    np.testing.assert_array_equal(np.asarray(quant.matmul(shared, qd)),
+                                  np.asarray(quant.matmul(x, qd)))
+    print("  int8 fused matmul parity: static + dynamic + shared-quant ok")
+
+
+def check_piggyback() -> None:
+    import torch
+    import transformers as tf
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.models.loader import config_from_hf, convert_decoder
+
+    torch.manual_seed(0)
+    hf = tf.LlamaForCausalLM(tf.LlamaConfig(
+        vocab_size=FakeTokenizer.VOCAB, hidden_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        intermediate_size=128, max_position_embeddings=512,
+        tie_word_embeddings=False)).eval()
+    cfg, fam = config_from_hf(hf.config)
+    params = convert_decoder(hf.state_dict(), cfg, fam)
+    prompts = (LegalPrompt(
+        main="Does a vehicle include a bicycle ?",
+        response_format="Answer Covered or Not .",
+        target_tokens=("Covered", "Not"),
+        confidence_format="Give a number from 0 to 100 ."),)
+    perts = ([f"Would a bicycle number {i} count as a vehicle maybe ?"
+              for i in range(11)],)
+
+    def run(piggy, td):
+        rt = RuntimeConfig(batch_size=4, max_new_tokens=8, max_seq_len=256,
+                           piggyback_prefill=piggy, sweep_group_min_cells=0)
+        eng = ScoringEngine(params, cfg, FakeTokenizer(), rt)
+        rows = run_perturbation_sweep(eng, "ksmoke", prompts, perts,
+                                      Path(td) / "r.xlsx",
+                                      checkpoint_every=100)
+        return rows, eng
+
+    with tempfile.TemporaryDirectory() as td:
+        rows_on, eng_on = run(True, td)
+    with tempfile.TemporaryDirectory() as td:
+        rows_off, _ = run(False, td)
+    c = eng_on.kernel_stats.counters
+    assert c.get("chains_opened", 0) >= 1, c
+    assert c.get("piggybacked_steps", 0) >= 1, c
+    assert c.get("chains_drained", 0) >= 1, c
+    key = lambda r: r.rephrased_main  # noqa: E731
+    for a, b in zip(sorted(rows_on, key=key), sorted(rows_off, key=key)):
+        assert a.model_response == b.model_response
+        assert a.confidence_value == b.confidence_value
+        assert abs(a.token_1_prob - b.token_1_prob) < 1e-5
+        assert abs(a.weighted_confidence - b.weighted_confidence) < 1e-4
+    print(f"  piggyback chain: {c.get('piggybacked_steps')} piggybacked "
+          f"steps, rows identical to the sequential sweep")
+
+
+def main() -> int:
+    print("kernel smoke: fused paths vs their references (CPU interpret)")
+    check_flash_decode()
+    check_int8_fusion()
+    check_piggyback()
+    print("kernel smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
