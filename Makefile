@@ -2,11 +2,19 @@
 # ROADMAP tier-1 suite and fails if the pass count drops below the
 # recorded floor (tools/check_tier1.py — the floor lives there).
 
-.PHONY: verify test bench serve-smoke prefix-smoke chaos-smoke \
+.PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
 	kernel-smoke install-hooks
 
-verify:
+verify: lint
 	python tools/check_tier1.py
+
+# graft-lint: AST static analysis proving the engine's JAX/XLA
+# invariants — donation-safety, trace-hazard, host-sync,
+# lock-discipline, config-drift (lir_tpu/lint, DEPLOY.md §1i). Fails on
+# any finding outside tools/lint_baseline.json; runs in ~2 s with no
+# jax import, so it gates verify and the pre-push hook first.
+lint:
+	python -m lir_tpu.lint
 
 # The raw tier-1 suite without the floor gate (interactive debugging).
 test:
@@ -54,8 +62,10 @@ chaos-smoke:
 kernel-smoke:
 	JAX_PLATFORMS=cpu python tools/kernel_smoke.py
 
-# Run the tier-1 guard automatically before every `git push`.
+# Run graft-lint (seconds) then the tier-1 guard before every
+# `git push` — lint first so an invariant break fails in two seconds,
+# not after the full suite.
 install-hooks:
-	printf '#!/bin/sh\nexec python tools/check_tier1.py\n' > .git/hooks/pre-push
+	printf '#!/bin/sh\npython -m lir_tpu.lint || exit 1\nexec python tools/check_tier1.py\n' > .git/hooks/pre-push
 	chmod +x .git/hooks/pre-push
-	@echo "pre-push hook installed: tier-1 guard runs before every push"
+	@echo "pre-push hook installed: graft-lint + tier-1 guard run before every push"

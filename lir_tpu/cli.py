@@ -12,6 +12,7 @@ experiment and analysis is one subcommand of ``python -m lir_tpu``:
   survey       human-survey pipeline -> every survey JSON artifact
   bench        the prompts/sec/chip benchmark (end-to-end sweep path)
   precompile   warm the persistent compile cache for a model/ladder
+  lint         graft-lint static analysis (JAX/XLA invariants, seconds)
   concat-shards  merge per-host .hostN sweep shards into the final artifact
 
 Every command runs with the persistent XLA compilation cache ON (compiled
@@ -137,6 +138,7 @@ def _add_perturb(sub) -> None:
                         "on one engine resume shared prefixes from the "
                         "page pool, bitwise-identical results")
     _add_prefix_pool_flags(p)
+    _add_engine_tuning_flags(p)
     _add_guard_flags(p)
     _add_kernel_flags(p)
     p.add_argument("--barrier-timeout", type=float, default=None,
@@ -170,6 +172,58 @@ def _prefix_rt_kw(args, rt_kw: dict) -> None:
         rt_kw["prefix_cache_pages"] = args.prefix_cache_pages
     if getattr(args, "prefix_page_size", None) is not None:
         rt_kw["prefix_page_size"] = args.prefix_page_size
+
+
+def _add_engine_tuning_flags(p) -> None:
+    """Engine-shape knobs (RuntimeConfig) shared by perturb and serve —
+    surfaced so no config field needs a source edit to change
+    (lint/configdrift.py enforces the coverage)."""
+    p.add_argument("--max-seq-len", type=_positive_int, default=None,
+                   help="prompt-length ceiling in tokens (default 1024): "
+                        "tops the bucket ladder and sizes every KV "
+                        "cache; legal prompt + format is ≲700 tokens")
+    p.add_argument("--max-new-tokens", type=_positive_int, default=None,
+                   help="full-completion decode budget (default 50; the "
+                        "short sweep budgets are --sweep-decode-tokens/"
+                        "--sweep-confidence-tokens — this one gates "
+                        "--full-completions text parity and rephrasing)")
+    p.add_argument("--no-ragged-scheduler", action="store_true",
+                   help="disable the ragged bucket-ladder scheduler and "
+                        "restore legacy todo-order batching (every "
+                        "mixed-length batch pads to its longest row — "
+                        "the bench's single-bucket baseline; results "
+                        "identical per cell)")
+    p.add_argument("--sweep-group-min-prefix", type=_positive_int,
+                   default=None,
+                   help="cross-cell prefix grouping: minimum shared "
+                        "leading tokens (default 16; see DEPLOY.md §1b)")
+    p.add_argument("--sweep-group-min-cells", type=int, default=None,
+                   help="cross-cell prefix grouping: minimum cells per "
+                        "group (default 4; 0 disables grouping)")
+    p.add_argument("--no-aot-precompile", action="store_true",
+                   help="disable background AOT precompilation of the "
+                        "planned dispatch shapes (every shape then pays "
+                        "lazy trace-on-first-call inside the sweep)")
+    p.add_argument("--precompile-workers", type=int, default=None,
+                   help="AOT precompile thread count (default 0 = one "
+                        "per CPU core, capped at the shape count)")
+
+
+def _engine_rt_kw(args, rt_kw: dict) -> None:
+    if getattr(args, "max_seq_len", None) is not None:
+        rt_kw["max_seq_len"] = args.max_seq_len
+    if getattr(args, "max_new_tokens", None) is not None:
+        rt_kw["max_new_tokens"] = args.max_new_tokens
+    if getattr(args, "no_ragged_scheduler", False):
+        rt_kw["ragged_scheduler"] = False
+    if getattr(args, "sweep_group_min_prefix", None) is not None:
+        rt_kw["sweep_group_min_prefix"] = args.sweep_group_min_prefix
+    if getattr(args, "sweep_group_min_cells", None) is not None:
+        rt_kw["sweep_group_min_cells"] = args.sweep_group_min_cells
+    if getattr(args, "no_aot_precompile", False):
+        rt_kw["aot_precompile"] = False
+    if getattr(args, "precompile_workers", None) is not None:
+        rt_kw["precompile_workers"] = args.precompile_workers
 
 
 def _add_kernel_flags(p) -> None:
@@ -297,7 +351,21 @@ def _add_serve(sub) -> None:
                         "prefill only for their unshared suffix, results "
                         "bitwise-identical; OFF restores PR-3 exact-"
                         "match dedup only)")
+    p.add_argument("--no-pad-full", action="store_true",
+                   help="pad serve dispatches to the offline sweep's "
+                        "power-of-two tail instead of the full batch "
+                        "(saves tail FLOPs, costs extra executables and "
+                        "slow tiny-batch programs — DEPLOY.md §1d)")
+    p.add_argument("--no-degrade-ladder", action="store_true",
+                   help="on a dispatch that exhausts its retries, error "
+                        "the whole batch instead of degrading to lazy "
+                        "jit and bisecting out poison rows")
+    p.add_argument("--max-consecutive-failures", type=_positive_int,
+                   default=None,
+                   help="full dispatch failures in a row before the "
+                        "circuit breaker opens (default 3)")
     _add_prefix_pool_flags(p)
+    _add_engine_tuning_flags(p)
     _add_guard_flags(p)
     _add_kernel_flags(p)
 
@@ -341,6 +409,26 @@ def _add_repro(sub) -> None:
     p.add_argument("--out", type=Path, default=Path("results/repro"))
     p.add_argument("--quick", action="store_true")
     p.add_argument("--no-figures", action="store_true")
+
+
+def _add_lint(sub) -> None:
+    from .lint import cli as lint_cli
+
+    p = sub.add_parser(
+        "lint",
+        help="graft-lint: AST static analysis proving the engine's "
+             "JAX/XLA invariants — donation-safety, trace-hazard, "
+             "host-sync, lock-discipline, config-drift. Zero new "
+             "findings outside tools/lint_baseline.json or exit 1 "
+             "(DEPLOY.md §1i). Runs in seconds; wired into `make "
+             "verify` and the pre-push hook.")
+    lint_cli.build_parser(p)
+
+
+def cmd_lint(args) -> None:
+    from .lint import cli as lint_cli
+
+    sys.exit(lint_cli.run(args))
 
 
 def _add_survey(sub) -> None:
@@ -426,6 +514,7 @@ def cmd_perturb(args) -> None:
         rt_kw["sweep_decode_tokens"] = args.sweep_decode_tokens
     if args.sweep_confidence_tokens is not None:
         rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
+    _engine_rt_kw(args, rt_kw)
     _guard_rt_kw(args, rt_kw)
     _kernel_rt_kw(args, rt_kw)
     _prefix_rt_kw(args, rt_kw)
@@ -463,6 +552,7 @@ def cmd_serve(args) -> None:
         rt_kw["sweep_decode_tokens"] = args.sweep_decode_tokens
     if args.sweep_confidence_tokens is not None:
         rt_kw["sweep_confidence_tokens"] = args.sweep_confidence_tokens
+    _engine_rt_kw(args, rt_kw)
     _guard_rt_kw(args, rt_kw)
     _kernel_rt_kw(args, rt_kw)
     _prefix_rt_kw(args, rt_kw)
@@ -475,12 +565,17 @@ def cmd_serve(args) -> None:
             sep = ""
         if not sep or not name:
             raise SystemExit(f"--deadline {spec!r} must be CLASS=SECONDS")
+    serve_kw = {}
+    if args.max_consecutive_failures is not None:
+        serve_kw["max_consecutive_failures"] = args.max_consecutive_failures
     serve_cfg = ServeConfig(
         queue_depth=args.queue_depth, classes=tuple(classes.items()),
         linger_s=args.linger_ms / 1000.0,
         cache_entries=args.cache_entries,
         breaker_cooldown_s=args.breaker_cooldown,
-        prefix_cache=not args.no_prefix_cache)
+        prefix_cache=not args.no_prefix_cache,
+        pad_full=not args.no_pad_full,
+        degrade_ladder=not args.no_degrade_ladder, **serve_kw)
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
         cache_root=args.param_cache, quantize_int8=args.int8,
@@ -785,6 +880,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     _add_analyze(sub)
     _add_repro(sub)
     _add_survey(sub)
+    _add_lint(sub)
     bench_p = sub.add_parser(
         "bench", help="prompts/sec/chip benchmark (end-to-end sweep path); "
                       "unrecognized flags are forwarded to bench.py "
@@ -825,7 +921,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     if getattr(args, "int8_dynamic", False) and not getattr(args, "int8", False):
         parser.error("--int8-dynamic requires --int8 (it selects HOW int8 "
                      "matmuls run, not whether weights are quantized)")
-    if not args.no_compile_cache:
+    if not args.no_compile_cache and args.command != "lint":
+        # lint is pure host-side ast analysis — never touch jax (the
+        # pre-push hook runs it in containers without an accelerator).
         from .utils import compile_cache
 
         compile_cache.enable_persistent_cache(args.compile_cache_dir)
@@ -838,6 +936,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         "analyze": cmd_analyze,
         "repro": cmd_repro,
         "survey": cmd_survey,
+        "lint": cmd_lint,
         "bench": cmd_bench,
         "concat-shards": cmd_concat_shards,
     }[args.command](args)

@@ -107,14 +107,14 @@ class RuntimeConfig:
     # consume precompiled executables instead of paying trace-on-first-
     # call serially inside the sweep. 0 workers = one per CPU core
     # (capped at the shape count). OFF restores lazy per-shape jit.
-    aot_precompile: bool = True
-    precompile_workers: int = 0
+    aot_precompile: bool = True       # host-only (plan policy, not shapes)
+    precompile_workers: int = 0       # host-only
     # Persistent XLA compilation cache (utils/compile_cache.py): compiled
     # executables survive process restarts, so a restarted worker / model
     # swap / autoscale event deserializes instead of recompiling. None
     # resolves $LIR_TPU_COMPILE_CACHE then ~/.cache/lir_tpu/xla; the CLI
     # and bench enable it by default (--no-compile-cache opts out).
-    compile_cache_dir: Optional[str] = None
+    compile_cache_dir: Optional[str] = None   # host-only
 
     # Cross-request radix prefix cache over the paged KV allocator
     # (models/paged.py + engine/prefix_tree.py). ON: the engine keeps a
@@ -175,20 +175,20 @@ class RuntimeConfig:
     # legitimate cold compile must never be shot. The same deadline
     # (floor * multiple) bounds how long a dispatch waits on a
     # background AOT compile before falling back to lazy jit.
-    watchdog_multiple: float = 20.0
-    watchdog_floor_s: float = 30.0
+    watchdog_multiple: float = 20.0   # host-only (deadline policy)
+    watchdog_floor_s: float = 30.0    # host-only; cli: --watchdog-floor
     # Numerics guard — validate every row's readouts at score-extraction
     # time (probs finite and in [0,1], P(Yes)+P(No) <= 1, weighted
     # confidence in [0,100], logprob map NaN-free) and quarantine
     # offenders as error:numerics instead of writing garbage
     # (guard/numerics.py).
-    numerics_guard: bool = True
+    numerics_guard: bool = True       # host-only (validates host readouts)
     # Multihost liveness — sweep shard boundaries run a heartbeat
     # allgather + barrier bounded by this timeout; a dead peer host
     # then raises HostDesyncError on the survivors (manifest already
     # flushed -> resumable) instead of parking them in ICI/DCN forever
     # (parallel/multihost.py). <= 0 restores unbounded barriers.
-    barrier_timeout_s: float = 900.0
+    barrier_timeout_s: float = 900.0  # host-only; cli: --barrier-timeout
 
 
 @dataclasses.dataclass(frozen=True)
@@ -293,7 +293,9 @@ class ServeConfig:
     prefix_cache: bool = True
     classes: Tuple[Tuple[str, float], ...] = (
         ("interactive", 10.0), ("batch", 300.0))
-    default_class: str = "batch"
+    # Fallback CLASS name for unknown request classes — set through
+    # --deadline CLASS=SECS entries, not a flag of its own.
+    default_class: str = "batch"    # lint: allow(config-drift)
     linger_s: float = 0.02
     # Pad every dispatch to the FULL configured batch instead of the
     # offline sweep's power-of-two tail: serving wants shape stability
@@ -309,7 +311,10 @@ class ServeConfig:
     max_consecutive_failures: int = 3
     breaker_cooldown_s: float = 30.0
     degrade_ladder: bool = True
-    retry: RetryConfig = dataclasses.field(default_factory=lambda: RetryConfig(
+    # Composite policy object (utils/retry.RetryConfig): tuned in code
+    # next to the failure-domain story, not flag-by-flag.
+    retry: RetryConfig = dataclasses.field(  # lint: allow(config-drift)
+        default_factory=lambda: RetryConfig(
         max_retries=2, initial_delay=0.25, max_delay=2.0,
         backoff_factor=2.0, full_jitter=True, max_elapsed=8.0))
 

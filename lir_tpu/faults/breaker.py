@@ -50,17 +50,16 @@ class CircuitBreaker:
         self.cooldown_s = float(cooldown_s)
         self.clock = clock
         self.stats = stats if stats is not None else FaultStats()
-        self._state = CLOSED
-        self._consecutive = 0
-        self._opened_at: Optional[float] = None
+        self._state = CLOSED                      # guarded-by: _lock
+        self._consecutive = 0                     # guarded-by: _lock
+        self._opened_at: Optional[float] = None   # guarded-by: _lock
         self._lock = threading.Lock()
 
-    def _transition(self, to: str) -> None:
-        # lock held by caller
+    def _transition(self, to: str) -> None:  # guarded-by: _lock
         frm, self._state = self._state, to
         self.stats.transition(frm, to)
 
-    def _promote_locked(self) -> None:
+    def _promote_locked(self) -> None:  # guarded-by: _lock
         """OPEN -> HALF_OPEN once the cooldown has elapsed."""
         if (self._state == OPEN and self._opened_at is not None
                 and self.clock() - self._opened_at >= self.cooldown_s):

@@ -158,8 +158,11 @@ class DispatchWatchdog:
 
             seed_headroom = _sched.watchdog_seed_headroom()
         self.seed_headroom = max(float(seed_headroom), 1.0)
-        self._rate: Optional[float] = None      # EWMA s per cost unit
-        self._flat: Optional[float] = None      # EWMA s per dispatch
+        # Calibration EWMAs: observed from every dispatching thread
+        # (sweep main thread, serve supervisor, AOT-wait paths), so
+        # mutations hold the lock (enforced by lint/locks.py).
+        self._rate: Optional[float] = None   # guarded-by: _lock
+        self._flat: Optional[float] = None   # guarded-by: _lock
         self._lock = threading.Lock()
 
     @property

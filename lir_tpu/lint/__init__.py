@@ -1,0 +1,45 @@
+"""graft-lint: in-tree static analysis proving the engine's JAX/XLA
+invariants at review time.
+
+Seven PRs of perf and robustness work (ragged scheduler, donated KV
+handoff chains, paged prefix cache, fused Pallas decode, piggyback
+chaining, the threaded serve/guard layer) piled up invariants that
+nothing checked until a TPU run silently retraced, double-freed a
+donated buffer, or deadlocked the batcher. The guard layer (PR 5)
+catches those at RUNTIME; this package holds the line STATICALLY — five
+AST passes (stdlib ``ast`` only, zero heavy imports, runs in well under
+ten seconds) wired into ``lir_tpu lint``, ``make lint``, ``make
+verify`` and the pre-push hook:
+
+- **donation-safety** (lint/donation.py): any binding passed through a
+  ``donate_argnames``/``donate_argnums`` call site and READ afterwards
+  in the same function is a use-after-donate — the XLA buffer behind it
+  is dead the moment the donating call dispatches.
+- **trace-hazard** (lint/trace.py): inside functions reachable from
+  ``jit``/``pjit``/``pallas_call`` tracing, python branching on traced
+  values, ``int()``/``bool()``/``float()``/``.item()`` coercions, and
+  unordered-collection iteration feeding pytree construction — the
+  retrace / ConcretizationError / multihost-desync hazards.
+- **host-sync** (lint/hostsync.py): implicit device→host transfers
+  (``np.asarray``, ``.tolist()``, ``.item()``, truthiness, scalar
+  coercion) in the hot-path modules (``engine/``, ``ops/``,
+  ``serve/batcher.py``); legitimate readout boundaries are marked with
+  the ``@host_readout`` decorator (utils/annotations.py) or a
+  ``# lint: allow(host-sync)`` comment.
+- **lock-discipline** (lint/locks.py): an attribute annotated
+  ``# guarded-by: <lock>`` may only be mutated inside ``with
+  self.<lock>:`` (or from a method annotated as running with the lock
+  already held) — the batcher/queue state, breaker state machine, and
+  watchdog EWMA are the enforced surfaces.
+- **config-drift** (lint/configdrift.py): every ``RuntimeConfig`` /
+  ``ServeConfig`` field must have a cli.py flag, a DEPLOY.md mention,
+  and (RuntimeConfig) coverage by ``compile_cache.manifest_key`` — a
+  new knob can never silently miss the cache key again.
+
+Findings diff against the checked-in baseline (tools/lint_baseline.json)
+so the gate is zero-NEW-findings from day one while pre-existing ones
+burn down. Conventions, triage, and the allowlist story: DEPLOY.md §1i.
+"""
+
+from .core import (ALL_PASSES, Finding, Project, load_baseline,  # noqa: F401
+                   load_project, run_passes, save_baseline)

@@ -1,0 +1,7 @@
+"""`python -m lir_tpu.lint` — the dependency-free lint entry point."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,90 @@
+"""Command-line entry for graft-lint (`lir_tpu lint` / `make lint`).
+
+Kept free of jax and of every engine import on purpose: the pre-push
+hook and bare CI containers run this; budget is seconds (the whole
+suite parses ~90 files with stdlib ``ast`` in well under one).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from .core import (ALL_PASSES, diff_baseline, load_baseline, load_project,
+                   run_passes, save_baseline)
+
+DEFAULT_BASELINE = "tools/lint_baseline.json"
+
+
+def build_parser(parser: Optional[argparse.ArgumentParser] = None
+                 ) -> argparse.ArgumentParser:
+    p = parser or argparse.ArgumentParser(
+        prog="lir_tpu lint",
+        description="AST static analysis proving the engine's JAX/XLA "
+                    "invariants (DEPLOY.md §1i)")
+    p.add_argument("--root", type=Path, default=None,
+                   help="project root (default: the repo this package "
+                        "lives in)")
+    p.add_argument("--baseline", type=Path, default=None,
+                   help=f"baseline file (default {DEFAULT_BASELINE} under "
+                        "the root; 'none' disables)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline to the current findings "
+                        "(burn-down bookkeeping; review the diff!)")
+    p.add_argument("--select", action="append", default=None,
+                   metavar="PASS", choices=sorted(ALL_PASSES),
+                   help="run only this pass (repeatable); default all: "
+                        f"{', '.join(sorted(ALL_PASSES))}")
+    p.add_argument("--all", action="store_true",
+                   help="print every finding including baselined ones")
+    return p
+
+
+def run(args: argparse.Namespace) -> int:
+    t0 = time.perf_counter()
+    root = args.root
+    if root is None:
+        # lir_tpu/lint/cli.py -> repo root two levels above the package.
+        root = Path(__file__).resolve().parent.parent.parent
+    project = load_project(root)
+    findings = run_passes(project, only=args.select)
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = root / DEFAULT_BASELINE
+    if args.write_baseline:
+        save_baseline(baseline_path, findings)
+        print(f"lint: wrote {len(findings)} finding(s) -> {baseline_path}")
+        return 0
+    use_baseline = str(baseline_path) != "none"
+    allowed = load_baseline(baseline_path) if use_baseline else None
+    if allowed:
+        new, stale = diff_baseline(findings, allowed)
+    else:
+        new, stale = list(findings), 0
+    shown = findings if args.all else new
+    for f in shown:
+        print(f.render())
+    dt = time.perf_counter() - t0
+    n_base = len(findings) - len(new)
+    print(f"lint: {len(project.modules)} files, {len(findings)} finding(s) "
+          f"({n_base} baselined, {len(new)} new) in {dt:.2f}s")
+    if stale:
+        print(f"lint: {stale} baseline entr{'y' if stale == 1 else 'ies'} "
+              f"no longer fire — burn-down! prune with --write-baseline")
+    if new:
+        print("lint: FAIL — new findings above are not in "
+              f"{baseline_path}; fix them or justify a "
+              "`# lint: allow(<pass>)` (DEPLOY.md §1i)")
+        return 1
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    return run(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

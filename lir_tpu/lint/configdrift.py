@@ -1,0 +1,156 @@
+"""config-drift: every config knob is flagged, documented, and cache-keyed.
+
+PR 7's ``fused_decode`` had to be HAND-re-keyed into the compile-cache
+manifest after review noticed a new knob changed compiled code without
+changing ``compile_cache.manifest_key`` — the exact silent-staleness
+class the persistent cache was built to make impossible. This pass
+closes the loop structurally. For every field of ``RuntimeConfig`` and
+``ServeConfig`` (``lir_tpu/config.py``):
+
+1. **CLI flag** — ``lir_tpu/cli.py`` must mention the field: the
+   snake_case identifier (``rt_kw["field"]`` / ``args.field``), its
+   kebab-case flag, or the spelling declared by a ``# cli: --flag``
+   trailing comment on the field (for renamed flags like
+   ``linger_s`` → ``--linger-ms``).
+2. **DEPLOY.md mention** — the operator manual must contain the field
+   name or its declared flag. A knob nobody can find is a knob set
+   wrong at 3am.
+3. **manifest-key coverage** (RuntimeConfig only) — the engine's
+   ``cache_manifest_key`` must pass the WHOLE RuntimeConfig to
+   ``compile_cache.manifest_key`` (the ``self.rt`` argument — then
+   every present and future field is canonicalized into the key by
+   construction). If that call site ever degrades into a hand-picked
+   projection (a Dict literal / constructor call), every field absent
+   from the projection and not marked ``# host-only`` is flagged —
+   ``fused_decode`` can never happen again.
+
+A field that deliberately has no flag (composite policy objects,
+derived values) carries ``# lint: allow(config-drift)`` with the
+justification in the surrounding comment. ``# host-only`` marks fields
+that cannot change compiled executables (watchdog deadlines, barrier
+timeouts) and therefore owe nothing to the manifest key.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, LintPass, Module, Project, dotted, terminal_name
+
+CONFIG_REL = "lir_tpu/config.py"
+CLI_REL = "lir_tpu/cli.py"
+RUNNER_REL = "lir_tpu/engine/runner.py"
+DEPLOY_REL = "DEPLOY.md"
+CLASSES = ("RuntimeConfig", "ServeConfig")
+
+CLI_COMMENT_RE = re.compile(r"#\s*cli:\s*(--[A-Za-z0-9-]+)")
+HOST_ONLY_RE = re.compile(r"#\s*host-only\b")
+
+
+def _fields(mod: Module, cls: ast.ClassDef
+            ) -> List[Tuple[str, int, Optional[str], bool]]:
+    """(name, line, declared cli flag, host_only) per dataclass field."""
+    out = []
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            end = getattr(node, "end_lineno", node.lineno)
+            flag = None
+            host_only = False
+            for line in range(node.lineno, end + 1):
+                text = mod.line_text(line)
+                m = CLI_COMMENT_RE.search(text)
+                if m and flag is None:
+                    flag = m.group(1)
+                if HOST_ONLY_RE.search(text):
+                    host_only = True
+            out.append((node.target.id, node.lineno, flag, host_only))
+    return out
+
+
+def _manifest_runtime_arg(runner: Module) -> Optional[ast.AST]:
+    """The ``runtime`` argument of the manifest_key(...) call site."""
+    for node in ast.walk(runner.tree):
+        if isinstance(node, ast.Call) \
+                and terminal_name(node.func) == "manifest_key":
+            if len(node.args) >= 2:
+                return node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "runtime":
+                    return kw.value
+    return None
+
+
+def _projection_keys(node: ast.AST) -> Optional[Set[str]]:
+    """Keys of a hand-built projection (Dict literal / dict(...) call),
+    or None when the argument is a whole config object."""
+    if isinstance(node, ast.Dict):
+        return {k.value for k in node.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+    if isinstance(node, ast.Call) and terminal_name(node.func) == "dict":
+        return {kw.arg for kw in node.keywords if kw.arg}
+    return None
+
+
+class ConfigDriftPass(LintPass):
+    name = "config-drift"
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.module(CONFIG_REL)
+        if cfg is None:
+            return []
+        cli = project.module(CLI_REL)
+        cli_src = cli.source if cli is not None else ""
+        deploy = project.text(DEPLOY_REL) or ""
+        runner = project.module(RUNNER_REL)
+        findings: List[Finding] = []
+
+        projection: Optional[Set[str]] = None
+        have_manifest_call = False
+        if runner is not None:
+            arg = _manifest_runtime_arg(runner)
+            if arg is not None:
+                have_manifest_call = True
+                projection = _projection_keys(arg)
+
+        for node in ast.walk(cfg.tree):
+            if not isinstance(node, ast.ClassDef) or node.name not in CLASSES:
+                continue
+            for name, line, flag, host_only in _fields(cfg, node):
+                scope = f"{node.name}.{name}"
+                kebab = name.replace("_", "-")
+                spellings = [name, f"--{kebab}"]
+                if flag:
+                    spellings.append(flag)
+                if not any(s in cli_src for s in spellings):
+                    findings.append(Finding(
+                        self.name, cfg.rel, line, scope,
+                        f"config field '{name}' has no cli.py flag "
+                        f"(looked for --{kebab}, the identifier, or a "
+                        f"`# cli: --flag` declaration) — every knob must "
+                        f"be reachable without editing source"))
+                if not any(s in deploy for s in spellings):
+                    findings.append(Finding(
+                        self.name, cfg.rel, line, scope,
+                        f"config field '{name}' is not mentioned in "
+                        f"DEPLOY.md — document what it does and when to "
+                        f"change it"))
+                if node.name == "RuntimeConfig" and not host_only:
+                    if not have_manifest_call:
+                        findings.append(Finding(
+                            self.name, cfg.rel, line, scope,
+                            f"no compile_cache.manifest_key call site "
+                            f"found covering RuntimeConfig field "
+                            f"'{name}' — compiled-shape knobs must "
+                            f"participate in the cache key"))
+                    elif projection is not None and name not in projection:
+                        findings.append(Finding(
+                            self.name, cfg.rel, line, scope,
+                            f"RuntimeConfig field '{name}' is missing "
+                            f"from the hand-built manifest_key "
+                            f"projection — a stale compile cache can "
+                            f"serve this knob's old executables; add it "
+                            f"or pass the whole RuntimeConfig"))
+        return findings

@@ -1,0 +1,200 @@
+"""lock-discipline: annotated shared state mutates only under its lock.
+
+The serve/guard layer is the engine's only genuinely threaded surface:
+submitter threads, the supervisor loop, the watchdog's watched workers
+and the sweep writer all touch batcher queues, breaker state and
+calibration EWMAs. The convention this pass enforces (DEPLOY.md §1i):
+
+- **Attribute annotation** — a trailing comment on the attribute's
+  ``__init__`` assignment::
+
+      self._dq = deque()        # guarded-by: _lock | _nonempty
+
+  declares that ``self._dq`` may only be MUTATED (assignment,
+  aug-assignment, ``del``, or a mutating method call such as
+  ``.append()``/``.pop()``/``.update()``) inside a ``with self._lock:``
+  (or ``with self._nonempty:``) block. ``|``/``,`` list alternatives —
+  a ``Condition`` wraps the same underlying lock as the ``Lock`` it was
+  built from. Reads are NOT enforced (racy reads of monotonic counters
+  are this codebase's accepted idiom); single-thread-confined state
+  simply stays unannotated.
+- **Held-by-caller annotation** — the same comment on (or directly
+  above) a ``def`` line::
+
+      def _transition(self, to):   # guarded-by: _lock
+
+  declares the method runs with the lock already held (the
+  ``_promote_locked`` idiom); its mutations of attributes guarded by
+  that lock are exempt.
+- ``__init__`` itself is exempt (construction happens-before
+  publication), as is any line carrying ``# lint:
+  allow(lock-discipline)``.
+
+The pass also cross-checks that every named lock is actually created in
+``__init__`` (``threading.Lock/RLock/Condition``) — an annotation
+naming a lock that does not exist is a typo worth failing on.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, LintPass, Module, Project, dotted,
+                   parent_map, terminal_name)
+
+GUARDED_RE = re.compile(
+    r"#\s*guarded-by:\s*([A-Za-z_]\w*(?:\s*[|,]\s*[A-Za-z_]\w*)*)")
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+            "pop", "popleft", "popitem", "remove", "discard", "clear",
+            "update", "add", "setdefault", "sort", "reverse",
+            "rotate", "put", "put_nowait", "move_to_end"}
+
+
+def _parse_locks(text: str) -> Set[str]:
+    m = GUARDED_RE.search(text)
+    if not m:
+        return set()
+    return {t.strip() for t in re.split(r"[|,]", m.group(1)) if t.strip()}
+
+
+def _stmt_annotation(mod: Module, node: ast.stmt) -> Set[str]:
+    """Locks named by a guarded-by comment on any source line the
+    statement spans (trailing comments usually sit on the first line)."""
+    end = getattr(node, "end_lineno", node.lineno)
+    locks: Set[str] = set()
+    for line in range(node.lineno, end + 1):
+        locks |= _parse_locks(mod.line_text(line))
+    return locks
+
+
+def _def_annotation(mod: Module, fn: ast.FunctionDef) -> Set[str]:
+    """Held-by-caller locks: comment on the def line or the line above."""
+    locks = _parse_locks(mod.line_text(fn.lineno))
+    locks |= _parse_locks(mod.line_text(fn.lineno - 1))
+    return locks
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for self.x; also unwraps self.x[...] subscripts."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class LockDisciplinePass(LintPass):
+    name = "lock-discipline"
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules:
+            if "guarded-by:" not in mod.source:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    findings.extend(self._check_class(mod, node))
+        return findings
+
+    # -- per class -----------------------------------------------------------
+
+    def _collect(self, mod: Module, cls: ast.ClassDef
+                 ) -> Tuple[Dict[str, Set[str]], Set[str]]:
+        """(guarded attr -> lock alternatives, locks created in class)."""
+        guarded: Dict[str, Set[str]] = {}
+        created: Set[str] = set()
+        for fn in (n for n in cls.body
+                   if isinstance(n, ast.FunctionDef)):
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    value = node.value
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        locks = _stmt_annotation(mod, node)
+                        if locks:
+                            guarded.setdefault(attr, set()).update(locks)
+                        if isinstance(value, ast.Call) \
+                                and terminal_name(value.func) in LOCK_CTORS:
+                            created.add(attr)
+        return guarded, created
+
+    def _check_class(self, mod: Module, cls: ast.ClassDef) -> List[Finding]:
+        guarded, created = self._collect(mod, cls)
+        findings: List[Finding] = []
+        if not guarded:
+            return findings
+        all_locks = set().union(*guarded.values())
+        for lock in sorted(all_locks - created):
+            findings.append(Finding(
+                self.name, mod.rel, cls.lineno, cls.name,
+                f"guarded-by names lock '{lock}' which is never created "
+                f"in {cls.name}.__init__ (threading.Lock/RLock/"
+                f"Condition) — typo or missing lock"))
+        for fn in (n for n in cls.body if isinstance(n, ast.FunctionDef)):
+            if fn.name == "__init__":
+                continue
+            held = _def_annotation(mod, fn)
+            parents = parent_map(fn)
+            for node in ast.walk(fn):
+                for attr, mutation in self._mutations(node):
+                    locks = guarded.get(attr)
+                    if not locks or locks & held:
+                        continue
+                    if self._under_lock(node, parents, locks):
+                        continue
+                    findings.append(Finding(
+                        self.name, mod.rel, node.lineno,
+                        f"{cls.name}.{fn.name}",
+                        f"{mutation} of 'self.{attr}' (guarded-by "
+                        f"{'/'.join(sorted(locks))}) outside a `with "
+                        f"self.<lock>:` block — annotate the method "
+                        f"`# guarded-by: <lock>` if the caller holds it"))
+        return findings
+
+    def _mutations(self, node: ast.AST):
+        """Yield (attr, kind) for mutations of self.<attr> at ``node``."""
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    yield attr, ("augmented assignment"
+                                 if isinstance(node, ast.AugAssign)
+                                 else "assignment")
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    yield attr, "deletion"
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                yield attr, f".{node.func.attr}() call"
+
+    def _under_lock(self, node: ast.AST, parents: Dict[ast.AST, ast.AST],
+                    locks: Set[str]) -> bool:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Call):
+                        ctx = ctx.func   # with self._lock: vs acquire()
+                    attr = _self_attr(ctx)
+                    if attr in locks:
+                        return True
+            cur = parents.get(cur)
+        return False

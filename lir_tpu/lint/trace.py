@@ -1,0 +1,316 @@
+"""trace-hazard: python control flow / coercion on traced values.
+
+Inside a function being traced by ``jit``/``pjit``/``pallas_call``,
+array arguments are tracers. Three things silently cost a cold compile,
+raise ``ConcretizationTypeError``, or — worst — hang a multihost pod:
+
+- **python branching on a traced value** (``if``/``while``/ternary/
+  ``assert``): forces concretization. Branching on ``x.shape``/
+  ``x.ndim``/``x.dtype`` or identity (``x is None``) is static and
+  exempt;
+- **scalar coercion** — ``int()``/``bool()``/``float()``/``.item()``/
+  ``.tolist()`` on a traced value: same concretization, usually smuggled
+  in via an innocent-looking ``max()`` or format string;
+- **unordered-collection iteration feeding pytree construction**: a
+  ``for``/comprehension over a ``set`` inside traced code bakes
+  iteration order into the jaxpr. Set order varies across processes
+  (PYTHONHASHSEED), so two pod hosts can trace DIFFERENT programs from
+  identical source — the desync the PR-5 heartbeat barrier only catches
+  after it hangs. (Python dicts are insertion-ordered and exempt; a
+  dict BUILT from a set inherits the hazard at the set.)
+
+Reachability: roots are functions decorated with ``jit``/``pjit``
+(directly or via ``functools.partial``) — minus their
+``static_argnames``/``static_argnums`` parameters — and kernels passed
+to ``pallas_call`` (every parameter is a Ref). Taint then propagates
+through same-module calls: an argument expression containing a traced
+name marks the callee's parameter traced, to a fixpoint. Assignments
+propagate taint locally (``y = x * 2`` taints ``y``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, LintPass, Module, Project, arg_names,
+                   const_int_tuple, const_str_tuple, dotted, iter_functions,
+                   parent_map, terminal_name)
+
+JIT_NAMES = {"jit", "pjit"}
+# Calls whose result is a tracer when any input is (taint conduits).
+DEVICE_PREFIXES = ("jnp.", "jax.lax.", "jax.nn.", "jax.random.", "lax.",
+                   "jax.vmap", "vmap")
+# Attribute reads that are static under tracing (shape metadata).
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                "weak_type"}
+COERCIONS = {"int", "bool", "float", "complex"}
+CONCRETIZING_METHODS = {"item", "tolist"}
+MAX_ROUNDS = 8
+
+
+def _jit_statics(deco: ast.Call) -> Tuple[Set[str], Set[int]]:
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in deco.keywords:
+        if kw.arg == "static_argnames":
+            names |= set(const_str_tuple(kw.value))
+        elif kw.arg == "static_argnums":
+            nums |= set(const_int_tuple(kw.value))
+    return names, nums
+
+
+def _traced_root_params(fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """Param names traced when ``fn`` is a jit/pjit root, else None."""
+    for deco in fn.decorator_list:
+        call = deco if isinstance(deco, ast.Call) else None
+        target = terminal_name(call.func if call else deco)
+        if target == "partial" and call and call.args:
+            inner = terminal_name(call.args[0])
+            if inner not in JIT_NAMES:
+                continue
+        elif target not in JIT_NAMES:
+            continue
+        params = arg_names(fn)
+        if call is not None:
+            static_names, static_nums = _jit_statics(call)
+        else:
+            static_names, static_nums = set(), set()
+        return {p for i, p in enumerate(params)
+                if p not in static_names and i not in static_nums}
+    return None
+
+
+def _pallas_kernels(mod: Module) -> Set[str]:
+    """Names of functions passed (by name) to pallas_call in this
+    module — every parameter of a Pallas kernel is a traced Ref."""
+    kernels: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) \
+                and terminal_name(node.func) == "pallas_call" and node.args:
+            name = terminal_name(node.args[0])
+            if name:
+                kernels.add(name)
+    return kernels
+
+
+class _FunctionScan:
+    """Per-function taint scan. ``traced`` seeds from the root/propagated
+    parameter set; assignments extend it in source-line order."""
+
+    def __init__(self, pass_name: str, mod: Module, qual: str,
+                 fn: ast.FunctionDef, traced: Set[str]):
+        self.pass_name = pass_name
+        self.mod = mod
+        self.qual = qual
+        self.fn = fn
+        self.traced = set(traced)
+        self.parents = parent_map(fn)
+        self.findings: List[Finding] = []
+        # calls into same-module defs with traced args: (callee, {pos})
+        self.propagations: List[Tuple[str, Dict[int, bool],
+                                      Dict[str, bool]]] = []
+
+    # -- taint ----------------------------------------------------------------
+
+    def _is_static_use(self, name_node: ast.AST) -> bool:
+        """True when this traced-name occurrence only feeds static
+        metadata (x.shape, len-free), or an identity test."""
+        node = name_node
+        parent = self.parents.get(node)
+        while parent is not None and not isinstance(parent, ast.stmt):
+            if isinstance(parent, ast.Attribute) and parent.value is node \
+                    and parent.attr in STATIC_ATTRS:
+                return True
+            if isinstance(parent, ast.Compare) \
+                    and all(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in parent.ops):
+                return True
+            node, parent = parent, self.parents.get(parent)
+        return False
+
+    def _tainted_names(self, expr: ast.AST) -> List[ast.Name]:
+        """Traced names feeding ``expr`` DIRECTLY. Names nested inside
+        other calls are shielded: ``is_per_row_keys(key)`` inspects
+        ``key.ndim`` and returns a static bool — only jnp/lax/random
+        calls are known to return tracers for tracer inputs."""
+        shielded: Set[int] = set()
+        for n in ast.walk(expr):
+            if not isinstance(n, ast.Call):
+                continue
+            path = dotted(n.func) or ""
+            if not path.startswith(DEVICE_PREFIXES):
+                shielded.update(id(x) for x in ast.walk(n))
+                shielded.discard(id(n))
+        out = []
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in self.traced \
+                    and isinstance(n.ctx, ast.Load) \
+                    and id(n) not in shielded \
+                    and not self._is_static_use(n):
+                out.append(n)
+        return out
+
+    def _expr_tainted(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            # Result taint only through tracer-producing calls.
+            path = dotted(expr.func) or ""
+            if not path.startswith(DEVICE_PREFIXES):
+                return False
+            return any(self._expr_tainted(a) for a in expr.args) \
+                or any(self._expr_tainted(kw.value)
+                       for kw in expr.keywords)
+        return bool(self._tainted_names(expr))
+
+    # -- scan -----------------------------------------------------------------
+
+    def scan(self, module_defs: Dict[str, ast.FunctionDef]) -> None:
+        nested: Set[int] = set()
+        for child in ast.walk(self.fn):
+            if child is not self.fn and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.update(id(n) for n in ast.walk(child))
+        nodes = [n for n in ast.walk(self.fn) if id(n) not in nested]
+        nodes.sort(key=lambda n: (getattr(n, "lineno", 0),
+                                  getattr(n, "col_offset", 0)))
+        for node in nodes:
+            if isinstance(node, ast.Assign) and self._expr_tainted(node.value):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            self.traced.add(n.id)
+            elif isinstance(node, (ast.If, ast.While)):
+                self._flag_branch(node.test, "python branch")
+            elif isinstance(node, ast.IfExp):
+                self._flag_branch(node.test, "conditional expression")
+            elif isinstance(node, ast.Assert):
+                self._flag_branch(node.test, "assert")
+            elif isinstance(node, ast.Call):
+                self._check_call(node, module_defs)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                if self._is_unordered(it):
+                    self.findings.append(Finding(
+                        self.pass_name, self.mod.rel,
+                        getattr(node, "lineno", it.lineno), self.qual,
+                        "iteration over an unordered set inside traced "
+                        "code — pytree/program order can differ across "
+                        "hosts (retrace or multihost desync); sort it or "
+                        "use an ordered collection"))
+
+    def _is_unordered(self, it: ast.AST) -> bool:
+        if isinstance(it, ast.Set):
+            return True
+        if isinstance(it, ast.Call) \
+                and terminal_name(it.func) in {"set", "frozenset"}:
+            return True
+        return False
+
+    def _flag_branch(self, test: ast.AST, what: str) -> None:
+        hits = self._tainted_names(test)
+        if hits:
+            self.findings.append(Finding(
+                self.pass_name, self.mod.rel, hits[0].lineno, self.qual,
+                f"{what} on traced value '{hits[0].id}' — concretizes "
+                f"under jit (trace error or silent host sync + retrace); "
+                f"use lax.cond/lax.select or hoist the branch out of the "
+                f"traced region"))
+
+    def _check_call(self, call: ast.Call,
+                    module_defs: Dict[str, ast.FunctionDef]) -> None:
+        name = terminal_name(call.func)
+        if isinstance(call.func, ast.Name) and name in COERCIONS:
+            for arg in call.args:
+                hits = self._tainted_names(arg)
+                if hits:
+                    self.findings.append(Finding(
+                        self.pass_name, self.mod.rel, call.lineno,
+                        self.qual,
+                        f"{name}() coerces traced value '{hits[0].id}' "
+                        f"to a python scalar inside traced code — use "
+                        f"jnp/lax equivalents or mark the argument "
+                        f"static"))
+                    return
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in CONCRETIZING_METHODS \
+                and self._expr_tainted(call.func.value):
+            base = dotted(call.func.value) or "<expr>"
+            self.findings.append(Finding(
+                self.pass_name, self.mod.rel, call.lineno, self.qual,
+                f".{call.func.attr}() on traced value '{base}' inside "
+                f"traced code — concretization hazard"))
+            return
+        # Same-module taint propagation: record which callee params
+        # receive traced expressions.
+        if name in module_defs and isinstance(call.func, ast.Name):
+            by_pos = {i: True for i, a in enumerate(call.args)
+                      if self._expr_tainted(a)}
+            by_kw = {kw.arg: True for kw in call.keywords
+                     if kw.arg and self._expr_tainted(kw.value)}
+            if by_pos or by_kw:
+                self.propagations.append((name, by_pos, by_kw))
+
+
+class TraceHazardPass(LintPass):
+    name = "trace-hazard"
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules:
+            findings.extend(self._run_module(mod))
+        return findings
+
+    def _run_module(self, mod: Module) -> List[Finding]:
+        defs: Dict[str, ast.FunctionDef] = {}
+        quals: Dict[str, str] = {}
+        for q, fn in iter_functions(mod):
+            defs.setdefault(fn.name, fn)
+            quals.setdefault(fn.name, q)
+        kernels = _pallas_kernels(mod)
+        # Seed traced-param sets per function name.
+        traced: Dict[str, Set[str]] = {}
+        for q, fn in iter_functions(mod):
+            root = _traced_root_params(fn)
+            if fn.name in kernels:
+                root = set(arg_names(fn))
+            if root is not None:
+                traced[fn.name] = set(traced.get(fn.name, set())) | root
+        findings: List[Finding] = []
+        seen: Dict[str, frozenset] = {}
+        for _ in range(MAX_ROUNDS):
+            frontier = {n: p for n, p in traced.items()
+                        if seen.get(n) != frozenset(p)}
+            if not frontier:
+                break
+            round_findings: List[Finding] = []
+            new_traced: Dict[str, Set[str]] = {}
+            for name, params in sorted(frontier.items()):
+                seen[name] = frozenset(params)
+                fn = defs.get(name)
+                if fn is None:
+                    continue
+                scan = _FunctionScan(self.name, mod, quals[name], fn,
+                                     params)
+                scan.scan(defs)
+                round_findings.extend(scan.findings)
+                for callee, by_pos, by_kw in scan.propagations:
+                    target = defs.get(callee)
+                    if target is None or callee in kernels:
+                        continue
+                    names = arg_names(target)
+                    marked = new_traced.setdefault(
+                        callee, set(traced.get(callee, set())))
+                    for i in by_pos:
+                        if i < len(names):
+                            marked.add(names[i])
+                    for kw in by_kw:
+                        if kw in names:
+                            marked.add(kw)
+            # Findings are recomputed per round as taint widens; keep
+            # only the final round's scan per function by replacing.
+            findings = [f for f in findings
+                        if f.scope not in {quals.get(n) for n in frontier}]
+            findings.extend(round_findings)
+            for name, params in new_traced.items():
+                traced[name] = set(traced.get(name, set())) | params
+        return findings

@@ -50,7 +50,7 @@ class ResultCache:
                  stats: Optional[ServeStats] = None):
         self.max_entries = int(max_entries)
         self.stats = stats if stats is not None else ServeStats()
-        self._od: "OrderedDict[str, Dict]" = OrderedDict()
+        self._od: "OrderedDict[str, Dict]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def __len__(self) -> int:

@@ -145,7 +145,9 @@ class RequestQueue:
         self.maxlen = int(maxlen)
         self.stats = stats if stats is not None else ServeStats()
         self.clock = clock
-        self._dq: Deque[Pending] = deque()
+        # _lock and _nonempty share one underlying lock; holding either
+        # guards the deque (lint/locks.py enforces the annotation).
+        self._dq: Deque[Pending] = deque()  # guarded-by: _lock | _nonempty
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
 

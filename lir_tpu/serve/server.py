@@ -102,7 +102,14 @@ class ScoringServer:
             cooldown_s=self.config.breaker_cooldown_s,
             clock=clock, stats=self.faults)
         self._engine_key = engine.cache_manifest_key
-        self._target_memo: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        # Target-token memo: written from EVERY submitter thread (submit
+        # runs client-side), so its mutations take a dedicated lock —
+        # racing dict writes are benign under today's GIL but the
+        # guarded-by convention is enforced statically (lint/locks.py),
+        # not by interpreter implementation details.
+        self._memo_lock = threading.Lock()
+        self._target_memo: Dict[
+            Tuple[str, str], Tuple[int, int]] = {}  # guarded-by: _memo_lock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._abort = False          # stop WITHOUT draining (checkpoint)
@@ -133,14 +140,16 @@ class ScoringServer:
     # -- client side ---------------------------------------------------------
 
     def _target_ids(self, targets: Tuple[str, str]) -> Tuple[int, int]:
-        ids = self._target_memo.get(targets)
+        with self._memo_lock:
+            ids = self._target_memo.get(targets)
         if ids is None:
             with self.engine._tok_lock:
                 t1, t2 = tok.target_token_ids(
                     self.engine.tokenizer, targets,
                     encoder_decoder=self.engine.encoder_decoder)
             ids = (int(t1), int(t2))
-            self._target_memo[targets] = ids
+            with self._memo_lock:
+                self._target_memo[targets] = ids
         return ids
 
     def submit(self, request: ServeRequest) -> ServeFuture:

@@ -1,0 +1,20 @@
+"""Source-level annotations the static-analysis suite (lir_tpu/lint)
+understands. Import-free and side-effect-free by design: hot-path
+modules may import this without pulling in anything."""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+
+def host_readout(fn: F) -> F:
+    """Mark ``fn`` as a LEGITIMATE device→host readout boundary: it
+    deliberately synchronizes with the device (``jax.device_get``,
+    ``np.asarray`` on device values, scalar coercion) and the host-sync
+    lint pass must not flag it. Decorating a function is a reviewable
+    claim that the sync is off the dispatch thread's critical path —
+    e.g. the sweep's writer thread or a bench's final readout — not a
+    license to block dispatch (DEPLOY.md §1i)."""
+    return fn

@@ -1,0 +1,3 @@
+"""Mini CLI knowing only batch_size / queue_depth / log_level."""
+
+FLAGS = ["--batch-size", "--queue-depth", "--log-level"]

@@ -1,0 +1,17 @@
+"""Mini config with a drifted knob: ``fancy_knob`` has no CLI flag, no
+DEPLOY.md mention, and is missing from the hand-built manifest-key
+projection in engine/runner.py."""
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    batch_size: int = 32
+    fancy_knob: int = 7
+    log_level: str = "info"    # host-only
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    queue_depth: int = 256

@@ -1,0 +1,9 @@
+"""Mini runner whose manifest key degraded into a hand-picked
+projection — the drift the pass exists to catch."""
+
+
+def cache_manifest_key(self):
+    from ..utils import compile_cache
+
+    return compile_cache.manifest_key(
+        self.cfg, {"batch_size": self.rt.batch_size}, buckets=[64])

@@ -1,0 +1,3 @@
+"""Mini CLI covering every knob."""
+
+FLAGS = ["--batch-size", "--fancy-knob", "--queue-depth", "--log-level"]

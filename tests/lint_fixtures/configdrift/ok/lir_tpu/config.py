@@ -1,0 +1,15 @@
+"""Clean twin: every knob flagged, documented, and key-covered."""
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    batch_size: int = 32
+    fancy_knob: int = 7
+    log_level: str = "info"    # host-only
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    queue_depth: int = 256

@@ -1,0 +1,8 @@
+"""Clean twin: the whole RuntimeConfig feeds the manifest key, so every
+field participates by construction."""
+
+
+def cache_manifest_key(self):
+    from ..utils import compile_cache
+
+    return compile_cache.manifest_key(self.cfg, self.rt, buckets=[64])

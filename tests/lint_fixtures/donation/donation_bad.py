@@ -1,0 +1,22 @@
+"""Seeded donation-safety violations (tests/test_lint.py). Never
+imported — parsed by the lint pass only."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnames=("scratch",))
+def consume(x, scratch):
+    del scratch  # donated: memory reuse only
+    return x + 1
+
+
+def chain_bad(x, scratch):
+    out = consume(x, scratch)
+    return out + scratch.sum()       # VIOLATION: read after donation
+
+
+def chain_bad_kw(x, scratch):
+    out = consume(x, scratch=scratch)
+    return out, scratch              # VIOLATION: read after kw donation

@@ -1,0 +1,35 @@
+"""Clean twins for donation-safety: the sanctioned idioms the pass must
+NOT flag."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnames=("carry",))
+def step(carry):
+    return carry * 2
+
+
+def chain_ok(carry):
+    carry = step(carry)          # rebind from the result: the chain idiom
+    return carry + 1
+
+
+def branch_ok(x, scratch, flag):
+    if flag:
+        out = step(scratch)      # donation in this arm only
+    else:
+        out = x + scratch.sum()  # sibling arm: never reached after it
+    return out
+
+
+def identity_ok(scratch):
+    out = step(scratch)
+    used = scratch is not None   # identity test touches the ref, not
+    return out, used             # the dead buffer
+
+
+def splat_ok(x, kwargs):
+    out = step(x, **kwargs)      # **splat is not a donated slot
+    return out, kwargs

@@ -1,0 +1,40 @@
+"""Seeded host-sync violations in a hot-path module
+(tests/test_lint.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bad_asarray(tokens):
+    fused = jnp.dot(tokens, tokens)
+    return np.asarray(fused)          # VIOLATION: implicit transfer
+
+
+def bad_float(tokens):
+    total = jnp.sum(tokens)
+    return float(total)               # VIOLATION: scalar coercion sync
+
+
+def bad_truthiness(tokens):
+    mask = jnp.any(tokens)
+    if mask:                          # VIOLATION: truthiness blocks
+        return 1
+    return 0
+
+
+def bad_iteration(tokens):
+    rows = jnp.abs(tokens)
+    out = []
+    for r in rows:                    # VIOLATION: per-element sync
+        out.append(r)
+    return out
+
+
+def _decode_row(row):
+    return row.tolist()               # VIOLATION: reached with device arg
+
+
+def bad_cross_function(tokens):
+    dev = jnp.exp(tokens)
+    return _decode_row(dev)

@@ -1,0 +1,35 @@
+"""Clean twins for host-sync: sanctioned readout patterns."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lir_tpu.utils.annotations import host_readout
+
+
+def ok_device_get(tokens):
+    fused = jnp.dot(tokens, tokens)
+    host = jax.device_get(fused)      # explicit boundary
+    return float(host[0])
+
+
+@host_readout
+def ok_declared_boundary(tokens):
+    total = jnp.sum(tokens)
+    return float(total)               # allowed: declared readout
+
+
+def ok_allow_comment(tokens):
+    total = jnp.sum(tokens)
+    return float(total)  # lint: allow(host-sync)
+
+
+def ok_shape_metadata(tokens):
+    total = jnp.sum(tokens)
+    n = int(total.shape[0]) if total.ndim else 0   # static metadata
+    return n
+
+
+def ok_host_data(lengths):
+    arr = np.asarray(lengths, np.int32)            # host list in
+    return arr.tolist()

@@ -1,0 +1,10 @@
+"""Same syntactic pattern as hot_bad, but OUTSIDE the hot-path scope
+(stats code syncs freely) — the pass must not flag it."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def cold_readout(values):
+    dev = jnp.asarray(values) * 2
+    return np.asarray(dev)

@@ -1,0 +1,26 @@
+"""Seeded lock-discipline violations (tests/test_lint.py)."""
+
+import threading
+from collections import deque
+
+
+class BadServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = deque()          # guarded-by: _lock
+        self._state = "closed"     # guarded-by: _lock
+
+    def submit(self, item):
+        self._q.append(item)       # VIOLATION: mutator outside the lock
+
+    def trip(self):
+        self._state = "open"       # VIOLATION: assignment outside lock
+
+    def ok_read(self):
+        return len(self._q)        # reads are not enforced
+
+
+class TypoServer:
+    def __init__(self):
+        self._x = 0                # guarded-by: _missing_lock
+        # VIOLATION: annotation names a lock the class never creates

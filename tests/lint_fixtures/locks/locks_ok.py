@@ -1,0 +1,33 @@
+"""Clean twins for lock-discipline: every annotated mutation holds its
+lock (or runs in a held-by-caller method)."""
+
+import threading
+from collections import deque
+
+
+class GoodServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._q = deque()          # guarded-by: _lock | _cond
+        self._state = "closed"     # guarded-by: _lock
+
+    def submit(self, item):
+        with self._cond:           # the Condition wraps the same lock
+            self._q.append(item)
+
+    def drain(self):
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+        return out
+
+    def trip(self):
+        with self._lock:
+            self._transition("open")
+
+    def _transition(self, to):  # guarded-by: _lock
+        self._state = to
+
+    def depth(self):
+        return len(self._q)        # read: not enforced

@@ -1,0 +1,41 @@
+"""Seeded trace-hazard violations (tests/test_lint.py)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def bad_branch(x, n):
+    if x > 0:                # VIOLATION: python branch on traced value
+        return x * n
+    return -x
+
+
+@jax.jit
+def bad_coerce(x):
+    return float(x)          # VIOLATION: scalar coercion under trace
+
+
+@jax.jit
+def bad_item(x):
+    y = jnp.sum(x)
+    return y.item()          # VIOLATION: .item() under trace
+
+
+@jax.jit
+def bad_set(x):
+    leaves = {}
+    for name in {"alpha", "beta"}:   # VIOLATION: unordered set feeds
+        leaves[name] = x * 2         # pytree construction
+    return leaves
+
+
+def helper(y):
+    return int(y)            # VIOLATION: reached with traced arg
+
+
+@jax.jit
+def bad_propagated(x):
+    return helper(x * 2)

@@ -1,0 +1,54 @@
+"""Clean twins for trace-hazard: static branches the pass must NOT
+flag."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def ok_static_branch(x, flag):
+    if flag:                     # static argument: resolved at trace time
+        return x
+    return -x
+
+
+@jax.jit
+def ok_shape_branch(x):
+    if x.shape[0] > 1:           # shape metadata is static under trace
+        return jnp.sum(x)
+    return x
+
+
+@jax.jit
+def ok_identity(x, y=None):
+    if y is None:                # identity test: no concretization
+        return x
+    return x + y
+
+
+@jax.jit
+def ok_lax_cond(x):
+    return lax.cond(jnp.sum(x) > 0, lambda v: v, lambda v: -v, x)
+
+
+@jax.jit
+def ok_dict_iteration(x):
+    out = {}
+    for k, v in {"a": x, "b": x * 2}.items():   # dicts are ordered
+        out[k] = v + 1
+    return out
+
+
+def host_probe(key):
+    return key.ndim == 2         # metadata probe: returns a static bool
+
+
+@jax.jit
+def ok_metadata_call(x, key):
+    per_row = host_probe(key)
+    if per_row:                  # static bool from a metadata probe
+        return x * 2
+    return x
