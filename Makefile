@@ -3,7 +3,7 @@
 # recorded floor (tools/check_tier1.py — the floor lives there).
 
 .PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
-	kernel-smoke install-hooks
+	kernel-smoke stats-smoke install-hooks
 
 verify: lint
 	python tools/check_tier1.py
@@ -61,6 +61,16 @@ chaos-smoke:
 # rows exactly while its chain counters move (tools/kernel_smoke.py).
 kernel-smoke:
 	JAX_PLATFORMS=cpu python tools/kernel_smoke.py
+
+# Streaming-statistics smoke: the grid -> CIs device pipeline on the
+# fake backend — the accumulator finalize must equal the csv-reload
+# pipeline (counts/kappa bitwise, moments/CIs within FLOAT_TOL), a
+# streaming-only pass must fold every row on device with zero result
+# rows written (host-sync lint clean over the sink module), and the
+# serve `stats` endpoint must answer live mid-workload
+# (tools/stats_smoke.py).
+stats-smoke:
+	JAX_PLATFORMS=cpu python tools/stats_smoke.py
 
 # Run graft-lint (seconds) then the tier-1 guard before every
 # `git push` — lint first so an invariant break fails in two seconds,

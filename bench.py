@@ -234,6 +234,14 @@ def main() -> None:
                          "headline key \"prefix_serve\")")
     ap.add_argument("--no-prefix-serve", action="store_true",
                     help="skip the prefix-heavy serve mode")
+    ap.add_argument("--no-streaming-stats", action="store_true",
+                    help="skip the streaming-statistics mode (identical "
+                         "grid swept twice: device accumulator -> CIs "
+                         "with the row artifact OFF vs csv-write + "
+                         "host reload baseline; asserts parity and "
+                         "reports sweep+analysis wall-clock and host-"
+                         "transferred bytes under the headline key "
+                         "\"streaming_stats\")")
     ap.add_argument("--chaos", action="store_true",
                     help="also measure goodput UNDER a seeded fault "
                          "schedule (lir_tpu/faults: transient errors + "
@@ -540,6 +548,21 @@ def main() -> None:
         headline["fused_decode_fallback"] = fused_fallback
     if varlen is not None:
         headline["varlen"] = varlen
+    # Streaming-statistics mode (ROADMAP item 4): grid -> CIs as one
+    # device pipeline (row artifact OFF) vs the csv-write + host-reload
+    # baseline on the IDENTICAL grid. Asserts streaming == reloaded
+    # (counts/kappa bitwise) before reporting; a failure never discards
+    # the already-measured headline.
+    if not args.no_streaming_stats:
+        try:
+            streaming = _stream_stats_bench(params, cfg, on_accel,
+                                            tokenizer=sweep_tok,
+                                            batches=batch_override)
+            if streaming is not None:
+                headline["streaming_stats"] = streaming
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# streaming stats mode failed ({err!r}); headline "
+                  "is unaffected", file=sys.stderr)
     # Serve mode (online serving layer): open-loop Poisson load against
     # the continuous batcher, with an offline sweep over the identical
     # grid as the goodput baseline. Like varlen, a failure here never
@@ -1393,6 +1416,138 @@ def _prefix_serve_bench(params, cfg, on_accel: bool, tokenizer=None,
               file=sys.stderr)
         return out
     print(f"# prefix serve mode: every batch candidate OOMed; "
+          f"last: {last_oom}", file=sys.stderr)
+    return None
+
+
+def _stream_stats_bench(params, cfg, on_accel: bool, tokenizer=None,
+                        batches=None, n_boot=300):
+    """Streaming-statistics mode: ONE grid swept twice on fresh engines —
+
+    - BASELINE: streaming sink OFF, row artifact ON; "analysis" is the
+      pre-tentpole pipeline (read the csv back, rebuild the lattice,
+      summarize) — sweep + reload + CIs on the host path.
+    - STREAMING: sink ON, row artifact OFF; every dispatch folds on
+      device, finalize reads the accumulator once — no per-row payload
+      ever crosses to the host (rows_folded == grid size is asserted,
+      as is counts/kappa parity between the two paths).
+
+    Returns the "streaming_stats" headline dict: sweep+analysis
+    wall-clock both ways, the speedup ratio, rows folded, and the
+    host-transferred bytes (csv artifact vs accumulator + the avoided
+    per-row payload bytes)."""
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data import schemas
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine import grid as grid_mod
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.stats import streaming as st
+
+    if batches is None:
+        batches = SWEEP_BATCHES_TPU if on_accel else SWEEP_BATCHES_CPU
+    cells = SWEEP_CELLS_TPU if on_accel else 2 * SWEEP_CELLS_CPU
+    rng = np.random.default_rng(41)
+    if tokenizer is not None:
+        from chain7b import (CHAIN_CONFIDENCE_FORMAT, CHAIN_RESPONSE_FORMAT,
+                             bucket_sized_words)
+        words, n_words = bucket_sized_words(tokenizer, rng)
+        response_format = CHAIN_RESPONSE_FORMAT
+        confidence_format = CHAIN_CONFIDENCE_FORMAT
+    else:
+        words = ("coverage policy flood water damage claim insurer "
+                 "premium exclusion endorsement peril deductible").split()
+        n_words = 170 if on_accel else 12
+        response_format = "Respond with either ' Yes' or ' No' only ."
+        confidence_format = "Give a confidence number from 0 to 100 ."
+
+    def text():
+        return " ".join(rng.choice(words) for _ in range(n_words)) + " ?"
+
+    lp = (LegalPrompt(main=text(), response_format=response_format,
+                      target_tokens=("Yes", "No"),
+                      confidence_format=confidence_format),)
+    perts = ([text() for _ in range(cells - 1)],)
+    slot_map = st.slot_map_from_cells(
+        grid_mod.build_grid("bench-stream", lp, perts))
+
+    last_oom = None
+    for batch in batches:
+        def make_engine(streaming: bool):
+            return ScoringEngine(
+                params, cfg,
+                tokenizer if tokenizer is not None else FakeTokenizer(),
+                RuntimeConfig(batch_size=batch, max_seq_len=512,
+                              streaming_stats=streaming,
+                              row_artifact=not streaming))
+
+        try:
+            # warmup: the IDENTICAL grid on a throwaway engine, so both
+            # timed passes run all-warm (the fold executable is keyed by
+            # the lattice shape — a smaller warmup grid would leave its
+            # compile inside the streaming window).
+            with tempfile.TemporaryDirectory() as td:
+                run_perturbation_sweep(make_engine(True), "bench-stream",
+                                       lp, perts, Path(td) / "w.csv")
+
+            # BASELINE: csv rows + host reload analysis.
+            with tempfile.TemporaryDirectory() as td:
+                out = Path(td) / "base.csv"
+                t0 = time.perf_counter()
+                run_perturbation_sweep(make_engine(False), "bench-stream",
+                                       lp, perts, out)
+                df = schemas.read_results_frame(out)
+                acc_reload = st.accum_from_rows(df, slot_map, 1, cells,
+                                                seed=42)
+                reloaded = st.summarize(acc_reload, n_boot=n_boot)
+                base_s = time.perf_counter() - t0
+                csv_bytes = out.stat().st_size
+
+            # STREAMING: device accumulator, no row artifact.
+            with tempfile.TemporaryDirectory() as td:
+                out = Path(td) / "stream.csv"
+                t0 = time.perf_counter()
+                engine = make_engine(True)
+                run_perturbation_sweep(engine, "bench-stream", lp, perts,
+                                       out)
+                sink = engine.stream_sink
+                streamed = sink.finalize(n_boot=n_boot)
+                stream_s = time.perf_counter() - t0
+        except Exception as err:  # noqa: BLE001 — OOM falls back
+            if _is_oom(err):
+                last_oom = err
+                continue
+            raise
+        st.assert_parity(streamed, reloaded)   # counts/kappa bitwise
+        counters = sink.stats.summary()
+        assert counters["rows_folded"] == cells, counters
+        out = {
+            "cells": cells, "batch": batch, "n_boot": n_boot,
+            "rows_folded_on_device": counters["rows_folded"],
+            "dispatch_folds": counters["dispatch_folds"],
+            "streaming_sweep_analysis_s": round(stream_s, 3),
+            "baseline_sweep_analysis_s": round(base_s, 3),
+            "speedup_vs_csv_reload": round(base_s / stream_s, 3),
+            "finalize_s": counters["finalize_s"],
+            # Host-transfer accounting: what crossed device->host/disk.
+            "baseline_row_artifact_bytes": csv_bytes,
+            "streaming_accum_bytes": counters["accum_bytes"],
+            "host_payload_bytes_avoided": counters["host_bytes_avoided"],
+            "parity_ok": True,
+        }
+        print(f"# streaming stats mode ({cells} cells, batch {batch}): "
+              f"sweep+analysis {stream_s:.2f}s streaming vs "
+              f"{base_s:.2f}s csv-reload "
+              f"({out['speedup_vs_csv_reload']:.2f}x), "
+              f"{counters['rows_folded']} rows folded on device, "
+              f"{counters['host_bytes_avoided']} payload bytes + "
+              f"{csv_bytes} artifact bytes never crossed the host",
+              file=sys.stderr)
+        return out
+    print(f"# streaming stats mode: every batch candidate OOMed; "
           f"last: {last_oom}", file=sys.stderr)
     return None
 

@@ -137,6 +137,14 @@ def _add_perturb(sub) -> None:
                         "serving enables it by default): repeated grids "
                         "on one engine resume shared prefixes from the "
                         "page pool, bitwise-identical results")
+    p.add_argument("--no-row-artifact", action="store_true",
+                   help="with streaming stats ON, skip materializing "
+                        "the per-row csv/xlsx artifact entirely: the "
+                        "sweep transfers NO per-row payloads through "
+                        "the host — distributions come straight off "
+                        "the device accumulator (resume runs on the "
+                        "manifest + accumulator checkpoint). CSV stays "
+                        "the schema-parity default (DEPLOY.md §1j)")
     _add_prefix_pool_flags(p)
     _add_engine_tuning_flags(p)
     _add_guard_flags(p)
@@ -207,6 +215,35 @@ def _add_engine_tuning_flags(p) -> None:
     p.add_argument("--precompile-workers", type=int, default=None,
                    help="AOT precompile thread count (default 0 = one "
                         "per CPU core, capped at the shape count)")
+    p.add_argument("--dtype", default=None,
+                   choices=["bfloat16", "float32", "float16"],
+                   help="parameter/activation dtype on device (default "
+                        "bfloat16; float32 for parity audits — "
+                        "DEPLOY.md §1a)")
+    p.add_argument("--logits-dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="final-logits accumulation dtype (default "
+                        "float32; the softmax readouts assume fp32 "
+                        "accuracy — lower only for measurement)")
+    p.add_argument("--scan-positions", type=_positive_int, default=None,
+                   help="generated positions scanned for the yes/no "
+                        "top-k match (default 10 = the reference's "
+                        "MAX_LOOK_AHEAD; the D6 sweep reads position 0 "
+                        "regardless)")
+    p.add_argument("--topk-match", type=_positive_int, default=None,
+                   help="top-k membership rule for the scan-position "
+                        "readout (default 2 = the reference's top-2 "
+                        "rule)")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint the decoder blocks "
+                        "(rematerialize activations — slower, fits "
+                        "bigger models per chip)")
+    p.add_argument("--no-streaming-stats", action="store_true",
+                   help="disable the device-resident streaming-"
+                        "statistics sink (per-dispatch accumulator "
+                        "fold, live percentile/kappa estimates, "
+                        "accumulator checkpoints); analysis then runs "
+                        "only off the row artifact (DEPLOY.md §1j)")
 
 
 def _engine_rt_kw(args, rt_kw: dict) -> None:
@@ -224,6 +261,18 @@ def _engine_rt_kw(args, rt_kw: dict) -> None:
         rt_kw["aot_precompile"] = False
     if getattr(args, "precompile_workers", None) is not None:
         rt_kw["precompile_workers"] = args.precompile_workers
+    if getattr(args, "dtype", None) is not None:
+        rt_kw["dtype"] = args.dtype
+    if getattr(args, "logits_dtype", None) is not None:
+        rt_kw["logits_dtype"] = args.logits_dtype
+    if getattr(args, "scan_positions", None) is not None:
+        rt_kw["scan_positions"] = args.scan_positions
+    if getattr(args, "topk_match", None) is not None:
+        rt_kw["topk_match"] = args.topk_match
+    if getattr(args, "remat", False):
+        rt_kw["remat"] = True
+    if getattr(args, "no_streaming_stats", False):
+        rt_kw["streaming_stats"] = False
 
 
 def _add_kernel_flags(p) -> None:
@@ -364,6 +413,13 @@ def _add_serve(sub) -> None:
                    default=None,
                    help="full dispatch failures in a row before the "
                         "circuit breaker opens (default 3)")
+    p.add_argument("--stream-window", type=int, default=None,
+                   help="live streaming-statistics ring size (default "
+                        "4096): a JSONL request line {\"op\": "
+                        "\"stats\"} returns in-progress percentile/"
+                        "kappa estimates over the last N served rows "
+                        "without touching the device; 0 disables "
+                        "(DEPLOY.md §1j)")
     _add_prefix_pool_flags(p)
     _add_engine_tuning_flags(p)
     _add_guard_flags(p)
@@ -518,6 +574,8 @@ def cmd_perturb(args) -> None:
     _guard_rt_kw(args, rt_kw)
     _kernel_rt_kw(args, rt_kw)
     _prefix_rt_kw(args, rt_kw)
+    if args.no_row_artifact:
+        rt_kw["row_artifact"] = False
     if args.barrier_timeout is not None:
         rt_kw["barrier_timeout_s"] = args.barrier_timeout
     factory = engine_factory(
@@ -568,6 +626,8 @@ def cmd_serve(args) -> None:
     serve_kw = {}
     if args.max_consecutive_failures is not None:
         serve_kw["max_consecutive_failures"] = args.max_consecutive_failures
+    if args.stream_window is not None:
+        serve_kw["stream_window"] = args.stream_window
     serve_cfg = ServeConfig(
         queue_depth=args.queue_depth, classes=tuple(classes.items()),
         linger_s=args.linger_ms / 1000.0,
@@ -613,6 +673,15 @@ def cmd_serve(args) -> None:
             if not line:
                 continue
             obj = json.loads(line)
+            if obj.get("op") == "stats":
+                # Live streaming-statistics readout: in-progress
+                # percentile/kappa estimates over the served window,
+                # answered immediately from the host-side ring (no
+                # device work, no queueing).
+                print(json.dumps({"op": "stats",
+                                  "stats": server.stream_summary()}),
+                      flush=True)
+                continue
             prompt = obj.get("prompt")
             req = ServeRequest(
                 binary_prompt=obj.get(
@@ -637,6 +706,9 @@ def cmd_serve(args) -> None:
     if args.state_checkpoint is not None and args.state_checkpoint.exists():
         args.state_checkpoint.unlink()   # clean drain: nothing pending
     log.info("serve stats: %s", json.dumps(server.stats.summary()))
+    if server.stream is not None:
+        log.info("serve stream stats: %s",
+                 json.dumps(server.stream_summary()))
     if engine.prefix_cache is not None:
         log.info("serve prefix cache: %s",
                  json.dumps(engine.prefix_stats.summary()))

@@ -183,6 +183,26 @@ class RuntimeConfig:
     # offenders as error:numerics instead of writing garbage
     # (guard/numerics.py).
     numerics_guard: bool = True       # host-only (validates host readouts)
+    # Streaming statistics (engine/stream_stats.py + stats/streaming):
+    # every scoring dispatch folds its position-0 readouts into a
+    # device-resident accumulator lattice with ONE fused update (no
+    # per-row device->host transfer), checkpointed at flush boundaries
+    # and merged across hosts at the shard fences; grid -> percentile/
+    # kappa/bootstrap-CI estimates come straight off the accumulator
+    # (live mid-run via the serve `stats` endpoint, final via
+    # StreamSink.finalize). The bootstrap key is recorded in the sweep
+    # manifest so CIs reproduce across resume and re-runs. OFF restores
+    # the csv-reload-only pipeline (which always remains available for
+    # parity — DEPLOY.md §1j).
+    streaming_stats: bool = True      # host-only (sink policy, not shapes)
+    # With streaming stats ON, the per-row results artifact (csv/xlsx
+    # rows + manifest union resume) becomes OPTIONAL schema parity:
+    # row_artifact=False skips materializing rows entirely — the
+    # dispatch loop then transfers NO per-row payloads through the host
+    # (resume runs off the manifest + accumulator checkpoint alone).
+    # Ignored (rows always written) when streaming_stats is off.
+    row_artifact: bool = True         # host-only
+
     # Multihost liveness — sweep shard boundaries run a heartbeat
     # allgather + barrier bounded by this timeout; a dead peer host
     # then raises HostDesyncError on the survivors (manifest already
@@ -283,6 +303,13 @@ class ServeConfig:
     """
 
     queue_depth: int = 256
+    # Live streaming-statistics window (engine/stream_stats.py
+    # ServeStreamSink): the `stats` endpoint reports percentile/kappa
+    # estimates over the last `stream_window` resolved rows, grouped by
+    # target pair; folded idempotently by content address so SIGTERM
+    # checkpoint/resume never double-counts a row. Gated on
+    # RuntimeConfig.streaming_stats; 0 disables the ring.
+    stream_window: int = 4096
     # Cross-request radix prefix cache (engine/prefix_tree.py over
     # models/paged.py): ON by default for serving — an arriving request
     # whose tokenized prefix is already resident pays prefill only for

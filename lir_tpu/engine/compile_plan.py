@@ -122,6 +122,19 @@ def grouped_paged_spec(bucket: int, groups: int, batch: int, window: int,
                      bool(scratch), int(window))
 
 
+def stream_fold_spec(n_prompts: int, n_rephrase: int, batch: int,
+                     guard: bool) -> ShapeSpec:
+    """Streaming-statistics accumulator update (engine/stream_stats.
+    fold_update) for one fold width: ``bucket`` carries the prompt
+    count, ``groups`` the rephrase-slot count, ``batch`` the dispatch's
+    fold width (shared: padded member rows; grouped: one branch's row
+    count), and ``stops_armed`` the numerics-guard bit — the guard is a
+    STATIC of the fold program (it changes the lowered predicate), so
+    guarded and unguarded sinks can never share an executable."""
+    return ShapeSpec("stream_fold", int(n_prompts), int(batch),
+                     int(n_rephrase), 0, 0, 0, 0, bool(guard), False)
+
+
 def piggy_prefill_spec(bucket: int, batch: int, sfx_a: int, sfx_b: int,
                        new_tokens: int, conf_tokens: int) -> ShapeSpec:
     """Chain opener (generate.shared_piggyback_prefill): prefill + suffix
@@ -156,7 +169,9 @@ def piggy_drain_spec(bucket: int, batch: int, sfx_a: int, sfx_b: int,
 def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
                conf_tokens: int, stops_armed: bool,
                prefix_page_size: int = 0,
-               piggyback: bool = False) -> List[ShapeSpec]:
+               piggyback: bool = False,
+               stream_shape: Optional[Tuple[int, int, bool]] = None,
+               ) -> List[ShapeSpec]:
     """Distinct executables a dispatch plan will call, in first-use order
     (the precompile pool works the list front-to-back, so the first
     bucket's executable compiles first and the dispatch loop rarely
@@ -176,7 +191,14 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
     same-shape shared dispatches — the exact chains the sweep forms:
     opener (prefill-only), step (parked decode + next prefill), and
     drain. Plain specs stay planned regardless (the runtime memory gate
-    may refuse a chain, and the recovery path re-dispatches plainly)."""
+    may refuse a chain, and the recovery path re-dispatches plainly).
+
+    ``stream_shape`` = (n_prompts, n_rephrase, numerics_guard) plans the
+    streaming-statistics accumulator-update executable for every
+    distinct fold width the plan's dispatches will use (shared: the
+    padded member-row count; grouped: one branch's row count), so the
+    sink's per-dispatch fold never pays trace-on-first-call inside the
+    timed loop either. Planned FIRST — the very first dispatch folds."""
     from ..models import paged as paged_mod
 
     specs: List[ShapeSpec] = []
@@ -188,6 +210,12 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
             seen.add(spec)
             specs.append(spec)
 
+    if stream_shape is not None:
+        n_prompts, n_rephrase, guard = stream_shape
+        for d in dispatches:
+            _, m_pad = d.padded_rows(batch_size)
+            width = m_pad if d.kind == "shared" else len(d.items)
+            add(stream_fold_spec(n_prompts, n_rephrase, width, guard))
     for d in dispatches:
         g_pad, m_pad = d.padded_rows(batch_size)
         if d.kind == "shared":
@@ -401,6 +429,12 @@ def _lower_compile(engine, spec: ShapeSpec):
     (tracing only, no device work)."""
     from . import generate
 
+    if spec.kind == "stream_fold":
+        from . import stream_stats
+
+        return stream_stats.lower_fold(
+            spec.bucket, spec.groups, spec.batch, TOPK,
+            spec.stops_armed).compile()
     if spec.kind.startswith("piggy"):
         fn = {"piggy_prefill": generate.shared_piggyback_prefill,
               "piggy_step": generate.shared_piggyback_step,

@@ -1,0 +1,527 @@
+"""Device-resident streaming statistics: grid -> CIs with no host row
+round-trip (ROADMAP item 4).
+
+The paper's deliverables are distributions, not rows — percentile CIs
+over ~2,000 rephrasings per prompt, within-prompt kappa/agreement
+contingency counts, bootstrap CIs on the per-prompt means. Before this
+module the sweep materialized every row to results.csv and the
+``stats``/``survey`` layers re-loaded it host-side; at the ROADMAP's
+1M-rephrasing scale that host round-trip (generated ids + top-20 maps +
+text per row) dominates the post-sweep cost and is the only reason live
+reliability estimates don't exist mid-run.
+
+The sink is a donated accumulator pytree held on device:
+
+- ``filled`` (P, R) int32 — which grid cells have folded;
+- ``rel``    (P, R) f32  — per-cell relative probability P(yes)/(P(yes)+
+  P(no)), NaN for zero-mass or guard-quarantined cells;
+- ``conf``   (P, R) f32  — per-cell weighted confidence, NaN likewise;
+- ``dec``    (P, R) int32 — binarized decision (1 = yes > no, 0 = no,
+  -1 = invalid). Computed as ``yes > no`` on device, which is EXACTLY
+  equivalent to the host pipeline's float64 ``Relative_Prob > 0.5``
+  rule (y > n in float32 implies y/(y+n) >= 0.5 + 2.5e-8 in float64 —
+  far outside division rounding), so contingency counts match the
+  csv-reload path bitwise.
+
+Every scoring dispatch updates it with ONE fused XLA call
+(:func:`fold_update`, accumulator donated, padding rows dropped via an
+out-of-range scatter index) — no per-row device->host transfer in the
+dispatch hot loop. The per-cell slot layout is the design's crux:
+scatter writes are idempotent and commutative, so
+
+- a resumed sweep re-folding rows that were dispatched but not yet
+  checkpointed lands bitwise on the same accumulator (greedy decode is
+  deterministic per backend) — `make chaos-smoke` proves resume-merged
+  accumulators identical to an uninterrupted run;
+- multihost shards fold disjoint slots and merge at the shard fence by
+  elementwise union (stats/streaming.merge_accums) — order-free, no
+  float reassociation;
+- moments/percentiles/kappa/bootstrap reduce from the lattice in ONE
+  canonical order at finalize (stats/streaming), so Welford/Chan-style
+  running sums never accumulate in a resume-dependent order.
+
+Memory: 16 bytes per grid cell (DEPLOY.md §1j arithmetic) — a
+1M-rephrasing sweep holds a 16 MB accumulator where the row artifact
+would stream ~2 KB per row through the host.
+
+The device-side validity predicate mirrors guard/numerics.check_values
+exactly (probs finite in [0,1], sum <= 1 + eps, weighted confidence in
+[0,100], top-20 logprob map NaN-free and non-positive) so a row the
+host pipeline quarantines as ``error:numerics`` is NaN'd here too —
+counts agree bitwise with the csv-reload path whether or not rows were
+ever materialized.
+
+:class:`ServeStreamSink` is the online variant: serving answers clients
+host-side anyway, so it folds resolved payloads into a bounded ring
+(grouped by target pair) keyed by content address — idempotent across
+SIGTERM checkpoint/resume, which is what keeps ``inflight_cancelled``
+rows from double-counting.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import os
+import tempfile
+import threading
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import get_logger
+from ..utils.profiling import StreamStats
+
+log = get_logger(__name__)
+
+# Validation slop mirrored from guard/numerics.py — rounding, not
+# tolerance for corruption. Kept numerically identical so the device
+# predicate and the host quarantine can never disagree about a row.
+_P_EPS = 1e-4
+_SUM_EPS = 1e-3
+_CONF_EPS = 1e-3
+
+ACCUM_SUFFIX = ".accum.npz"
+
+
+def new_accum(n_prompts: int, n_rephrase: int) -> Dict[str, jax.Array]:
+    """Fresh device accumulator lattice for a (P, R) grid."""
+    P, R = int(n_prompts), int(n_rephrase)
+    return {
+        "filled": jnp.zeros((P, R), jnp.int32),
+        "rel": jnp.full((P, R), jnp.nan, jnp.float32),
+        "conf": jnp.full((P, R), jnp.nan, jnp.float32),
+        "dec": jnp.full((P, R), -1, jnp.int32),
+    }
+
+
+def _row_ok(yes, no, wconf, lp):
+    """Device mirror of guard/numerics.check_values over the fields the
+    statistics consume."""
+    ok = jnp.isfinite(yes) & (yes >= -_P_EPS) & (yes <= 1.0 + _P_EPS)
+    ok &= jnp.isfinite(no) & (no >= -_P_EPS) & (no <= 1.0 + _P_EPS)
+    ok &= (yes + no) <= 1.0 + _SUM_EPS
+    ok &= (jnp.isfinite(wconf) & (wconf >= -_CONF_EPS)
+           & (wconf <= 100.0 + _CONF_EPS))
+    ok &= ~jnp.any(jnp.isnan(lp), axis=-1)
+    ok &= ~jnp.any(lp > _P_EPS, axis=-1)
+    return ok
+
+
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("guard",))
+def fold_update(acc, yes, no, wconf, lp, pidx, ridx, *,
+                guard: bool = True):
+    """One fused accumulator update per dispatch (the tentpole kernel).
+
+    ``yes``/``no``/``wconf`` are the dispatch's (B,) position-0 readouts,
+    ``lp`` its (B, K) top-K logprob values, ``pidx``/``ridx`` the (B,)
+    grid coordinates of each row — padding rows carry ``ridx == R``
+    (out of range) and are dropped by the scatter, so a dispatch's pad
+    rows can never overwrite a real cell regardless of what values the
+    engine happened to pad with. The accumulator is DONATED: the update
+    is an in-place scatter on device, not a copy. ``guard`` is STATIC
+    (baked into the executable): False — the numerics guard disabled —
+    accepts every row verbatim, matching the host pipeline."""
+    ok = (_row_ok(yes, no, wconf, lp) if guard
+          else jnp.ones(yes.shape, bool))
+    total = yes + no
+    has_mass = total > 0
+    rel = jnp.where(ok & has_mass, yes / total, jnp.nan)
+    conf = jnp.where(ok, wconf, jnp.nan)
+    dec = jnp.where(ok & has_mass, (yes > no).astype(jnp.int32), -1)
+    at = lambda leaf: leaf.at[pidx, ridx]  # noqa: E731
+    return {
+        "filled": at(acc["filled"]).set(1, mode="drop"),
+        "rel": at(acc["rel"]).set(rel.astype(jnp.float32), mode="drop"),
+        "conf": at(acc["conf"]).set(conf.astype(jnp.float32),
+                                    mode="drop"),
+        "dec": at(acc["dec"]).set(dec, mode="drop"),
+    }
+
+
+def lower_fold(n_prompts: int, n_rephrase: int, batch: int, topk: int,
+               guard: bool):
+    """AOT lowering of :func:`fold_update` for one dispatch batch shape
+    (engine/compile_plan plans one per distinct fold width, so the sweep
+    loop never pays trace-on-first-call for the sink either)."""
+    P, R, B = int(n_prompts), int(n_rephrase), int(batch)
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+    acc = {"filled": i32(P, R), "rel": f32(P, R), "conf": f32(P, R),
+           "dec": i32(P, R)}
+    return fold_update.lower(acc, f32(B), f32(B), f32(B), f32(B, topk),
+                             i32(B), i32(B), guard=guard)
+
+
+class StreamSink:
+    """Per-sweep streaming sink: owns the device accumulator, the fold
+    entry point, checkpoint save/load, and the multihost fence merge.
+
+    The accumulator is only ever touched from the sweep writer thread
+    (folds are serialized in dispatch order there), so no lock guards
+    it; the StreamStats counters are thread-safe on their own.
+    """
+
+    def __init__(self, n_prompts: int, n_rephrase: int, seed: int,
+                 guard: bool = True,
+                 stats: Optional[StreamStats] = None,
+                 registry_get: Optional[Callable] = None):
+        self.n_prompts = int(n_prompts)
+        self.n_rephrase = int(n_rephrase)
+        self.seed = int(seed)
+        self.guard = bool(guard)
+        self.stats = stats if stats is not None else StreamStats()
+        # Optional AOT registry hook (engine/compile_plan): called with
+        # the fold batch width; returns a compiled executable or None
+        # (lazy jit fallback — always correct).
+        self.registry_get = registry_get
+        self._acc = new_accum(self.n_prompts, self.n_rephrase)
+        # Mesh placement: on a sharded engine the dispatch outputs carry
+        # a NamedSharding, so the accumulator must live REPLICATED on
+        # that same mesh (set on first fold; see _ensure_placement).
+        # Registry executables are lowered single-device and are
+        # bypassed then — the jit path compiles for the mesh shardings.
+        self._mesh_placed = False
+        self.stats.gauge("accum_bytes", self.accum_bytes)
+
+    @property
+    def accum_bytes(self) -> int:
+        return sum(leaf.nbytes for leaf in self._acc.values())
+
+    # -- fold (dispatch hot loop: device-side only) --------------------------
+
+    def _ensure_placement(self, ref) -> None:
+        """Colocate the accumulator with the dispatch outputs. A mesh
+        engine's readouts are committed to the mesh; folding them
+        against a single-device accumulator would be an incompatible-
+        devices error, so the lattice is replicated onto that mesh once
+        (PartitionSpec() — every device holds the identical copy; the
+        scatter update then runs replicated and deterministic). Static
+        metadata only: no device sync."""
+        if self._mesh_placed:
+            return
+        sh = getattr(ref, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is None or len(getattr(sh, "device_set", ())) <= 1:
+            self._mesh_placed = True   # single-device: nothing to do
+            return
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        target = NamedSharding(mesh, PartitionSpec())
+        self._acc = jax.device_put(self._acc, target)
+        # AOT fold executables were lowered without shardings — a mesh
+        # sink takes the lazily-jitted path, which compiles for the
+        # actual input shardings.
+        self.registry_get = None
+        self._mesh_placed = True
+
+    def fold(self, yes, no, wconf, lp, cells: Sequence,
+             topk: int) -> None:
+        """Fold one dispatch's device readouts. ``cells`` are the REAL
+        grid cells of the dispatch in row order; rows beyond them are
+        padding and fold with an out-of-range slot (dropped). The update
+        is ONE fused device call; nothing here reads a device value."""
+        self._ensure_placement(yes)
+        bsz = int(yes.shape[0])
+        n = len(cells)
+        pidx = np.zeros(bsz, np.int32)
+        ridx = np.full(bsz, self.n_rephrase, np.int32)  # pad -> dropped
+        for j, c in enumerate(cells):
+            pidx[j] = c.prompt_idx
+            ridx[j] = c.rephrase_idx
+        compiled = (self.registry_get(bsz, topk)
+                    if self.registry_get is not None else None)
+        if compiled is not None:
+            self._acc = compiled(self._acc, yes, no, wconf, lp,
+                                 jnp.asarray(pidx), jnp.asarray(ridx))
+        else:
+            self._acc = fold_update(self._acc, yes, no, wconf, lp,
+                                    jnp.asarray(pidx),
+                                    jnp.asarray(ridx), guard=self.guard)
+        self.stats.count("rows_folded", n)
+        self.stats.count("dispatch_folds")
+
+    def note_bytes_avoided(self, arrays: Sequence) -> None:
+        """Account the per-row payload bytes the csv path would have
+        device_get for this dispatch (shape metadata only — no sync)."""
+        self.stats.count("host_bytes_avoided",
+                         sum(int(a.nbytes) for a in arrays))
+
+    # -- readout boundary (checkpoints, fences, finalize) --------------------
+
+    def snapshot(self):
+        """Explicit device->host readout of the accumulator (the ONE
+        sanctioned transfer: a few bytes per grid cell, at checkpoint /
+        fence / finalize cadence, never per row)."""
+        from ..stats import streaming
+
+        host = jax.device_get(self._acc)
+        return streaming.HostAccum(
+            filled=np.asarray(host["filled"]),
+            rel=np.asarray(host["rel"]),
+            conf=np.asarray(host["conf"]),
+            dec=np.asarray(host["dec"]),
+            seed=self.seed)
+
+    def checkpoint(self, path: Path) -> None:
+        """Atomic accumulator snapshot next to the results artifact
+        (PR-4 manifest machinery: tmp + fsync + rename, so a kill
+        mid-checkpoint leaves the previous snapshot, never a torn one).
+        Called at every flush boundary and from the preemption exit
+        path — a resumed sweep seeds from it and re-folds only what the
+        manifest says is pending (idempotent by slot layout)."""
+        acc = self.snapshot()
+        save_accum(acc, path)
+        self.stats.count("checkpoints")
+
+    def load(self, path: Path) -> bool:
+        """Seed the device accumulator from a prior checkpoint. Shape
+        mismatch (a different grid) starts fresh instead of corrupting."""
+        acc = load_accum(path)
+        if acc is None:
+            return False
+        if acc.filled.shape != (self.n_prompts, self.n_rephrase):
+            log.warning("stream accum %s has shape %s != grid (%d, %d); "
+                        "starting fresh", path, acc.filled.shape,
+                        self.n_prompts, self.n_rephrase)
+            return False
+        self.seed = int(acc.seed)
+        self._acc = {
+            "filled": jnp.asarray(acc.filled),
+            "rel": jnp.asarray(acc.rel),
+            "conf": jnp.asarray(acc.conf),
+            "dec": jnp.asarray(acc.dec),
+        }
+        self._mesh_placed = False   # re-colocate on the next fold
+        return True
+
+    def merge_across_hosts(self):
+        """Multihost fence merge: allgather every host's (disjoint)
+        shard accumulator and union them slot-wise. A COLLECTIVE —
+        every host must call it at the same fence. Returns the merged
+        HostAccum (identical on every host)."""
+        from ..parallel import multihost
+        from ..stats import streaming
+
+        mine = self.snapshot()
+        gathered = [
+            streaming.HostAccum(filled=f, rel=r, conf=c, dec=d,
+                                seed=self.seed)
+            for f, r, c, d in zip(
+                multihost.gather_stacked(mine.filled),
+                multihost.gather_stacked(mine.rel),
+                multihost.gather_stacked(mine.conf),
+                multihost.gather_stacked(mine.dec))
+        ]
+        merged = streaming.merge_accums(gathered)
+        self.stats.count("merges")
+        return merged
+
+    def finalize(self, n_boot: int = 1000, confidence: float = 0.95):
+        """Grid -> CIs directly from the accumulator (no csv reload).
+        Also the live mid-run estimate: callable at any point of a
+        running sweep for in-progress percentile/kappa estimates."""
+        import time as _time
+
+        from ..stats import streaming
+
+        t0 = _time.perf_counter()
+        out = streaming.summarize(self.snapshot(), n_boot=n_boot,
+                                  confidence=confidence)
+        self.stats.count("finalize_s", _time.perf_counter() - t0)
+        return out
+
+
+def save_accum(acc, path: Path) -> None:
+    """Crash-safe accumulator write (tmp + fsync + rename)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=str(path.parent),
+                               prefix=path.name + ".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, filled=acc.filled, rel=acc.rel, conf=acc.conf,
+                     dec=acc.dec, seed=np.int64(acc.seed))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_accum(path: Path):
+    """Read a checkpointed accumulator; None when missing/unreadable
+    (resume then re-folds from the manifest's pending set alone)."""
+    from ..stats import streaming
+
+    path = Path(path)
+    if not path.exists():
+        return None
+    try:
+        with np.load(path) as z:
+            return streaming.HostAccum(
+                filled=z["filled"], rel=z["rel"], conf=z["conf"],
+                dec=z["dec"], seed=int(z["seed"]))
+    except Exception as err:  # noqa: BLE001 — a torn/foreign file only
+        # costs re-folding; never fails the resume.
+        log.warning("stream accum %s unreadable (%r); starting fresh",
+                    path, err)
+        return None
+
+
+class ServeStreamSink:
+    """Online streaming sink: live percentile/kappa estimates over the
+    last ``window`` served rows, grouped by target pair.
+
+    Serving transfers every payload host-side anyway (clients need
+    answers),
+    so folds consume resolved payloads — which is also what makes the
+    accounting idempotent across a SIGTERM checkpoint: a row folds iff
+    its future resolved ok, exactly once, keyed by content address. An
+    ``inflight_cancelled`` row never folds (its future resolved expired
+    before the payload landed); if it is re-submitted after a resume it
+    folds on its fresh score — once.
+    """
+
+    def __init__(self, window: int = 4096, max_groups: int = 64,
+                 stats: Optional[StreamStats] = None):
+        self.window = max(int(window), 1)
+        self.max_groups = int(max_groups)
+        self.stats = stats if stats is not None else StreamStats()
+        self._lock = threading.Lock()
+        # Ring lattice + idempotence set; all guarded by _lock (the
+        # supervisor folds while stats endpoints read).
+        self._group_ids: Dict[Tuple[str, str], int] = {}  # guarded-by: _lock
+        self._group: np.ndarray = np.full(self.window, -1, np.int32)  # guarded-by: _lock
+        self._rel: np.ndarray = np.full(self.window, np.nan, np.float64)  # guarded-by: _lock
+        self._conf: np.ndarray = np.full(self.window, np.nan, np.float64)  # guarded-by: _lock
+        self._dec: np.ndarray = np.full(self.window, -1, np.int32)  # guarded-by: _lock
+        self._head: int = 0  # guarded-by: _lock
+        self._folded: "collections.OrderedDict[str, None]" = (  # guarded-by: _lock
+            collections.OrderedDict())
+        self._folded_cap = max(8 * self.window, 65536)
+
+    def _group_id(self, targets: Tuple[str, str]) -> int:  # guarded-by: _lock
+        gid = self._group_ids.get(targets)
+        if gid is None:
+            if len(self._group_ids) >= self.max_groups:
+                return self.max_groups - 1  # overflow bucket
+            gid = len(self._group_ids)
+            self._group_ids[targets] = gid
+        return gid
+
+    def fold_payload(self, key, targets: Tuple[str, str],
+                     payload: Dict) -> bool:
+        """Fold one resolved measurement payload; returns False when the
+        content address already folded (dedup hit, checkpoint resume,
+        re-submitted cancelled row) — the double-count guard."""
+        key = str(key)
+        t1p = payload.get("token_1_prob")
+        t2p = payload.get("token_2_prob")
+        wc = payload.get("weighted_confidence")
+        with self._lock:
+            if key in self._folded:
+                return False
+            self._folded[key] = None
+            while len(self._folded) > self._folded_cap:
+                self._folded.popitem(last=False)
+            i = self._head % self.window
+            self._head += 1
+            self._group[i] = self._group_id(tuple(targets))
+            if (t1p is not None and t2p is not None
+                    and np.isfinite(t1p) and np.isfinite(t2p)
+                    and t1p + t2p > 0):
+                self._rel[i] = t1p / (t1p + t2p)
+                self._dec[i] = 1 if t1p > t2p else 0
+            else:
+                self._rel[i] = np.nan
+                self._dec[i] = -1
+            self._conf[i] = (float(wc) if wc is not None
+                             and np.isfinite(wc) else np.nan)
+        self.stats.count("rows_folded")
+        return True
+
+    def summary(self) -> Dict[str, object]:
+        """Live estimates over the ring: per-group n/mean/percentiles of
+        the relative probability, confidence mean, and the within-group
+        kappa over binarized decisions (stats/streaming closed form)."""
+        from ..stats import streaming
+
+        self.stats.count("live_queries")
+        with self._lock:
+            used = self._group >= 0
+            group = self._group[used].copy()
+            rel = self._rel[used].copy()
+            conf = self._conf[used].copy()
+            dec = self._dec[used].copy()
+            names = {gid: list(t) for t, gid in self._group_ids.items()}
+        per_group: Dict[str, object] = {}
+        for gid in sorted(set(group.tolist())):
+            m = group == gid
+            r = rel[m]
+            r = r[np.isfinite(r)]
+            entry: Dict[str, object] = {
+                "targets": names.get(gid, ["?", "?"]),
+                "rows": int(m.sum()), "n_valid": int(r.size),
+            }
+            if r.size:
+                entry.update({
+                    "mean_relative_prob": float(r.mean()),
+                    "p2_5": float(np.percentile(r, 2.5)),
+                    "p97_5": float(np.percentile(r, 97.5)),
+                })
+            c = conf[m]
+            c = c[np.isfinite(c)]
+            if c.size:
+                entry["mean_weighted_confidence"] = float(c.mean())
+            per_group[str(gid)] = entry
+        valid = dec >= 0
+        kap = streaming.kappa_from_counts(
+            *streaming.group_counts(group[valid], dec[valid]))
+        return {"rows_folded": int(self._head),
+                "window": int(min(self._head, self.window)),
+                "per_group": per_group, "kappa": kap}
+
+    # -- SIGTERM checkpoint / resume -----------------------------------------
+
+    def state(self) -> Dict[str, object]:
+        """JSON-serializable snapshot for the serve shutdown checkpoint:
+        the ring lattice AND the folded-key set, so a resumed server
+        never re-folds a row the previous incarnation counted."""
+        with self._lock:
+            return {
+                "window": self.window,
+                "head": self._head,
+                "groups": [[list(t), gid]
+                           for t, gid in self._group_ids.items()],
+                "group": self._group.tolist(),
+                "rel": [None if not np.isfinite(v) else float(v)
+                        for v in self._rel],
+                "conf": [None if not np.isfinite(v) else float(v)
+                         for v in self._conf],
+                "dec": self._dec.tolist(),
+                "folded": list(self._folded.keys()),
+            }
+
+    def restore(self, state: Optional[Dict[str, object]]) -> None:
+        if not state or int(state.get("window", 0)) != self.window:
+            return
+        with self._lock:
+            self._head = int(state["head"])
+            self._group_ids = {tuple(t): int(g)
+                               for t, g in state["groups"]}
+            self._group = np.asarray(state["group"], np.int32)
+            self._rel = np.asarray(
+                [np.nan if v is None else v for v in state["rel"]],
+                np.float64)
+            self._conf = np.asarray(
+                [np.nan if v is None else v for v in state["conf"]],
+                np.float64)
+            self._dec = np.asarray(state["dec"], np.int32)
+            self._folded = collections.OrderedDict(
+                (k, None) for k in state.get("folded", ()))

@@ -28,13 +28,14 @@ from ..guard import numerics
 from ..data.prompts import LegalPrompt
 from ..utils.logging import get_logger
 from ..utils.manifest import SweepManifest
-from ..utils.profiling import OccupancyStats
+from ..utils.profiling import OccupancyStats, StreamStats
 from ..utils.retry import retry_with_exponential_backoff
 from . import compile_plan
 from . import generate
 from . import grid as grid_mod
 from . import scheduler as sched_mod
 from . import score as score_mod
+from . import stream_stats as stream_mod
 from . import tokens as tok
 from .runner import PiggybackIneligible, ScoringEngine, _tail_batch
 
@@ -220,6 +221,38 @@ def run_perturbation_sweep(
     todo = grid_mod.pending_cells(cells, manifest)
     log.info("%s: %d/%d grid cells pending", model_name, len(todo), len(cells))
 
+    # Streaming statistics (engine/stream_stats.py): a device-resident
+    # accumulator lattice every scoring dispatch updates with ONE fused
+    # XLA call — grid -> percentile/kappa/bootstrap-CI estimates without
+    # round-tripping rows through the host. The bootstrap key is
+    # RECORDED in the manifest on first run and read back on resume, so
+    # streaming CIs are reproducible across resume and across
+    # --no-streaming-stats re-runs over the row artifact; the
+    # accumulator itself checkpoints at every flush boundary (atomic
+    # write) and re-seeds from that checkpoint, with re-folds of
+    # already-dispatched rows idempotent by slot layout.
+    sink = None
+    accum_path = None
+    write_rows = True
+    if (engine.rt.streaming_stats and not reasoning
+            and not engine.encoder_decoder and cells):
+        n_reph = 1 + max(c.rephrase_idx for c in cells)
+        stream_seed = manifest.meta.get("stream_seed")
+        if stream_seed is None:
+            stream_seed = int(seed)
+            manifest.set_meta("stream_seed", stream_seed)
+        sink = stream_mod.StreamSink(
+            len(prompts), n_reph, int(stream_seed),
+            guard=engine.rt.numerics_guard, stats=StreamStats())
+        accum_path = results_path.with_suffix(stream_mod.ACCUM_SUFFIX)
+        if len(manifest) and accum_path.exists():
+            if sink.load(accum_path):
+                log.info("streaming stats: resumed accumulator from %s "
+                         "(%d rows already folded)", accum_path,
+                         sink.snapshot().rows_folded)
+        write_rows = bool(engine.rt.row_artifact)
+    engine.stream_sink = sink
+
     # Pre-resolve per-prompt target token ids once (SURVEY §7 hard part 1).
     target_ids = {
         pi: tok.target_token_ids(engine.tokenizer, p.target_tokens,
@@ -259,9 +292,22 @@ def run_perturbation_sweep(
                 pending_rows = []
     else:
         engine.compile_stats.snapshot_persistent()
-        _run_pipelined(engine, model_name, todo, target_ids, results_path,
-                       manifest, checkpoint_every, new_tokens, conf_tokens,
-                       rows, pending_rows)
+        try:
+            _run_pipelined(engine, model_name, todo, target_ids,
+                           results_path, manifest, checkpoint_every,
+                           new_tokens, conf_tokens, rows, pending_rows,
+                           sink=sink, accum_path=accum_path,
+                           write_rows=write_rows)
+        finally:
+            # Flush the PARTIAL accumulator on every exit path —
+            # including a preemption kill (BaseException) and the chaos
+            # harness's injected faults — so a resumed sweep seeds from
+            # the latest folds. Safe against the manifest done-set:
+            # folds are idempotent per cell, so rows dispatched-but-not-
+            # marked re-fold to bitwise-identical values, never double-
+            # count (pinned by make chaos-smoke scenario 7).
+            if sink is not None and accum_path is not None:
+                sink.checkpoint(accum_path)
         engine.compile_stats.finish_persistent()
         log.info("compile plan: %s",
                  json.dumps(engine.compile_stats.summary()))
@@ -275,15 +321,24 @@ def run_perturbation_sweep(
                 and engine.kernel_stats.counters:
             log.info("piggyback chains: %s",
                      json.dumps(engine.kernel_stats.counters))
+        if sink is not None:
+            # Cheap finalize (counts + kappa; CIs on demand via
+            # sink.finalize(n_boot=...)) — the live-estimate readout.
+            final = sink.finalize(n_boot=0)
+            log.info("streaming stats: %d rows folded on device, "
+                     "kappa=%.4f; counters: %s",
+                     final["rows_folded"], final["kappa"]["kappa"],
+                     json.dumps(sink.stats.summary()))
 
     if pending_rows:
-        _flush(pending_rows, results_path, manifest)
+        _flush(pending_rows, results_path, manifest, sink=sink,
+               accum_path=accum_path)
     if shard_grid:
         # A host whose shard had zero pending cells (grid smaller than the
         # pod, or a fully-resumed shard) still writes a header-only shard
         # file: the post-barrier merge distinguishes "host had nothing to
         # do" from "shard invisible — no shared filesystem" by existence.
-        if not results_path.exists():
+        if write_rows and not results_path.exists():
             schemas.write_perturbation_results([], results_path)
         # Fence so no host's caller reads partial peers; per-host workbooks
         # concatenate row-wise (the D6 schema has no cross-row state).
@@ -296,7 +351,25 @@ def run_perturbation_sweep(
             "perturbation-sweep-done",
             timeout_s=engine.rt.barrier_timeout_s,
             payload=len(rows), stats=engine.guard_stats)
-        if __import__("jax").process_index() == 0:
+        if sink is not None:
+            # Streaming-statistics fence merge: allgather every host's
+            # (disjoint) shard accumulator and union slot-wise — ONE
+            # small collective per sweep, so a pod-wide run produces
+            # one global accumulator without any host touching rows.
+            # Runs between the liveness barriers: peers are known alive
+            # and their folds flushed. Every host computes the merged
+            # lattice (the collective is symmetric); host 0 persists it
+            # next to the merged row artifact.
+            merged_acc = sink.merge_across_hosts()
+            if __import__("jax").process_index() == 0:
+                merged_path = schemas.resolve_results_path(
+                    base_results_path).with_suffix(
+                        stream_mod.ACCUM_SUFFIX)
+                stream_mod.save_accum(merged_acc, merged_path)
+                log.info("multihost: merged stream accumulator -> %s "
+                         "(%d rows folded)", merged_path,
+                         merged_acc.rows_folded)
+        if __import__("jax").process_index() == 0 and write_rows:
             # Gather step on a shared filesystem: merge every visible
             # .hostN shard (+ manifests) into the final artifact — the
             # reference's "download each batch output and append"
@@ -382,7 +455,8 @@ def _plan_ragged(engine, todo, new_tokens, conf_tokens):
 
 def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                    manifest, checkpoint_every, new_tokens, conf_tokens,
-                   rows, pending_rows) -> None:
+                   rows, pending_rows, sink=None, accum_path=None,
+                   write_rows=True) -> None:
     """Greedy (non-reasoning) sweep loop, pipelined over a writer thread.
 
     The device is the scarce resource; everything host-side rides shotgun:
@@ -444,14 +518,48 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 prefix_page_size=(engine.prefix_cache.page_size
                                   if engine.prefix_cache is not None
                                   else 0),
-                piggyback=engine.piggyback_supported())
+                piggyback=engine.piggyback_supported(),
+                stream_shape=(None if sink is None else
+                              (sink.n_prompts, sink.n_rephrase,
+                               sink.guard)))
             engine.exec_registry = compile_plan.precompile_async(
                 engine, specs, max_workers=engine.rt.precompile_workers)
             log.info("compile plan: precompiling %d executable shapes "
                      "in the background (manifest %s)", len(specs),
                      engine.exec_registry.manifest_key)
+        if sink is not None and engine.exec_registry is not None:
+            # The sink consumes its planned accumulator-update
+            # executables through the same registry (lazy-jit fallback
+            # on any miss, as everywhere else).
+            registry = engine.exec_registry
+
+            def _stream_exec(width, _topk, _registry=registry):
+                return _registry.get(compile_plan.stream_fold_spec(
+                    sink.n_prompts, sink.n_rephrase, width, sink.guard))
+
+            sink.registry_get = _stream_exec
 
     def _drain(batch, fused, res, cfused):
+        if sink is not None:
+            # THE tentpole hot-loop step: fold this dispatch's device
+            # readouts into the donated accumulator with one fused XLA
+            # call. Everything it consumes stays on device; padding
+            # rows scatter out-of-range and drop.
+            sink.fold(res.yes_prob, res.no_prob,
+                      cfused.weighted_confidence, fused.topk_logprobs,
+                      batch, topk=int(fused.topk_logprobs.shape[-1]))
+        if not write_rows:
+            # Streaming-only mode: the row artifact is skipped, so NO
+            # per-row payload is ever device_get — the bytes the csv
+            # path would have transferred are accounted as avoided.
+            sink.note_bytes_avoided(
+                (fused.generated, fused.topk_logprobs, fused.topk_ids,
+                 cfused.generated, cfused.weighted_confidence,
+                 res.yes_prob, res.no_prob))
+            pending_marks.extend(c.resume_record() for c in batch)
+            if len(pending_marks) >= checkpoint_every:
+                _flush_marks()
+            return
         res_h, lp_vals, lp_ids, gen_host = jax.device_get(
             (res, fused.topk_logprobs, fused.topk_ids, fused.generated))
         wconf, cgen_host = jax.device_get(
@@ -535,8 +643,25 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
             rows.append(row)
             pending_rows.append(row)
         if len(pending_rows) >= checkpoint_every:
-            _flush(pending_rows, results_path, manifest)
+            _flush(pending_rows, results_path, manifest, sink=sink,
+                   accum_path=accum_path)
             del pending_rows[:]
+
+    # Streaming-only manifest marks (no rows to key them off). Flush
+    # order mirrors _flush's write-ahead rule with the accumulator
+    # playing the results artifact: checkpoint the accum FIRST, then
+    # mark done — a crash between the two re-dispatches rows whose
+    # folds are already (idempotently) in the checkpoint, and can never
+    # mark a row done that the accumulator lost.
+    pending_marks: List[dict] = []
+
+    def _flush_marks():
+        if sink is not None and accum_path is not None:
+            sink.checkpoint(accum_path)
+        manifest.mark_done_many(pending_marks)
+        log.info("checkpoint: +%d rows (streaming-only) -> %s",
+                 len(pending_marks), accum_path)
+        del pending_marks[:]
 
     def _writer():
         while True:
@@ -799,6 +924,8 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
         wt.join()
     if writer_err:
         raise writer_err[0]
+    if pending_marks:
+        _flush_marks()
 
 
 def _reasoning_batch(engine, model_name, prompts, batch, full, seed,
@@ -854,9 +981,18 @@ def _reasoning_batch(engine, model_name, prompts, batch, full, seed,
 
 
 def _flush(rows: List[schemas.PerturbationRow], results_path: Path,
-           manifest: SweepManifest) -> None:
+           manifest: SweepManifest, sink=None, accum_path=None) -> None:
     """Atomic-append rows then mark them done (write-ahead order: a crash
-    between the two re-scores at most one checkpoint, never loses rows)."""
+    between the two re-scores at most one checkpoint, never loses rows).
+
+    The streaming accumulator checkpoints FIRST: the resume done-set is
+    the union of manifest and results artifact, so an accumulator
+    written after the rows could miss rows the union declares done — a
+    permanent lattice hole. Checkpoint-then-append means the accum is
+    always a superset of the done-set, and superset folds are
+    idempotent re-scores, never losses."""
+    if sink is not None and accum_path is not None:
+        sink.checkpoint(accum_path)
     schemas.write_perturbation_results(rows, results_path, append=True)
     manifest.mark_done_many([
         {"model": r.model, "original_main": r.original_main,

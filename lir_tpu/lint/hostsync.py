@@ -52,6 +52,13 @@ DEVICE_FNS = {
     "greedy_decode_fused_shared", "greedy_decode_fused_grouped",
     "greedy_decode_fused_shared_paged", "greedy_decode_fused_grouped_paged",
     "gather_slots", "scatter_pages", "flash_attention", "flash_decode",
+    # Streaming-statistics sink (engine/stream_stats.py): the fold
+    # update returns the live device accumulator; touching it host-side
+    # anywhere but an explicit snapshot() readout is the per-row sync
+    # the sink exists to eliminate. (Redundant with the jitted-def
+    # registry while fold_update keeps its jax.jit decorator — pinned
+    # here so renaming the decorator can't silently drop coverage.)
+    "fold_update",
 }
 LAUNDER_FNS = {"device_get", "block_until_ready"}
 NP_TRANSFER = {"asarray", "array", "ascontiguousarray"}

@@ -216,6 +216,22 @@ def liveness_barrier(name: str, timeout_s: Optional[float] = None,
         raise
 
 
+def gather_stacked(arr: np.ndarray) -> np.ndarray:
+    """All-gather one equal-shape array per host, stacked on a new
+    leading host axis: returns (n_hosts, *shape) in process-index order.
+    The streaming-statistics fence merge rides this (every host's shard
+    accumulator is the same (P, R) lattice; the merge is a slot-wise
+    union of the stack — stats/streaming.merge_accums). Single-process:
+    the input under a length-1 leading axis."""
+    arr = np.asarray(arr)
+    if not is_multiprocess():
+        return arr[None]
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(arr)
+    return np.reshape(np.asarray(gathered), (-1,) + arr.shape)
+
+
 def host_shard(items, process_index: int | None = None,
                process_count: int | None = None):
     """Deterministic round-robin split of a work list across hosts: host i

@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..config import ServeConfig
 from ..engine import compile_plan
 from ..engine import scheduler as sched_mod
+from ..engine import stream_stats
 from ..engine import tokens as tok
 from ..faults import CLOSED, HALF_OPEN, CircuitBreaker, degrade_dispatch
 from ..guard import numerics
@@ -97,6 +98,17 @@ class ScoringServer:
                                          pad_full=self.config.pad_full,
                                          prefix_cache=self.config.prefix_cache)
         self.faults = FaultStats()
+        # Live streaming statistics (engine/stream_stats.ServeStreamSink):
+        # every OK-resolved payload folds once (keyed by content
+        # address — idempotent across checkpoint/resume and dedup) into
+        # a bounded ring, so the `stats` endpoint answers in-progress
+        # percentile/kappa estimates mid-run without touching the
+        # device. Gated on RuntimeConfig.streaming_stats.
+        self.stream = None
+        if (getattr(engine.rt, "streaming_stats", False)
+                and self.config.stream_window > 0):
+            self.stream = stream_stats.ServeStreamSink(
+                window=self.config.stream_window)
         self.breaker = CircuitBreaker(
             failure_threshold=self.config.max_consecutive_failures,
             cooldown_s=self.config.breaker_cooldown_s,
@@ -240,8 +252,23 @@ class ScoringServer:
                 continue
             self._dispatch(*d)
 
+    def stream_summary(self) -> Dict:
+        """Live streaming-statistics estimates (the `stats` endpoint):
+        percentile/kappa over the last stream_window served rows. Safe
+        from any thread; empty dict when the sink is disabled."""
+        if self.stream is None:
+            return {}
+        return self.stream.summary()
+
     def _resolve_ok(self, p: Pending, payload: Dict, now: float) -> None:
         self.cache.put(p.cache_key, payload)
+        if self.stream is not None:
+            # Fold AFTER the row survived the numerics guard, BEFORE the
+            # future resolves — keyed by content address, so a
+            # checkpoint-resumed or deadline-cancelled-then-resubmitted
+            # row can never fold twice.
+            self.stream.fold_payload(p.cache_key,
+                                     tuple(p.request.targets), payload)
         latency = now - p.t_submit
         self.stats.count("completed")
         if now > p.t_deadline:
@@ -434,10 +461,17 @@ class ScoringServer:
         leaves the previous checkpoint, never a torn one). Returns the
         number of requests checkpointed."""
         reqs = [r.to_record() for r in self.pending_requests()]
+        # Flush the partial streaming accumulator with the checkpoint:
+        # the resumed server restores the ring AND the folded-key set,
+        # so rows this incarnation already counted (including rows whose
+        # deadline passed mid-dispatch and will be re-submitted) are
+        # never double-counted on resume.
         atomic_write_json(Path(path), {
             "version": CHECKPOINT_VERSION,
             "model": self.model_name,
             "requests": reqs,
+            "stream": (self.stream.state()
+                       if self.stream is not None else None),
         })
         return len(reqs)
 
@@ -465,6 +499,8 @@ class ScoringServer:
         import json
 
         data = json.loads(Path(path).read_text())
+        if self.stream is not None:
+            self.stream.restore(data.get("stream"))
         reqs = [ServeRequest.from_record(r)
                 for r in data.get("requests", ())]
         log.info("serve: resuming %d checkpointed requests from %s",

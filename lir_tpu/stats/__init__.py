@@ -49,9 +49,23 @@ from .normality import (
     compare_distributions,
     normality_tests,
 )
+from .streaming import (
+    HostAccum,
+    accum_from_rows,
+    assert_parity,
+    merge_accums,
+    slot_map_from_cells,
+    summarize as summarize_accum,
+)
 
 __all__ = [
     "BootstrapResult",
+    "HostAccum",
+    "accum_from_rows",
+    "assert_parity",
+    "merge_accums",
+    "slot_map_from_cells",
+    "summarize_accum",
     "aggregate_kappa",
     "anderson_darling_pvalue",
     "average_ranks",

@@ -1,0 +1,329 @@
+"""Finalize path for the streaming-statistics accumulator: grid -> CIs
+without reloading results.csv.
+
+The device sink (engine/stream_stats.py) scatters per-cell sufficient
+values into a (P, R) lattice; this module reduces that lattice — in ONE
+canonical prompt-major order, so moments are deterministic regardless
+of dispatch/resume order — into exactly the quantities the host-side
+``stats``/``analysis`` pipeline computes from the csv:
+
+- per-prompt moments + 2.5/97.5 percentiles of the relative
+  probability and weighted confidence (analysis/perturbation.py's
+  prompt_summary_stats columns, float64, pandas ddof=1 std);
+- within-prompt Cohen's kappa from the binarized decisions — computed
+  through the SAME ``stats.kappa.within_group_kappa`` code path the
+  csv pipeline runs, fed from the accumulator's integer contingency
+  counts (n_g, s_g per prompt are sufficient), so the result is
+  bitwise-identical, not merely close;
+- seeded bootstrap CIs on the per-prompt means, resample indices drawn
+  from the key recorded in the sweep manifest (fold_in per prompt), so
+  streaming CIs reproduce across resume and across
+  ``--no-streaming-stats`` re-runs.
+
+The csv-reload path is kept for parity: :func:`accum_from_rows` builds
+the identical lattice from a results frame + the grid's slot map, and
+``make stats-smoke`` / tests/test_streaming_stats.py assert the two
+agree (counts and kappa bitwise; moments and CIs within FLOAT_TOL —
+the lattice stores float32 device values where the csv pipeline
+recomputes relative probabilities in float64).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Documented float tolerance between streaming (float32 lattice values,
+# f32 on-device division) and the csv-reload pipeline (float64 recompute
+# from the same stored readouts). Decisions/counts carry NO tolerance —
+# they are integers and the yes>no rule is exactly equivalent to the
+# float64 Relative_Prob > 0.5 rule (engine/stream_stats.py docstring).
+FLOAT_TOL = 5e-5
+
+
+@dataclasses.dataclass
+class HostAccum:
+    """Host copy of the device lattice (one device_get at checkpoint /
+    fence / finalize cadence — never per row)."""
+
+    filled: np.ndarray   # (P, R) int32 0/1
+    rel: np.ndarray      # (P, R) float32, NaN when invalid
+    conf: np.ndarray     # (P, R) float32, NaN when invalid
+    dec: np.ndarray      # (P, R) int32 1/0/-1
+    seed: int
+
+    @property
+    def rows_folded(self) -> int:
+        return int(self.filled.sum())
+
+
+def empty_accum(n_prompts: int, n_rephrase: int, seed: int) -> HostAccum:
+    P, R = int(n_prompts), int(n_rephrase)
+    return HostAccum(
+        filled=np.zeros((P, R), np.int32),
+        rel=np.full((P, R), np.nan, np.float32),
+        conf=np.full((P, R), np.nan, np.float32),
+        dec=np.full((P, R), -1, np.int32),
+        seed=int(seed))
+
+
+def merge_accums(accs: Sequence[HostAccum]) -> HostAccum:
+    """Union of disjoint shard lattices (the multihost fence merge).
+    Slot-wise and order-free: each host folded its own shard's cells,
+    so for every slot at most one shard has it filled — asserted,
+    because a double-fill would mean two hosts scored one cell (the
+    exact duplicate-work bug host_shard exists to prevent)."""
+    assert accs, "merge_accums needs at least one accumulator"
+    out = empty_accum(*accs[0].filled.shape, seed=accs[0].seed)
+    for acc in accs:
+        overlap = (out.filled > 0) & (acc.filled > 0)
+        if overlap.any():
+            raise ValueError(
+                f"accumulator merge overlap on {int(overlap.sum())} "
+                "cells — two hosts folded the same grid cell")
+        m = acc.filled > 0
+        out.filled[m] = acc.filled[m]
+        out.rel[m] = acc.rel[m]
+        out.conf[m] = acc.conf[m]
+        out.dec[m] = acc.dec[m]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Contingency counts and kappa (exact, integer-derived)
+# ---------------------------------------------------------------------------
+
+
+def contingency(acc: HostAccum) -> Dict[str, np.ndarray]:
+    """Per-prompt integer contingency/agreement counts — the kappa
+    sufficient statistic. Bitwise comparable across streaming and
+    csv-reload paths."""
+    filled = acc.filled > 0
+    valid = filled & (acc.dec >= 0)
+    return {
+        "n_folded": filled.sum(axis=1).astype(np.int64),
+        "n_valid": valid.sum(axis=1).astype(np.int64),
+        "n_yes": ((acc.dec == 1) & filled).sum(axis=1).astype(np.int64),
+        "n_conf": (filled & np.isfinite(acc.conf)).sum(axis=1)
+                  .astype(np.int64),
+    }
+
+
+def group_counts(group_ids: np.ndarray, decisions: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """(n_g, s_g) per group from flat (group, decision) vectors — the
+    serve ring's path into :func:`kappa_from_counts`."""
+    group_ids = np.asarray(group_ids)
+    decisions = np.asarray(decisions)
+    uniq = np.unique(group_ids) if group_ids.size else np.empty(0, int)
+    n_g = np.asarray([(group_ids == g).sum() for g in uniq], np.int64)
+    s_g = np.asarray([decisions[group_ids == g].sum() for g in uniq],
+                     np.int64)
+    return n_g, s_g
+
+
+def kappa_from_counts(n_g: np.ndarray, s_g: np.ndarray
+                      ) -> Dict[str, float]:
+    """Within-group kappa from per-group (n, s) counts, routed through
+    the SAME stats.kappa.within_group_kappa code the csv pipeline calls
+    — the counts are sufficient (the closed form only consumes per-group
+    sums), and reusing the exact function makes streaming-vs-reload
+    kappa bitwise-identical, not tolerance-close."""
+    from .kappa import within_group_kappa
+
+    n_g = np.asarray(n_g, np.int64)
+    s_g = np.asarray(s_g, np.int64)
+    decisions: List[int] = []
+    groups: List[int] = []
+    for g, (n, s) in enumerate(zip(n_g, s_g)):
+        decisions.extend([1] * int(s) + [0] * int(n - s))
+        groups.extend([g] * int(n))
+    return within_group_kappa(np.asarray(decisions, int),
+                              np.asarray(groups, int))
+
+
+def kappa(acc: HostAccum) -> Dict[str, float]:
+    """The D6 within-prompt kappa (analysis/perturbation.py's
+    perturbation_kappa) straight from the accumulator."""
+    c = contingency(acc)
+    return kappa_from_counts(c["n_valid"], c["n_yes"])
+
+
+# ---------------------------------------------------------------------------
+# Moments / percentiles / bootstrap CIs (canonical-order reductions)
+# ---------------------------------------------------------------------------
+
+
+def prompt_values(acc: HostAccum, field: str, p: int) -> np.ndarray:
+    """One prompt's valid values in canonical slot order (float64)."""
+    arr = getattr(acc, field)[p].astype(np.float64)
+    mask = (acc.filled[p] > 0) & np.isfinite(arr)
+    return arr[mask]
+
+
+def _moments(values: np.ndarray) -> Dict[str, float]:
+    """prompt_summary_stats' numeric columns: mean, pandas-style ddof=1
+    std, min/max, 2.5/97.5 percentiles, interval width (float64)."""
+    if values.size == 0:
+        return {k: float("nan") for k in
+                ("n", "mean", "std", "min", "max", "p2_5", "p97_5",
+                 "ci95_width")} | {"n": 0}
+    lo, hi = np.percentile(values, [2.5, 97.5])
+    return {
+        "n": int(values.size),
+        "mean": float(values.mean()),
+        "std": float(values.std(ddof=1)) if values.size > 1
+               else float("nan"),
+        "min": float(values.min()),
+        "max": float(values.max()),
+        "p2_5": float(lo),
+        "p97_5": float(hi),
+        "ci95_width": float(hi - lo),
+    }
+
+
+def bootstrap_mean_ci_seeded(values: np.ndarray, seed: int,
+                             prompt_idx: int, n_boot: int,
+                             confidence: float = 0.95,
+                             salt: int = 0) -> Dict[str, float]:
+    """Percentile bootstrap CI on the mean, resample indices drawn from
+    fold_in(PRNGKey(seed), prompt_idx [, salt]) — the key recorded in
+    the sweep manifest, so the SAME values in the SAME canonical order
+    give the SAME CI on every run, resumed or not."""
+    import jax
+
+    from .bootstrap import _resampled_means_jit
+    from .core import percentile_ci, resample_indices
+
+    if values.size == 0 or n_boot <= 0:
+        return {"ci_lower": float("nan"), "ci_upper": float("nan"),
+                "standard_error": float("nan")}
+    key = jax.random.fold_in(jax.random.PRNGKey(int(seed)),
+                             int(prompt_idx))
+    if salt:
+        key = jax.random.fold_in(key, int(salt))
+    idx = resample_indices(key, int(n_boot), int(values.size))
+    samples = np.asarray(_resampled_means_jit(
+        np.asarray(values, np.float64), idx))
+    lo, hi = percentile_ci(samples, confidence)
+    return {"ci_lower": float(lo), "ci_upper": float(hi),
+            "standard_error": float(np.nanstd(samples))}
+
+
+_CONF_SALT = 10_000  # confidence bootstrap keys never collide with rel's
+
+
+def summarize(acc: HostAccum, n_boot: int = 1000,
+              confidence: float = 0.95) -> Dict[str, object]:
+    """The full finalize: per-prompt moments/percentiles/bootstrap CIs
+    for relative probability and weighted confidence, the within-prompt
+    kappa, and the integer contingency counts. ``n_boot=0`` skips the
+    bootstrap (cheap live mid-run estimates)."""
+    counts = contingency(acc)
+    per_prompt: List[Dict[str, object]] = []
+    for p in range(acc.filled.shape[0]):
+        rel = prompt_values(acc, "rel", p)
+        conf = prompt_values(acc, "conf", p)
+        entry: Dict[str, object] = {
+            "prompt_idx": p,
+            "n_folded": int(counts["n_folded"][p]),
+            "n_valid": int(counts["n_valid"][p]),
+            "n_yes": int(counts["n_yes"][p]),
+            "n_no": int(counts["n_valid"][p] - counts["n_yes"][p]),
+            "relative_prob": _moments(rel),
+            "weighted_confidence": _moments(conf),
+        }
+        if n_boot > 0:
+            entry["relative_prob"].update(bootstrap_mean_ci_seeded(
+                rel, acc.seed, p, n_boot, confidence))
+            entry["weighted_confidence"].update(bootstrap_mean_ci_seeded(
+                conf, acc.seed, p, n_boot, confidence,
+                salt=_CONF_SALT))
+        per_prompt.append(entry)
+    return {
+        "rows_folded": acc.rows_folded,
+        "seed": int(acc.seed),
+        "n_boot": int(n_boot),
+        "per_prompt": per_prompt,
+        "kappa": kappa(acc),
+    }
+
+
+# ---------------------------------------------------------------------------
+# csv-reload parity path (kept alongside streaming, per the ROADMAP)
+# ---------------------------------------------------------------------------
+
+
+def slot_map_from_cells(cells: Iterable) -> Dict[Tuple[str, str],
+                                                 Tuple[int, int]]:
+    """(original_main, rephrased_main) -> (prompt_idx, rephrase_idx)
+    from the sweep's own grid cells — how a results frame maps back
+    onto lattice slots."""
+    return {(c.original_main, c.rephrased_main):
+            (c.prompt_idx, c.rephrase_idx) for c in cells}
+
+
+def accum_from_rows(df, slot_map: Mapping[Tuple[str, str],
+                                          Tuple[int, int]],
+                    n_prompts: int, n_rephrase: int,
+                    seed: int) -> HostAccum:
+    """Rebuild the lattice from a D6 results frame (the csv-reload
+    parity path): relative probability recomputed in float64 exactly as
+    analysis/perturbation.add_relative_prob does, decision as
+    Relative_Prob > 0.5, quarantined rows (null token probs) invalid.
+    With the manifest-recorded ``seed`` this reproduces the streaming
+    CIs from a ``--no-streaming-stats`` re-run's artifact."""
+    acc = empty_accum(n_prompts, n_rephrase, seed)
+    t1 = df["Token_1_Prob"].to_numpy(dtype=np.float64)
+    t2 = df["Token_2_Prob"].to_numpy(dtype=np.float64)
+    wc = (df["Weighted Confidence"].to_numpy(dtype=np.float64)
+          if "Weighted Confidence" in df.columns
+          else np.full(len(df), np.nan))
+    orig = df["Original Main Part"].tolist()
+    reph = df["Rephrased Main Part"].tolist()
+    for i in range(len(df)):
+        slot = slot_map.get((orig[i], reph[i]))
+        if slot is None:
+            continue
+        p, r = slot
+        acc.filled[p, r] = 1
+        total = t1[i] + t2[i]
+        if np.isfinite(total) and total > 0:
+            rel = t1[i] / total
+            acc.rel[p, r] = np.float32(rel)
+            acc.dec[p, r] = 1 if rel > 0.5 else 0
+        if np.isfinite(wc[i]):
+            acc.conf[p, r] = np.float32(wc[i])
+    return acc
+
+
+def assert_parity(streamed: Dict[str, object],
+                  reloaded: Dict[str, object],
+                  tol: float = FLOAT_TOL) -> None:
+    """The acceptance gate: counts and kappa bitwise, moments and CIs
+    within the documented float tolerance. Raises AssertionError with
+    the first divergence."""
+    assert streamed["rows_folded"] == reloaded["rows_folded"], (
+        streamed["rows_folded"], reloaded["rows_folded"])
+    ks, kr = streamed["kappa"], reloaded["kappa"]
+    for k in ("kappa", "observed_agreement", "expected_agreement"):
+        a, b = ks[k], kr[k]
+        assert (np.isnan(a) and np.isnan(b)) or a == b, (k, a, b)
+    for es, er in zip(streamed["per_prompt"], reloaded["per_prompt"]):
+        for k in ("n_folded", "n_valid", "n_yes", "n_no"):
+            assert es[k] == er[k], (k, es[k], er[k])
+        for field in ("relative_prob", "weighted_confidence"):
+            ms, mr = es[field], er[field]
+            assert ms["n"] == mr["n"], (field, ms["n"], mr["n"])
+            for k in ("mean", "std", "min", "max", "p2_5", "p97_5",
+                      "ci_lower", "ci_upper"):
+                if k not in ms and k not in mr:
+                    continue
+                a, b = ms.get(k, float("nan")), mr.get(k, float("nan"))
+                if np.isnan(a) and np.isnan(b):
+                    continue
+                assert abs(a - b) <= tol, (
+                    f"prompt {es['prompt_idx']} {field}.{k}: "
+                    f"{a} vs {b} (tol {tol})")

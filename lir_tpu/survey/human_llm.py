@@ -484,3 +484,30 @@ def write_bootstrap_results(payload: Dict[str, object], path: Path) -> None:
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(payload, indent=2))
+
+
+def llm_prompt_estimates_from_accum(acc, n_boot: int = 1000,
+                                    confidence: float = 0.95
+                                    ) -> Dict[int, Dict[str, float]]:
+    """Axis-3 entry point consuming the streaming accumulator DIRECTLY
+    (engine/stream_stats.py via stats/streaming.HostAccum): per-prompt
+    mean relative probability + seeded bootstrap CI — the LLM side of
+    the human-vs-LLM comparison, available live mid-sweep without a
+    results.csv reload. The resample key is the accumulator's recorded
+    manifest seed, so estimates are reproducible across resume and
+    match a csv-reload replay (stats.streaming.accum_from_rows)."""
+    from ..stats import streaming as streaming_mod
+
+    out: Dict[int, Dict[str, float]] = {}
+    for p in range(acc.filled.shape[0]):
+        values = streaming_mod.prompt_values(acc, "rel", p)
+        if values.size == 0:
+            continue
+        entry: Dict[str, float] = {
+            "estimate": float(values.mean()),
+            "n": int(values.size),
+        }
+        entry.update(streaming_mod.bootstrap_mean_ci_seeded(
+            values, acc.seed, p, n_boot, confidence))
+        out[p] = entry
+    return out

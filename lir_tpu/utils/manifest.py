@@ -38,6 +38,12 @@ class SweepManifest:
         self.path = Path(path)
         self.key_fields = key_fields
         self._done: Set[Key] = set()
+        # Sweep-scoped metadata records ({"__meta__": {...}} lines):
+        # run parameters that must survive a resume — e.g. the
+        # streaming-statistics bootstrap key ("stream_seed"), so a
+        # resumed sweep's CIs are drawn from the SAME resample indices
+        # as an uninterrupted one (engine/stream_stats.py).
+        self.meta: Dict[str, object] = {}
         # Byte offset to truncate to before the next append (a torn
         # trailing line from a mid-append crash); None = file is clean.
         self._truncate_to: Optional[int] = None
@@ -52,6 +58,10 @@ class SweepManifest:
                     continue
                 try:
                     rec = json.loads(chunk.decode("utf-8"))
+                    if isinstance(rec, dict) and "__meta__" in rec:
+                        if isinstance(rec["__meta__"], dict):
+                            self.meta.update(rec["__meta__"])
+                        continue
                     key = tuple(str(rec[f]) for f in key_fields)
                 except (UnicodeDecodeError, json.JSONDecodeError, KeyError,
                         TypeError):
@@ -77,6 +87,16 @@ class SweepManifest:
     def mark_done(self, record: Dict[str, object]) -> None:
         self.mark_done_many([record])
 
+    def set_meta(self, key: str, value) -> None:
+        """Record (or re-record) one metadata value as a durable
+        ``{"__meta__": ...}`` line. Idempotent: an unchanged value
+        appends nothing, and a resumed manifest returns the recorded
+        value via ``self.meta`` before any caller re-derives it."""
+        if self.meta.get(key) == value:
+            return
+        self.meta[key] = value
+        self._append_lines([json.dumps({"__meta__": {key: value}})])
+
     def mark_done_many(self, records: Iterable[Dict[str, object]]) -> None:
         """Append all not-yet-done keys in one open + single fsync."""
         lines = []
@@ -86,6 +106,9 @@ class SweepManifest:
                 continue
             self._done.add(key)
             lines.append(json.dumps(dict(zip(self.key_fields, key))))
+        self._append_lines(lines)
+
+    def _append_lines(self, lines) -> None:
         if not lines:
             return
         self.path.parent.mkdir(parents=True, exist_ok=True)

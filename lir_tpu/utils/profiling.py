@@ -696,6 +696,68 @@ class PrefixCacheStats:
             }
 
 
+@dataclasses.dataclass
+class StreamStats:
+    """Streaming-statistics sink counters (engine/stream_stats.py): how
+    much of the grid folded on device, how many host bytes the streaming
+    path avoided, and what finalize/checkpoint work cost. Thread-safe —
+    the sweep writer thread folds while checkpoints and the live serve
+    endpoint read concurrently.
+
+    Definitions (reported by ``summary()``, logged per sweep, and in
+    bench.py's "streaming_stats" key):
+
+    - ``rows_folded`` / ``dispatch_folds``: grid rows folded into the
+      device accumulator and the fused update calls that carried them
+      (one per dispatch — the tentpole invariant; rows_folded == grid
+      size means no row ever needed the host).
+    - ``host_bytes_avoided``: bytes of per-row dispatch payloads
+      (generated ids, top-20 maps, confidence scans) that were NEVER
+      device_get because the row artifact was skipped — the transfer
+      the csv-reload pipeline pays per row. ``accum_bytes`` gauges the
+      accumulator's own size: what DOES cross at a checkpoint/finalize.
+    - ``checkpoints`` / ``merges``: accumulator snapshots written at
+      flush boundaries and multihost fence merges performed.
+    - ``finalize_s``: seconds spent in the grid -> CIs finalize;
+      ``live_queries`` counts mid-run stats-endpoint reads.
+    """
+
+    rows_folded: int = 0
+    dispatch_folds: int = 0
+    host_bytes_avoided: int = 0
+    accum_bytes: int = 0
+    checkpoints: int = 0
+    merges: int = 0
+    live_queries: int = 0
+    finalize_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def gauge(self, field: str, value) -> None:
+        with self._lock:
+            setattr(self, field, value)
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "rows_folded": self.rows_folded,
+                "dispatch_folds": self.dispatch_folds,
+                "host_bytes_avoided": self.host_bytes_avoided,
+                "accum_bytes": self.accum_bytes,
+                "checkpoints": self.checkpoints,
+                "merges": self.merges,
+                "live_queries": self.live_queries,
+                "finalize_s": round(self.finalize_s, 4),
+            }
+
+
 # Published peak dense-matmul throughput per chip (bf16 FLOPS). Weight-only
 # int8 still computes in bf16 on the MXU, so bf16 peak is the MFU denominator
 # there; dynamic int8 (s8 x s8 -> s32 dots) gets 2x this on every listed

@@ -178,9 +178,10 @@ def _grid(n_cells, words_each=12, seed=5):
 
 def test_same_shape_dispatches_reuse_one_executable(tmp_path):
     """12 equal-length cells at batch 4 = 3 dispatches of one shape: with
-    piggybacking OFF the registry compiles exactly two executables (fresh
-    + donated handoff variants) and serves every dispatch — zero lazy
-    misses."""
+    piggybacking OFF the registry compiles exactly three executables
+    (fresh + donated handoff variants, plus the streaming-stats fold for
+    the one fold width) and serves every dispatch AND every fold — zero
+    lazy misses."""
     from lir_tpu.engine.sweep import run_perturbation_sweep
 
     compile_plan.exec_cache_clear()  # order-independence: force compiles
@@ -192,10 +193,12 @@ def test_same_shape_dispatches_reuse_one_executable(tmp_path):
                                   checkpoint_every=100)
     assert len(rows) == 12
     reg = engine.exec_registry
-    assert reg is not None and len(reg) == 2
-    assert engine.compile_stats.aot_hits == 3
+    assert reg is not None and len(reg) == 3
+    assert {s.kind for s in reg._futures} == {"shared", "stream_fold"}
+    # 3 dispatch hits + 3 accumulator-fold hits.
+    assert engine.compile_stats.aot_hits == 6
     assert engine.compile_stats.lazy_misses == 0
-    assert len(engine.compile_stats.shapes) == 2
+    assert len(engine.compile_stats.shapes) == 3
     assert all(t > 0 for t in engine.compile_stats.shapes.values())
     # Registry is namespaced by the engine's manifest key.
     assert reg.manifest_key == engine.cache_manifest_key
@@ -217,12 +220,13 @@ def test_piggyback_chain_runs_precompiled(tmp_path):
     assert len(rows) == 12
     reg = engine.exec_registry
     # 2 plain (fresh + donated, kept for the recovery fallback) + the
-    # piggyback chain's 3 stages.
-    assert reg is not None and len(reg) == 5
+    # piggyback chain's 3 stages + the streaming-stats fold width.
+    assert reg is not None and len(reg) == 6
     kinds = {s.kind for s in reg._futures}
-    assert {"piggy_prefill", "piggy_step", "piggy_drain"} <= kinds
-    # opener + 2 steps + drain, all registry-served.
-    assert engine.compile_stats.aot_hits == 4
+    assert {"piggy_prefill", "piggy_step", "piggy_drain",
+            "stream_fold"} <= kinds
+    # opener + 2 steps + drain + 3 accumulator folds, all registry-served.
+    assert engine.compile_stats.aot_hits == 7
     assert engine.compile_stats.lazy_misses == 0
     assert engine.kernel_stats.counters.get("piggybacked_steps") == 2
 

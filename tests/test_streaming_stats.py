@@ -1,0 +1,479 @@
+"""Streaming statistics: the device-resident accumulator sink
+(engine/stream_stats.py) vs the host-side csv-reload pipeline.
+
+The tentpole contract (ISSUE 9 / ROADMAP item 4), pinned on CPU:
+
+- streaming moments/kappa/contingency counts equal the host-side
+  ``stats``/``analysis`` results computed from the SAME rows — counts
+  and kappa bitwise, moments/CIs within stats.streaming.FLOAT_TOL;
+- the multihost fence merge over a fake 8-host shard split equals the
+  single-host fold bitwise;
+- a killed-and-resumed sweep yields accumulators bitwise-identical to
+  an uninterrupted one, and the manifest-recorded bootstrap key makes
+  CIs reproducible across resume and across --no-streaming-stats
+  re-runs analyzed from the row artifact;
+- the serve sink folds once per content address: SIGTERM checkpoint /
+  resume / re-submitted (deadline-cancelled) rows never double-count.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import RuntimeConfig, ServeConfig
+from lir_tpu.data import schemas
+from lir_tpu.data.prompts import LegalPrompt
+from lir_tpu.engine import grid as grid_mod
+from lir_tpu.engine import stream_stats as stream_mod
+from lir_tpu.engine.runner import ScoringEngine
+from lir_tpu.engine.sweep import run_perturbation_sweep
+from lir_tpu.models import decoder
+from lir_tpu.models.registry import ModelConfig
+from lir_tpu.stats import streaming as st
+
+N_CELLS = 12
+BATCH = 4
+N_REPH = N_CELLS  # one prompt: rephrase slots 0..N_CELLS-1
+
+
+def _cfg():
+    return ModelConfig(name="stream-test", vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=32, n_layers=1, n_heads=2,
+                       intermediate_size=64, max_seq_len=256)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return decoder.init_params(_cfg(), jax.random.PRNGKey(11))
+
+
+def _engine(params, **rt_kw):
+    rt_kw.setdefault("batch_size", BATCH)
+    rt_kw.setdefault("max_seq_len", 256)
+    # Plain dispatch path: chaos/bitwise comparisons must not depend on
+    # the piggyback chain's fault-wrap gating.
+    rt_kw.setdefault("piggyback_prefill", False)
+    rt_kw.setdefault("aot_precompile", False)
+    return ScoringEngine(params, _cfg(), FakeTokenizer(),
+                         RuntimeConfig(**rt_kw))
+
+
+def _grid(n_cells=N_CELLS, seed=21):
+    rng = np.random.default_rng(seed)
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible").split()
+
+    def text(n):
+        return " ".join(rng.choice(words) for _ in range(n)) + " ?"
+
+    lp = (LegalPrompt(main=text(10),
+                      response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Give a number from 0 to 100 ."),)
+    perts = ([text(10 if i % 2 else 24) for i in range(n_cells - 1)],)
+    return lp, perts
+
+
+def _sweep(engine, tmp_path, name="r.csv", **kw):
+    lp, perts = _grid()
+    rows = run_perturbation_sweep(engine, "sm", lp, perts,
+                                  tmp_path / name, **kw)
+    return rows, engine.stream_sink
+
+
+def _slot_map():
+    lp, perts = _grid()
+    return st.slot_map_from_cells(grid_mod.build_grid("sm", lp, perts))
+
+
+# ---------------------------------------------------------------------------
+# Parity: streaming == csv-reload on the same rows
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_matches_csv_reload(params, tmp_path):
+    rows, sink = _sweep(_engine(params), tmp_path)
+    assert len(rows) == N_CELLS
+    acc = sink.snapshot()
+    assert acc.rows_folded == N_CELLS
+    streamed = st.summarize(acc, n_boot=200)
+
+    df = schemas.read_results_frame(tmp_path / "r.csv")
+    reload_acc = st.accum_from_rows(df, _slot_map(), 1, N_REPH, acc.seed)
+    reloaded = st.summarize(reload_acc, n_boot=200)
+
+    # counts + kappa bitwise, moments/CIs within FLOAT_TOL
+    st.assert_parity(streamed, reloaded)
+    # decisions themselves are bitwise (yes>no == f64 rel>0.5)
+    assert np.array_equal(acc.dec, reload_acc.dec)
+    assert np.array_equal(acc.filled, reload_acc.filled)
+
+
+def test_streaming_kappa_matches_analysis_pipeline(params, tmp_path):
+    """The accumulator kappa runs through the SAME within_group_kappa
+    the analysis layer calls on the dataframe — identical floats."""
+    from lir_tpu.analysis.perturbation import (add_relative_prob,
+                                               perturbation_kappa)
+
+    rows, sink = _sweep(_engine(params), tmp_path)
+    k_stream = st.kappa(sink.snapshot())
+    df = add_relative_prob(schemas.read_results_frame(tmp_path / "r.csv"))
+    k_host, obs, exp = perturbation_kappa(df)
+
+    def eq(a, b):
+        return (np.isnan(a) and np.isnan(b)) or a == b
+
+    assert eq(k_stream["kappa"], k_host)
+    assert eq(k_stream["observed_agreement"], obs)
+    assert eq(k_stream["expected_agreement"], exp)
+
+
+def test_quarantined_rows_excluded_identically(params, tmp_path):
+    """An injected-NaN row is NaN'd by the device predicate exactly as
+    the host numerics guard quarantines it: counts still bitwise."""
+    from lir_tpu import faults
+
+    engine = _engine(params)
+    plan = faults.FaultPlan(seed=23, schedules={
+        "dispatch": faults.SiteSchedule.nan_at(0, rows=(1,))},
+        stats=engine.fault_stats)
+    faults.wrap_engine(engine, plan)
+    rows, sink = _sweep(engine, tmp_path)
+    acc = sink.snapshot()
+    assert acc.rows_folded == N_CELLS
+    # exactly one cell invalid on the streaming side...
+    counts = st.contingency(acc)
+    assert int(counts["n_valid"].sum()) == N_CELLS - 1
+    # ...and the csv-reload side agrees bitwise (the quarantined row's
+    # measurement fields are nulled in the artifact).
+    df = schemas.read_results_frame(tmp_path / "r.csv")
+    reload_acc = st.accum_from_rows(df, _slot_map(), 1, N_REPH, acc.seed)
+    st.assert_parity(st.summarize(acc, n_boot=50),
+                     st.summarize(reload_acc, n_boot=50))
+
+
+def test_moments_match_summary_statistics(params, tmp_path):
+    """Per-prompt moments line up with the analysis layer's
+    prompt_summary_stats columns within FLOAT_TOL."""
+    from lir_tpu.analysis.perturbation import (add_relative_prob,
+                                               prompt_summary_stats)
+
+    rows, sink = _sweep(_engine(params), tmp_path)
+    streamed = st.summarize(sink.snapshot(), n_boot=0)
+    df = add_relative_prob(schemas.read_results_frame(tmp_path / "r.csv"))
+    host = prompt_summary_stats(df, 0, ("Yes", "No"))
+    m = streamed["per_prompt"][0]["relative_prob"]
+    assert abs(m["mean"]
+               - host['Mean Relative Probability of "Yes"']) <= st.FLOAT_TOL
+    assert abs(m["std"] - host["Std Dev"]) <= st.FLOAT_TOL
+    assert abs(m["p2_5"] - host["2.5th Percentile"]) <= st.FLOAT_TOL
+    assert abs(m["p97_5"] - host["97.5th Percentile"]) <= st.FLOAT_TOL
+
+
+# ---------------------------------------------------------------------------
+# Multihost fence merge == single-host fold
+# ---------------------------------------------------------------------------
+
+
+def test_shard_merge_equals_single_host_fold(params, tmp_path):
+    """Fold the grid as 8 disjoint host shards (the fake 8-host split
+    host_shard performs) and union at the fence: bitwise equal to one
+    host folding everything."""
+    from lir_tpu.parallel import multihost
+
+    rows, sink = _sweep(_engine(params), tmp_path)
+    full = sink.snapshot()
+
+    lp, perts = _grid()
+    cells = grid_mod.build_grid("sm", lp, perts)
+    shards = []
+    for h in range(8):
+        shard_cells = multihost.host_shard(cells, process_index=h,
+                                           process_count=8)
+        acc = st.empty_accum(1, N_REPH, full.seed)
+        for c in shard_cells:
+            p, r = c.prompt_idx, c.rephrase_idx
+            acc.filled[p, r] = full.filled[p, r]
+            acc.rel[p, r] = full.rel[p, r]
+            acc.conf[p, r] = full.conf[p, r]
+            acc.dec[p, r] = full.dec[p, r]
+        shards.append(acc)
+    merged = st.merge_accums(shards)
+    assert np.array_equal(merged.filled, full.filled)
+    assert np.array_equal(merged.rel, full.rel, equal_nan=True)
+    assert np.array_equal(merged.conf, full.conf, equal_nan=True)
+    assert np.array_equal(merged.dec, full.dec)
+    # merge refuses overlapping shards (two hosts scoring one cell)
+    with pytest.raises(ValueError):
+        st.merge_accums([full, shards[0]])
+    # gather_stacked is the identity stack on a single process
+    stacked = multihost.gather_stacked(full.rel)
+    assert stacked.shape == (1,) + full.rel.shape
+
+
+# ---------------------------------------------------------------------------
+# Resume: bitwise accumulators + reproducible CIs
+# ---------------------------------------------------------------------------
+
+
+def test_kill_resume_accumulator_bitwise(params, tmp_path):
+    from lir_tpu import faults
+
+    e_clean = _engine(params)
+    _sweep(e_clean, tmp_path, name="clean.csv", checkpoint_every=4)
+    acc_clean = stream_mod.load_accum(
+        (tmp_path / "clean.csv").with_suffix(stream_mod.ACCUM_SUFFIX))
+
+    e_kill = _engine(params)
+    plan = faults.FaultPlan(seed=5, schedules={
+        "dispatch": faults.SiteSchedule.kill_at(1)},
+        stats=e_kill.fault_stats)
+    faults.wrap_engine(e_kill, plan)
+    with pytest.raises(faults.InjectedPreemption):
+        _sweep(e_kill, tmp_path, name="killed.csv", checkpoint_every=4)
+    # the partial accumulator was flushed on the kill path
+    partial = stream_mod.load_accum(
+        (tmp_path / "killed.csv").with_suffix(stream_mod.ACCUM_SUFFIX))
+    assert partial is not None and 0 < partial.rows_folded < N_CELLS
+
+    _sweep(_engine(params), tmp_path, name="killed.csv",
+           checkpoint_every=4)
+    acc_resumed = stream_mod.load_accum(
+        (tmp_path / "killed.csv").with_suffix(stream_mod.ACCUM_SUFFIX))
+    assert acc_resumed.rows_folded == N_CELLS
+    assert np.array_equal(acc_clean.filled, acc_resumed.filled)
+    assert np.array_equal(acc_clean.rel, acc_resumed.rel, equal_nan=True)
+    assert np.array_equal(acc_clean.conf, acc_resumed.conf,
+                          equal_nan=True)
+    assert np.array_equal(acc_clean.dec, acc_resumed.dec)
+    assert acc_clean.seed == acc_resumed.seed
+
+
+def test_stream_seed_recorded_and_cis_reproducible(params, tmp_path):
+    """The bootstrap key rides the manifest: a --no-streaming-stats
+    re-run analyzed from the row artifact with the recorded key yields
+    the same CIs (within float tolerance of the f32 lattice)."""
+    from lir_tpu.utils.manifest import SweepManifest
+
+    rows, sink = _sweep(_engine(params), tmp_path, seed=1234)
+    m = SweepManifest((tmp_path / "r.csv").with_suffix(".manifest.jsonl"),
+                      grid_mod.RESUME_KEY_FIELDS)
+    assert m.meta.get("stream_seed") == 1234
+    streamed = st.summarize(sink.snapshot(), n_boot=200)
+
+    # "--no-streaming-stats re-run": same grid swept with the sink off,
+    # analysis from the artifact + recorded key.
+    e2 = _engine(params, streaming_stats=False)
+    rows2, sink2 = _sweep(e2, tmp_path, name="off.csv", seed=1234)
+    assert sink2 is None
+    df = schemas.read_results_frame(tmp_path / "off.csv")
+    replay = st.summarize(
+        st.accum_from_rows(df, _slot_map(), 1, N_REPH,
+                           m.meta["stream_seed"]), n_boot=200)
+    st.assert_parity(streamed, replay)
+
+
+def test_streaming_only_mode_no_rows(params, tmp_path):
+    """row_artifact=False: zero rows materialized, rows folded == grid,
+    bytes-avoided counter moves, resume runs off manifest + accum."""
+    engine = _engine(params, row_artifact=False)
+    rows, sink = _sweep(engine, tmp_path)
+    assert rows == []
+    assert not (tmp_path / "r.csv").exists()
+    assert sink.stats.rows_folded == N_CELLS
+    assert sink.stats.host_bytes_avoided > 0
+    assert sink.snapshot().rows_folded == N_CELLS
+    # resume: nothing pending, accumulator intact
+    rows2, _ = _sweep(_engine(params, row_artifact=False), tmp_path)
+    acc = stream_mod.load_accum(
+        (tmp_path / "r.csv").with_suffix(stream_mod.ACCUM_SUFFIX))
+    assert acc.rows_folded == N_CELLS
+
+
+def test_accum_checkpoint_roundtrip(tmp_path):
+    acc = st.empty_accum(2, 3, seed=7)
+    acc.filled[0, 1] = 1
+    acc.rel[0, 1] = np.float32(0.25)
+    acc.dec[0, 1] = 0
+    stream_mod.save_accum(acc, tmp_path / "a.accum.npz")
+    back = stream_mod.load_accum(tmp_path / "a.accum.npz")
+    assert back.seed == 7
+    assert np.array_equal(back.filled, acc.filled)
+    assert np.array_equal(back.rel, acc.rel, equal_nan=True)
+    # unreadable file degrades to None, never raises
+    (tmp_path / "torn.accum.npz").write_bytes(b"not-an-npz")
+    assert stream_mod.load_accum(tmp_path / "torn.accum.npz") is None
+
+
+def test_fold_mesh_sharded_inputs(params):
+    """Mesh engines hand the sink NamedSharding-committed readouts: the
+    accumulator must replicate onto that mesh on first fold (and bypass
+    the single-device AOT registry) instead of raising an incompatible-
+    devices error — the bug the 8-device dryrun surfaced."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devices = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devices, ("data", "model"))
+    repl = NamedSharding(mesh, PartitionSpec())
+    sink = stream_mod.StreamSink(1, 4, seed=0,
+                                 registry_get=lambda *a: (_ for _ in ()
+                                                          ).throw(
+                                     AssertionError("registry must be "
+                                                    "bypassed on mesh")))
+
+    class C:
+        prompt_idx = 0
+        rephrase_idx = 1
+
+    put = lambda x: jax.device_put(jnp.asarray(x, jnp.float32), repl)  # noqa: E731
+    sink.fold(put([0.6, 0.0]), put([0.2, 0.0]), put([40.0, 0.0]),
+              put(np.full((2, 20), -1.0)), [C()], topk=20)
+    assert sink.registry_get is None          # AOT path disabled on mesh
+    acc = sink.snapshot()
+    assert acc.rows_folded == 1 and acc.dec[0, 1] == 1
+    assert abs(acc.rel[0, 1] - 0.75) < 1e-6
+
+
+def test_fold_padding_rows_dropped_and_idempotent():
+    import jax.numpy as jnp
+
+    sink = stream_mod.StreamSink(1, 4, seed=0)
+
+    class C:
+        prompt_idx = 0
+        rephrase_idx = 2
+
+    yes = jnp.asarray([0.8, 999.0], jnp.float32)   # row 1 is padding
+    no = jnp.asarray([0.1, 999.0], jnp.float32)
+    wc = jnp.asarray([50.0, -5.0], jnp.float32)
+    lp = jnp.full((2, 20), -1.0, jnp.float32)
+    sink.fold(yes, no, wc, lp, [C()], topk=20)
+    acc = sink.snapshot()
+    assert acc.rows_folded == 1
+    assert acc.filled[0, 2] == 1 and acc.dec[0, 2] == 1
+    # refold: bitwise no-op
+    sink.fold(yes, no, wc, lp, [C()], topk=20)
+    acc2 = sink.snapshot()
+    assert np.array_equal(acc.rel, acc2.rel, equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# Serve: live endpoint + no double-count across checkpoint/resume
+# ---------------------------------------------------------------------------
+
+
+def _serve_cfg(**kw):
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("classes", (("t", 600.0),))
+    kw.setdefault("default_class", "t")
+    kw.setdefault("linger_s", 0.005)
+    kw.setdefault("prefix_cache", False)
+    kw.setdefault("stream_window", 64)
+    return ServeConfig(**kw)
+
+
+def _request(i, deadline_s=None):
+    from lir_tpu.serve import ServeRequest
+
+    return ServeRequest(
+        binary_prompt=f"claim {i} flood damage ? Answer Yes or No .",
+        confidence_prompt=(f"claim {i} flood damage ? Give a number "
+                           "from 0 to 100 ."),
+        targets=("Yes", "No"), klass="t", deadline_s=deadline_s,
+        request_id=f"r{i}")
+
+
+def test_serve_live_stats_endpoint(params):
+    from lir_tpu.serve import ScoringServer
+
+    server = ScoringServer(_engine(params), "sm", _serve_cfg()).start()
+    try:
+        futs = [server.submit(_request(i)) for i in range(8)]
+        for f in futs:
+            assert f.result(timeout=300).status == "ok"
+        live = server.stream_summary()
+        assert live["rows_folded"] == 8
+        g = live["per_group"]["0"]
+        assert g["targets"] == ["Yes", "No"] and g["n_valid"] == 8
+        assert 0.0 <= g["mean_relative_prob"] <= 1.0
+        assert "kappa" in live
+        # json-serializable end to end (the cli endpoint prints it)
+        json.dumps(live)
+        # dedup re-ask: answered from cache, folded once
+        server.submit(_request(3)).result(timeout=60)
+        assert server.stream_summary()["rows_folded"] == 8
+    finally:
+        server.stop()
+
+
+def test_serve_checkpoint_resume_never_double_counts(params, tmp_path):
+    """The bugfix pin: SIGTERM checkpoint flushes the partial sink; a
+    resumed server restores the folded-key set, so rows cancelled
+    in-flight (or re-submitted after resume) fold at most once."""
+    from lir_tpu.serve import ScoringServer
+
+    server = ScoringServer(_engine(params), "sm", _serve_cfg()).start()
+    for i in range(6):
+        assert server.submit(_request(i)).result(timeout=300).status == "ok"
+    # one row expires before dispatch: resolves partial, never folds
+    dead = server.submit(_request(6, deadline_s=-1.0))
+    assert dead.result(timeout=60).status == "deadline_exceeded"
+    assert server.stream_summary()["rows_folded"] == 6
+
+    ck = tmp_path / "state.json"
+    server.shutdown_checkpoint(ck)
+    payload = json.loads(ck.read_text())
+    assert payload["stream"]["head"] == 6      # partial accum flushed
+
+    resumed = ScoringServer(_engine(params), "sm", _serve_cfg())
+    resumed.resume_from_checkpoint(ck)
+    resumed.start()
+    try:
+        assert resumed.stream_summary()["rows_folded"] == 6
+        # the cancelled row re-submitted post-resume folds ONCE...
+        assert resumed.submit(_request(6)).result(timeout=300).status == "ok"
+        assert resumed.stream_summary()["rows_folded"] == 7
+        # ...and an already-counted row from before the checkpoint
+        # (fresh server, empty dedup cache -> scored again) does NOT.
+        assert resumed.submit(_request(2)).result(timeout=300).status == "ok"
+        assert resumed.stream_summary()["rows_folded"] == 7
+    finally:
+        resumed.stop()
+
+
+def test_serve_stream_disabled(params):
+    from lir_tpu.serve import ScoringServer
+
+    server = ScoringServer(_engine(params, streaming_stats=False), "sm",
+                           _serve_cfg())
+    assert server.stream is None and server.stream_summary() == {}
+    server2 = ScoringServer(_engine(params), "sm",
+                            _serve_cfg(stream_window=0))
+    assert server2.stream is None
+
+
+# ---------------------------------------------------------------------------
+# Survey layer: finalize consuming the accumulator directly
+# ---------------------------------------------------------------------------
+
+
+def test_survey_estimates_from_accum(params, tmp_path):
+    from lir_tpu.survey.human_llm import llm_prompt_estimates_from_accum
+
+    rows, sink = _sweep(_engine(params), tmp_path)
+    est = llm_prompt_estimates_from_accum(sink.snapshot(), n_boot=100)
+    assert set(est) == {0}
+    e = est[0]
+    assert 0.0 <= e["estimate"] <= 1.0
+    assert e["ci_lower"] <= e["estimate"] <= e["ci_upper"]
+    assert e["n"] == N_CELLS
+    # reproducible from the same accumulator + recorded key
+    est2 = llm_prompt_estimates_from_accum(sink.snapshot(), n_boot=100)
+    assert est == est2

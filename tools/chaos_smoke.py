@@ -206,6 +206,77 @@ def sweep_chaos(failures):
                 "sweep_recovered": engine.fault_stats.summary()}
 
 
+def stream_accum_chaos(failures):
+    """Mechanism 7 (streaming statistics): a mid-sweep kill — fired with
+    rows DISPATCHED (folded into the device accumulator) but not yet
+    checkpointed/marked — must leave a partial accumulator flushed on
+    the kill path, and the RESUMED sweep's accumulator must be
+    bitwise-identical to an uninterrupted run's: re-folds of the
+    inflight rows are idempotent per cell, never double-counted,
+    never lost."""
+    import tempfile
+
+    import numpy as np
+
+    from lir_tpu import faults
+    from lir_tpu.engine import stream_stats as stream_mod
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+
+    lp, perts = _grid(N_CELLS)
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        run_perturbation_sweep(_make_engine(), "chaos", lp, perts,
+                               td / "clean.csv", checkpoint_every=4)
+        acc_clean = stream_mod.load_accum(
+            (td / "clean.csv").with_suffix(stream_mod.ACCUM_SUFFIX))
+        if acc_clean is None or acc_clean.rows_folded != N_CELLS:
+            failures.append("stream: fault-free accumulator incomplete")
+            return {}
+
+        engine = _make_engine()
+        plan = faults.FaultPlan(seed=9, schedules={
+            "dispatch": faults.SiteSchedule.kill_at(1)},
+            stats=engine.fault_stats)
+        faults.wrap_engine(engine, plan)
+        out = td / "chaos.csv"
+        try:
+            run_perturbation_sweep(engine, "chaos", lp, perts, out,
+                                   checkpoint_every=4)
+            failures.append("stream: scheduled kill never fired")
+            return {}
+        except faults.InjectedPreemption:
+            pass
+        partial = stream_mod.load_accum(
+            out.with_suffix(stream_mod.ACCUM_SUFFIX))
+        if partial is None:
+            failures.append("stream: partial accumulator not flushed "
+                            "on the preemption exit path")
+            return {}
+        if not 0 < partial.rows_folded < N_CELLS:
+            failures.append(
+                f"stream: partial accumulator folded "
+                f"{partial.rows_folded} rows (expected mid-sweep)")
+
+        run_perturbation_sweep(_make_engine(), "chaos", lp, perts, out,
+                               checkpoint_every=4)
+        acc = stream_mod.load_accum(
+            out.with_suffix(stream_mod.ACCUM_SUFFIX))
+        same = (acc is not None
+                and np.array_equal(acc_clean.filled, acc.filled)
+                and np.array_equal(acc_clean.rel, acc.rel,
+                                   equal_nan=True)
+                and np.array_equal(acc_clean.conf, acc.conf,
+                                   equal_nan=True)
+                and np.array_equal(acc_clean.dec, acc.dec)
+                and acc_clean.seed == acc.seed)
+        if not same:
+            failures.append("stream: resume-merged accumulator is NOT "
+                            "bitwise-identical to the uninterrupted run")
+        return {"partial_rows_folded": int(partial.rows_folded),
+                "resumed_rows_folded": int(acc.rows_folded
+                                           if acc else -1)}
+
+
 def serve_chaos(failures):
     """Mechanisms 2+3: breaker trip -> half-open probe -> recovery;
     poison-row isolation; SIGTERM checkpoint resume with zero lost."""
@@ -568,6 +639,7 @@ def main() -> int:
     guard_summary = guard_chaos(failures)
     serve_guard_summary = serve_guard_chaos(failures)
     mh_summary = multihost_chaos(failures)
+    stream_summary = stream_accum_chaos(failures)
     if failures:
         for f in failures:
             print(f"CHAOS-SMOKE FAIL: {f}")
@@ -575,14 +647,16 @@ def main() -> int:
     print(json.dumps({"sweep": sweep_summary, "serve": serve_summary,
                       "guard": guard_summary,
                       "serve_guard": serve_guard_summary,
-                      "multihost": mh_summary}))
+                      "multihost": mh_summary,
+                      "stream": stream_summary}))
     print("chaos smoke: OK (sweep resumed bitwise-identical after "
           "injected kill + torn manifest; breaker tripped and recovered "
           "via half-open probe; poison row isolated; checkpoint resume "
           "lost nothing; injected hang stalled-out within its deadline "
           "and recovered; NaN rows quarantined as error:numerics with "
           "clean rows bitwise-identical; dead peer detected within the "
-          "liveness timeout)")
+          "liveness timeout; resume-merged streaming accumulators "
+          "bitwise-identical to an uninterrupted run)")
     return 0
 
 

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Streaming-statistics smoke: the grid -> CIs device pipeline exercised
+end-to-end on the fake backend (`make stats-smoke`). Asserts the ISSUE-9
+acceptance criteria hermetically on CPU:
+
+1. PARITY — one sweep with streaming ON + the row artifact ON: the
+   accumulator finalize (moments / percentiles / bootstrap CIs / kappa /
+   contingency counts) must match the csv-reload pipeline on the same
+   rows — counts and kappa BITWISE, moments and CIs within
+   stats.streaming.FLOAT_TOL.
+2. NO PER-ROW HOST TRANSFER — a streaming-only pass (row artifact off)
+   must fold every grid row on device (rows_folded == grid size), write
+   zero result rows, and report nonzero host_bytes_avoided; statically,
+   the host-sync lint pass over the sink module (engine/stream_stats.py
+   is hot-path scanned) must report ZERO findings — the dispatch hot
+   loop contains no implicit device->host sync.
+3. LIVE ESTIMATES — a serve session's `stats` endpoint returns
+   in-progress percentile/kappa estimates mid-workload, and the
+   StreamStats counters move.
+
+Prints the streaming summaries as JSON on success; exits 1 on the first
+violated invariant.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_CELLS = 16
+BATCH = 4
+
+
+def _make_engine(**rt_kw):
+    import jax
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="stats-smoke", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=1, n_heads=2,
+                      intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(13))
+    rt_kw.setdefault("batch_size", BATCH)
+    rt_kw.setdefault("max_seq_len", 256)
+    return ScoringEngine(params, cfg, FakeTokenizer(),
+                         RuntimeConfig(**rt_kw))
+
+
+def _grid(n_cells=N_CELLS, seed=31):
+    import numpy as np
+
+    from lir_tpu.data.prompts import LegalPrompt
+
+    rng = np.random.default_rng(seed)
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible").split()
+
+    def text(n):
+        return " ".join(rng.choice(words) for _ in range(n)) + " ?"
+
+    lp = (LegalPrompt(main=text(10),
+                      response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Give a number from 0 to 100 ."),)
+    perts = ([text(10 if i % 2 else 22) for i in range(n_cells - 1)],)
+    return lp, perts
+
+
+def parity(failures):
+    """Invariant 1: streaming finalize == csv-reload pipeline."""
+    import tempfile
+
+    from lir_tpu.data import schemas
+    from lir_tpu.engine import grid as grid_mod
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.stats import streaming as st
+
+    lp, perts = _grid()
+    engine = _make_engine()
+    with tempfile.TemporaryDirectory() as td:
+        out = Path(td) / "results.csv"
+        rows = run_perturbation_sweep(engine, "smoke", lp, perts, out)
+        sink = engine.stream_sink
+        acc = sink.snapshot()
+        streamed = st.summarize(acc, n_boot=300)
+        cells = grid_mod.build_grid("smoke", lp, perts)
+        df = schemas.read_results_frame(out)
+        reloaded = st.summarize(
+            st.accum_from_rows(df, st.slot_map_from_cells(cells), 1,
+                               len(rows), acc.seed), n_boot=300)
+        try:
+            st.assert_parity(streamed, reloaded)
+        except AssertionError as err:
+            failures.append(f"parity: streaming != csv-reload: {err}")
+            return
+        if acc.rows_folded != len(rows):
+            failures.append(
+                f"parity: rows folded {acc.rows_folded} != {len(rows)}")
+        print("parity: streaming == csv-reload "
+              f"(counts/kappa bitwise, CIs within {st.FLOAT_TOL}); "
+              f"kappa: {json.dumps(streamed['kappa'])}")
+
+
+def no_host_rows(failures):
+    """Invariant 2: streaming-only pass — zero rows materialized, every
+    row folded on device, and the host-sync lint pass clean over the
+    sink module."""
+    import tempfile
+
+    from lir_tpu.engine import stream_stats as stream_mod
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+
+    lp, perts = _grid()
+    engine = _make_engine(row_artifact=False)
+    with tempfile.TemporaryDirectory() as td:
+        out = Path(td) / "results.csv"
+        rows = run_perturbation_sweep(engine, "smoke", lp, perts, out)
+        sink = engine.stream_sink
+        if rows:
+            failures.append(f"no-host-rows: {len(rows)} rows built")
+        if out.exists():
+            failures.append("no-host-rows: row artifact was written")
+        if sink.stats.rows_folded != N_CELLS:
+            failures.append(
+                f"no-host-rows: rows_folded {sink.stats.rows_folded} "
+                f"!= grid {N_CELLS}")
+        if sink.stats.dispatch_folds <= 0:
+            failures.append("no-host-rows: zero dispatch folds")
+        if sink.stats.host_bytes_avoided <= 0:
+            failures.append("no-host-rows: host_bytes_avoided is zero")
+        acc = stream_mod.load_accum(
+            out.with_suffix(stream_mod.ACCUM_SUFFIX))
+        if acc is None or acc.rows_folded != N_CELLS:
+            failures.append("no-host-rows: accumulator checkpoint "
+                            "missing or incomplete")
+        print(f"no-host-rows: {sink.stats.rows_folded} rows folded on "
+              f"device, {sink.stats.host_bytes_avoided} host bytes "
+              f"avoided, counters: {json.dumps(sink.stats.summary())}")
+
+    # Static half: the host-sync pass over the sink module must be
+    # clean — the dispatch hot loop performs no implicit sync.
+    from lir_tpu.lint.core import load_project
+    from lir_tpu.lint.hostsync import HostSyncPass
+
+    repo = Path(__file__).resolve().parent.parent
+    project = load_project(repo)
+    findings = [f for f in HostSyncPass().run(project)
+                if "stream_stats" in f.path or "sweep" in f.path]
+    if findings:
+        failures.append(
+            "no-host-rows: host-sync findings in the sink/sweep hot "
+            f"loop: {[(f.path, f.line, f.message) for f in findings]}")
+    else:
+        print("no-host-rows: host-sync lint clean over the sink module "
+              "and sweep hot loop")
+
+
+def live_endpoint(failures):
+    """Invariant 3: mid-run serve `stats` endpoint returns estimates."""
+    from lir_tpu.config import ServeConfig
+    from lir_tpu.serve import ScoringServer, ServeRequest
+
+    engine = _make_engine()
+    cfg = ServeConfig(queue_depth=64, classes=(("t", 600.0),),
+                      default_class="t", linger_s=0.005,
+                      prefix_cache=False, stream_window=64)
+    server = ScoringServer(engine, "smoke", cfg).start()
+    try:
+        futs = []
+        for i in range(10):
+            futs.append(server.submit(ServeRequest(
+                binary_prompt=f"claim {i} ? Answer Yes or No .",
+                confidence_prompt=(f"claim {i} ? Give a number from 0 "
+                                   "to 100 ."),
+                targets=("Yes", "No"), klass="t", request_id=f"s{i}")))
+            if i == 5:
+                mid = server.stream_summary()  # LIVE: mid-workload read
+        for f in futs:
+            if f.result(timeout=300).status != "ok":
+                failures.append("live: request not ok")
+        final = server.stream_summary()
+    finally:
+        server.stop()
+    if final.get("rows_folded") != 10:
+        failures.append(f"live: rows_folded {final.get('rows_folded')} "
+                        "!= 10")
+    if "kappa" not in final or "per_group" not in final:
+        failures.append("live: summary missing kappa/per_group")
+    if mid.get("rows_folded", 0) > 10:
+        failures.append("live: mid-run fold count insane")
+    print(f"live: mid-run estimate at {mid.get('rows_folded')} rows, "
+          f"final {json.dumps(final)[:200]}...")
+
+
+def main() -> int:
+    failures: list = []
+    for step in (parity, no_host_rows, live_endpoint):
+        step(failures)
+    if failures:
+        print("\nSTATS SMOKE FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("\nstats smoke: all invariants hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
