@@ -3,7 +3,7 @@
 # recorded floor (tools/check_tier1.py — the floor lives there).
 
 .PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
-	kernel-smoke stats-smoke install-hooks
+	kernel-smoke stats-smoke fleet-smoke install-hooks
 
 verify: lint
 	python tools/check_tier1.py
@@ -71,6 +71,15 @@ kernel-smoke:
 # (tools/stats_smoke.py).
 stats-smoke:
 	JAX_PLATFORMS=cpu python tools/stats_smoke.py
+
+# Fleet smoke: the multi-model fleet layer on the fake backend — a
+# 3-model sweep must book nonzero prefetch overlap (swap_s_hidden > 0,
+# exactly one exposed load), per-model rows must be bitwise-identical
+# to standalone single-model engines, and a fleet_score serve fan-out
+# must answer per-model P(yes)/P(no) with kappa exactly equal to the
+# analysis layer's within_group_kappa (tools/fleet_smoke.py).
+fleet-smoke:
+	JAX_PLATFORMS=cpu python tools/fleet_smoke.py
 
 # Run graft-lint (seconds) then the tier-1 guard before every
 # `git push` — lint first so an invariant break fails in two seconds,

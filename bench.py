@@ -234,6 +234,13 @@ def main() -> None:
                          "headline key \"prefix_serve\")")
     ap.add_argument("--no-prefix-serve", action="store_true",
                     help="skip the prefix-heavy serve mode")
+    ap.add_argument("--no-fleet", action="store_true",
+                    help="skip the multi-model fleet mode (N-model "
+                         "agreement sweep: streamed/cached fleet vs the "
+                         "sequential drop-and-reload baseline on the "
+                         "identical question waves; reports the swap-"
+                         "hidden fraction, fleet p/s, and the within-"
+                         "question kappa — headline key \"fleet\")")
     ap.add_argument("--no-streaming-stats", action="store_true",
                     help="skip the streaming-statistics mode (identical "
                          "grid swept twice: device accumulator -> CIs "
@@ -592,6 +599,20 @@ def main() -> None:
                 headline["prefix_serve"] = prefix_serve
         except (Exception, SystemExit) as err:  # noqa: BLE001
             print(f"# prefix serve mode failed ({err!r}); headline is "
+                  "unaffected", file=sys.stderr)
+    # Fleet mode (ROADMAP item 3): the N-model agreement workload —
+    # every question wave scored under ALL fleet models — measured with
+    # the streamed/cached fleet vs the sequential drop-and-reload
+    # baseline (one model resident at a time, reload per switch: the
+    # pre-fleet engine/serve reality). Asserts per-model score parity
+    # bitwise before reporting; a failure never discards the headline.
+    if not args.no_fleet:
+        try:
+            fleet = _fleet_bench(on_accel)
+            if fleet is not None:
+                headline["fleet"] = fleet
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# fleet bench mode failed ({err!r}); headline is "
                   "unaffected", file=sys.stderr)
     # Chaos mode (--chaos): the same serving layer under a seeded
     # transient fault schedule — the robustness cost (recovery work +
@@ -1418,6 +1439,157 @@ def _prefix_serve_bench(params, cfg, on_accel: bool, tokenizer=None,
     print(f"# prefix serve mode: every batch candidate OOMed; "
           f"last: {last_oom}", file=sys.stderr)
     return None
+
+
+def _fleet_bench(on_accel: bool):
+    """Multi-model fleet mode: the inter-model agreement workload
+    (paper axis 2 — every question scored under ALL N models, κ over
+    the decisions) arriving as question WAVES, measured two ways on the
+    identical waves:
+
+    1. sequential drop-and-reload (the pre-fleet reality: one model
+       resident at a time, every switch re-converts + re-uploads the
+       next model's weights serially before its first dispatch);
+    2. the fleet scheduler (engine/fleet.py): all models co-resident up
+       to the weight-cache budget (revisits are cache hits), misses
+       streamed by the async prefetcher BEHIND the previous model's
+       compute.
+
+    Per-model scores are asserted BITWISE identical across the two
+    paths before reporting (weights are moved, never transformed), and
+    the within-question kappa over the fleet's decisions is computed
+    through the stats/streaming contingency path — the number the
+    agreement axis exists to produce. Models share one ModelConfig
+    (distinct weights per model id) so both paths reuse one set of
+    executables: the measured delta is pure weight logistics, never
+    compile skew."""
+    import time as _time
+
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine.fleet import ModelFleet
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import loader
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.stats import streaming
+
+    n_models, n_waves, q_per_wave = 6, 4, 2
+    # Sized so one model's checkpoint-load (torch-layout convert +
+    # host->device upload, the REAL loader path) is comparable to one
+    # wave of <=10-token scoring — the ServerlessLLM regime the fleet
+    # targets. bf16 + a deeper stack on accelerators.
+    if on_accel:
+        D, L, F = 2048, 4, 4096
+        dtype = jnp.bfloat16
+    else:
+        D, L, F = 512, 3, 1024
+        dtype = jnp.float32
+    V = FakeTokenizer.VOCAB
+    cfg = ModelConfig(name="fleet-member", vocab_size=V, hidden_size=D,
+                      n_layers=L, n_heads=8, intermediate_size=F,
+                      max_seq_len=256, tie_embeddings=True)
+    rt = RuntimeConfig(batch_size=4, max_seq_len=256, max_new_tokens=6)
+
+    def host_sd(seed: int):
+        """Torch-layout llama state dict in host RAM — the checkpoint
+        stand-in both paths load through loader.convert_decoder."""
+        rng = np.random.default_rng(seed)
+        sd = {"embed_tokens.weight":
+              rng.standard_normal((V, D)).astype(np.float32) * 0.02,
+              "norm.weight": np.ones(D, np.float32)}
+        for i in range(L):
+            p = f"layers.{i}."
+            sd[p + "input_layernorm.weight"] = np.ones(D, np.float32)
+            sd[p + "post_attention_layernorm.weight"] = np.ones(
+                D, np.float32)
+            for k, shape in (("self_attn.q_proj", (D, D)),
+                             ("self_attn.k_proj", (D, D)),
+                             ("self_attn.v_proj", (D, D)),
+                             ("self_attn.o_proj", (D, D)),
+                             ("mlp.gate_proj", (F, D)),
+                             ("mlp.up_proj", (F, D)),
+                             ("mlp.down_proj", (D, F))):
+                sd[p + k + ".weight"] = (
+                    rng.standard_normal(shape).astype(np.float32) * 0.02)
+        return sd
+
+    sds = {f"fleet-m{i}": host_sd(i) for i in range(n_models)}
+
+    def factory(name: str) -> ScoringEngine:
+        params = loader.convert_decoder(sds[name], cfg, "llama",
+                                        dtype=dtype)
+        jax.block_until_ready(jax.tree.leaves(params)[0])
+        return ScoringEngine(params, cfg, FakeTokenizer(), rt)
+
+    rng = np.random.default_rng(11)
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement").split()
+    waves = [[" ".join(rng.choice(words) for _ in range(10)) + " ?"
+              for _ in range(q_per_wave)] for _ in range(n_waves)]
+    mids = list(sds)
+
+    def score(engine, qs):
+        return [(r.yes_prob, r.no_prob) for r in engine.score_prompts(qs)]
+
+    # Warm every executable once so neither timed path pays a compile
+    # (shared cfg => shared jit cache across models and paths).
+    score(factory(mids[0]), waves[0])
+
+    t0 = _time.perf_counter()
+    seq = {m: [] for m in mids}
+    for wave in waves:
+        for mid in mids:
+            engine = factory(mid)       # reload-per-switch, serial
+            seq[mid].extend(score(engine, wave))
+            engine = None               # drop: one model resident
+    sequential_s = _time.perf_counter() - t0
+
+    fleet = ModelFleet.from_factory(factory, mids, stage_reloads=False)
+    t0 = _time.perf_counter()
+    fl = {m: [] for m in mids}
+    for wave in waves:
+        out = fleet.sweep(mids, lambda mid, eng: score(eng, wave))
+        for m in mids:
+            fl[m].extend(out[m])
+    fleet_s = _time.perf_counter() - t0
+    fleet.shutdown()
+
+    parity_ok = fl == seq               # exact float equality, per score
+    assert parity_ok, "fleet scores diverged from single-model engines"
+    s = fleet.stats.summary()
+    assert s["swap_s_hidden"] > s["swap_s_exposed"], (
+        "prefetch failed to hide swaps behind compute", s)
+    # Within-question kappa across the fleet — the agreement number,
+    # through the exact streaming contingency path.
+    groups, decisions = [], []
+    for m in mids:
+        for q, (yes, no) in enumerate(fl[m]):
+            groups.append(q)
+            decisions.append(1 if yes > no else 0)
+    kap = streaming.kappa_from_counts(*streaming.group_counts(
+        np.asarray(groups), np.asarray(decisions)))
+    rows = n_models * n_waves * q_per_wave
+    return {
+        "n_models": n_models,
+        "waves": n_waves,
+        "questions_per_wave": q_per_wave,
+        "sequential_s": round(sequential_s, 3),
+        "fleet_s": round(fleet_s, 3),
+        "fleet_vs_sequential": round(sequential_s / fleet_s, 3),
+        "fleet_p_s": round(rows / fleet_s, 3),
+        "sequential_p_s": round(rows / sequential_s, 3),
+        "swap_s_hidden": s["swap_s_hidden"],
+        "swap_s_exposed": s["swap_s_exposed"],
+        "swap_hidden_frac": s["swap_hidden_frac"],
+        "prefetch_hits": s["prefetch_hits"],
+        "cache_hits": s["cache_hits"],
+        "loads": s["loads"],
+        "evictions": s["evictions"],
+        "parity_ok": parity_ok,
+        "kappa": {k: round(float(v), 6) for k, v in kap.items()},
+    }
 
 
 def _stream_stats_bench(params, cfg, on_accel: bool, tokenizer=None,

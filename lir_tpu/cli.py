@@ -80,6 +80,7 @@ def _add_sweep(sub) -> None:
                    help="store the KV cache int8 with per-vector scales: "
                         "half the cache HBM (longer contexts / bigger "
                         "batches on one chip), s8 decode attention dots")
+    _add_fleet_flags(p, with_models=False)
     _add_multihost_flag(p)
 
 
@@ -275,6 +276,35 @@ def _engine_rt_kw(args, rt_kw: dict) -> None:
         rt_kw["streaming_stats"] = False
 
 
+def _add_fleet_flags(p, with_models: bool) -> None:
+    """Multi-model fleet knobs (config.FleetConfig — engine/fleet.py
+    over models/weights.py; DEPLOY.md §1k)."""
+    if with_models:
+        p.add_argument("--fleet-models", default=None,
+                       help="comma-separated model ids to serve as a "
+                            "FLEET: all models co-resident up to the "
+                            "weight-cache budget, per-model dispatch "
+                            "queues, and the {\"op\": \"fleet_score\"} "
+                            "request class — one question scored under "
+                            "every model, answered with per-model "
+                            "P(yes)/P(no) plus pairwise kappa/"
+                            "disagreement (DEPLOY.md §1k)")
+        p.add_argument("--fleet-deadline", type=float, default=None,
+                       help="default deadline in seconds for fleet_score "
+                            "fan-outs (default 60; per-request "
+                            "\"deadline_s\" overrides)")
+    p.add_argument("--weight-cache-gb", type=float, default=None,
+                   help="HBM budget for co-resident model weights in the "
+                        "fleet's LRU weight cache (default 0 = "
+                        "unbounded; size it so budget >= largest model, "
+                        "see DEPLOY.md §1k arithmetic)")
+    p.add_argument("--no-weight-prefetch", action="store_true",
+                   help="disable async weight streaming: every model "
+                        "swap then serializes its host->device load "
+                        "with compute (the pre-fleet drop-and-reload "
+                        "behavior; measurement baseline)")
+
+
 def _add_kernel_flags(p) -> None:
     """Fused-kernel knobs (ops/flash_decode + piggybacking), shared by
     perturb and serve (precompile follows the serving defaults)."""
@@ -351,9 +381,17 @@ def _add_serve(sub) -> None:
              "Request lines: {\"id\", \"binary_prompt\", "
              "\"confidence_prompt\"} or {\"prompt\"} with optional "
              "\"response_format\"/\"confidence_format\", plus optional "
-             "\"targets\": [t1, t2], \"class\", \"deadline_s\"")
+             "\"targets\": [t1, t2], \"class\", \"deadline_s\". With "
+             "--fleet-models, lines score under EVERY fleet model "
+             "({\"op\": \"fleet_score\"} or any line without a "
+             "\"model\" key) and return per-model P(yes)/P(no) plus "
+             "pairwise kappa/disagreement; a \"model\" key routes a "
+             "line to that one model's dispatch queue")
     p.add_argument("--checkpoints", type=Path, required=True)
-    p.add_argument("--model", required=True)
+    p.add_argument("--model", default=None,
+                   help="single-model serving (the full ScoringServer: "
+                        "breaker/ladder/checkpoint); exactly one of "
+                        "--model / --fleet-models is required")
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--mesh", type=str, default=None)
     p.add_argument("--param-cache", type=Path, default=None)
@@ -424,6 +462,7 @@ def _add_serve(sub) -> None:
     _add_engine_tuning_flags(p)
     _add_guard_flags(p)
     _add_kernel_flags(p)
+    _add_fleet_flags(p, with_models=True)
 
 
 def _add_rephrase(sub) -> None:
@@ -536,6 +575,9 @@ def cmd_sweep(args) -> None:
     run_model_comparison_sweep(
         _parse_models(args.models), factory, args.out,
         sweep_kind=args.sweep_kind,
+        weight_prefetch=not args.no_weight_prefetch,
+        weight_cache_bytes=(int(args.weight_cache_gb * 2**30)
+                            if args.weight_cache_gb else None),
     )
 
 
@@ -636,10 +678,16 @@ def cmd_serve(args) -> None:
         prefix_cache=not args.no_prefix_cache,
         pad_full=not args.no_pad_full,
         degrade_ladder=not args.no_degrade_ladder, **serve_kw)
+    if bool(args.model) == bool(args.fleet_models):
+        raise SystemExit("serve needs exactly one of --model (single-"
+                         "model) or --fleet-models (multiplexed fleet)")
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
         cache_root=args.param_cache, quantize_int8=args.int8,
         int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8)
+    if args.fleet_models:
+        _run_fleet_serve(args, serve_cfg, factory)
+        return
     engine = factory(args.model)
     server = ScoringServer(engine, args.model, serve_cfg,
                            precompile=not args.no_precompile).start()
@@ -715,6 +763,87 @@ def cmd_serve(args) -> None:
     log.info("serve faults: %s", json.dumps(server.faults.summary()))
     if not server.healthy:
         sys.exit(1)
+
+
+def _run_fleet_serve(args, serve_cfg, factory) -> None:
+    """Fleet serving loop (``serve --fleet-models``): every JSONL line
+    without a "model" key (or with {"op": "fleet_score"}) fans across
+    all fleet models and prints one aggregated agreement payload —
+    per-model P(yes)/P(no)/decision, pairwise kappa/disagreement
+    through the stats/streaming contingency path; a "model" key routes
+    the line to that one model's dispatch queue (DEPLOY.md §1k)."""
+    import json
+
+    from .data.prompts import LEGAL_PROMPTS
+    from .engine.fleet import ModelFleet
+    from .serve import FleetScoringServer, ServeRequest
+
+    if args.state_checkpoint is not None:
+        raise SystemExit(
+            "--state-checkpoint is not supported with --fleet-models; "
+            "run fleet serving behind an external retry layer")
+    models = [m for m in args.fleet_models.split(",") if m]
+    if not models:
+        raise SystemExit("--fleet-models needs at least one model id")
+    # Engines load at boot (tokenizer/buckets are submit-time state);
+    # WEIGHT residency is the cache's call from here on — under a
+    # budget, boot itself evicts down to what fits and later acquires
+    # re-stream from the pinned host staging.
+    fleet = ModelFleet.from_engines(
+        [(m, factory(m)) for m in models],
+        cache_budget_bytes=(int(args.weight_cache_gb * 2**30)
+                            if args.weight_cache_gb else None),
+        prefetch=not args.no_weight_prefetch)
+    server = FleetScoringServer(
+        fleet, serve_cfg,
+        fleet_deadline_s=(args.fleet_deadline
+                          if args.fleet_deadline is not None else 60.0),
+    ).start()
+    default_rf = LEGAL_PROMPTS[0].response_format
+    default_cf = LEGAL_PROMPTS[0].confidence_format
+    stream = (sys.stdin if args.requests == "-"
+              else open(args.requests, encoding="utf-8"))
+    futures = []
+    try:
+        for i, line in enumerate(stream):
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if obj.get("op") == "stats":
+                print(json.dumps({"op": "stats",
+                                  "fleet": server.fleet_summary()}),
+                      flush=True)
+                continue
+            prompt = obj.get("prompt")
+            req = ServeRequest(
+                binary_prompt=obj.get(
+                    "binary_prompt",
+                    f"{prompt} {obj.get('response_format', default_rf)}"),
+                confidence_prompt=obj.get(
+                    "confidence_prompt",
+                    f"{prompt} {obj.get('confidence_format', default_cf)}"),
+                targets=tuple(obj.get("targets", ("Yes", "No"))),
+                klass=obj.get("class", serve_cfg.default_class),
+                deadline_s=obj.get("deadline_s"),
+                request_id=str(obj.get("id", i)))
+            if obj.get("model"):
+                futures.append(("single",
+                                server.submit(req, obj["model"])))
+            else:
+                futures.append(("fleet", server.submit_fleet(req)))
+    finally:
+        if stream is not sys.stdin:
+            stream.close()
+    for kind, fut in futures:
+        r = fut.result()
+        print(json.dumps(r if kind == "fleet"
+                         else {k: v for k, v in vars(r).items()
+                               if not k.startswith("_")}), flush=True)
+    server.stop()
+    fleet.shutdown()
+    log.info("serve stats: %s", json.dumps(server.stats.summary()))
+    log.info("fleet stats: %s", json.dumps(server.fleet_summary()))
 
 
 def cmd_precompile(args) -> None:

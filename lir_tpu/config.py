@@ -354,6 +354,46 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Multi-model fleet knobs (engine/fleet.py over models/weights.py).
+
+    The fleet layer serves/sweeps N co-resident models off one engine
+    cluster: an HBM-budgeted LRU weight cache holds as many model param
+    trees as fit, an async streamer prefetches the next model's weights
+    behind the current model's compute, and serve grows the
+    ``fleet_score`` request class (one question across every resident
+    model, answered with per-model P(yes)/P(no) + pairwise
+    kappa/disagreement). DEPLOY.md §1k has the sizing arithmetic.
+
+    - ``fleet_models``: the model ids served by ``lir_tpu serve
+      --fleet-models`` (comma-separated on the CLI). Empty = single-
+      model serving (the pre-fleet ScoringServer path).
+    - ``weight_cache_gb``: HBM budget for co-resident model weights.
+      0 = unbounded (every model stays resident — correct whenever the
+      fleet fits; the CPU smoke default). When a model would not fit,
+      the LRU model with no in-flight dispatch is evicted; a budget
+      smaller than the single largest model is a loud error.
+    - ``weight_prefetch``: stream the next model's weights on a
+      background worker while the current model scores
+      (``--no-weight-prefetch`` serializes every swap — measurement
+      baseline, the pre-fleet drop-and-reload behavior).
+    - ``fleet_deadline_s``: default deadline for fleet_score fan-outs
+      (each per-model sub-request inherits it unless the request
+      carries an explicit ``deadline_s``).
+    """
+
+    fleet_models: Tuple[str, ...] = ()
+    weight_cache_gb: float = 0.0
+    weight_prefetch: bool = True
+    fleet_deadline_s: float = 60.0   # cli: --fleet-deadline
+
+    @property
+    def weight_cache_bytes(self) -> Optional[int]:
+        return (int(self.weight_cache_gb * 2**30)
+                if self.weight_cache_gb > 0 else None)
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     """Top-level framework configuration."""
 
@@ -364,6 +404,7 @@ class Config:
     stats: StatsConfig = dataclasses.field(default_factory=StatsConfig)
     retry: RetryConfig = dataclasses.field(default_factory=RetryConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
 
     # Paths: everything under one results root; no personal gdrive paths.
     results_dir: Path = Path("results")

@@ -1,5 +1,6 @@
 """Inference engine: batched scoring, greedy decode, grid + sweep drivers."""
 
+from .fleet import ModelFleet  # noqa: F401
 from .runner import PromptScore, ScoringEngine  # noqa: F401
 from .score import YesNoScores, readout_from_step_logits, weighted_confidence  # noqa: F401
 from .sweep import run_perturbation_sweep, run_word_meaning_sweep  # noqa: F401

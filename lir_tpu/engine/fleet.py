@@ -1,0 +1,255 @@
+"""Fleet scheduler: model-id-aware engine orchestration for the
+inter-model agreement axis.
+
+The paper's axis 2 (κ over 10-18 open-weight models) is a MODEL-major
+workload on a chip that holds one model comfortably and several tiny
+ones easily. AlpaServe's statistical-multiplexing result and
+ServerlessLLM's load-dominates-switching observation both land here:
+
+- a :class:`ModelFleet` owns one :class:`~lir_tpu.engine.runner.
+  ScoringEngine` per model plus the HBM-budgeted LRU
+  :class:`~lir_tpu.models.weights.WeightCache` and the single-worker
+  :class:`~lir_tpu.models.weights.AsyncWeightStreamer`;
+- ``acquire(model_id)`` makes a model's weights device-resident
+  (cache hit -> free; prefetched -> pay only the un-overlapped tail;
+  cold -> inline load, fully exposed) and refcounts them against the
+  caller's dispatch stream, so LRU eviction can never pull weights out
+  from under an in-flight dispatch;
+- ``sweep(model_ids, fn)`` is the prefetch pipeline engine/multi.py now
+  drives sweeps through: while model i scores, model i+1 streams —
+  swap cost hides behind compute (FleetStats.swap_s_hidden) instead of
+  serializing with it, replacing the old drop-params-and-reload loop
+  whose every switch was dead MXU time.
+
+Engines are constructed once (tokenizer, buckets, manifest key, stats
+all persist); only the param tree moves. ``compile_plan`` executables
+re-key on model config, so a model whose weights were evicted and
+re-streamed warm-starts: same avals, same executables, zero recompiles
+— and re-streamed weights are BITWISE the staged originals, so results
+cannot depend on eviction history (pinned by tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..models import weights
+from ..utils.profiling import FleetStats
+
+# An engine factory maps model id -> ready ScoringEngine (models/
+# factory.engine_factory is the checkpoint-backed one; tests inject
+# closures over tiny params).
+EngineFactory = Callable[[str], Any]
+
+
+class _Slot:
+    """One model's fleet state. ``engine`` is built lazily (on the
+    prefetch worker when possible — tokenizer load and weight
+    conversion overlap the previous model's compute); ``staged`` is the
+    pinned host staging copy reloads stream from."""
+
+    __slots__ = ("model_id", "make_engine", "engine", "staged", "nbytes")
+
+    def __init__(self, model_id: str,
+                 make_engine: Optional[EngineFactory] = None,
+                 engine: Any = None):
+        self.model_id = model_id
+        self.make_engine = make_engine
+        self.engine = engine
+        self.staged: Any = None
+        self.nbytes: int = 0
+
+
+class ModelFleet:
+    """Co-resident model pool + async weight streaming + swap
+    accounting. Thread discipline: ``acquire``/``release``/``sweep``
+    run on ONE consumer thread (the sweep loop or the serve fleet
+    supervisor); the streamer's single worker is the only other thread
+    that touches slots, and every slot it writes is handed over through
+    a future (happens-before at ``take``)."""
+
+    def __init__(self, cache_budget_bytes: Optional[int] = None,
+                 prefetch: bool = True, mesh=None,
+                 stage_reloads: bool = True,
+                 stats: Optional[FleetStats] = None):
+        self.stats = stats if stats is not None else FleetStats()
+        self.mesh = mesh
+        self.prefetch_enabled = bool(prefetch)
+        # Keep a host staging copy at first load so an evicted model
+        # reloads via the chunked streamer (one host->device copy)
+        # instead of a full checkpoint re-conversion. Costs host RAM =
+        # fleet weight bytes; single-pass sweeps that never revisit a
+        # model can turn it off.
+        self.stage_reloads = bool(stage_reloads)
+        self.cache = weights.WeightCache(cache_budget_bytes,
+                                         stats=self.stats,
+                                         on_evict=self._on_evict)
+        self.streamer = weights.AsyncWeightStreamer()
+        self._slots: Dict[str, _Slot] = {}
+        self._order: List[str] = []
+        self._active: Optional[str] = None
+        self._lock = threading.RLock()
+
+    # -- construction --------------------------------------------------------
+
+    def add_model(self, model_id: str, engine: Any = None,
+                  make_engine: Optional[EngineFactory] = None) -> None:
+        """Register a model. With ``engine`` (already loaded), its
+        params move under cache ownership immediately — the engine
+        keeps everything BUT the weights. With ``make_engine``, the
+        first acquire/prefetch builds the engine (checkpoint load on
+        the worker thread)."""
+        assert (engine is None) != (make_engine is None), (
+            "pass exactly one of engine / make_engine")
+        with self._lock:
+            assert model_id not in self._slots, f"duplicate model {model_id}"
+            slot = _Slot(model_id, make_engine=make_engine, engine=engine)
+            if engine is not None:
+                params = engine.params
+                slot.nbytes = weights.tree_bytes(params)
+                if self.stage_reloads:
+                    slot.staged = weights.host_stage(params)
+                self.cache.insert(model_id, params, slot.nbytes)
+            self._slots[model_id] = slot
+            self._order.append(model_id)
+
+    @classmethod
+    def from_factory(cls, factory: EngineFactory,
+                     model_ids: Sequence[str], **kwargs) -> "ModelFleet":
+        fleet = cls(**kwargs)
+        for mid in model_ids:
+            fleet.add_model(mid, make_engine=factory)
+        return fleet
+
+    @classmethod
+    def from_engines(cls, engines: Sequence[tuple], **kwargs
+                     ) -> "ModelFleet":
+        """[(model_id, ScoringEngine), ...] — tests and the serve boot
+        path, where engines are already built."""
+        fleet = cls(**kwargs)
+        for mid, engine in engines:
+            fleet.add_model(mid, engine=engine)
+        return fleet
+
+    @property
+    def model_ids(self) -> List[str]:
+        return list(self._order)
+
+    def engine(self, model_id: str) -> Any:
+        """The model's engine, WITHOUT making weights resident (host
+        metadata only: tokenizer, buckets, rt). None until first
+        load for make_engine slots."""
+        return self._slots[model_id].engine
+
+    def resident(self, model_id: str) -> bool:
+        return model_id in self.cache
+
+    # -- load path -----------------------------------------------------------
+
+    def _on_evict(self, model_id: str) -> None:
+        slot = self._slots.get(model_id)
+        if slot is None or slot.engine is None:
+            return
+        # Drop every engine-held reference to device weight/scratch HBM:
+        # the cache's entry was the canonical reference, the engine's
+        # param pointer and its donation-chain scratch cache are the
+        # stragglers that would keep the buffers alive.
+        slot.engine.params = None
+        slot.engine.fresh_handoff()
+
+    def _load(self, slot: _Slot) -> Any:
+        """Runs on the streamer worker (prefetch) or inline (cold
+        acquire): produce the model's device param tree."""
+        if slot.staged is not None:
+            eng = slot.engine
+            cfg = None if eng is None else eng.cfg
+            return weights.stream_params(
+                slot.staged, cfg=cfg if self.mesh is not None else None,
+                mesh=self.mesh, stats=self.stats)
+        engine = slot.make_engine(slot.model_id)
+        params = engine.params
+        slot.engine = engine
+        slot.nbytes = weights.tree_bytes(params)
+        if self.stage_reloads:
+            slot.staged = weights.host_stage(params)
+        return params
+
+    def prefetch(self, model_id: str) -> None:
+        """Start streaming ``model_id``'s weights in the background (a
+        no-op when already resident, prefetch disabled, or a prefetch
+        is already in flight)."""
+        if not self.prefetch_enabled:
+            return
+        slot = self._slots[model_id]
+        if model_id in self.cache:
+            return
+        self.streamer.prefetch(model_id, lambda: self._load(slot))
+
+    def acquire(self, model_id: str):
+        """Engine with weights device-resident + refcounted. Swap
+        accounting: a cache hit costs nothing; a prefetched load books
+        only the un-overlapped wait as exposed; a cold inline load is
+        fully exposed (exactly what the sequential drop-and-reload
+        baseline pays for EVERY switch)."""
+        slot = self._slots[model_id]
+        if model_id in self.cache:
+            params = self.cache.acquire(model_id)
+            self.stats.count("cache_hits")
+        else:
+            taken = self.streamer.take(model_id)
+            if taken is not None:
+                params, load_s, waited = taken
+                self.stats.count("prefetch_hits")
+                self.stats.count("loads")
+                self.stats.count("load_s", load_s)
+                self.stats.count("swap_s_exposed", waited)
+                self.stats.count("swap_s_hidden", max(load_s - waited, 0.0))
+            else:
+                t0 = time.perf_counter()
+                params = self._load(slot)
+                load_s = time.perf_counter() - t0
+                self.stats.count("prefetch_misses")
+                self.stats.count("loads")
+                self.stats.count("load_s", load_s)
+                self.stats.count("swap_s_exposed", load_s)
+            self.cache.insert(model_id, params, slot.nbytes or None)
+            params = self.cache.acquire(model_id)
+        if self._active != model_id:
+            self.stats.count("model_swaps")
+            self._active = model_id
+        slot.engine.params = params
+        return slot.engine
+
+    def release(self, model_id: str) -> None:
+        self.cache.release(model_id)
+
+    def pin(self, model_id: str) -> None:
+        self.cache.pin(model_id)
+
+    def unpin(self, model_id: str) -> None:
+        self.cache.unpin(model_id)
+
+    # -- the prefetch pipeline -----------------------------------------------
+
+    def sweep(self, model_ids: Sequence[str],
+              fn: Callable[[str, Any], Any]) -> Dict[str, Any]:
+        """Model-major sweep with next-model prefetch overlap: while
+        ``fn(model_id, engine)`` computes on model i, model i+1's
+        weights stream in the background. The engine handed to ``fn``
+        is resident and refcounted for the duration of the call."""
+        ids = list(model_ids)
+        out: Dict[str, Any] = {}
+        for i, mid in enumerate(ids):
+            engine = self.acquire(mid)
+            if i + 1 < len(ids):
+                self.prefetch(ids[i + 1])
+            try:
+                out[mid] = fn(mid, engine)
+            finally:
+                self.release(mid)
+        return out
+
+    def shutdown(self) -> None:
+        self.streamer.shutdown()

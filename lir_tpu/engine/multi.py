@@ -8,10 +8,23 @@ Parity targets:
   - the ThreadPoolExecutor model fan-out of perturb_prompts.py:917-962 —
     on TPU the models share the chips, so the sweep is sequential per model
     (SURVEY.md §2.5) with the same results-merging semantics;
-  - C15 memory management: params are dropped and the device allowed to
-    reclaim HBM between models (replacing gc/empty_cache/HF-cache-delete,
-    compare_base_vs_instruct.py:68-88);
+  - C15 memory management, now fleet-owned: instead of dropping params
+    and reloading cold per model (dead MXU time per switch), the sweep
+    drives the :class:`~lir_tpu.engine.fleet.ModelFleet` prefetch
+    pipeline — model i+1's weights stream host->device WHILE model i
+    scores, co-resident models stay cached up to the HBM budget, and the
+    LRU weight cache reclaims exactly when pressure demands (replacing
+    gc/empty_cache/HF-cache-delete, compare_base_vs_instruct.py:68-88);
   - C16 session capture: the whole sweep log is written next to the CSVs.
+
+Failure semantics (guard-layer parity with the single-model sweep): a
+model that fails to load or dispatch still emits its NaN rows, but the
+failure is now CLASSIFIED — ``error:model`` (load/dispatch exception)
+vs ``error:numerics`` (rows whose readouts fail guard/numerics.
+check_values are quarantined: cell identity kept, measurement fields
+nulled) — with the same GuardStats counters the single-model sweep and
+serve paths produce, so a fleet sweep's corruption is countable, not
+silent.
 
 Cost accounting becomes throughput accounting: every scored prompt feeds a
 ThroughputMeter and the sweep summary reports prompts/sec/chip
@@ -32,15 +45,22 @@ from ..data.prompts import (
     format_instruct_direct,
     format_instruct_prompt,
 )
+from ..guard import numerics
 from ..utils.logging import get_logger, save_captured_output, start_capture
-from ..utils.profiling import ThroughputMeter, device_memory_stats, trace
+from ..utils.profiling import (GuardStats, ThroughputMeter,
+                               device_memory_stats, trace)
+from .fleet import ModelFleet
 from .runner import ScoringEngine
 from .sweep import run_word_meaning_sweep
 
 log = get_logger(__name__)
 
+# Model-level failure status prefix (vs error:numerics for row-level
+# quarantine) — guard/numerics owns the numerics spelling.
+MODEL_ERROR = "error:model"
+
 # An engine factory returns a ready ScoringEngine for a model name; the
-# sweep drops every reference to it afterwards so HBM is reclaimed.
+# fleet layer owns its weights afterwards (LRU cache + async streaming).
 EngineFactory = Callable[[str], ScoringEngine]
 
 
@@ -102,6 +122,30 @@ def _host_path(path: Path) -> Path:
         f"{path.stem}.host{jax.process_index()}{path.suffix}")
 
 
+def _quarantine_rows(rows: List[schemas.ScoreRow],
+                     guard: GuardStats) -> Tuple[List[schemas.ScoreRow], int]:
+    """Row-level numerics boundary (guard/numerics parity with the
+    perturbation sweep): every scored row's readouts are validated;
+    offenders keep their cell identity with measurement fields nulled
+    and count as ``error:numerics`` quarantines."""
+    out: List[schemas.ScoreRow] = []
+    n_bad = 0
+    for r in rows:
+        guard.site("checked", "multi")
+        reason = numerics.check_values(r.yes_prob, r.no_prob)
+        if reason is None:
+            out.append(r)
+            continue
+        n_bad += 1
+        guard.quarantine("multi", reason)
+        log.warning("numerics guard: quarantined %s row %r (%s)",
+                    r.model, r.prompt[:60], reason)
+        out.append(dataclasses.replace(
+            r, model_output="ERROR", yes_prob=float("nan"),
+            no_prob=float("nan"), yes_no_found=False))
+    return out, n_bad
+
+
 def run_model_comparison_sweep(
     specs: Sequence[ModelSpec],
     engine_factory: EngineFactory,
@@ -110,9 +154,20 @@ def run_model_comparison_sweep(
     write_base_csv: bool = True,
     write_instruct_csv: bool = True,
     sweep_kind: str = "base_vs_instruct",
+    fleet: Optional[ModelFleet] = None,
+    weight_prefetch: bool = True,
+    weight_cache_bytes: Optional[int] = None,
 ) -> Dict[str, object]:
     """Sweep every model over the 50 word-meaning questions, producing the
-    D1 and/or D2 CSVs plus throughput metrics and a session log."""
+    D1 and/or D2 CSVs plus throughput metrics and a session log.
+
+    The sweep runs through the fleet scheduler (engine/fleet.py): model
+    i+1's weights stream host->device while model i scores, co-resident
+    models stay cached inside ``weight_cache_bytes`` (None = unbounded),
+    and per-model results are BITWISE what a standalone engine produces
+    (weights are moved, never transformed). Pass an existing ``fleet``
+    to reuse residency/staging across sweeps (the serve fleet does);
+    otherwise one is built from ``engine_factory`` for this call."""
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     capture = start_capture()
@@ -126,19 +181,42 @@ def run_model_comparison_sweep(
         log.info("multihost: process %d sweeps %d model(s)",
                  __import__("jax").process_index(), len(specs))
     meter = ThroughputMeter()
+    guard = GuardStats()
     all_rows: List[schemas.ScoreRow] = []
     per_model: Dict[str, Dict[str, object]] = {}
 
+    own_fleet = fleet is None
+    if own_fleet:
+        fleet = ModelFleet.from_factory(
+            engine_factory, [], prefetch=weight_prefetch,
+            cache_budget_bytes=weight_cache_bytes,
+            # A comparison sweep visits each model once; staging a host
+            # copy for reloads that never happen would only burn RAM.
+            stage_reloads=False)
+    known = set(fleet.model_ids)
     for spec in specs:
+        if spec.name not in known:
+            fleet.add_model(spec.name, make_engine=engine_factory)
+            known.add(spec.name)
+
+    for i, spec in enumerate(specs):
         log.info("=== %s (%s) ===", spec.name, spec.base_or_instruct)
-        engine: Optional[ScoringEngine] = None
+        acquired = False
         try:
-            engine = engine_factory(spec.name)
+            engine = fleet.acquire(spec.name)
+            acquired = True
+            if i + 1 < len(specs):
+                # The prefetch pipeline: the next model's weights stream
+                # on the background worker while this model's dispatches
+                # run — the swap cost the old drop-and-reload loop paid
+                # as dead device time per switch.
+                fleet.prefetch(specs[i + 1].name)
             fmt = format_for(spec, sweep_kind)
             with meter.measure(), trace(f"sweep/{spec.name.split('/')[-1]}"):
                 rows = run_word_meaning_sweep(
                     engine, spec.name, spec.base_or_instruct, questions, fmt,
                 )
+            rows, n_quarantined = _quarantine_rows(rows, guard)
             # Token accounting — the counters the reference priced into
             # dollars (perturb_prompts.py:1021-1066) feed throughput here.
             tokens_in = sum(
@@ -170,26 +248,39 @@ def run_model_comparison_sweep(
             per_model[spec.name] = {
                 "rows": len(rows),
                 "yes_no_found": n_found,
-                "status": "ok",
+                "status": ("ok" if n_quarantined == 0 else
+                           f"{numerics.NUMERICS_ERROR} — {n_quarantined} "
+                           f"row(s) quarantined"),
+                "rows_quarantined": n_quarantined,
             }
             log.info(
                 "%s: %d rows, yes/no found in %d", spec.name, len(rows), n_found
             )
         except Exception as exc:
             log.error("Model %s failed: %s — emitting NaN rows", spec.name, exc)
+            guard.quarantine("multi", MODEL_ERROR)
             rows = nan_rows_for_model(spec, questions)
-            per_model[spec.name] = {"rows": len(rows), "status": f"error: {exc}"}
+            per_model[spec.name] = {"rows": len(rows),
+                                    "status": f"{MODEL_ERROR}: {exc}"}
         finally:
-            # C15: drop the params reference so the backend reclaims HBM
-            # before the next model loads.
-            engine = None
+            # C15, fleet edition: drop THIS dispatch stream's reference;
+            # the LRU weight cache decides whether the model stays
+            # co-resident (budget headroom -> free re-acquire later) or
+            # reclaims its HBM under pressure.
+            if acquired:
+                fleet.release(spec.name)
         all_rows.extend(rows)
         mem = device_memory_stats()
         if mem:
             log.info("device memory: %s", mem)
+    if own_fleet:
+        fleet.shutdown()
 
     artifacts: Dict[str, object] = {"per_model": per_model,
-                                    "throughput": meter.summary()}
+                                    "throughput": meter.summary(),
+                                    "fleet": fleet.stats.summary(),
+                                    "guard": guard.summary()}
+    log.info("fleet: %s", artifacts["fleet"])
     if write_base_csv:
         # D1 holds every swept model, base and instruct alike.
         df = schemas.write_model_comparison_csv(

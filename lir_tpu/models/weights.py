@@ -1,0 +1,472 @@
+"""Async weight streaming + the HBM-budgeted LRU weight cache.
+
+The inter-model agreement axis (the paper's axis 2) scores 10-18
+open-weight models over one grid. Before the fleet layer, engine/multi.py
+paid a full host->device weight load as DEAD MXU time per model: params
+dropped between models, the next model's transfer serialized behind the
+previous model's last dispatch. ServerlessLLM's observation transfers
+directly — for a <=10-token scoring decode, checkpoint LOAD time (host
+staging + host->device copy), not compute, dominates model-switch
+latency — so this module makes the load overlappable and, where HBM
+allows, makes it disappear entirely:
+
+- **Pinned host staging** (:func:`host_stage`): the converted pytree
+  (models/loader.py layout) held as host numpy buffers, QuantTensor
+  payload/scale included. Staging is the slow, torch/safetensors-touching
+  step; it runs ONCE per model and the staged tree is what the streamer
+  re-ships on every (re)load — a reload costs one host->device copy, not
+  a re-conversion.
+- **Chunked, double-buffered streaming** (:func:`stream_params`): leaves
+  ship through ``jax.device_put`` in bounded chunks with a small
+  in-flight window, so a 7B tree never needs a second full host copy and
+  transfers overlap. Per-model partition rules are honored via the
+  ``parallel/sharding.py`` registry (``spec_tree_for``), QuantTensor
+  scales taking the derived output-axis spec exactly like
+  ``sharding.shard_params``. The streamed tree is BITWISE-identical to a
+  monolithic ``device_put`` (pinned by tests/test_loader_streaming.py
+  for every architecture family converter).
+- **LRU weight cache** (:class:`WeightCache`): an HBM-budgeted pool of
+  co-resident model param trees — the weight-side sibling of
+  models/paged.py's KV page pool, with the same refcount discipline:
+  every in-flight dispatch holds a reference, eviction (LRU) may only
+  drop models nobody is dispatching, pinned models are unevictable, and
+  a refcount can never go negative (a double release is a bug worth
+  crashing on).
+- **Async prefetch** (:class:`AsyncWeightStreamer`): a background worker
+  streams the NEXT model's staged tree while the CURRENT model's
+  dispatches run, so swap cost hides behind compute
+  (``FleetStats.swap_s_hidden``) instead of serializing with it
+  (``swap_s_exposed``). One worker on purpose: host->device bandwidth is
+  one resource; two concurrent streams just halve each other.
+
+engine/fleet.py composes these into the fleet scheduler; serve's
+multiplexed fleet server and the rewritten engine/multi.py both ride it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# Streaming chunk size. Large enough that per-chunk dispatch overhead is
+# noise against the copy itself, small enough that the in-flight window
+# (2 chunks) bounds transient host pinned memory well under one leaf of
+# a 7B tree. DEPLOY.md §1k documents the tuning story.
+DEFAULT_CHUNK_BYTES = 64 << 20
+# Double buffering: chunk k+1 is issued while chunk k is still in
+# flight; chunk k-1 must have landed before k+1 is issued.
+INFLIGHT_CHUNKS = 2
+
+
+class WeightCacheOOM(RuntimeError):
+    """The weight cache cannot fit a model inside its HBM budget — every
+    resident candidate for eviction is pinned or referenced by an
+    in-flight dispatch. Deliberately loud: silently thrashing weights
+    under a mis-sized budget is the failure DEPLOY.md §1k's arithmetic
+    exists to prevent."""
+
+
+def leaf_bytes(leaf) -> int:
+    """Payload bytes of one tree leaf (QuantTensor-aware)."""
+    from .quant import QuantTensor
+
+    if isinstance(leaf, QuantTensor):
+        return leaf_bytes(leaf.q) + leaf_bytes(leaf.scale)
+    return int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+
+
+def tree_bytes(params: Any) -> int:
+    """Total payload bytes of a param tree (the cache's accounting unit;
+    equals models/quant.param_bytes on device trees)."""
+    from .quant import QuantTensor
+
+    return sum(leaf_bytes(l) for l in _leaves(params, QuantTensor))
+
+
+def _leaves(tree: Any, quant_cls) -> List[Any]:
+    import jax
+
+    return jax.tree.leaves(tree,
+                           is_leaf=lambda x: isinstance(x, quant_cls))
+
+
+def host_stage(params: Any) -> Any:
+    """Host staging copy of a converted param tree: every array leaf
+    becomes a host numpy buffer (QuantTensor structure preserved —
+    int8 payload + fp32 scale stay exactly as quantized). This is the
+    tree the streamer ships; it never changes after staging, so a
+    reload after eviction is bitwise-identical by construction."""
+    import jax
+
+    from .quant import QuantTensor
+
+    def leaf(x):
+        if isinstance(x, QuantTensor):
+            return QuantTensor(q=np.asarray(jax.device_get(x.q)),
+                               scale=np.asarray(jax.device_get(x.scale)),
+                               dynamic=x.dynamic)
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(leaf, params,
+                        is_leaf=lambda x: isinstance(x, QuantTensor))
+
+
+class _InflightWindow:
+    """Bounded device_put pipeline: admit a new transfer only after the
+    one two slots back has landed (double buffering). ``drain`` blocks
+    until everything landed."""
+
+    def __init__(self, depth: int = INFLIGHT_CHUNKS):
+        self.depth = depth
+        self._pending: List[Any] = []
+
+    def admit(self, arr) -> Any:
+        self._pending.append(arr)
+        if len(self._pending) > self.depth:
+            head = self._pending.pop(0)
+            if hasattr(head, "block_until_ready"):
+                head.block_until_ready()
+        return arr
+
+    def drain(self) -> None:
+        for arr in self._pending:
+            if hasattr(arr, "block_until_ready"):
+                arr.block_until_ready()
+        self._pending.clear()
+
+
+def _chunk_starts(n_rows: int, rows_per_chunk: int) -> List[int]:
+    return list(range(0, n_rows, max(rows_per_chunk, 1)))
+
+
+def _stream_array(arr: np.ndarray, sharding, chunk_bytes: int,
+                  window: _InflightWindow):
+    """One leaf host->device, split along axis 0 into <= chunk_bytes
+    pieces re-joined on device. Axis 0 is the layer-stack (or vocab)
+    axis — replicated in every partition rule this engine emits — so a
+    chunk's sharding equals the full leaf's. Bitwise: concatenation of
+    device_put chunks is the identical buffer a monolithic device_put
+    produces."""
+    import jax
+    import jax.numpy as jnp
+
+    nbytes = leaf_bytes(arr)
+    put = (lambda a: jax.device_put(a, sharding)) if sharding is not None \
+        else jax.device_put
+    if arr.ndim == 0 or nbytes <= chunk_bytes or arr.shape[0] <= 1:
+        return window.admit(put(arr))
+    rows = max(int(arr.shape[0] * chunk_bytes / nbytes), 1)
+    parts = [window.admit(put(arr[s:s + rows]))
+             for s in _chunk_starts(arr.shape[0], rows)]
+    if len(parts) == 1:
+        return parts[0]
+    joined = jnp.concatenate(parts, axis=0)
+    if sharding is not None:
+        # Re-pin the joined buffer: concatenate of same-sharded operands
+        # already lands there, but make the placement explicit rather
+        # than relying on XLA's default propagation.
+        joined = jax.device_put(joined, sharding)
+    return window.admit(joined)
+
+
+def stream_params(staged: Any, cfg=None, mesh=None,
+                  chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                  stats=None) -> Any:
+    """Ship a host-staged tree to device in chunks with a double-buffered
+    in-flight window; returns the fully-landed device tree.
+
+    With ``cfg`` and ``mesh``, every leaf takes its NamedSharding from
+    the per-model partition-rule registry
+    (``parallel.sharding.spec_tree_for``); QuantTensor payloads take the
+    dense weight's spec and scales the derived output-axis spec —
+    exactly ``sharding.shard_params``'s placement, arrived at chunk by
+    chunk. Without a mesh, leaves land on the default device.
+
+    ``stats`` (profiling.FleetStats) counts ``weight_bytes_streamed``.
+    """
+    import jax
+
+    from .quant import QuantTensor
+
+    specs = None
+    shard = None
+    if mesh is not None and cfg is not None:
+        from jax.sharding import NamedSharding
+
+        from ..parallel import sharding as sharding_mod
+
+        specs = sharding_mod.spec_tree_for(cfg, mesh, staged)
+        shard = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+
+    window = _InflightWindow()
+
+    def leaf(x, spec=None):
+        from ..parallel.sharding import quant_scale_spec
+
+        if isinstance(x, QuantTensor):
+            q = _stream_array(np.asarray(x.q),
+                              shard(spec) if spec is not None else None,
+                              chunk_bytes, window)
+            scale = _stream_array(
+                np.asarray(x.scale),
+                shard(quant_scale_spec(spec)) if spec is not None else None,
+                chunk_bytes, window)
+            return QuantTensor(q=q, scale=scale, dynamic=x.dynamic)
+        return _stream_array(np.asarray(x),
+                             shard(spec) if spec is not None else None,
+                             chunk_bytes, window)
+
+    is_qt = lambda x: isinstance(x, QuantTensor)  # noqa: E731
+    if specs is not None:
+        out = jax.tree.map(leaf, staged, specs, is_leaf=is_qt)
+    else:
+        out = jax.tree.map(leaf, staged, is_leaf=is_qt)
+    window.drain()
+    if stats is not None:
+        stats.count("weight_bytes_streamed", tree_bytes(staged))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# LRU weight cache
+# ---------------------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("params", "nbytes", "refcount", "pinned")
+
+    def __init__(self, params: Any, nbytes: int):
+        self.params = params
+        self.nbytes = int(nbytes)
+        self.refcount = 0
+        self.pinned = False
+
+
+class WeightCache:
+    """HBM-budgeted LRU pool of co-resident model param trees.
+
+    Bookkeeping only — loading/streaming is the fleet's job (the cache
+    must never hold its lock across a multi-second host->device copy).
+    Discipline mirrors the KV page pool (models/paged.py):
+
+    - ``acquire`` marks a model in use by one dispatch stream
+      (refcount += 1, MRU touch); ``release`` drops it. A refcount can
+      never go negative.
+    - ``insert`` evicts LRU models until the new tree fits the budget.
+      Only models with refcount == 0 and not pinned are evictable; if
+      nothing evictable frees enough, :class:`WeightCacheOOM`.
+    - ``pin``/``unpin``: a pinned model is unevictable regardless of
+      refcount (serving pins the models a fleet request is fanning
+      across so no sub-request can evict another's weights mid-fan).
+
+    ``budget_bytes=None`` means unbounded (CPU smoke / tests size by
+    entry count instead via eviction pressure).
+    """
+
+    def __init__(self, budget_bytes: Optional[int] = None, stats=None,
+                 on_evict: Optional[Callable[[str], None]] = None):
+        self.budget_bytes = (None if budget_bytes is None
+                             else int(budget_bytes))
+        self.stats = stats
+        # Eviction hook: the fleet clears the evicted engine's params
+        # reference and donation-chain scratch so the HBM actually
+        # reclaims (the cache's own reference is not the only one).
+        self.on_evict = on_evict
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()  # guarded-by: _lock
+
+    # -- gauges --------------------------------------------------------------
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    @property
+    def resident_models(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def __contains__(self, model_id: str) -> bool:
+        with self._lock:
+            return model_id in self._entries
+
+    def refcount(self, model_id: str) -> int:
+        with self._lock:
+            e = self._entries.get(model_id)
+            return 0 if e is None else e.refcount
+
+    def _gauge(self) -> None:
+        if self.stats is not None:
+            self.stats.gauge("resident_models", len(self._entries))
+            self.stats.gauge("resident_bytes",
+                             sum(e.nbytes for e in self._entries.values()))
+
+    # -- resident set --------------------------------------------------------
+
+    def insert(self, model_id: str, params: Any,
+               nbytes: Optional[int] = None) -> None:
+        """Make ``model_id`` resident (idempotent — re-inserting a
+        resident model only touches MRU order). Evicts LRU models as
+        needed; raises :class:`WeightCacheOOM` when the budget cannot be
+        met by evicting unreferenced, unpinned models."""
+        with self._lock:
+            if model_id in self._entries:
+                self._entries.move_to_end(model_id)
+                return
+            nbytes = tree_bytes(params) if nbytes is None else int(nbytes)
+            if self.budget_bytes is not None:
+                self._evict_until(self.budget_bytes - nbytes, model_id)
+            self._entries[model_id] = _Entry(params, nbytes)
+            self._gauge()
+
+    def _evict_until(self, budget_left: int, incoming: str) -> None:  # guarded-by: _lock
+        used = sum(e.nbytes for e in self._entries.values())
+        if used <= budget_left:
+            return
+        for mid in list(self._entries):       # OrderedDict = LRU first
+            e = self._entries[mid]
+            if e.refcount > 0 or e.pinned:
+                continue
+            del self._entries[mid]
+            used -= e.nbytes
+            if self.stats is not None:
+                self.stats.count("evictions")
+            if self.on_evict is not None:
+                self.on_evict(mid)
+            log.info("weight cache: evicted %s (%.2f GB) for %s",
+                     mid, e.nbytes / 2**30, incoming)
+            if used <= budget_left:
+                self._gauge()
+                return
+        raise WeightCacheOOM(
+            f"cannot fit {incoming} in the weight cache: "
+            f"{used / 2**30:.2f} GB resident is pinned or in use, "
+            f"budget leaves {max(budget_left, 0) / 2**30:.2f} GB")
+
+    def drop(self, model_id: str) -> None:
+        """Explicitly evict one model (must be unreferenced/unpinned)."""
+        with self._lock:
+            e = self._entries.get(model_id)
+            if e is None:
+                return
+            if e.refcount > 0 or e.pinned:
+                raise WeightCacheOOM(
+                    f"cannot drop {model_id}: refcount {e.refcount}, "
+                    f"pinned {e.pinned}")
+            del self._entries[model_id]
+            if self.stats is not None:
+                self.stats.count("evictions")
+            if self.on_evict is not None:
+                self.on_evict(model_id)
+            self._gauge()
+
+    # -- reference discipline ------------------------------------------------
+
+    def acquire(self, model_id: str) -> Any:
+        """Params of a RESIDENT model, refcounted for one dispatch
+        stream. KeyError when not resident (the fleet loads first)."""
+        with self._lock:
+            e = self._entries[model_id]
+            e.refcount += 1
+            self._entries.move_to_end(model_id)
+            return e.params
+
+    def release(self, model_id: str) -> None:
+        with self._lock:
+            e = self._entries[model_id]
+            e.refcount -= 1
+            assert e.refcount >= 0, (
+                f"weight cache refcount for {model_id} went negative — "
+                "double release")
+
+    def pin(self, model_id: str) -> None:
+        with self._lock:
+            self._entries[model_id].pinned = True
+
+    def unpin(self, model_id: str) -> None:
+        with self._lock:
+            self._entries[model_id].pinned = False
+
+    def peek(self, model_id: str) -> Optional[Any]:
+        """Params without touching refcount or MRU order (tests)."""
+        with self._lock:
+            e = self._entries.get(model_id)
+            return None if e is None else e.params
+
+
+# ---------------------------------------------------------------------------
+# Async prefetch
+# ---------------------------------------------------------------------------
+
+
+class AsyncWeightStreamer:
+    """One background worker streaming staged trees to device ahead of
+    need. ``prefetch`` enqueues a load; ``take`` blocks until that load
+    lands and reports (params, load_seconds, waited_seconds) — the fleet
+    books ``waited`` as exposed swap time and ``load - waited`` as
+    hidden (overlapped with the previous model's compute).
+
+    Single worker by design: host->device bandwidth is one shared
+    resource, and the scheduler only ever needs the NEXT model early.
+    """
+
+    def __init__(self):
+        import concurrent.futures
+
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="weight-stream")
+        self._lock = threading.Lock()
+        self._futures: Dict[str, Any] = {}  # guarded-by: _lock
+
+    def prefetch(self, model_id: str,
+                 load_fn: Callable[[], Any]) -> None:
+        """Start loading ``model_id`` in the background (idempotent while
+        a load is already queued/running)."""
+        with self._lock:
+            if model_id in self._futures:
+                return
+
+            def timed():
+                t0 = time.perf_counter()
+                params = load_fn()
+                return params, time.perf_counter() - t0
+
+            self._futures[model_id] = self._pool.submit(timed)
+
+    def pending(self, model_id: str) -> bool:
+        with self._lock:
+            return model_id in self._futures
+
+    def take(self, model_id: str) -> Optional[Tuple[Any, float, float]]:
+        """Claim a prefetched load: blocks until it lands, returns
+        (params, load_s, waited_s), or None when nothing was prefetched
+        for ``model_id``. A load that raised re-raises HERE, on the
+        consumer thread — prefetch failures surface exactly where an
+        inline load's would."""
+        with self._lock:
+            fut = self._futures.pop(model_id, None)
+        if fut is None:
+            return None
+        t0 = time.perf_counter()
+        params, load_s = fut.result()
+        return params, load_s, time.perf_counter() - t0
+
+    def cancel_all(self) -> None:
+        with self._lock:
+            futures = dict(self._futures)
+            self._futures.clear()
+        for fut in futures.values():
+            fut.cancel()
+
+    def shutdown(self) -> None:
+        self.cancel_all()
+        self._pool.shutdown(wait=True)

@@ -22,7 +22,8 @@ replicated attention + sharded MLP rather than failing.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -32,6 +33,11 @@ from ..config import MeshConfig
 from ..models.registry import ModelConfig
 
 Params = Dict[str, Any]
+
+# (regex over '/'-joined param paths, PartitionSpec) — the rule shape of
+# the fleet's per-model registry (SNIPPETS.md [2] match_partition_rules
+# is the exemplar). First match wins; scalar leaves always replicate.
+PartitionRules = Sequence[Tuple[str, P]]
 
 
 def build_mesh(cfg: MeshConfig, devices=None) -> Mesh:
@@ -159,6 +165,108 @@ def encdec_param_specs(cfg, mesh: Mesh) -> Params:
     return specs
 
 
+# ---------------------------------------------------------------------------
+# Per-model partition-rule registry (the fleet layer's seam)
+# ---------------------------------------------------------------------------
+
+# Model-name pattern -> rules factory. A factory takes (cfg, mesh) and
+# returns EITHER a full PartitionSpec pytree matching the param tree, OR
+# a PartitionRules sequence to be matched against '/'-joined param paths
+# (match_partition_rules). Registered rules win over the structural
+# defaults (decoder_param_specs / encdec_param_specs), so one
+# odd-architecture model in a fleet can shard its own way without
+# forking shard_params — and the weight streamer (models/weights.py)
+# places every chunk under the SAME registry, so streamed and monolithic
+# loads can never disagree on placement.
+_PARTITION_RULE_REGISTRY: List[
+    Tuple[str, Callable[[Any, Mesh], Any]]] = []
+
+
+def register_partition_rules(
+        name_pattern: str,
+        rules_fn: Callable[[Any, Mesh], Any]) -> None:
+    """Register per-model partition rules: ``name_pattern`` is a regex
+    matched (re.search) against ``cfg.name``. Later registrations win
+    over earlier ones (override in tests / deployment preludes)."""
+    _PARTITION_RULE_REGISTRY.insert(0, (str(name_pattern), rules_fn))
+
+
+def unregister_partition_rules(name_pattern: str) -> None:
+    _PARTITION_RULE_REGISTRY[:] = [
+        (p, f) for p, f in _PARTITION_RULE_REGISTRY if p != name_pattern]
+
+
+def registered_rules_for(cfg) -> Optional[Callable[[Any, Mesh], Any]]:
+    name = str(getattr(cfg, "name", ""))
+    for pattern, fn in _PARTITION_RULE_REGISTRY:
+        if re.search(pattern, name):
+            return fn
+    return None
+
+
+def _tree_with_paths(params: Params) -> List[Tuple[str, Any]]:
+    """('/'-joined path, leaf) pairs; QuantTensor is a leaf (its q/scale
+    split is derived, not matched)."""
+    from ..models.quant import QuantTensor
+
+    flat = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, QuantTensor))[0]
+    out = []
+    for path, leaf in flat:
+        parts = []
+        for k in path:
+            parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+        out.append(("/".join(parts), leaf))
+    return out
+
+
+def match_partition_rules(rules: PartitionRules, params: Params) -> Params:
+    """PartitionSpec pytree for ``params`` from (regex, spec) rules —
+    the SNIPPETS.md [2] exemplar adapted to this engine's dict pytrees:
+    first re.search match on the '/'-joined path wins, scalar leaves
+    always replicate, and an unmatched non-scalar leaf is a loud error
+    (a silently replicated 7B matrix is an OOM at 3am, not a default).
+    """
+    from ..models.quant import QuantTensor
+
+    def spec_for(name: str, leaf) -> P:
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"partition rule not found for param: {name}")
+
+    leaves = [spec_for(name, leaf) for name, leaf in _tree_with_paths(params)]
+    treedef = jax.tree_util.tree_structure(
+        params, is_leaf=lambda x: isinstance(x, QuantTensor))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def spec_tree_for(cfg, mesh: Mesh, params: Optional[Params] = None
+                  ) -> Params:
+    """The PartitionSpec pytree for one model on one mesh — registry
+    first (per-model rules), structural defaults otherwise. This is the
+    ONE resolution path: shard_params (monolithic load) and
+    models/weights.stream_params (chunked fleet load) both call it, so
+    a model's placement cannot depend on how its weights arrived."""
+    from ..models.registry import T5Config
+
+    fn = registered_rules_for(cfg)
+    if fn is not None:
+        rules = fn(cfg, mesh)
+        if isinstance(rules, (list, tuple)):
+            if params is None:
+                raise ValueError(
+                    "rule-list partition rules need the param tree to "
+                    "match against (pass params=)")
+            return match_partition_rules(rules, params)
+        return rules
+    return (encdec_param_specs(cfg, mesh) if isinstance(cfg, T5Config)
+            else decoder_param_specs(cfg, mesh))
+
+
 def quant_scale_spec(spec: P) -> P:
     """Spec for a QuantTensor's per-output-channel scale, derived from the
     dense weight's spec: keep the leading (layer-stack) axes, keep the OUTPUT
@@ -175,12 +283,12 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
 
     int8 trees compose: a QuantTensor's payload takes the dense weight's
     spec, its scale the derived output-axis spec (quant_scale_spec).
-    Dispatches on the config type: T5Config trees get the enc-dec specs."""
+    Resolution goes through spec_tree_for — per-model registry rules
+    first, then the structural defaults (T5Config trees get the enc-dec
+    specs)."""
     from ..models.quant import QuantTensor
-    from ..models.registry import T5Config
 
-    specs = (encdec_param_specs(cfg, mesh) if isinstance(cfg, T5Config)
-             else decoder_param_specs(cfg, mesh))
+    specs = spec_tree_for(cfg, mesh, params)
 
     def place(leaf, spec):
         if isinstance(leaf, QuantTensor):

@@ -25,21 +25,29 @@ Components:
   after a cooldown, closed on probe success — lir_tpu/faults), a
   degradation ladder that bisects failing batches to isolate poison
   rows, and a SIGTERM state checkpoint for preemption-safe restarts.
+- batcher.FleetBatcher + server.FleetScoringServer — the multi-model
+  fleet layer (engine/fleet.py underneath): per-model dispatch queues
+  with resident-first selection and background weight prefetch, and the
+  ``fleet_score`` request class fanning one question across every fleet
+  model, answered with per-model P(yes)/P(no) plus pairwise
+  kappa/disagreement through the stats/streaming contingency path.
 
 Surface: the ``lir_tpu serve`` CLI subcommand (JSONL over stdin/stdout),
 profiling.ServeStats observability, and bench.py's Poisson open-loop
 load driver ("serve" headline key).
 """
 
-from .batcher import ContinuousBatcher
+from .batcher import ContinuousBatcher, FleetBatcher
 from .cache import ResultCache, content_key
 from .queue import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK, STATUS_SHED,
                     RequestQueue, ServeFuture, ServeRequest, ServeResult)
-from .server import ScoringServer
+from .server import (FleetScoreFuture, FleetScoringServer, ScoringServer,
+                     aggregate_fleet, fleet_decision)
 
 __all__ = [
-    "ContinuousBatcher", "ResultCache", "content_key",
+    "ContinuousBatcher", "FleetBatcher", "ResultCache", "content_key",
     "RequestQueue", "ServeFuture", "ServeRequest", "ServeResult",
-    "ScoringServer",
+    "ScoringServer", "FleetScoringServer", "FleetScoreFuture",
+    "aggregate_fleet", "fleet_decision",
     "STATUS_OK", "STATUS_EXPIRED", "STATUS_SHED", "STATUS_ERROR",
 ]

@@ -251,3 +251,91 @@ class ContinuousBatcher:
             ))
         self.stats.add_dispatch(n, bsz)
         return payloads
+
+
+class FleetBatcher:
+    """Per-model dispatch queues over co-resident models — the fleet
+    layer's serve seam (engine/fleet.ModelFleet underneath).
+
+    One :class:`ContinuousBatcher` per fleet model keeps the bucket/
+    linger/price machinery unchanged per model; this class adds the two
+    things a multi-model server needs on top:
+
+    - **Resident-first selection**: among models with a ripe bucket, one
+      whose weights are already in HBM dispatches before any model that
+      would pay a swap (AlpaServe's statistical-multiplexing insight:
+      co-resident models absorb each other's bursts for free). The
+      resident scan order rotates per call so equally-loaded resident
+      models round-robin instead of the first one starving the rest;
+      a non-resident model's rows still age toward their deadlines and
+      dispatch as soon as no resident work is ripe.
+    - **Swap overlap**: the moment a dispatch is chosen, the next
+      NON-resident model with waiting work starts streaming its weights
+      in the background (fleet.prefetch), so the swap it will
+      eventually pay hides behind this dispatch's device time.
+
+    ``score`` wraps the per-model batcher's dispatch in fleet
+    acquire/release, so the LRU weight cache can never evict a model
+    mid-dispatch (refcount) and swap timing lands in FleetStats.
+    """
+
+    def __init__(self, fleet, stats: ServeStats, linger_s: float,
+                 clock: Callable[[], float] = time.monotonic,
+                 pad_full: bool = True):
+        self.fleet = fleet
+        self.stats = stats
+        self.clock = clock
+        self.batchers: Dict[str, ContinuousBatcher] = {
+            mid: ContinuousBatcher(fleet.engine(mid), stats, linger_s,
+                                   clock, pad_full=pad_full,
+                                   prefix_cache=False)
+            for mid in fleet.model_ids}
+        self._rr = 0
+
+    def admit(self, pending: Pending) -> None:
+        self.batchers[pending.model_id].admit(pending)
+
+    @property
+    def pending_rows(self) -> int:
+        return sum(b.pending_rows for b in self.batchers.values())
+
+    def snapshot(self) -> List[Pending]:
+        return [p for mid in sorted(self.batchers)
+                for p in self.batchers[mid].snapshot()]
+
+    def next_dispatch(self, now: float, flush: bool = False
+                      ) -> Optional[Tuple[str, int, List[Pending]]]:
+        """(model_id, bucket, rows) of the next dispatch, or None when
+        no model has a ripe bucket."""
+        mids = list(self.batchers)
+        resident = [m for m in mids if self.fleet.resident(m)]
+        if resident:
+            self._rr = (self._rr + 1) % len(resident)
+            resident = resident[self._rr:] + resident[:self._rr]
+        rest = [m for m in mids if not self.fleet.resident(m)]
+        for mid in resident + rest:
+            d = self.batchers[mid].next_dispatch(now, flush=flush)
+            if d is None:
+                continue
+            bucket, rows = d
+            for nxt in mids:
+                if (nxt != mid and not self.fleet.resident(nxt)
+                        and self.batchers[nxt].pending_rows):
+                    self.fleet.prefetch(nxt)
+                    break
+            return mid, bucket, rows
+        return None
+
+    def flush_all(self, status: str, note: str) -> int:
+        return sum(b.flush_all(status, note)
+                   for b in self.batchers.values())
+
+    def score(self, model_id: str, bucket: int,
+              rows: List[Pending]) -> List[Dict]:
+        """One dispatch on ``model_id``'s engine with its weights held
+        resident (fleet refcount) for the duration."""
+        self.fleet.acquire(model_id)
+        try:
+            return self.batchers[model_id].score(bucket, rows)
+        finally:
+            self.fleet.release(model_id)

@@ -131,6 +131,9 @@ class Pending:
     # match_len) — ADVISORY: feeds the batcher's prefix-aware
     # bucket_cost pricing; the dispatch re-looks up with a pin.
     cached_hint: int = 0
+    # Fleet routing: which model's dispatch queue this row belongs to
+    # (serve/batcher.FleetBatcher); "" on single-model servers.
+    model_id: str = ""
 
     @property
     def prefix_len(self) -> int:

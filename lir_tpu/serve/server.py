@@ -53,7 +53,7 @@ from ..utils.logging import get_logger
 from ..utils.manifest import atomic_write_json
 from ..utils.profiling import FaultStats, ServeStats
 from ..utils.retry import retry_with_exponential_backoff
-from .batcher import ContinuousBatcher
+from .batcher import ContinuousBatcher, FleetBatcher
 from .cache import ResultCache, content_key
 from .queue import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK, STATUS_SHED,
                     Pending, RequestQueue, ServeFuture, ServeRequest,
@@ -506,3 +506,293 @@ class ScoringServer:
         log.info("serve: resuming %d checkpointed requests from %s",
                  len(reqs), path)
         return [self.submit(r) for r in reqs]
+
+
+# ---------------------------------------------------------------------------
+# Fleet serving: one question across all resident models (the agreement
+# axis as a request class)
+# ---------------------------------------------------------------------------
+
+
+def fleet_decision(token_1_prob, token_2_prob):
+    """Binary decision for the agreement statistic — EXACTLY the rule
+    the streaming-statistics lattice folds (engine/stream_stats.py:
+    yes > no on device == float64 Relative_Prob > 0.5): 1/0, or None
+    when the row is invalid (missing/non-finite/zero-total probs), so
+    fleet kappa is bitwise-comparable with every other kappa this
+    framework reports."""
+    import math
+
+    if token_1_prob is None or token_2_prob is None:
+        return None
+    t1, t2 = float(token_1_prob), float(token_2_prob)
+    total = t1 + t2
+    if not math.isfinite(total) or total <= 0:
+        return None
+    return 1 if t1 / total > 0.5 else 0
+
+
+def aggregate_fleet(request_id: str, results: Dict[str, "ServeResult"],
+                    latency_s: float) -> Dict:
+    """Fold one fleet_score fan-out's per-model results into the
+    agreement payload: per-model P(yes)/P(no)/decision, the within-
+    question kappa over the valid decisions — routed through stats/
+    streaming.kappa_from_counts, the SAME contingency path the
+    streaming sink and the csv pipeline use, so serve-reported kappa is
+    bitwise what an offline analysis of the same rows computes — and
+    the pairwise disagreement fraction (1 - observed agreement over all
+    model pairs)."""
+    import numpy as np
+
+    from ..stats import streaming
+
+    per_model: Dict[str, Dict] = {}
+    decisions = []
+    for mid in sorted(results):
+        r = results[mid]
+        dec = (fleet_decision(r.token_1_prob, r.token_2_prob)
+               if r.status == STATUS_OK else None)
+        per_model[mid] = {
+            "status": r.status,
+            "token_1_prob": r.token_1_prob,
+            "token_2_prob": r.token_2_prob,
+            "weighted_confidence": r.weighted_confidence,
+            "confidence_value": r.confidence_value,
+            "decision": dec,
+            "cached": r.cached,
+        }
+        if r.note:
+            per_model[mid]["note"] = r.note
+        if dec is not None:
+            decisions.append(dec)
+    n_ok = sum(1 for m in per_model.values()
+               if m["status"] == STATUS_OK)
+    if decisions:
+        n_g, s_g = streaming.group_counts(
+            np.zeros(len(decisions), dtype=np.int64),
+            np.asarray(decisions, dtype=np.int64))
+        kap = streaming.kappa_from_counts(n_g, s_g)
+    else:
+        kap = {"kappa": float("nan"),
+               "observed_agreement": float("nan"),
+               "expected_agreement": float("nan")}
+    n = len(decisions)
+    n_pairs = n * (n - 1) // 2
+    disagreement = (1.0 - float(kap["observed_agreement"])
+                    if n_pairs > 0 else float("nan"))
+    status = (STATUS_OK if n_ok == len(per_model) and per_model
+              else "partial" if n_ok else STATUS_ERROR)
+    return {
+        "request_id": request_id,
+        "status": status,
+        "n_models": len(per_model),
+        "n_valid": n,
+        "per_model": per_model,
+        "kappa": {k: float(v) for k, v in kap.items()},
+        "disagreement": disagreement,
+        "latency_s": latency_s,
+    }
+
+
+class FleetScoreFuture:
+    """Completion handle for one fleet fan-out: resolves when every
+    per-model sub-future has (each with SOME status — the serving
+    contract), then aggregates probabilities + agreement."""
+
+    def __init__(self, request_id: str, futures: Dict[str, ServeFuture],
+                 t_submit: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.request_id = request_id
+        self._futures = futures
+        self._t_submit = t_submit
+        self._clock = clock
+
+    def done(self) -> bool:
+        return all(f.done() for f in self._futures.values())
+
+    def result(self, timeout: Optional[float] = None) -> Dict:
+        deadline = (None if timeout is None
+                    else self._clock() + timeout)
+        results: Dict[str, ServeResult] = {}
+        for mid, fut in self._futures.items():
+            left = (None if deadline is None
+                    else max(deadline - self._clock(), 0.0))
+            results[mid] = fut.result(left)
+        return aggregate_fleet(self.request_id, results,
+                               self._clock() - self._t_submit)
+
+
+class FleetScoringServer:
+    """Multiplexed scoring service over a ModelFleet: per-model dispatch
+    queues (serve/batcher.FleetBatcher), resident-first selection with
+    background weight prefetch, and the ``fleet_score`` request class —
+    one question fanned across every fleet model, answered with
+    per-model P(yes)/P(no) plus pairwise kappa/disagreement through the
+    stats/streaming contingency path.
+
+    Deliberately leaner than :class:`ScoringServer` (which remains the
+    single-model production server with breaker/ladder/checkpoint):
+    the fleet supervisor keeps the retry policy, deadline expiry, and
+    the numerics-guard quarantine boundary — the pieces that shape
+    per-row results — and trades the failure-domain machinery for
+    model-multiplexing. Per-model results are BITWISE what the same
+    request on a single-model ScoringServer over the same engine
+    returns (pinned by tests/test_fleet.py): the dispatch path is the
+    same ContinuousBatcher.score call on the same engine.
+    """
+
+    def __init__(self, fleet, config: Optional[ServeConfig] = None,
+                 fleet_deadline_s: float = 60.0,
+                 stats: Optional[ServeStats] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.fleet = fleet
+        self.config = config or ServeConfig()
+        self.fleet_deadline_s = float(fleet_deadline_s)
+        self.stats = stats if stats is not None else ServeStats()
+        self.clock = clock
+        self.queue = RequestQueue(self.config.queue_depth, self.stats,
+                                  clock)
+        self.batcher = FleetBatcher(fleet, self.stats,
+                                    self.config.linger_s, clock,
+                                    pad_full=self.config.pad_full)
+        for mid in fleet.model_ids:
+            fleet.engine(mid).fresh_handoff()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @property
+    def model_ids(self):
+        return self.fleet.model_ids
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, request: ServeRequest, model_id: str) -> ServeFuture:
+        """Admit one request routed to ONE fleet model. Tokenization
+        runs here with THAT model's tokenizer (per-model vocabularies —
+        the reason the fleet layer is model-id-aware all the way down)."""
+        self.stats.count("submitted")
+        engine = self.fleet.engine(model_id)
+        assert engine is not None, f"unknown fleet model {model_id}"
+        fut = ServeFuture()
+        now = self.clock()
+        with engine._tok_lock:
+            bin_ids = tuple(int(i) for i in engine.tokenizer(
+                request.binary_prompt).input_ids)
+            conf_ids = tuple(int(i) for i in engine.tokenizer(
+                request.confidence_prompt).input_ids)
+        lcp = tok.shared_prefix_len(bin_ids, conf_ids)
+        with engine._tok_lock:
+            t1, t2 = tok.target_token_ids(
+                engine.tokenizer, tuple(request.targets),
+                encoder_decoder=engine.encoder_decoder)
+        deadline = (request.deadline_s if request.deadline_s is not None
+                    else self.fleet_deadline_s)
+        bucket = tok.assign_bucket(max(lcp, 1), engine.buckets)
+        self.queue.offer(Pending(
+            request=request, future=fut, t_submit=now,
+            t_deadline=now + deadline, bin_ids=bin_ids,
+            conf_ids=conf_ids, lcp=lcp, bucket=bucket,
+            t1=int(t1), t2=int(t2), model_id=model_id))
+        return fut
+
+    def submit_fleet(self, request: ServeRequest,
+                     models: Optional[List[str]] = None
+                     ) -> FleetScoreFuture:
+        """The fleet request class: fan ``request`` across every fleet
+        model (or the ``models`` subset) and aggregate agreement."""
+        mids = list(models) if models is not None else self.fleet.model_ids
+        self.fleet.stats.count("fleet_requests")
+        self.fleet.stats.count("fleet_rows", len(mids))
+        t0 = self.clock()
+        futures = {
+            mid: self.submit(dataclasses_replace_id(request, mid), mid)
+            for mid in mids}
+        return FleetScoreFuture(request.request_id, futures, t0,
+                                self.clock)
+
+    # -- supervisor side -----------------------------------------------------
+
+    def start(self) -> "FleetScoringServer":
+        assert self._thread is None, "server already started"
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-supervisor",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            stopping = self._stop.is_set()
+            for p in self.queue.drain():
+                self.batcher.admit(p)
+            d = self.batcher.next_dispatch(self.clock(), flush=stopping)
+            if d is None:
+                if (stopping and len(self.queue) == 0
+                        and self.batcher.pending_rows == 0):
+                    return
+                self.queue.wait_nonempty(
+                    0.005 if self.batcher.pending_rows else 0.05)
+                continue
+            self._dispatch(*d)
+
+    def _dispatch(self, model_id: str, bucket: int, rows) -> None:
+        engine = self.fleet.engine(model_id)
+        try:
+            payloads = retry_with_exponential_backoff(
+                lambda: self.batcher.score(model_id, bucket, rows),
+                retry_on=(Exception,), config=self.config.retry,
+                log=lambda m: log.warning(
+                    "fleet dispatch retry (%s): %s", model_id, m),
+                clock=self.clock)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as err:  # noqa: BLE001 — resolve, never crash
+            now = self.clock()
+            self.stats.count("errors", len(rows))
+            for p in rows:
+                p.future.resolve(ServeResult(
+                    request_id=p.request.request_id, status=STATUS_ERROR,
+                    note=f"device error after retries on {model_id}: "
+                         f"{err!r}",
+                    latency_s=now - p.t_submit))
+            return
+        now = self.clock()
+        for p, payload in zip(rows, payloads):
+            reason = None
+            if engine.rt.numerics_guard:
+                engine.guard_stats.site("checked", "fleet")
+                reason = numerics.check_payload(payload)
+            if reason is not None:
+                engine.guard_stats.quarantine("fleet", reason)
+                self.stats.count("errors")
+                p.future.resolve(ServeResult(
+                    request_id=p.request.request_id, status=STATUS_ERROR,
+                    note=f"{numerics.NUMERICS_ERROR} — {reason} "
+                         f"(row quarantined by the numerics guard)",
+                    latency_s=now - p.t_submit))
+                continue
+            self.stats.count("completed")
+            self.stats.record_latency(now - p.t_submit)
+            p.future.resolve(ServeResult(
+                request_id=p.request.request_id, status=STATUS_OK,
+                latency_s=now - p.t_submit, **payload))
+
+    def fleet_summary(self) -> Dict:
+        return self.fleet.stats.summary()
+
+
+def dataclasses_replace_id(request: ServeRequest,
+                           model_id: str) -> ServeRequest:
+    """Per-model sub-request of a fleet fan-out: same prompts/targets,
+    request id suffixed with the model so every sub-result is
+    attributable in logs and checkpoints."""
+    import dataclasses as _dc
+
+    return _dc.replace(
+        request, request_id=f"{request.request_id}#{model_id}")

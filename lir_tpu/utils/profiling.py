@@ -697,6 +697,94 @@ class PrefixCacheStats:
 
 
 @dataclasses.dataclass
+class FleetStats:
+    """Multi-model fleet counters (engine/fleet.py over
+    models/weights.py): how much model-swap latency the async weight
+    streamer hid behind compute, and how hard the LRU weight cache is
+    working. Thread-safe — the prefetch worker, the fleet supervisor,
+    and serve submitters all mutate it concurrently.
+
+    Definitions (reported by ``summary()``, logged per fleet sweep,
+    surfaced in serve fleet stats, and in bench.py's "fleet" key):
+
+    - ``swap_s_hidden`` / ``swap_s_exposed``: per-load wall seconds
+      overlapped with the previous model's compute vs actually waited on
+      by the scoring loop. hidden > exposed is the tentpole claim — the
+      prefetch pipeline genuinely hides swap cost (the sequential
+      drop-and-reload baseline is 100% exposed by construction).
+    - ``loads`` / ``load_s`` / ``weight_bytes_streamed``: host->device
+      weight loads performed, their total wall time, and bytes shipped
+      through the chunked streamer.
+    - ``prefetch_hits``: acquires satisfied by a prefetched (background)
+      load; ``prefetch_misses``: acquires that had to load inline
+      (fully exposed); ``cache_hits``: acquires finding the model
+      already resident (zero swap cost — the co-residency win).
+    - ``evictions``: models dropped by the LRU weight cache under HBM
+      pressure; ``resident_models`` / ``resident_bytes``: occupancy
+      gauges. Sustained eviction with low cache_hits means the budget
+      is undersized for the fleet (DEPLOY.md §1k arithmetic).
+    - ``model_swaps``: acquires that changed the active model;
+      ``fleet_requests`` / ``fleet_rows``: serve fleet_score fan-outs
+      and the per-model rows they produced.
+    """
+
+    swap_s_hidden: float = 0.0
+    swap_s_exposed: float = 0.0
+    loads: int = 0
+    load_s: float = 0.0
+    weight_bytes_streamed: int = 0
+    prefetch_hits: int = 0
+    prefetch_misses: int = 0
+    cache_hits: int = 0
+    evictions: int = 0
+    resident_models: int = 0
+    resident_bytes: int = 0
+    model_swaps: int = 0
+    fleet_requests: int = 0
+    fleet_rows: int = 0
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n=1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def gauge(self, field: str, value) -> None:
+        with self._lock:
+            setattr(self, field, value)
+
+    @property
+    def hidden_frac(self) -> float:
+        total = self.swap_s_hidden + self.swap_s_exposed
+        return self.swap_s_hidden / total if total > 0 else 0.0
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            total = self.swap_s_hidden + self.swap_s_exposed
+            return {
+                "swap_s_hidden": round(self.swap_s_hidden, 4),
+                "swap_s_exposed": round(self.swap_s_exposed, 4),
+                "swap_hidden_frac": round(self.swap_s_hidden / total, 4)
+                                    if total > 0 else 0.0,
+                "loads": self.loads,
+                "load_s": round(self.load_s, 4),
+                "weight_bytes_streamed": self.weight_bytes_streamed,
+                "prefetch_hits": self.prefetch_hits,
+                "prefetch_misses": self.prefetch_misses,
+                "cache_hits": self.cache_hits,
+                "evictions": self.evictions,
+                "resident_models": self.resident_models,
+                "resident_bytes": self.resident_bytes,
+                "model_swaps": self.model_swaps,
+                "fleet_requests": self.fleet_requests,
+                "fleet_rows": self.fleet_rows,
+            }
+
+
+@dataclasses.dataclass
 class StreamStats:
     """Streaming-statistics sink counters (engine/stream_stats.py): how
     much of the grid folded on device, how many host bytes the streaming

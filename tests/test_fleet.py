@@ -1,0 +1,482 @@
+"""Multi-model fleet engine tests (engine/fleet.py + models/weights.py
++ the serve fleet layer).
+
+Pins the contracts the fleet tentpole rides on:
+
+- weight-cache refcount invariants: never negative, pinned/in-flight
+  models unevictable, eviction is LRU, evict-then-reload is bitwise;
+- the prefetch pipeline: a fleet sweep loads model i+1 in the
+  background while model i scores (prefetch_hits, swap_s_hidden > 0)
+  and per-model rows are BITWISE what standalone engines produce;
+- multi.py failure routing: a model that cannot load emits NaN rows
+  classified error:model; rows with corrupt readouts quarantine as
+  error:numerics with the guard counters moving — never written as
+  plausible numbers;
+- the per-model partition-rule registry (parallel/sharding.py):
+  regex-over-path rules resolve per model and win over the structural
+  defaults, for both monolithic shard_params and the chunked streamer;
+- fleet serving: a fleet_score fan-out answers per-model P(yes)/P(no)
+  plus pairwise kappa/disagreement, with kappa EXACTLY
+  stats/streaming.kappa_from_counts (== the analysis layer's
+  within_group_kappa) on the same decisions, and per-model results
+  bitwise-identical to a single-model ScoringServer.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import RuntimeConfig, ServeConfig
+from lir_tpu.engine.fleet import ModelFleet
+from lir_tpu.engine.multi import (MODEL_ERROR, ModelSpec,
+                                  run_model_comparison_sweep)
+from lir_tpu.engine.runner import ScoringEngine
+from lir_tpu.engine.sweep import run_word_meaning_sweep
+from lir_tpu.models import decoder, weights
+from lir_tpu.models.registry import ModelConfig
+from lir_tpu.serve import (FleetScoringServer, ScoringServer, ServeRequest,
+                           aggregate_fleet, fleet_decision)
+from lir_tpu.utils.profiling import FleetStats
+
+
+def _tiny_cfg(name):
+    return ModelConfig(name=name, vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=32, n_layers=1, n_heads=2,
+                       intermediate_size=64, max_seq_len=256)
+
+
+def _tiny_params(seed):
+    return decoder.init_params(_tiny_cfg("x"), jax.random.PRNGKey(seed))
+
+
+def _tiny_engine(name, seed, batch_size=4):
+    return ScoringEngine(
+        decoder.init_params(_tiny_cfg(name), jax.random.PRNGKey(seed)),
+        _tiny_cfg(name), FakeTokenizer(),
+        RuntimeConfig(batch_size=batch_size, max_seq_len=256))
+
+
+QUESTIONS = ["Is a cat an animal", "Is a rock an animal",
+             "Is rain considered weather"]
+
+
+# ---------------------------------------------------------------------------
+# WeightCache invariants
+# ---------------------------------------------------------------------------
+
+
+class TestWeightCache:
+    def test_refcount_never_negative(self):
+        wc = weights.WeightCache()
+        p = _tiny_params(0)
+        wc.insert("a", p)
+        wc.acquire("a")
+        wc.release("a")
+        with pytest.raises(AssertionError, match="negative"):
+            wc.release("a")
+
+    def test_in_flight_model_is_unevictable(self):
+        p = _tiny_params(0)
+        nb = weights.tree_bytes(p)
+        wc = weights.WeightCache(budget_bytes=nb + nb // 2)
+        wc.insert("a", p)
+        wc.acquire("a")          # in-flight dispatch holds a
+        with pytest.raises(weights.WeightCacheOOM):
+            wc.insert("b", _tiny_params(1))
+        wc.release("a")          # dispatch done -> a becomes evictable
+        wc.insert("b", _tiny_params(1))
+        assert "a" not in wc and "b" in wc
+
+    def test_pinned_model_is_unevictable(self):
+        p = _tiny_params(0)
+        nb = weights.tree_bytes(p)
+        wc = weights.WeightCache(budget_bytes=nb + nb // 2)
+        wc.insert("a", p)
+        wc.pin("a")
+        with pytest.raises(weights.WeightCacheOOM):
+            wc.insert("b", _tiny_params(1))
+        wc.unpin("a")
+        wc.insert("b", _tiny_params(1))
+        assert "a" not in wc and "b" in wc
+
+    def test_eviction_is_lru(self):
+        stats = FleetStats()
+        p = _tiny_params(0)
+        nb = weights.tree_bytes(p)
+        wc = weights.WeightCache(budget_bytes=2 * nb + nb // 2,
+                                 stats=stats)
+        wc.insert("a", _tiny_params(0), nb)
+        wc.insert("b", _tiny_params(1), nb)
+        wc.acquire("a")          # a is MRU now
+        wc.release("a")
+        wc.insert("c", _tiny_params(2), nb)   # evicts b, the LRU
+        assert wc.resident_models == ["a", "c"]
+        assert stats.evictions == 1 and stats.resident_models == 2
+
+    def test_drop_refuses_in_flight(self):
+        wc = weights.WeightCache()
+        wc.insert("a", _tiny_params(0))
+        wc.acquire("a")
+        with pytest.raises(weights.WeightCacheOOM):
+            wc.drop("a")
+        wc.release("a")
+        wc.drop("a")
+        assert "a" not in wc
+
+    def test_evict_then_reload_is_bitwise(self):
+        """The acceptance pin: weights that were evicted and re-streamed
+        from host staging are bit-for-bit the originals."""
+        e0, e1 = _tiny_engine("m0", 0), _tiny_engine("m1", 1)
+        original = jax.tree.map(lambda x: np.asarray(x).copy(), e0.params)
+        nb = weights.tree_bytes(e0.params)
+        fleet = ModelFleet.from_engines([("m0", e0), ("m1", e1)],
+                                        cache_budget_bytes=nb + nb // 2,
+                                        prefetch=False)
+        try:
+            # Boot under a one-model budget already evicted m0 for m1.
+            assert not fleet.resident("m0") and fleet.resident("m1")
+            assert e0.params is None        # HBM reference dropped
+            eng = fleet.acquire("m0")       # re-stream, evicting m1
+            got = jax.tree.map(np.asarray, eng.params)
+            for a, b in zip(jax.tree.leaves(original),
+                            jax.tree.leaves(got)):
+                np.testing.assert_array_equal(
+                    a.view(np.uint8), b.view(np.uint8))
+            assert fleet.stats.evictions == 2
+            assert fleet.stats.loads == 1
+            fleet.release("m0")
+        finally:
+            fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Partition-rule registry
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionRuleRegistry:
+    def test_match_partition_rules_paths_and_scalars(self):
+        from jax.sharding import PartitionSpec as P
+
+        from lir_tpu.parallel import sharding
+
+        params = {"layers": {"wq": np.zeros((2, 8, 8)),
+                             "ln1": {"scale": np.zeros((2, 8))}},
+                  "scalar": np.zeros(())}
+        rules = [("layers/wq", P(None, None, "model")), (".*", P())]
+        tree = sharding.match_partition_rules(rules, params)
+        assert tree["layers"]["wq"] == P(None, None, "model")
+        assert tree["layers"]["ln1"]["scale"] == P()
+        assert tree["scalar"] == P()   # scalars replicate before rules
+
+    def test_unmatched_param_is_loud(self):
+        from jax.sharding import PartitionSpec as P
+
+        from lir_tpu.parallel import sharding
+
+        with pytest.raises(ValueError, match="partition rule not found"):
+            sharding.match_partition_rules(
+                [("nope", P())], {"w": np.zeros((4, 4))})
+
+    def test_registry_overrides_defaults_for_matching_model(self):
+        from jax.sharding import PartitionSpec as P
+
+        from lir_tpu.config import MeshConfig
+        from lir_tpu.parallel import sharding
+
+        cfg = _tiny_cfg("special/fleet-model")
+        params = decoder.init_params(cfg, jax.random.PRNGKey(0))
+        mesh = sharding.build_mesh(MeshConfig(data=1, model=2))
+        default = sharding.spec_tree_for(cfg, mesh, params)
+        rules = [("layers/(wq|wk|wv|wo|w_up|w_down)", P()), (".*", P())]
+        sharding.register_partition_rules("special/", lambda c, m: rules)
+        try:
+            tree = sharding.spec_tree_for(cfg, mesh, params)
+            assert tree["layers"]["wq"] == P()
+            assert default["layers"]["wq"] != P()
+            # A NON-matching model keeps the structural defaults.
+            other = sharding.spec_tree_for(_tiny_cfg("plain"), mesh,
+                                           params)
+            assert other["layers"]["wq"] == default["layers"]["wq"]
+        finally:
+            sharding.unregister_partition_rules("special/")
+
+    def test_streamed_placement_honors_registry(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from lir_tpu.config import MeshConfig
+        from lir_tpu.parallel import sharding
+
+        cfg = _tiny_cfg("ruled/streamed")
+        params = decoder.init_params(cfg, jax.random.PRNGKey(3))
+        mesh = sharding.build_mesh(MeshConfig(data=1, model=2))
+        rules = [("w_up", P(None, None, "model")), (".*", P())]
+        sharding.register_partition_rules("ruled/", lambda c, m: rules)
+        try:
+            streamed = weights.stream_params(
+                weights.host_stage(params), cfg=cfg, mesh=mesh,
+                chunk_bytes=512)
+            assert (streamed["layers"]["w_up"].sharding
+                    == NamedSharding(mesh, P(None, None, "model")))
+            assert (streamed["layers"]["wq"].sharding
+                    == NamedSharding(mesh, P()))
+            for a, b in zip(jax.tree.leaves(params),
+                            jax.tree.leaves(streamed)):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+        finally:
+            sharding.unregister_partition_rules("ruled/")
+
+
+# ---------------------------------------------------------------------------
+# Fleet sweep: prefetch pipeline + bitwise parity + failure routing
+# ---------------------------------------------------------------------------
+
+
+def _factory(seeds):
+    def factory(name):
+        if "broken" in name:
+            raise RuntimeError("checkpoint load failure")
+        return _tiny_engine(name, seeds[name])
+    return factory
+
+
+class TestFleetSweep:
+    SEEDS = {"org/m0": 0, "org/m1": 1, "org/m2": 2}
+
+    def test_fleet_rows_bitwise_vs_standalone_engines(self, tmp_path):
+        specs = [ModelSpec(n, "instruct") for n in self.SEEDS]
+        res = run_model_comparison_sweep(
+            specs, _factory(self.SEEDS), tmp_path, questions=QUESTIONS)
+        # The in-memory frame (csv text rounds floats; bitwise means
+        # comparing the actual float64 values the sweep produced).
+        df = res["model_comparison_csv"]
+        for name, seed in self.SEEDS.items():
+            # Score through the same formatter the driver used, on a
+            # STANDALONE engine (no fleet, no streaming).
+            from lir_tpu.engine.multi import format_for
+            ref = run_word_meaning_sweep(
+                _tiny_engine(name, seed), name, "instruct", QUESTIONS,
+                format_for(ModelSpec(name, "instruct")))
+            got = df[df["model"] == name]
+            assert list(got["prompt"]) == [r.prompt for r in ref]
+            # Bitwise: the fleet moved the weights, never transformed
+            # them, so every probability matches exactly.
+            assert list(got["yes_prob"]) == [r.yes_prob for r in ref]
+            assert list(got["no_prob"]) == [r.no_prob for r in ref]
+        assert all(v["status"] == "ok" for v in res["per_model"].values())
+
+    def test_prefetch_pipeline_counters(self, tmp_path):
+        specs = [ModelSpec(n, "instruct") for n in self.SEEDS]
+        res = run_model_comparison_sweep(
+            specs, _factory(self.SEEDS), tmp_path, questions=QUESTIONS)
+        fleet = res["fleet"]
+        # First model loads inline (nothing to hide behind); every
+        # later one rides the background streamer.
+        assert fleet["loads"] == 3
+        assert fleet["prefetch_misses"] == 1
+        assert fleet["prefetch_hits"] == 2
+        assert fleet["swap_s_hidden"] > 0.0
+        assert fleet["resident_models"] == 3   # unbounded budget: co-resident
+
+    def test_no_prefetch_is_fully_exposed(self, tmp_path):
+        specs = [ModelSpec(n, "instruct") for n in self.SEEDS]
+        res = run_model_comparison_sweep(
+            specs, _factory(self.SEEDS), tmp_path, questions=QUESTIONS,
+            weight_prefetch=False)
+        fleet = res["fleet"]
+        assert fleet["prefetch_hits"] == 0
+        assert fleet["swap_s_hidden"] == 0.0
+        assert fleet["swap_s_exposed"] > 0.0
+
+    def test_model_failure_is_classified_and_counted(self, tmp_path):
+        specs = [ModelSpec("org/m0", "instruct"),
+                 ModelSpec("org/broken", "instruct")]
+        res = run_model_comparison_sweep(
+            specs, _factory(dict(self.SEEDS, **{"org/broken": 9})),
+            tmp_path, questions=QUESTIONS)
+        status = res["per_model"]["org/broken"]["status"]
+        assert status.startswith(MODEL_ERROR)
+        assert res["guard"]["quarantine_reasons"][MODEL_ERROR] == 1
+        df = __import__("pandas").read_csv(
+            tmp_path / "model_comparison_results.csv")
+        broken = df[df["model"] == "org/broken"]
+        assert len(broken) == len(QUESTIONS)
+        assert broken["yes_prob"].isna().all()
+
+    def test_numerics_quarantine_on_corrupt_readouts(self, tmp_path):
+        """A model whose readouts are NaN (SDC / corrupt weights) must
+        quarantine as error:numerics — cell identity kept, measurement
+        fields nulled, counters moving — not write plausible garbage."""
+        def factory(name):
+            eng = _tiny_engine(name, 0)
+            if name == "org/corrupt":
+                eng.params = dict(
+                    eng.params,
+                    tok_embed=jnp.full_like(eng.params["tok_embed"],
+                                            jnp.nan))
+            return eng
+
+        specs = [ModelSpec("org/ok", "instruct"),
+                 ModelSpec("org/corrupt", "instruct")]
+        res = run_model_comparison_sweep(
+            specs, factory, tmp_path, questions=QUESTIONS)
+        assert res["per_model"]["org/ok"]["status"] == "ok"
+        corrupt = res["per_model"]["org/corrupt"]
+        assert corrupt["status"].startswith("error:numerics")
+        assert corrupt["rows_quarantined"] == len(QUESTIONS)
+        assert res["guard"]["quarantined"]["multi"] == len(QUESTIONS)
+        df = __import__("pandas").read_csv(
+            tmp_path / "model_comparison_results.csv")
+        bad = df[df["model"] == "org/corrupt"]
+        assert bad["yes_prob"].isna().all()
+        assert (bad["model_output"] == "ERROR").all()
+
+    def test_fleet_sweep_under_tight_budget_still_bitwise(self, tmp_path):
+        """One-model budget: every switch evicts + reloads, results
+        unchanged (the evict-then-reload bitwise contract end to end)."""
+        nb = weights.tree_bytes(_tiny_params(0))
+        specs = [ModelSpec(n, "instruct") for n in self.SEEDS]
+        res = run_model_comparison_sweep(
+            specs, _factory(self.SEEDS), tmp_path, questions=QUESTIONS,
+            weight_cache_bytes=nb + nb // 2)
+        assert all(v["status"] == "ok" for v in res["per_model"].values())
+        assert res["fleet"]["evictions"] >= 2
+        assert res["fleet"]["resident_models"] == 1
+        df = res["model_comparison_csv"]
+        from lir_tpu.engine.multi import format_for
+        for name, seed in self.SEEDS.items():
+            ref = run_word_meaning_sweep(
+                _tiny_engine(name, seed), name, "instruct", QUESTIONS,
+                format_for(ModelSpec(name, "instruct")))
+            got = df[df["model"] == name]
+            assert list(got["yes_prob"]) == [r.yes_prob for r in ref]
+
+
+# ---------------------------------------------------------------------------
+# Fleet serving: fleet_score fan-out + kappa + bitwise parity
+# ---------------------------------------------------------------------------
+
+
+_SERVE_CFG = ServeConfig(queue_depth=64, classes=(("t", 600.0),),
+                         default_class="t", linger_s=0.01)
+
+
+def _request(rid="q0"):
+    body = "the policy covers flood damage under the endorsement"
+    return ServeRequest(
+        binary_prompt=f"{body} Answer Yes or No .",
+        confidence_prompt=f"{body} Give a number from 0 to 100 .",
+        klass="t", request_id=rid)
+
+
+class TestFleetServe:
+    def _fleet(self, budget=None):
+        engines = [(f"m{i}", _tiny_engine(f"m{i}", i)) for i in range(3)]
+        return ModelFleet.from_engines(engines,
+                                       cache_budget_bytes=budget)
+
+    def test_fleet_score_answers_probs_and_kappa(self):
+        fleet = self._fleet()
+        server = FleetScoringServer(fleet, _SERVE_CFG,
+                                    fleet_deadline_s=600.0).start()
+        try:
+            res = server.submit_fleet(_request()).result(timeout=300)
+        finally:
+            server.stop()
+            fleet.shutdown()
+        assert res["status"] == "ok"
+        assert res["n_models"] == 3 and res["n_valid"] == 3
+        for m in res["per_model"].values():
+            assert m["status"] == "ok"
+            assert 0.0 <= m["token_1_prob"] <= 1.0
+            assert m["decision"] in (0, 1)
+        # kappa EXACTLY the streaming contingency path == the analysis
+        # layer's within_group_kappa on the same decisions.
+        from lir_tpu.stats import streaming
+        from lir_tpu.stats.kappa import within_group_kappa
+
+        decs = [m["decision"] for m in res["per_model"].values()]
+        n_g, s_g = streaming.group_counts(
+            np.zeros(len(decs), np.int64), np.asarray(decs, np.int64))
+        ref = streaming.kappa_from_counts(n_g, s_g)
+        ref2 = within_group_kappa(np.asarray(decs, int),
+                                  np.zeros(len(decs), int))
+        for k in ("kappa", "observed_agreement", "expected_agreement"):
+            assert res["kappa"][k] == float(ref[k]) == float(ref2[k])
+        assert res["disagreement"] == 1.0 - res["kappa"]["observed_agreement"]
+        assert fleet.stats.fleet_requests == 1
+        assert fleet.stats.fleet_rows == 3
+
+    def test_fleet_per_model_results_bitwise_vs_single_server(self):
+        fleet = self._fleet()
+        server = FleetScoringServer(fleet, _SERVE_CFG,
+                                    fleet_deadline_s=600.0).start()
+        try:
+            res = server.submit_fleet(_request()).result(timeout=300)
+        finally:
+            server.stop()
+            fleet.shutdown()
+        for i in range(3):
+            single = ScoringServer(_tiny_engine(f"m{i}", i), f"m{i}",
+                                   _SERVE_CFG).start()
+            try:
+                ref = single.submit(_request("ref")).result(timeout=300)
+            finally:
+                single.stop()
+            got = res["per_model"][f"m{i}"]
+            assert got["token_1_prob"] == ref.token_1_prob
+            assert got["token_2_prob"] == ref.token_2_prob
+            assert got["weighted_confidence"] == ref.weighted_confidence
+
+    def test_single_model_routing(self):
+        fleet = self._fleet()
+        server = FleetScoringServer(fleet, _SERVE_CFG,
+                                    fleet_deadline_s=600.0).start()
+        try:
+            r = server.submit(_request("solo"), "m1").result(timeout=300)
+        finally:
+            server.stop()
+            fleet.shutdown()
+        assert r.status == "ok"
+        assert r.request_id == "solo"
+
+    def test_fleet_serve_under_eviction_pressure(self):
+        """A one-model weight budget forces swap-per-dispatch; every
+        sub-request still resolves ok and the counters show the churn."""
+        nb = weights.tree_bytes(_tiny_params(0))
+        fleet = self._fleet(budget=nb + nb // 2)
+        server = FleetScoringServer(fleet, _SERVE_CFG,
+                                    fleet_deadline_s=600.0).start()
+        try:
+            res = server.submit_fleet(_request()).result(timeout=300)
+        finally:
+            server.stop()
+            fleet.shutdown()
+        assert res["status"] == "ok" and res["n_valid"] == 3
+        assert fleet.stats.evictions >= 2
+        assert fleet.stats.loads >= 2
+
+    def test_fleet_decision_matches_streaming_rule(self):
+        assert fleet_decision(0.6, 0.2) == 1
+        assert fleet_decision(0.2, 0.6) == 0
+        assert fleet_decision(None, 0.5) is None
+        assert fleet_decision(0.0, 0.0) is None
+        assert fleet_decision(float("nan"), 0.5) is None
+
+    def test_aggregate_partial_and_error_statuses(self):
+        from lir_tpu.serve import ServeResult
+
+        ok = ServeResult(request_id="a#m0", status="ok",
+                         token_1_prob=0.7, token_2_prob=0.1)
+        bad = ServeResult(request_id="a#m1", status="error", note="boom")
+        agg = aggregate_fleet("a", {"m0": ok, "m1": bad}, 0.1)
+        assert agg["status"] == "partial"
+        assert agg["n_valid"] == 1
+        assert agg["per_model"]["m1"]["decision"] is None
+        assert np.isnan(agg["disagreement"])   # < 2 valid decisions
+        agg2 = aggregate_fleet("a", {"m1": bad}, 0.1)
+        assert agg2["status"] == "error"

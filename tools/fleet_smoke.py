@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Fleet smoke: the multi-model fleet layer on the fake backend — the
+invariants the `make fleet-smoke` CI target guards:
+
+- the prefetch pipeline genuinely overlaps: a 3-model sweep books
+  nonzero swap_s_hidden (model i+1's weights streamed while model i
+  scored) with exactly one fully-exposed load (the first);
+- per-model results are BITWISE identical to three separate
+  single-model engines scoring the same questions (weights are moved by
+  the cache/streamer, never transformed);
+- a fleet_score serve fan-out answers per-model P(yes)/P(no) plus a
+  kappa that matches the analysis layer's within_group_kappa on the
+  same decisions EXACTLY (the serve path routes through
+  stats/streaming.kappa_from_counts — one contingency code path
+  everywhere).
+
+Runs hermetically on CPU with FakeTokenizer + tiny random decoders (the
+test suite's stand-ins); prints the FleetStats summary JSON on success.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_MODELS = 3
+QUESTIONS = ["Is a cat an animal", "Is a rock an animal",
+             "Is rain considered weather", "Is a contract binding"]
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig, ServeConfig
+    from lir_tpu.engine.fleet import ModelFleet
+    from lir_tpu.engine.multi import ModelSpec, format_for, \
+        run_model_comparison_sweep
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_word_meaning_sweep
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import (FleetScoringServer, ScoringServer,
+                               ServeRequest)
+    from lir_tpu.stats.kappa import within_group_kappa
+
+    from lir_tpu.models import weights
+
+    names = [f"org/fleet-m{i}" for i in range(N_MODELS)]
+
+    def _cfg(name: str) -> ModelConfig:
+        return ModelConfig(name=name, vocab_size=FakeTokenizer.VOCAB,
+                           hidden_size=32, n_layers=1, n_heads=2,
+                           intermediate_size=64, max_seq_len=256)
+
+    # Host staging built up front (the checkpoint stand-in): every
+    # factory call then pays the fleet's REAL load path — a chunked
+    # host->device stream of the staged tree — which is what the
+    # prefetch worker overlaps behind compute.
+    staged = {name: weights.host_stage(
+        decoder.init_params(_cfg(name), jax.random.PRNGKey(i)))
+        for i, name in enumerate(names)}
+
+    def make_engine(name: str) -> ScoringEngine:
+        params = weights.stream_params(staged[name])
+        jax.block_until_ready(jax.tree.leaves(params)[0])
+        return ScoringEngine(params, _cfg(name), FakeTokenizer(),
+                             RuntimeConfig(batch_size=4, max_seq_len=256))
+
+    failures = []
+    specs = [ModelSpec(n, "instruct") for n in names]
+
+    # 1+2: fleet sweep — prefetch overlap + bitwise parity.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        res = run_model_comparison_sweep(specs, make_engine, Path(td),
+                                         questions=QUESTIONS)
+    fleet_stats = res["fleet"]
+    if fleet_stats["swap_s_hidden"] <= 0.0:
+        failures.append(f"prefetch overlap is zero: {fleet_stats}")
+    if fleet_stats["prefetch_hits"] != N_MODELS - 1 \
+            or fleet_stats["prefetch_misses"] != 1:
+        failures.append(f"prefetch pipeline misbehaved: {fleet_stats}")
+    df = res["model_comparison_csv"]
+    for name in names:
+        ref = run_word_meaning_sweep(
+            make_engine(name), name, "instruct", QUESTIONS,
+            format_for(ModelSpec(name, "instruct")))
+        got = df[df["model"] == name]
+        if (list(got["yes_prob"]) != [r.yes_prob for r in ref]
+                or list(got["no_prob"]) != [r.no_prob for r in ref]):
+            failures.append(f"{name}: fleet rows != standalone engine")
+
+    # 3: fleet_score serving — per-model probs + kappa parity.
+    fleet = ModelFleet.from_engines([(n, make_engine(n)) for n in names])
+    cfg = ServeConfig(queue_depth=64, classes=(("smoke", 600.0),),
+                      default_class="smoke", linger_s=0.01)
+    server = FleetScoringServer(fleet, cfg, fleet_deadline_s=600.0).start()
+    body = "clause nine covers flood damage under the endorsement"
+    req = ServeRequest(binary_prompt=f"{body} Answer Yes or No .",
+                       confidence_prompt=f"{body} Give a number from "
+                                         f"0 to 100 .",
+                       klass="smoke", request_id="q0")
+    agg = server.submit_fleet(req).result(timeout=600)
+    server.stop()
+    fleet.shutdown()
+    if agg["status"] != "ok" or agg["n_valid"] != N_MODELS:
+        failures.append(f"fleet_score did not answer cleanly: {agg}")
+    decs = [m["decision"] for m in agg["per_model"].values()
+            if m["decision"] is not None]
+    ref_kappa = within_group_kappa(np.asarray(decs, int),
+                                   np.zeros(len(decs), int))
+    for k in ("kappa", "observed_agreement", "expected_agreement"):
+        a, b = agg["kappa"][k], float(ref_kappa[k])
+        same = (np.isnan(a) and np.isnan(b)) or a == b
+        if not same:
+            failures.append(f"kappa[{k}] {a} != within_group_kappa {b}")
+    for mid in names:
+        single = ScoringServer(make_engine(mid), mid, cfg).start()
+        ref = single.submit(ServeRequest(
+            binary_prompt=req.binary_prompt,
+            confidence_prompt=req.confidence_prompt,
+            klass="smoke", request_id="ref")).result(timeout=600)
+        single.stop()
+        got = agg["per_model"][mid]
+        if (got["token_1_prob"] != ref.token_1_prob
+                or got["token_2_prob"] != ref.token_2_prob):
+            failures.append(f"{mid}: fleet_score probs != single-model "
+                            f"server")
+
+    if failures:
+        for f in failures:
+            print(f"FLEET-SMOKE FAIL: {f}")
+        return 1
+    print(json.dumps(fleet_stats))
+    print(f"fleet smoke: OK ({N_MODELS} models x {len(QUESTIONS)} "
+          f"questions swept with {fleet_stats['prefetch_hits']} "
+          f"prefetched loads, swap hidden "
+          f"{fleet_stats['swap_s_hidden']:.3f}s vs exposed "
+          f"{fleet_stats['swap_s_exposed']:.3f}s; fleet_score kappa "
+          f"{agg['kappa']['kappa']:.3f} == within_group_kappa)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
