@@ -3,7 +3,7 @@
 # recorded floor (tools/check_tier1.py — the floor lives there).
 
 .PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
-	kernel-smoke stats-smoke fleet-smoke install-hooks
+	kernel-smoke stats-smoke fleet-smoke observe-smoke install-hooks
 
 verify: lint
 	python tools/check_tier1.py
@@ -80,6 +80,17 @@ stats-smoke:
 # analysis layer's within_group_kappa (tools/fleet_smoke.py).
 fleet-smoke:
 	JAX_PLATFORMS=cpu python tools/fleet_smoke.py
+
+# Observatory smoke: the reliability observatory + telemetry spine on
+# the fake backend — a 2-model fleet re-scores a sentinel grid across 3
+# time windows; the two clean windows raise no alert, a seeded
+# fault-plan NaN injection in window 3 raises EXACTLY one drift alert
+# naming window 3 and the injected model, per-window kappa is bitwise
+# the analysis layer's within_group_kappa, and the unified metrics
+# snapshot is non-empty for every registered stats source
+# (tools/observe_smoke.py).
+observe-smoke:
+	JAX_PLATFORMS=cpu python tools/observe_smoke.py
 
 # Run graft-lint (seconds) then the tier-1 guard before every
 # `git push` — lint first so an invariant break fails in two seconds,

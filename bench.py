@@ -241,6 +241,18 @@ def main() -> None:
                          "identical question waves; reports the swap-"
                          "hidden fraction, fleet p/s, and the within-"
                          "question kappa — headline key \"fleet\")")
+    ap.add_argument("--no-observatory", action="store_true",
+                    help="skip the reliability-observatory mode "
+                         "(sustained mixed load on one fleet server: "
+                         "fleet_score traffic + scheduled sentinel "
+                         "sweeps + stats/metrics polling with tracing "
+                         "ON, vs the identical client load with "
+                         "observability OFF; asserts seeded drift is "
+                         "caught within one window with zero clean-"
+                         "window false alarms, per-window kappa "
+                         "bitwise == within_group_kappa, and goodput "
+                         ">= 0.95x the off baseline — headline key "
+                         "\"observatory\")")
     ap.add_argument("--no-streaming-stats", action="store_true",
                     help="skip the streaming-statistics mode (identical "
                          "grid swept twice: device accumulator -> CIs "
@@ -614,6 +626,22 @@ def main() -> None:
         except (Exception, SystemExit) as err:  # noqa: BLE001
             print(f"# fleet bench mode failed ({err!r}); headline is "
                   "unaffected", file=sys.stderr)
+    # Observatory mode (ROADMAP item 5): sustained mixed load — client
+    # fleet_score traffic + scheduled sentinel sweeps + stats/metrics
+    # polling + tracing on ONE fleet server — with a seeded drift
+    # injection that must be caught within one window, zero
+    # clean-window false alarms, per-window kappa bitwise equal to the
+    # analysis layer, and observability overhead bounded (goodput >=
+    # 0.95x the observability-off baseline). Failures never discard
+    # the headline.
+    if not args.no_observatory:
+        try:
+            observatory = _observatory_bench(on_accel)
+            if observatory is not None:
+                headline["observatory"] = observatory
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# observatory bench mode failed ({err!r}); headline "
+                  "is unaffected", file=sys.stderr)
     # Chaos mode (--chaos): the same serving layer under a seeded
     # transient fault schedule — the robustness cost (recovery work +
     # goodput delta) tracked alongside perf. Failures never discard the
@@ -1589,6 +1617,267 @@ def _fleet_bench(on_accel: bool):
         "evictions": s["evictions"],
         "parity_ok": parity_ok,
         "kappa": {k: round(float(v), 6) for k, v in kap.items()},
+    }
+
+
+def _observatory_bench(on_accel: bool):
+    """Reliability-observatory mode (ROADMAP item 5): the first mode to
+    exercise fleet_score traffic + scheduled sentinel sweeps +
+    stats/metrics polling UNDER ONE SERVER at once.
+
+    Two runs over identical client waves (fresh servers, same weights,
+    shared executables so the delta is pure observability):
+
+    1. OFF baseline: fleet server, client fleet_score waves only, no
+       recorder/registry polling/scheduler.
+    2. ON: trace recorder installed, SentinelScheduler sweeping a
+       sentinel grid into 3 drift windows (driven by a synthetic
+       scheduler clock so window boundaries are deterministic), the
+       stats/metrics endpoints polled every wave, and a seeded
+       fault-plan NaN injection on one model during window 3.
+
+    Asserted before reporting: exactly ONE drift alert naming window 3
+    and the injected model (caught within one window), zero
+    clean-window false alarms, per-window kappa BITWISE equal to
+    within_group_kappa recomputed from the sweep payloads (an
+    independent path: host payload decisions vs the device lattice),
+    and CLIENT goodput at least 0.95x the OFF baseline — the gate is
+    the metrics/tracing bookkeeping (spans, registry snapshots,
+    windowed folding) staying off the dispatch hot path, measured on
+    identical client work (median per-wave time, so one scheduler
+    hiccup can't fake a regression); the sentinel sweeps' own device
+    time is DELIBERATE added work and is reported separately
+    (sentinel_sweep_s), not smuggled into the overhead ratio."""
+    import time as _time
+
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import ObserveConfig, RuntimeConfig, ServeConfig
+    from lir_tpu.engine.fleet import ModelFleet
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.faults.plan import FaultPlan, SiteSchedule
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.observe import SentinelScheduler, tracing
+    from lir_tpu.serve import (FleetScoringServer, ServeRequest,
+                               fleet_decision)
+    from lir_tpu.stats.kappa import within_group_kappa
+
+    n_models, n_waves, q_per_wave = 3, 9, 4
+    window_s = 100.0
+    names = [f"obs-m{i}" for i in range(n_models)]
+
+    def _cfg(name):
+        return ModelConfig(name=name, vocab_size=FakeTokenizer.VOCAB,
+                           hidden_size=64 if on_accel else 32,
+                           n_layers=1, n_heads=2, intermediate_size=64,
+                           max_seq_len=256)
+
+    def _server():
+        fleet = ModelFleet.from_engines(
+            [(n, ScoringEngine(
+                decoder.init_params(_cfg(n), jax.random.PRNGKey(i)),
+                _cfg(n), FakeTokenizer(),
+                RuntimeConfig(batch_size=4, max_seq_len=256)))
+             for i, n in enumerate(names)])
+        return fleet, FleetScoringServer(
+            fleet, ServeConfig(linger_s=0.002)).start()
+
+    rng = np.random.default_rng(5)
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement").split()
+    waves = [[" ".join(rng.choice(words) for _ in range(10)) + " ?"
+              for _ in range(q_per_wave)] for _ in range(n_waves)]
+
+    def _req(q, rid):
+        return ServeRequest(
+            binary_prompt=f"{q} Answer Yes or No.",
+            confidence_prompt=f"{q} Give a confidence 0-100.",
+            request_id=rid)
+
+    def _run_waves(server, per_wave=None):
+        """Drive the client waves; returns per-wave client seconds
+        (submit -> all resolved). ``per_wave`` (scheduler ticks,
+        endpoint polls) runs BETWEEN waves, outside the client slice —
+        its cost is reported on its own."""
+        wave_s = []
+        for w, wave in enumerate(waves):
+            t0 = _time.perf_counter()
+            futs = [server.submit_fleet(_req(q, f"w{w}q{j}"))
+                    for j, q in enumerate(wave)]
+            for f in futs:
+                f.result(60.0)
+            wave_s.append(_time.perf_counter() - t0)
+            if per_wave is not None:
+                per_wave(w)
+        return wave_s
+
+    # Four sentinels = one full-batch dispatch per model per sweep, so
+    # sentinel traffic rides the same executable shape as client waves.
+    sentinels = [_req(q, f"sent{j}")
+                 for j, q in enumerate(["Is a cat an animal",
+                                        "Is rain considered weather",
+                                        "Is a rock an animal",
+                                        "Is a contract binding"])]
+
+    # Warmup: compiles the shared scoring executables AND the
+    # observatory's own programs (windowed fold_update, the drift
+    # window reduce) so neither timed run pays a trace — the measured
+    # delta is steady-state bookkeeping, not one-off compiles.
+    fleet, server = _server()
+    _run_waves(server)
+    warm_now = {"t": window_s}
+    warm_sched = SentinelScheduler(
+        server, sentinels,
+        cfg=ObserveConfig(sentinel_interval_s=0.0,
+                          sentinel_window_s=window_s),
+        clock=lambda: warm_now["t"])
+    warm_sched.tick()
+    warm_sched.finalize_all()
+    server.stop()
+    fleet.shutdown()
+
+    client_reqs = n_waves * q_per_wave * n_models
+
+    # 1. Observability OFF.
+    fleet, server = _server()
+    off_wave_s = _run_waves(server)
+    off_completed = server.stats.completed
+    server.stop()
+    fleet.shutdown()
+    goodput_off = client_reqs / sum(off_wave_s)
+
+    # 2. Observability ON: tracing + scheduler + endpoint polling.
+    rec = tracing.TraceRecorder()
+    prev = tracing.set_recorder(rec)
+    try:
+        fleet, server = _server()
+        sched_now = {"t": window_s}
+        # Interval 2.5 "seconds" against the +1-per-wave synthetic
+        # clock = one sentinel sweep per 3-wave window — the production
+        # duty cycle (sweeps are sparse against client traffic), and
+        # the remaining waves exercise the tick-not-due path.
+        sched = SentinelScheduler(
+            server, sentinels,
+            cfg=ObserveConfig(sentinel_interval_s=2.5,
+                              sentinel_window_s=window_s,
+                              drift_min_windows=2),
+            clock=lambda: sched_now["t"])
+        server.attach_observatory(sched)
+        plan = FaultPlan(seed=9, schedules={
+            "dispatch": SiteSchedule(rate=1.0, kind="nan",
+                                     nan_rows=(0, 1, 2, 3))})
+        victim = server.batcher.batchers[names[0]]
+        orig_score = victim.score
+        armed = {"v": False}
+        sweep_decisions = {}        # window -> payload-level decisions
+        sweep_s = [0.0]
+
+        def per_wave(w):
+            # Windows 1/2/3 over thirds of the wave stream; injection
+            # armed for window 3's sweep; endpoint polling every wave.
+            window = 1 + w // (n_waves // 3)
+            sched_now["t"] = window * window_s + (w % 3) + 1.0
+            if window == 3 and not armed["v"]:
+                armed["v"] = True
+                victim.score = plan.wrap("dispatch", victim.score)
+            t0 = _time.perf_counter()
+            rec_sweep = sched.tick()
+            sweep_s[0] += _time.perf_counter() - t0
+            if rec_sweep is not None:
+                groups, decs = sweep_decisions.setdefault(
+                    rec_sweep["window"], ([], []))
+                for j, per_model in enumerate(rec_sweep["results"]):
+                    for mid, row in per_model.items():
+                        d = (fleet_decision(row.get("token_1_prob"),
+                                            row.get("token_2_prob"))
+                             if row.get("status") == "ok" else None)
+                        if d is not None:
+                            groups.append(
+                                (rec_sweep["slot"], j))
+                            decs.append(d)
+            # Endpoint polling rides the same mixed load.
+            server.stats_summary()
+            server.metrics.snapshot(device_memory=False)
+
+        on_wave_s = _run_waves(server, per_wave)
+        on_completed = server.stats.completed
+        victim.score = orig_score
+        sched_now["t"] = 4 * window_s + 1.0
+        sched.finalize_closed()
+        obs = sched.summary()
+        snap = server.metrics.snapshot()
+        trace_doc = rec.export_chrome()
+        server.stop()
+        fleet.shutdown()
+    finally:
+        tracing.set_recorder(prev)
+    goodput_on = client_reqs / sum(on_wave_s)
+
+    # -- the acceptance gates -------------------------------------------------
+    alerts = obs["alerts"]
+    assert len(alerts) == 1, f"expected exactly 1 drift alert: {alerts}"
+    assert alerts[0]["window"] == 3, alerts[0]
+    assert any(m.get("model") == names[0]
+               for m in alerts[0]["metrics"]), alerts[0]
+    clean_false_alarms = sum(1 for w in obs["windows"]
+                             if w["window"] != 3 and w.get("drifted"))
+    assert clean_false_alarms == 0, obs["windows"]
+    # Per-window kappa: lattice path (device reduce -> kappa_from_
+    # counts) bitwise vs within_group_kappa over the PAYLOAD decisions
+    # the bench recorded itself.
+    kappa_bitwise = True
+    for w in obs["windows"]:
+        groups, decs = sweep_decisions.get(w["window"], ([], []))
+        uniq = {g: i for i, g in enumerate(sorted(set(groups)))}
+        ref = within_group_kappa(
+            np.asarray(decs, int),
+            np.asarray([uniq[g] for g in groups], int))
+        same = (w["kappa"]["kappa"] == ref["kappa"]
+                or (np.isnan(w["kappa"]["kappa"])
+                    and np.isnan(ref["kappa"])))
+        kappa_bitwise = kappa_bitwise and same
+    assert kappa_bitwise, "window kappa diverged from payload kappa"
+    # Overhead gate on MEDIAN per-wave client time (identical work both
+    # runs; the median makes one noisy wave unable to fake a
+    # regression). The mean-based goodputs are reported alongside.
+    med_off = float(np.median(off_wave_s))
+    med_on = float(np.median(on_wave_s))
+    goodput_ratio = med_off / med_on
+    assert goodput_ratio >= 0.95, (
+        f"observability overhead too high: client goodput "
+        f"{goodput_ratio:.3f}x the off baseline")
+    n_spans = len(trace_doc["traceEvents"])
+    span_names = {e["name"] for e in trace_doc["traceEvents"]
+                  if e.get("ph") == "X"}
+    for must in ("serve/admit", "serve/queue_wait", "serve/dispatch",
+                 "serve/readout", "serve/resolve", "sentinel/sweep"):
+        assert must in span_names, f"missing span {must}"
+
+    return {
+        "n_models": n_models,
+        "waves": n_waves,
+        "questions_per_wave": q_per_wave,
+        "n_sentinels": len(sentinels),
+        "windows": len(obs["windows"]),
+        "sentinel_sweeps": obs["sweeps"],
+        "alerts": len(alerts),
+        "drift_window": alerts[0]["window"],
+        "drift_detected_within_one_window": True,
+        "clean_window_false_alarms": clean_false_alarms,
+        "kappa_bitwise_vs_within_group_kappa": kappa_bitwise,
+        "per_window_kappa": {
+            str(w["window"]): round(float(w["kappa"]["kappa"]), 6)
+            for w in obs["windows"]},
+        "client_goodput_off_p_s": round(goodput_off, 3),
+        "client_goodput_on_p_s": round(goodput_on, 3),
+        "goodput_ratio": round(goodput_ratio, 3),
+        "sentinel_sweep_s": round(sweep_s[0], 4),
+        "completed_on": int(on_completed),
+        "completed_off": int(off_completed),
+        "trace_spans": n_spans,
+        "metrics_sources": len(snap["sources"]),
     }
 
 

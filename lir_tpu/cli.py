@@ -150,6 +150,7 @@ def _add_perturb(sub) -> None:
     _add_engine_tuning_flags(p)
     _add_guard_flags(p)
     _add_kernel_flags(p)
+    _add_trace_flags(p)
     p.add_argument("--barrier-timeout", type=float, default=None,
                    help="multihost liveness bound in seconds: a shard-"
                         "boundary barrier a peer never reaches raises "
@@ -326,6 +327,99 @@ def _kernel_rt_kw(args, rt_kw: dict) -> None:
         rt_kw["piggyback_prefill"] = False
 
 
+def _add_trace_flags(p) -> None:
+    """Structured-tracing knobs (lir_tpu/observe/tracing.py), shared by
+    perturb and serve."""
+    p.add_argument("--trace-out", type=Path, default=None,
+                   help="record per-request/per-dispatch trace spans "
+                        "(admit -> queue -> batch-form -> dispatch -> "
+                        "readout -> resolve, weight swaps, stream "
+                        "folds) and write Chrome/Perfetto trace-event "
+                        "JSON here at exit — open in chrome://tracing "
+                        "or ui.perfetto.dev; span names match the "
+                        "jax.profiler device-trace annotations")
+    p.add_argument("--trace-buffer", type=int, default=None,
+                   help="trace-span ring capacity (default 65536; "
+                        "oldest spans drop beyond it, drops counted in "
+                        "the metrics snapshot)")
+
+
+def _add_observatory_flags(p) -> None:
+    """Reliability-observatory knobs (lir_tpu/observe; fleet serving
+    only — the sentinel grid fans across every fleet model)."""
+    p.add_argument("--sentinels", type=Path, default=None,
+                   help="JSONL sentinel grid ({\"prompt\": ...} or "
+                        "{\"binary_prompt\", \"confidence_prompt\"}, "
+                        "optional \"targets\") re-scored across the "
+                        "whole fleet on --sentinel-interval and on any "
+                        "weight-cache residency change; per-window "
+                        "kappa/CI/mean drift alerts ride the stats "
+                        "endpoint (DEPLOY.md §1l)")
+    p.add_argument("--sentinel-interval", type=float, default=None,
+                   help="seconds between scheduled sentinel sweeps "
+                        "(default 60)")
+    p.add_argument("--sentinel-window", type=float, default=None,
+                   help="drift-window width in seconds (default 600): "
+                        "sweeps in one window fold into one "
+                        "accumulator lattice; kappa/CI/mean compare "
+                        "ACROSS windows")
+    p.add_argument("--sentinel-max-sweeps", type=int, default=None,
+                   help="lattice capacity in sweeps per window "
+                        "(default 32; a full window skips further "
+                        "sweeps loudly rather than overwriting slots)")
+    p.add_argument("--drift-sigma", type=float, default=None,
+                   help="alert threshold: |window metric - baseline "
+                        "mean| > sigma * max(std, floor) (default 3)")
+    p.add_argument("--drift-min-windows", type=int, default=None,
+                   help="clean windows required before drift detection "
+                        "arms (default 2)")
+    p.add_argument("--observe-history", type=int, default=None,
+                   help="window lattices kept on device / summaries "
+                        "queryable (default 64; oldest drop beyond it)")
+
+
+def _observe_cfg(args):
+    """ObserveConfig from the flags (None = dataclass default)."""
+    from .config import ObserveConfig
+
+    kw = {}
+    if getattr(args, "sentinel_interval", None) is not None:
+        kw["sentinel_interval_s"] = args.sentinel_interval
+    if getattr(args, "sentinel_window", None) is not None:
+        kw["sentinel_window_s"] = args.sentinel_window
+    if getattr(args, "sentinel_max_sweeps", None) is not None:
+        kw["max_sweeps_per_window"] = args.sentinel_max_sweeps
+    if getattr(args, "drift_sigma", None) is not None:
+        kw["drift_sigma"] = args.drift_sigma
+    if getattr(args, "drift_min_windows", None) is not None:
+        kw["drift_min_windows"] = args.drift_min_windows
+    if getattr(args, "observe_history", None) is not None:
+        kw["history_windows"] = args.observe_history
+    if getattr(args, "trace_buffer", None) is not None:
+        kw["trace_buffer"] = args.trace_buffer
+    return ObserveConfig(**kw)
+
+
+def _maybe_start_tracing(args):
+    """Install the process trace recorder under --trace-out; returns it
+    (or None). The caller exports at exit."""
+    if getattr(args, "trace_out", None) is None:
+        return None
+    from .observe import tracing
+
+    rec = tracing.TraceRecorder(capacity=_observe_cfg(args).trace_buffer)
+    tracing.set_recorder(rec)
+    return rec
+
+
+def _finish_tracing(rec, args) -> None:
+    if rec is None:
+        return
+    rec.export_chrome(args.trace_out)
+    log.info("trace: wrote %d spans (%d dropped) -> %s", len(rec),
+             rec.dropped, args.trace_out)
+
+
 def _add_guard_flags(p) -> None:
     """Guard-layer knobs (lir_tpu/guard) shared by perturb and serve."""
     p.add_argument("--watchdog-multiple", type=float, default=None,
@@ -462,6 +556,8 @@ def _add_serve(sub) -> None:
     _add_engine_tuning_flags(p)
     _add_guard_flags(p)
     _add_kernel_flags(p)
+    _add_trace_flags(p)
+    _add_observatory_flags(p)
     _add_fleet_flags(p, with_models=True)
 
 
@@ -631,11 +727,15 @@ def cmd_perturb(args) -> None:
         args.perturbations, LEGAL_PROMPTS, None
     )
     perturbations = [rephrasings for _, rephrasings in entries]
+    rec = _maybe_start_tracing(args)
     engine = factory(args.model)
-    rows = run_perturbation_sweep(
-        engine, args.model, LEGAL_PROMPTS, perturbations, args.out,
-        subset_size=args.subset_size,
-    )
+    try:
+        rows = run_perturbation_sweep(
+            engine, args.model, LEGAL_PROMPTS, perturbations, args.out,
+            subset_size=args.subset_size,
+        )
+    finally:
+        _finish_tracing(rec, args)
     log.info("perturbation sweep wrote %d rows", len(rows))
 
 
@@ -681,12 +781,23 @@ def cmd_serve(args) -> None:
     if bool(args.model) == bool(args.fleet_models):
         raise SystemExit("serve needs exactly one of --model (single-"
                          "model) or --fleet-models (multiplexed fleet)")
+    if args.sentinels is not None and not args.fleet_models:
+        raise SystemExit("--sentinels needs --fleet-models: the "
+                         "observatory re-scores the sentinel grid "
+                         "across a fleet (single-model drift has no "
+                         "agreement axis to watch)")
+    # Install the trace recorder BEFORE server construction so the
+    # server registers it as a metrics source.
+    rec = _maybe_start_tracing(args)
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
         cache_root=args.param_cache, quantize_int8=args.int8,
         int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8)
     if args.fleet_models:
-        _run_fleet_serve(args, serve_cfg, factory)
+        try:
+            _run_fleet_serve(args, serve_cfg, factory)
+        finally:
+            _finish_tracing(rec, args)
         return
     engine = factory(args.model)
     server = ScoringServer(engine, args.model, serve_cfg,
@@ -730,6 +841,13 @@ def cmd_serve(args) -> None:
                                   "stats": server.stream_summary()}),
                       flush=True)
                 continue
+            if obj.get("op") == "metrics":
+                # The unified metrics snapshot (observe/registry):
+                # every registered *Stats source + HBM gauges, live.
+                print(json.dumps({"op": "metrics",
+                                  "metrics": server.metrics.snapshot()}),
+                      flush=True)
+                continue
             prompt = obj.get("prompt")
             req = ServeRequest(
                 binary_prompt=obj.get(
@@ -751,9 +869,14 @@ def cmd_serve(args) -> None:
         print(json.dumps({k: v for k, v in vars(r).items()
                           if not k.startswith("_")}), flush=True)
     server.stop()
+    _finish_tracing(rec, args)
     if args.state_checkpoint is not None and args.state_checkpoint.exists():
         args.state_checkpoint.unlink()   # clean drain: nothing pending
     log.info("serve stats: %s", json.dumps(server.stats.summary()))
+    # Exit metrics snapshot — includes the per-device HBM gauges, so
+    # WeightCache/page-pool budget pressure is on the record even when
+    # nothing ever OOMed.
+    log.info("serve metrics: %s", json.dumps(server.metrics.snapshot()))
     if server.stream is not None:
         log.info("serve stream stats: %s",
                  json.dumps(server.stream_summary()))
@@ -801,6 +924,21 @@ def _run_fleet_serve(args, serve_cfg, factory) -> None:
     ).start()
     default_rf = LEGAL_PROMPTS[0].response_format
     default_cf = LEGAL_PROMPTS[0].confidence_format
+    scheduler = None
+    if args.sentinels is not None:
+        from .observe import SentinelScheduler
+
+        sentinels = _load_sentinels(args.sentinels, default_rf,
+                                    default_cf)
+        scheduler = SentinelScheduler(server, sentinels,
+                                      cfg=_observe_cfg(args))
+        server.attach_observatory(scheduler)
+        scheduler.start()
+        log.info("observatory: %d sentinels every %.0fs, %.0fs windows,"
+                 " %.1f-sigma alerts", len(sentinels),
+                 scheduler.cfg.sentinel_interval_s,
+                 scheduler.cfg.sentinel_window_s,
+                 scheduler.cfg.drift_sigma)
     stream = (sys.stdin if args.requests == "-"
               else open(args.requests, encoding="utf-8"))
     futures = []
@@ -811,8 +949,15 @@ def _run_fleet_serve(args, serve_cfg, factory) -> None:
                 continue
             obj = json.loads(line)
             if obj.get("op") == "stats":
+                # Serve + fleet counters, plus the observatory's window
+                # history and drift alerts when a sentinel grid runs.
                 print(json.dumps({"op": "stats",
-                                  "fleet": server.fleet_summary()}),
+                                  **server.stats_summary()}),
+                      flush=True)
+                continue
+            if obj.get("op") == "metrics":
+                print(json.dumps({"op": "metrics",
+                                  "metrics": server.metrics.snapshot()}),
                       flush=True)
                 continue
             prompt = obj.get("prompt")
@@ -840,10 +985,52 @@ def _run_fleet_serve(args, serve_cfg, factory) -> None:
         print(json.dumps(r if kind == "fleet"
                          else {k: v for k, v in vars(r).items()
                                if not k.startswith("_")}), flush=True)
+    if scheduler is not None:
+        # Stop sentinel traffic first, then drain client traffic; the
+        # final partial window finalizes so a drift that landed minutes
+        # before shutdown still alerts.
+        scheduler.stop()
     server.stop()
     fleet.shutdown()
     log.info("serve stats: %s", json.dumps(server.stats.summary()))
     log.info("fleet stats: %s", json.dumps(server.fleet_summary()))
+    log.info("serve metrics: %s", json.dumps(server.metrics.snapshot()))
+    if scheduler is not None:
+        obs = scheduler.summary()
+        log.info("observatory: %d sweeps over %d finalized windows, "
+                 "%d drift alert(s)", obs["sweeps"], len(obs["windows"]),
+                 len(obs["alerts"]))
+        for alert in obs["alerts"]:
+            log.warning("drift alert: %s", json.dumps(alert))
+
+
+def _load_sentinels(path: Path, default_rf: str, default_cf: str):
+    """Sentinel grid from a JSONL file (request-line schema minus the
+    serving metadata)."""
+    import json
+
+    from .serve import ServeRequest
+
+    sentinels = []
+    for i, line in enumerate(path.read_text(encoding="utf-8")
+                             .splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        obj = json.loads(line)
+        prompt = obj.get("prompt")
+        sentinels.append(ServeRequest(
+            binary_prompt=obj.get(
+                "binary_prompt",
+                f"{prompt} {obj.get('response_format', default_rf)}"),
+            confidence_prompt=obj.get(
+                "confidence_prompt",
+                f"{prompt} {obj.get('confidence_format', default_cf)}"),
+            targets=tuple(obj.get("targets", ("Yes", "No"))),
+            request_id=f"sentinel-{i}"))
+    if not sentinels:
+        raise SystemExit(f"--sentinels {path}: no sentinel lines found")
+    return sentinels
 
 
 def cmd_precompile(args) -> None:

@@ -354,6 +354,42 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObserveConfig:
+    """Reliability-observatory + telemetry knobs (lir_tpu/observe;
+    DEPLOY.md §1l).
+
+    The observatory re-scores a sentinel grid on a schedule (and on
+    weight-cache residency change), folds results into time-windowed
+    accumulator lattices, and raises σ-threshold drift alerts on
+    per-window κ / per-model mean / valid-fraction movement — all
+    queryable live through the serve ``stats``/``metrics`` endpoints.
+    """
+
+    # Seconds between scheduled sentinel re-scorings. A weight-cache
+    # residency change (model evicted/re-streamed) forces an immediate
+    # sweep regardless of the interval.
+    sentinel_interval_s: float = 60.0    # cli: --sentinel-interval
+    # Drift-window width in seconds: sweeps landing in the same window
+    # fold into one lattice; κ/CI/mean are compared ACROSS windows.
+    sentinel_window_s: float = 600.0     # cli: --sentinel-window
+    # Lattice capacity per window (columns = sweeps x sentinels); a
+    # window that fills logs and skips further sweeps rather than
+    # silently overwriting slots.
+    max_sweeps_per_window: int = 32      # cli: --sentinel-max-sweeps
+    # Alert threshold: |window metric - baseline mean| > drift_sigma *
+    # max(baseline std, floor). 3σ is the classic control-chart bound.
+    drift_sigma: float = 3.0             # cli: --drift-sigma
+    # Clean windows required before drift detection arms (a baseline of
+    # one window has no variance to threshold against).
+    drift_min_windows: int = 2           # cli: --drift-min-windows
+    # Window lattices kept on device / summaries kept queryable; the
+    # oldest drop beyond this (their summaries persist in history).
+    history_windows: int = 64            # cli: --observe-history
+    # Trace-span ring capacity for --trace-out recording.
+    trace_buffer: int = 65536            # cli: --trace-buffer
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Multi-model fleet knobs (engine/fleet.py over models/weights.py).
 
@@ -405,6 +441,8 @@ class Config:
     retry: RetryConfig = dataclasses.field(default_factory=RetryConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    observe: ObserveConfig = dataclasses.field(
+        default_factory=ObserveConfig)
 
     # Paths: everything under one results root; no personal gdrive paths.
     results_dir: Path = Path("results")

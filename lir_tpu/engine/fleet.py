@@ -193,28 +193,35 @@ class ModelFleet:
         only the un-overlapped wait as exposed; a cold inline load is
         fully exposed (exactly what the sequential drop-and-reload
         baseline pays for EVERY switch)."""
+        from ..observe import tracing
+
         slot = self._slots[model_id]
         if model_id in self.cache:
             params = self.cache.acquire(model_id)
             self.stats.count("cache_hits")
         else:
-            taken = self.streamer.take(model_id)
-            if taken is not None:
-                params, load_s, waited = taken
-                self.stats.count("prefetch_hits")
-                self.stats.count("loads")
-                self.stats.count("load_s", load_s)
-                self.stats.count("swap_s_exposed", waited)
-                self.stats.count("swap_s_hidden", max(load_s - waited, 0.0))
-            else:
-                t0 = time.perf_counter()
-                params = self._load(slot)
-                load_s = time.perf_counter() - t0
-                self.stats.count("prefetch_misses")
-                self.stats.count("loads")
-                self.stats.count("load_s", load_s)
-                self.stats.count("swap_s_exposed", load_s)
-            self.cache.insert(model_id, params, slot.nbytes or None)
+            # The weight-swap span covers exactly the EXPOSED wait —
+            # what the scoring loop actually stalls on (a prefetched
+            # load's hidden portion already overlapped compute).
+            with tracing.span("fleet/weight_swap", model=model_id):
+                taken = self.streamer.take(model_id)
+                if taken is not None:
+                    params, load_s, waited = taken
+                    self.stats.count("prefetch_hits")
+                    self.stats.count("loads")
+                    self.stats.count("load_s", load_s)
+                    self.stats.count("swap_s_exposed", waited)
+                    self.stats.count("swap_s_hidden",
+                                     max(load_s - waited, 0.0))
+                else:
+                    t0 = time.perf_counter()
+                    params = self._load(slot)
+                    load_s = time.perf_counter() - t0
+                    self.stats.count("prefetch_misses")
+                    self.stats.count("loads")
+                    self.stats.count("load_s", load_s)
+                    self.stats.count("swap_s_exposed", load_s)
+                self.cache.insert(model_id, params, slot.nbytes or None)
             params = self.cache.acquire(model_id)
         if self._active != model_id:
             self.stats.count("model_swaps")

@@ -46,9 +46,10 @@ from ..data.prompts import (
     format_instruct_prompt,
 )
 from ..guard import numerics
+from ..observe import tracing
 from ..utils.logging import get_logger, save_captured_output, start_capture
 from ..utils.profiling import (GuardStats, ThroughputMeter,
-                               device_memory_stats, trace)
+                               device_memory_stats)
 from .fleet import ModelFleet
 from .runner import ScoringEngine
 from .sweep import run_word_meaning_sweep
@@ -212,7 +213,8 @@ def run_model_comparison_sweep(
                 # as dead device time per switch.
                 fleet.prefetch(specs[i + 1].name)
             fmt = format_for(spec, sweep_kind)
-            with meter.measure(), trace(f"sweep/{spec.name.split('/')[-1]}"):
+            with meter.measure(), tracing.span(
+                    "sweep/model", model=spec.name.split("/")[-1]):
                 rows = run_word_meaning_sweep(
                     engine, spec.name, spec.base_or_instruct, questions, fmt,
                 )

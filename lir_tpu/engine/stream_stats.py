@@ -225,6 +225,13 @@ class StreamSink:
         grid cells of the dispatch in row order; rows beyond them are
         padding and fold with an out-of-range slot (dropped). The update
         is ONE fused device call; nothing here reads a device value."""
+        from ..observe import tracing
+
+        with tracing.span("stream/fold", rows=len(cells)):
+            self._fold(yes, no, wconf, lp, cells, topk)
+
+    def _fold(self, yes, no, wconf, lp, cells: Sequence,
+              topk: int) -> None:
         self._ensure_placement(yes)
         bsz = int(yes.shape[0])
         n = len(cells)
@@ -290,11 +297,19 @@ class StreamSink:
                         self.n_prompts, self.n_rephrase)
             return False
         self.seed = int(acc.seed)
+        # jnp.array (copy=True), NOT jnp.asarray: on CPU, asarray may
+        # ZERO-COPY-alias the checkpoint's host numpy buffers, and
+        # fold_update donates the lattice — XLA is then free to reuse
+        # any donated same-size buffer for any output (int32 `filled`
+        # and f32 `conf` are both 4 B/cell), which intermittently
+        # cross-wires the leaves after a resume (filled's bit pattern
+        # showing up as 1e-45 denormals in conf). A donated buffer must
+        # be one the device exclusively owns.
         self._acc = {
-            "filled": jnp.asarray(acc.filled),
-            "rel": jnp.asarray(acc.rel),
-            "conf": jnp.asarray(acc.conf),
-            "dec": jnp.asarray(acc.dec),
+            "filled": jnp.array(acc.filled),
+            "rel": jnp.array(acc.rel),
+            "conf": jnp.array(acc.conf),
+            "dec": jnp.array(acc.dec),
         }
         self._mesh_placed = False   # re-colocate on the next fold
         return True
@@ -372,6 +387,145 @@ def load_accum(path: Path):
         log.warning("stream accum %s unreadable (%r); starting fresh",
                     path, err)
         return None
+
+
+class WindowedStreamSink:
+    """The accumulator lattice with a TIME axis (ROADMAP item 5): one
+    donated device lattice per window id, managed as an ordered pool.
+
+    Each window is a full :class:`StreamSink` over the same (rows,
+    cols) grid, so EVERY property PR 9 proved carries over per window
+    unchanged: folds are one fused donated scatter, idempotent and
+    commutative within a window (a re-scored slot lands bitwise on the
+    same cell), per-window checkpoints are atomic, and resume/merge is
+    the same slot-wise union (``stats/streaming.merge_accums``) —
+    order-free, overlap a hard error. The time axis only chooses WHICH
+    lattice a fold targets; it never changes fold semantics.
+
+    The observatory uses rows = fleet models and cols = sentinel slots
+    (``sweep_slot * n_sentinels + sentinel_idx``), but the class is
+    grid-agnostic — an offline windowed re-scoring sweep can use
+    (prompt, rephrase) exactly like the single-window sink.
+
+    Window lifecycle: windows materialize on first fold; beyond
+    ``max_windows`` the OLDEST window's device lattice is dropped
+    (after an optional checkpoint via the eviction hook) so a
+    long-running observatory holds bounded HBM. Thread discipline
+    mirrors StreamSink: one folding thread (the sentinel scheduler /
+    sweep writer); checkpoints and drift readers consume host
+    snapshots.
+    """
+
+    def __init__(self, n_rows: int, n_cols: int, seed: int = 0,
+                 guard: bool = True, max_windows: int = 64,
+                 stats: Optional[StreamStats] = None,
+                 on_evict: Optional[Callable[[int], None]] = None):
+        self.n_rows = int(n_rows)
+        self.n_cols = int(n_cols)
+        self.seed = int(seed)
+        self.guard = bool(guard)
+        self.max_windows = max(int(max_windows), 1)
+        self.stats = stats if stats is not None else StreamStats()
+        self.on_evict = on_evict
+        self._sinks: Dict[int, StreamSink] = {}
+        self._order: List[int] = []      # insertion order = age
+
+    def window_ids(self) -> List[int]:
+        return sorted(self._sinks)
+
+    def sink(self, window_id: int) -> StreamSink:
+        """The window's sink, created on first touch. The per-window
+        bootstrap seed is fold_in-style derived (seed + window id) so
+        CIs stay reproducible per window across resume."""
+        wid = int(window_id)
+        s = self._sinks.get(wid)
+        if s is None:
+            s = StreamSink(self.n_rows, self.n_cols,
+                           seed=self.seed + wid, guard=self.guard,
+                           stats=self.stats)
+            self._sinks[wid] = s
+            self._order.append(wid)
+            while len(self._order) > self.max_windows:
+                old = self._order.pop(0)
+                if self.on_evict is not None:
+                    self.on_evict(old)
+                del self._sinks[old]
+                log.info("windowed sink: dropped window %d "
+                         "(max_windows=%d)", old, self.max_windows)
+        return s
+
+    def fold(self, window_id: int, yes, no, wconf, lp,
+             cells: Sequence, topk: int) -> None:
+        """One fused fold into the window's lattice (StreamSink.fold
+        semantics exactly — padding rows scatter out of range)."""
+        self.sink(window_id).fold(yes, no, wconf, lp, cells, topk)
+
+    def snapshot(self, window_id: int):
+        return self._sinks[int(window_id)].snapshot()
+
+    def device_acc(self, window_id: int) -> Dict[str, jax.Array]:
+        """The window's live device lattice (observe/drift.py reduces
+        it on device without a host round-trip)."""
+        return self._sinks[int(window_id)]._acc
+
+    # -- checkpoint / resume -------------------------------------------------
+
+    def _window_path(self, directory: Path, wid: int) -> Path:
+        return Path(directory) / f"w{int(wid)}{ACCUM_SUFFIX}"
+
+    def checkpoint(self, directory: Path) -> int:
+        """Atomic per-window accumulator snapshots (``w<id>.accum.npz``
+        — the single-window save_accum format, one file per window so a
+        kill mid-checkpoint tears at most one window back to its
+        previous snapshot). Returns windows written."""
+        directory = Path(directory)
+        directory.mkdir(parents=True, exist_ok=True)
+        for wid, s in self._sinks.items():
+            s.checkpoint(self._window_path(directory, wid))
+        return len(self._sinks)
+
+    def load(self, directory: Path) -> List[int]:
+        """Seed window lattices from a checkpoint directory; returns
+        the window ids restored. Re-folds after a resume are bitwise
+        no-ops per window (slot idempotence), so kill → load → re-score
+        converges on the uninterrupted run's lattices exactly."""
+        directory = Path(directory)
+        restored: List[int] = []
+        if not directory.is_dir():
+            return restored
+        for path in sorted(directory.glob(f"w*{ACCUM_SUFFIX}")):
+            stem = path.name[:-len(ACCUM_SUFFIX)]
+            try:
+                wid = int(stem[1:])
+            except ValueError:
+                continue
+            if self.sink(wid).load(path):
+                restored.append(wid)
+        return restored
+
+    def merge_window(self, window_id: int, other) -> None:
+        """Slot-wise union of a disjoint shard's HostAccum into one
+        window (streaming.merge_accums discipline: overlap on a filled
+        slot raises — two folders scored one sentinel cell)."""
+        from ..stats import streaming
+
+        wid = int(window_id)
+        mine = self.snapshot(wid) if wid in self._sinks else None
+        if mine is None:
+            merged = other
+        else:
+            merged = streaming.merge_accums([mine, other])
+        s = self.sink(wid)
+        # jnp.array (copy), not asarray: the lattice is donated on the
+        # next fold — see StreamSink.load.
+        s._acc = {
+            "filled": jnp.array(merged.filled),
+            "rel": jnp.array(merged.rel),
+            "conf": jnp.array(merged.conf),
+            "dec": jnp.array(merged.dec),
+        }
+        s._mesh_placed = False
+        self.stats.count("merges")
 
 
 class ServeStreamSink:

@@ -25,6 +25,8 @@ import numpy as np
 from ..config import RetryConfig
 from ..data import schemas
 from ..guard import numerics
+from ..observe import registry as metrics_mod
+from ..observe import tracing
 from ..data.prompts import LegalPrompt
 from ..utils.logging import get_logger
 from ..utils.manifest import SweepManifest
@@ -329,6 +331,11 @@ def run_perturbation_sweep(
                      "kappa=%.4f; counters: %s",
                      final["rows_folded"], final["kappa"]["kappa"],
                      json.dumps(sink.stats.summary()))
+        # Per-sweep unified metrics dump (observe/registry): the SAME
+        # canonical snapshot schema the serve {"op": "metrics"}
+        # endpoint answers live, with the per-device HBM gauges.
+        log.info("metrics: %s", json.dumps(
+            metrics_mod.engine_registry(engine, sink=sink).snapshot()))
 
     if pending_rows:
         _flush(pending_rows, results_path, manifest, sink=sink,
@@ -540,6 +547,10 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
             sink.registry_get = _stream_exec
 
     def _drain(batch, fused, res, cfused):
+        with tracing.span("sweep/drain", rows=len(batch)):
+            _drain_inner(batch, fused, res, cfused)
+
+    def _drain_inner(batch, fused, res, cfused):
         if sink is not None:
             # THE tentpole hot-loop step: fold this dispatch's device
             # readouts into the donated accumulator with one fused XLA
@@ -753,19 +764,21 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
 
     def _plain_shared(meta):
         full_items, t1, t2 = meta["full_items"], meta["t1"], meta["t2"]
-        fused, cfused = _dispatch_with_recovery(
-            engine, lambda: engine.decode_fused_shared(
-                [it.cell.binary_prompt for it in full_items],
-                [it.cell.confidence_prompt for it in full_items],
-                t1, t2, new_tokens=new_tokens,
-                conf_tokens=conf_tokens, early_stop=early_stop,
-                pretokenized_a=[it.bin_ids for it in full_items],
-                pretokenized_b=[it.conf_ids for it in full_items],
-                bucket=meta["bucket"], sfx_buckets_ab=meta["sfx_ab"],
-                reuse_cache=True, n_real=meta["n"]),
-            cost=sched_mod.bucket_cost(
-                meta["n"], meta["bucket"], B, new_tokens + conf_tokens,
-                fused_decode=fused_dec))
+        with tracing.span("sweep/dispatch", bucket=int(meta["bucket"]),
+                          rows=int(meta["n"])):
+            fused, cfused = _dispatch_with_recovery(
+                engine, lambda: engine.decode_fused_shared(
+                    [it.cell.binary_prompt for it in full_items],
+                    [it.cell.confidence_prompt for it in full_items],
+                    t1, t2, new_tokens=new_tokens,
+                    conf_tokens=conf_tokens, early_stop=early_stop,
+                    pretokenized_a=[it.bin_ids for it in full_items],
+                    pretokenized_b=[it.conf_ids for it in full_items],
+                    bucket=meta["bucket"], sfx_buckets_ab=meta["sfx_ab"],
+                    reuse_cache=True, n_real=meta["n"]),
+                cost=sched_mod.bucket_cost(
+                    meta["n"], meta["bucket"], B,
+                    new_tokens + conf_tokens, fused_decode=fused_dec))
         _emit(meta, fused, cfused)
 
     def _redispatch_pending():
@@ -874,17 +887,19 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 t2 = np.asarray(
                     [target_ids[it.cell.prompt_idx][1]
                      for it in d.items], np.int32)
-                out, m = _dispatch_with_recovery(
-                    engine, lambda: engine.decode_fused_grouped(
-                        d.groups, t1, t2, new_tokens, conf_tokens,
-                        early_stop, d.bucket,
-                        max(d.sfx_bucket_a, d.sfx_bucket_b),
-                        reuse_cache=True),
-                    # Grouped dispatches run [bin, conf] member rows per
-                    # cell — price the doubled row count.
-                    cost=sched_mod.bucket_cost(
-                        2 * n, d.bucket, B, new_tokens + conf_tokens,
-                        fused_decode=fused_dec))
+                with tracing.span("sweep/dispatch", kind="grouped",
+                                  bucket=int(d.bucket), rows=n):
+                    out, m = _dispatch_with_recovery(
+                        engine, lambda: engine.decode_fused_grouped(
+                            d.groups, t1, t2, new_tokens, conf_tokens,
+                            early_stop, d.bucket,
+                            max(d.sfx_bucket_a, d.sfx_bucket_b),
+                            reuse_cache=True),
+                        # Grouped dispatches run [bin, conf] member rows
+                        # per cell — price the doubled row count.
+                        cost=sched_mod.bucket_cost(
+                            2 * n, d.bucket, B, new_tokens + conf_tokens,
+                            fused_decode=fused_dec))
                 # Member rows are [bin, conf] per cell: even rows carry
                 # the binary readout, odd rows the confidence one. Both
                 # ran the shared max(new, conf) budget, so each branch

@@ -221,11 +221,13 @@ class LintPass:
 
 def all_passes() -> List[LintPass]:
     # Imported lazily so ``from lir_tpu.lint import core`` never cycles.
-    from . import configdrift, donation, hostsync, locks, trace
+    from . import (configdrift, donation, hostsync, locks, metricsdrift,
+                   trace)
 
     return [donation.DonationPass(), trace.TraceHazardPass(),
             hostsync.HostSyncPass(), locks.LockDisciplinePass(),
-            configdrift.ConfigDriftPass()]
+            configdrift.ConfigDriftPass(),
+            metricsdrift.MetricsDriftPass()]
 
 
 ALL_PASSES = tuple(p.name for p in all_passes())

@@ -1,0 +1,137 @@
+"""metrics-drift: every counter field reaches the metrics endpoint.
+
+Sibling of config-drift, closing the same class of silent hole one
+layer up: config-drift proves every knob is REACHABLE; this pass proves
+every counter is OBSERVABLE. The unified telemetry spine
+(lir_tpu/observe/registry.py) snapshots each registered ``*Stats``
+object through :data:`~lir_tpu.observe.registry.STATS_SCHEMA` — a pure
+dict literal mapping class name → tuple of public field names. A PR
+that adds a counter field to a ``*Stats`` dataclass in
+utils/profiling.py without adding it to that schema ships a counter the
+``{"op": "metrics"}`` endpoint silently never reports. This pass makes
+that a lint failure:
+
+1. every ``*Stats`` class in utils/profiling.py must have a
+   STATS_SCHEMA entry;
+2. every PUBLIC dataclass field (AnnAssign, no leading underscore) of
+   such a class must appear in its entry's tuple;
+3. schema entries naming fields that no longer exist are stale —
+   flagged too, so the schema cannot rot in the other direction.
+
+Underscore-prefixed fields are implementation detail (locks, ring
+buffers) and owe nothing to the endpoint. A field that deliberately
+stays out of the snapshot carries ``# lint: allow(metrics-drift)``
+with its justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding, LintPass, Module, Project
+
+PROFILING_REL = "lir_tpu/utils/profiling.py"
+REGISTRY_REL = "lir_tpu/observe/registry.py"
+SCHEMA_NAME = "STATS_SCHEMA"
+
+
+def _stats_classes(mod: Module) -> List[ast.ClassDef]:
+    return [node for node in ast.walk(mod.tree)
+            if isinstance(node, ast.ClassDef)
+            and node.name.endswith("Stats")]
+
+
+def _public_fields(cls: ast.ClassDef) -> List[Tuple[str, int]]:
+    out = []
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and not node.target.id.startswith("_"):
+            out.append((node.target.id, node.lineno))
+    return out
+
+
+def _parse_schema(mod: Module) -> Optional[Dict[str, Tuple[str, ...]]]:
+    """The STATS_SCHEMA literal: {str: (str, ...)}; None when absent."""
+    for node in ast.walk(mod.tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and target.id == SCHEMA_NAME):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Dict):
+            return None
+        schema: Dict[str, Tuple[str, ...]] = {}
+        for k, v in zip(value.keys, value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            if isinstance(v, (ast.Tuple, ast.List)):
+                schema[k.value] = tuple(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str))
+        return schema
+    return None
+
+
+class MetricsDriftPass(LintPass):
+    name = "metrics-drift"
+
+    def run(self, project: Project) -> List[Finding]:
+        prof = project.module(PROFILING_REL)
+        if prof is None:
+            return []
+        classes = _stats_classes(prof)
+        if not classes:
+            return []
+        reg = project.module(REGISTRY_REL)
+        schema = _parse_schema(reg) if reg is not None else None
+        findings: List[Finding] = []
+        if schema is None:
+            findings.append(Finding(
+                self.name, prof.rel, 1, "<module>",
+                f"no parseable {SCHEMA_NAME} dict literal in "
+                f"{REGISTRY_REL} — the metrics endpoint has no snapshot "
+                f"schema to hold these *Stats counters"))
+            return findings
+        seen_fields: Dict[str, set] = {}
+        for cls in classes:
+            fields = _public_fields(cls)
+            seen_fields[cls.name] = {n for n, _ in fields}
+            declared = schema.get(cls.name)
+            if declared is None:
+                findings.append(Finding(
+                    self.name, prof.rel, cls.lineno, cls.name,
+                    f"stats class '{cls.name}' has no {SCHEMA_NAME} "
+                    f"entry in {REGISTRY_REL} — its counters never "
+                    f"reach the metrics endpoint"))
+                continue
+            for fname, line in fields:
+                if fname not in declared:
+                    findings.append(Finding(
+                        self.name, prof.rel, line,
+                        f"{cls.name}.{fname}",
+                        f"counter field '{fname}' is missing from "
+                        f"{SCHEMA_NAME}['{cls.name}'] — it silently "
+                        f"drops out of the metrics snapshot; add it "
+                        f"(or justify a lint allow)"))
+        for cls_name, declared in schema.items():
+            have = seen_fields.get(cls_name)
+            if have is None:
+                continue        # schema may describe classes elsewhere
+            for fname in declared:
+                if fname not in have:
+                    findings.append(Finding(
+                        self.name, prof.rel, 1, f"{cls_name}.{fname}",
+                        f"{SCHEMA_NAME}['{cls_name}'] declares "
+                        f"'{fname}' but the dataclass has no such "
+                        f"public field — stale schema entry"))
+        return findings

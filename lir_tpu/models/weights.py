@@ -163,9 +163,13 @@ def _stream_array(arr: np.ndarray, sharding, chunk_bytes: int,
         else jax.device_put
     if arr.ndim == 0 or nbytes <= chunk_bytes or arr.shape[0] <= 1:
         return window.admit(put(arr))
+    from ..observe import tracing
+
     rows = max(int(arr.shape[0] * chunk_bytes / nbytes), 1)
-    parts = [window.admit(put(arr[s:s + rows]))
-             for s in _chunk_starts(arr.shape[0], rows)]
+    starts = _chunk_starts(arr.shape[0], rows)
+    with tracing.span("weights/stream_chunks", nbytes=nbytes,
+                      chunks=len(starts)):
+        parts = [window.admit(put(arr[s:s + rows])) for s in starts]
     if len(parts) == 1:
         return parts[0]
     joined = jnp.concatenate(parts, axis=0)
@@ -224,12 +228,15 @@ def stream_params(staged: Any, cfg=None, mesh=None,
                              shard(spec) if spec is not None else None,
                              chunk_bytes, window)
 
+    from ..observe import tracing
+
     is_qt = lambda x: isinstance(x, QuantTensor)  # noqa: E731
-    if specs is not None:
-        out = jax.tree.map(leaf, staged, specs, is_leaf=is_qt)
-    else:
-        out = jax.tree.map(leaf, staged, is_leaf=is_qt)
-    window.drain()
+    with tracing.span("weights/stream", bytes=tree_bytes(staged)):
+        if specs is not None:
+            out = jax.tree.map(leaf, staged, specs, is_leaf=is_qt)
+        else:
+            out = jax.tree.map(leaf, staged, is_leaf=is_qt)
+        window.drain()
     if stats is not None:
         stats.count("weight_bytes_streamed", tree_bytes(staged))
     return out
@@ -282,6 +289,23 @@ class WeightCache:
         self.on_evict = on_evict
         self._lock = threading.RLock()
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()  # guarded-by: _lock
+        # Residency-change listeners (observe/sentinel.py re-scores its
+        # sentinel grid when the resident set changes): called with
+        # ("insert" | "evict", model_id), possibly under the cache
+        # lock — listeners must be cheap and must NOT touch the cache.
+        self._listeners: list = []  # guarded-by: _lock
+
+    def add_listener(self, fn: Callable[[str, str], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _notify(self, event: str, model_id: str) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(event, model_id)
+            except Exception:  # noqa: BLE001 — telemetry must never
+                # break residency bookkeeping
+                log.exception("weight cache listener failed")
 
     # -- gauges --------------------------------------------------------------
 
@@ -327,6 +351,7 @@ class WeightCache:
                 self._evict_until(self.budget_bytes - nbytes, model_id)
             self._entries[model_id] = _Entry(params, nbytes)
             self._gauge()
+            self._notify("insert", model_id)
 
     def _evict_until(self, budget_left: int, incoming: str) -> None:  # guarded-by: _lock
         used = sum(e.nbytes for e in self._entries.values())
@@ -342,6 +367,7 @@ class WeightCache:
                 self.stats.count("evictions")
             if self.on_evict is not None:
                 self.on_evict(mid)
+            self._notify("evict", mid)
             log.info("weight cache: evicted %s (%.2f GB) for %s",
                      mid, e.nbytes / 2**30, incoming)
             if used <= budget_left:
@@ -367,6 +393,7 @@ class WeightCache:
                 self.stats.count("evictions")
             if self.on_evict is not None:
                 self.on_evict(model_id)
+            self._notify("evict", model_id)
             self._gauge()
 
     # -- reference discipline ------------------------------------------------

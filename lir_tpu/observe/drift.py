@@ -1,0 +1,209 @@
+"""Per-window κ/CI/mean drift over the windowed accumulator lattice.
+
+The observatory's question is never "what is κ" — PR 10 already answers
+that per request — but "did κ MOVE": is this window's agreement,
+per-model mean relative probability, or valid fraction outside what the
+previous windows establish as normal. Three pieces:
+
+- :func:`window_reduce` — ONE jitted device reduction over a window's
+  live lattice (engine/stream_stats.WindowedStreamSink.device_acc):
+  per-row (model) valid counts, means, and 2.5/97.5 percentiles, plus
+  per-column (sentinel occurrence) contingency counts — the κ
+  sufficient statistic. One ``device_get`` of a few small vectors per
+  window finalize; the (R, C) lattice itself never crosses to the host
+  on the drift path.
+- :func:`window_summary` — the queryable per-window record: fleet κ
+  through ``stats/streaming.kappa_from_counts`` (the SAME
+  ``within_group_kappa`` code path every other κ in this framework
+  runs, so per-window κ is bitwise what offline analysis computes on
+  those decisions), per-model mean/CI/valid-fraction, and the raw
+  (n_g, s_g) counts for re-derivation.
+- :func:`detect_drift` — σ-threshold comparison of the newest window
+  against the baseline of prior windows: |x − mean| > σ · max(std,
+  floor) on fleet κ, per-model mean relative probability, and
+  per-model valid fraction (a NaN-injected model shows up as a
+  valid-fraction collapse, not a silent NaN mean). At most ONE alert
+  per window, carrying every triggered metric — "model X dropped 3σ in
+  window W" is one record, not a page of them.
+
+Tuning: ``sigma`` trades sensitivity for false alarms (3σ default);
+the floors put a minimum absolute width on the band so a baseline of
+bitwise-identical clean windows (std = 0 — greedy decode is
+deterministic) alerts on real movement, never on float dust
+(DEPLOY.md §1l).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Minimum band half-widths (the std floor inside sigma * max(std,
+# floor)): deterministic clean baselines have std == 0.
+KAPPA_FLOOR = 0.05
+MEAN_FLOOR = 0.02
+VALID_FLOOR = 0.05
+
+
+@functools.partial(jax.jit)
+def _reduce(filled, rel, conf, dec):
+    """Device-side window reduction (no host values consumed; one
+    fused program per lattice shape)."""
+    present = filled > 0
+    valid = present & (dec >= 0)
+    n_folded_row = present.sum(axis=1)
+    n_valid_row = valid.sum(axis=1)
+    rel_ok = present & jnp.isfinite(rel)
+    n_rel_row = rel_ok.sum(axis=1)
+    rel0 = jnp.where(rel_ok, rel, 0.0)
+    mean_rel_row = rel0.sum(axis=1) / jnp.maximum(n_rel_row, 1)
+    conf_ok = present & jnp.isfinite(conf)
+    conf0 = jnp.where(conf_ok, conf, 0.0)
+    mean_conf_row = conf0.sum(axis=1) / jnp.maximum(conf_ok.sum(axis=1), 1)
+    # Percentiles over the row's valid rel values: NaN-masked
+    # nanpercentile (invalid cells are NaN in the lattice already;
+    # unfilled cells are NaN too by construction).
+    masked = jnp.where(rel_ok, rel, jnp.nan)
+    pcts = jnp.nanpercentile(masked, jnp.asarray([2.5, 97.5]), axis=1)
+    # Per-column contingency counts: each column is one scoring of one
+    # sentinel occurrence across every model — the within-group κ
+    # grouping ("do the fleet's models agree on this question").
+    n_valid_col = valid.sum(axis=0)
+    n_yes_col = ((dec == 1) & present).sum(axis=0)
+    return {
+        "n_folded_row": n_folded_row, "n_valid_row": n_valid_row,
+        "n_rel_row": n_rel_row, "mean_rel_row": mean_rel_row,
+        "mean_conf_row": mean_conf_row,
+        "p2_5_row": pcts[0], "p97_5_row": pcts[1],
+        "n_valid_col": n_valid_col, "n_yes_col": n_yes_col,
+    }
+
+
+def window_reduce(acc: Dict[str, jax.Array]) -> Dict[str, np.ndarray]:
+    """Reduce one window's LIVE device lattice; returns small host
+    vectors (the one sanctioned transfer on the drift path)."""
+    out = _reduce(acc["filled"], acc["rel"], acc["conf"], acc["dec"])
+    return {k: np.asarray(v) for k, v in jax.device_get(out).items()}
+
+
+def window_summary(reduced: Dict[str, np.ndarray],
+                   model_ids: Sequence[str], window_id: int,
+                   window_s: Optional[float] = None,
+                   sweeps: int = 0) -> Dict[str, object]:
+    """The per-window history record served by the stats endpoint."""
+    from ..stats import streaming
+
+    used = reduced["n_valid_col"] > 0
+    n_g = reduced["n_valid_col"][used].astype(np.int64)
+    s_g = reduced["n_yes_col"][used].astype(np.int64)
+    if n_g.size:
+        kap = streaming.kappa_from_counts(n_g, s_g)
+    else:
+        kap = {"kappa": float("nan"),
+               "observed_agreement": float("nan"),
+               "expected_agreement": float("nan")}
+    per_model: Dict[str, object] = {}
+    for i, mid in enumerate(model_ids):
+        n_folded = int(reduced["n_folded_row"][i])
+        n_valid = int(reduced["n_valid_row"][i])
+        n_rel = int(reduced["n_rel_row"][i])
+        entry: Dict[str, object] = {
+            "n_folded": n_folded,
+            "n_valid": n_valid,
+            "valid_frac": (n_valid / n_folded) if n_folded else
+                          float("nan"),
+            "mean_relative_prob": (float(reduced["mean_rel_row"][i])
+                                   if n_rel else float("nan")),
+            "mean_weighted_confidence": (
+                float(reduced["mean_conf_row"][i]) if n_folded else
+                float("nan")),
+            "p2_5": float(reduced["p2_5_row"][i]),
+            "p97_5": float(reduced["p97_5_row"][i]),
+        }
+        entry["ci95_width"] = (entry["p97_5"] - entry["p2_5"]
+                               if math.isfinite(entry["p2_5"])
+                               and math.isfinite(entry["p97_5"])
+                               else float("nan"))
+        per_model[mid] = entry
+    out: Dict[str, object] = {
+        "window": int(window_id),
+        "sweeps": int(sweeps),
+        "rows_folded": int(reduced["n_folded_row"].sum()),
+        "kappa": {k: float(v) for k, v in kap.items()},
+        "per_model": per_model,
+        "counts": {"n_g": n_g.tolist(), "s_g": s_g.tolist()},
+    }
+    if window_s is not None:
+        out["t_start_s"] = int(window_id) * float(window_s)
+    return out
+
+
+def _metric_drift(name: str, value: float, baseline: List[float],
+                  sigma: float, floor: float,
+                  model: Optional[str] = None) -> Optional[Dict]:
+    base = [b for b in baseline if b is not None and math.isfinite(b)]
+    if not base:
+        return None
+    mean = float(np.mean(base))
+    std = float(np.std(base))
+    if value is None or not math.isfinite(value):
+        # A metric that WAS finite across the baseline going NaN is
+        # itself drift (every sentinel row for a model quarantined).
+        return {"metric": name, "model": model, "value": None,
+                "baseline_mean": mean, "baseline_std": std,
+                "z": None, "reason": "metric became undefined"}
+    band = sigma * max(std, floor)
+    if abs(value - mean) <= band:
+        return None
+    z = abs(value - mean) / max(std, floor)
+    return {"metric": name, "model": model, "value": float(value),
+            "baseline_mean": mean, "baseline_std": std,
+            "z": round(z, 3),
+            "reason": f"|{value:.4f} - {mean:.4f}| > "
+                      f"{sigma:g} * max(std={std:.4f}, floor={floor:g})"}
+
+
+def detect_drift(history: List[Dict], entry: Dict, sigma: float = 3.0,
+                 min_baseline: int = 2,
+                 kappa_floor: float = KAPPA_FLOOR,
+                 mean_floor: float = MEAN_FLOOR,
+                 valid_floor: float = VALID_FLOOR) -> Optional[Dict]:
+    """Compare one finalized window against the baseline of prior
+    windows; returns ONE alert record (or None). ``history`` holds
+    prior :func:`window_summary` records in window order — entries
+    already flagged drifted are EXCLUDED from the baseline so a real
+    regression does not normalize itself into the band over time."""
+    baseline = [h for h in history if not h.get("drifted")]
+    if len(baseline) < max(int(min_baseline), 1):
+        return None
+    triggered: List[Dict] = []
+    hit = _metric_drift(
+        "kappa", entry["kappa"]["kappa"],
+        [h["kappa"]["kappa"] for h in baseline], sigma, kappa_floor)
+    if hit:
+        triggered.append(hit)
+    for mid in entry.get("per_model", {}):
+        cur = entry["per_model"][mid]
+        base = [h["per_model"].get(mid) for h in baseline]
+        base = [b for b in base if b is not None]
+        for metric, key, floor in (
+                ("mean_relative_prob", "mean_relative_prob", mean_floor),
+                ("valid_frac", "valid_frac", valid_floor)):
+            hit = _metric_drift(metric, cur.get(key),
+                                [b.get(key) for b in base], sigma,
+                                floor, model=mid)
+            if hit:
+                triggered.append(hit)
+    if not triggered:
+        return None
+    return {
+        "window": entry["window"],
+        "sigma": float(sigma),
+        "n_baseline_windows": len(baseline),
+        "metrics": triggered,
+    }

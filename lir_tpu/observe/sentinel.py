@@ -1,0 +1,309 @@
+"""SentinelScheduler: scheduled reliability re-scoring on the fleet
+server.
+
+The paper's three axes are one-shot runs; production wants them as a
+monitored time series. The scheduler owns that loop: a configured
+SENTINEL GRID (a small fixed set of probe questions) is re-scored
+across every fleet model on an interval — and immediately whenever the
+weight cache's resident set changes, because a re-streamed or newly
+loaded model is exactly when silent drift would enter — and each
+sweep's per-model decisions fold into the current time window's
+accumulator lattice (engine/stream_stats.WindowedStreamSink: rows =
+models, cols = sweep-slot x sentinel). When the clock crosses a window
+boundary the closed window finalizes: one on-device reduction
+(observe/drift.window_reduce), a history record with fleet κ (bitwise
+``within_group_kappa``) + per-model mean/CI/valid-fraction, and a
+σ-threshold drift check against the clean-window baseline
+(observe/drift.detect_drift). History and alerts are queryable through
+the serve ``stats`` endpoint while the server keeps serving — the
+observatory is a WORKLOAD on the fleet server, not a separate process,
+so sentinel traffic rides the same queues, batchers, guard boundary,
+and swap accounting as client traffic (sustained mixed load by
+construction).
+
+Thread model: one daemon scheduler thread calls :meth:`tick`; tests
+and the bench drive :meth:`tick` directly with an injected clock (the
+server may keep its real clock — the scheduler only reads its own).
+The weight-cache listener just sets an event; it never touches the
+cache (it runs under the cache lock).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import ObserveConfig
+from ..utils.logging import get_logger
+from . import drift as drift_mod
+from . import tracing
+
+log = get_logger(__name__)
+
+
+class _Slot:
+    """Grid coordinates of one fold row (StreamSink.fold's cell
+    contract: .prompt_idx / .rephrase_idx)."""
+
+    __slots__ = ("prompt_idx", "rephrase_idx")
+
+    def __init__(self, prompt_idx: int, rephrase_idx: int):
+        self.prompt_idx = prompt_idx
+        self.rephrase_idx = rephrase_idx
+
+
+class SentinelScheduler:
+    """Scheduled sentinel sweeps + windowed folding + drift alerts over
+    one :class:`~lir_tpu.serve.server.FleetScoringServer`."""
+
+    def __init__(self, server, sentinels: Sequence,
+                 cfg: Optional[ObserveConfig] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None, result_timeout_s: float = 60.0):
+        assert sentinels, "the sentinel grid must not be empty"
+        self.server = server
+        self.sentinels = list(sentinels)
+        self.cfg = cfg or ObserveConfig()
+        self.clock = clock
+        self.registry = registry
+        self.result_timeout_s = float(result_timeout_s)
+        self.model_ids: List[str] = list(server.model_ids)
+        self._model_idx = {m: i for i, m in enumerate(self.model_ids)}
+        from ..engine import stream_stats as stream_mod
+
+        n_cols = len(self.sentinels) * self.cfg.max_sweeps_per_window
+        self.windows = stream_mod.WindowedStreamSink(
+            n_rows=len(self.model_ids), n_cols=n_cols,
+            guard=True, max_windows=self.cfg.history_windows)
+        self._lock = threading.Lock()
+        self._history: List[Dict] = []   # guarded-by: _lock
+        self._alerts: List[Dict] = []    # guarded-by: _lock
+        self._sweeps_in_window: Dict[int, int] = {}
+        self._finalized: set = set()
+        self._last_sweep_t: Optional[float] = None
+        self._total_sweeps = 0
+        self._skipped_full = 0
+        self._forced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Residency-change trigger: a model streamed in or evicted is
+        # exactly when drift would enter — re-score immediately.
+        cache = getattr(getattr(server, "fleet", None), "cache", None)
+        if cache is not None and hasattr(cache, "add_listener"):
+            cache.add_listener(self._on_weight_event)
+
+    # -- triggers ------------------------------------------------------------
+
+    def _on_weight_event(self, event: str, model_id: str) -> None:
+        # Runs under the weight cache's lock: set-and-return only.
+        self._forced.set()
+
+    def force(self) -> None:
+        """Request an immediate sweep at the next tick."""
+        self._forced.set()
+
+    def window_id(self, now: Optional[float] = None) -> int:
+        t = self.clock() if now is None else now
+        return int(t // self.cfg.sentinel_window_s)
+
+    def due(self, now: Optional[float] = None) -> bool:
+        t = self.clock() if now is None else now
+        if self._forced.is_set() or self._last_sweep_t is None:
+            return True
+        return t - self._last_sweep_t >= self.cfg.sentinel_interval_s
+
+    # -- the sweep -----------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> Optional[Dict]:
+        """One scheduler step: finalize any windows the clock has
+        closed, then sweep if due. Returns the sweep record (or None
+        when nothing was due)."""
+        t = self.clock() if now is None else now
+        self.finalize_closed(t)
+        if not self.due(t):
+            return None
+        self._forced.clear()
+        return self.sweep(t)
+
+    def sweep(self, now: Optional[float] = None) -> Optional[Dict]:
+        """Score the whole sentinel grid across the fleet ONCE and fold
+        the per-model results into the current window's lattice."""
+        t = self.clock() if now is None else now
+        wid = self.window_id(t)
+        slot = self._sweeps_in_window.get(wid, 0)
+        if slot >= self.cfg.max_sweeps_per_window:
+            self._skipped_full += 1
+            log.warning("sentinel sweep skipped: window %d already holds"
+                        " %d sweeps (max_sweeps_per_window)", wid, slot)
+            return None
+        self._last_sweep_t = t
+        self._sweeps_in_window[wid] = slot + 1
+        self._total_sweeps += 1
+        with tracing.span("sentinel/sweep", window=wid, slot=slot):
+            futures = [
+                self.server.submit_fleet(self._request(q, wid, slot, j))
+                for j, q in enumerate(self.sentinels)]
+            results = [f.result(self.result_timeout_s) for f in futures]
+        self._fold(wid, slot, results)
+        if self.registry is not None:
+            self.registry.counter("sentinel_sweeps")
+            self.registry.counter(
+                "sentinel_rows", len(self.sentinels) * len(self.model_ids))
+            self.registry.gauge("observatory_window", wid)
+        return {"window": wid, "slot": slot,
+                "results": [r["per_model"] for r in results]}
+
+    def _request(self, sentinel, wid: int, slot: int, j: int):
+        from ..serve.queue import ServeRequest
+
+        if isinstance(sentinel, ServeRequest):
+            import dataclasses
+
+            return dataclasses.replace(
+                sentinel,
+                request_id=f"sentinel:{wid}:{slot}:{j}")
+        raise TypeError(f"sentinel {j} is not a ServeRequest: "
+                        f"{type(sentinel).__name__}")
+
+    def _fold(self, wid: int, slot: int, results: List[Dict]) -> None:
+        """One fused fold of the sweep's fleet decisions into the
+        window lattice. Invalid per-model rows (quarantined, errored,
+        missing probs) fold as NaN and are excluded by the device guard
+        — exactly how the single-window sink treats them."""
+        import jax.numpy as jnp
+
+        n_m, n_s = len(self.model_ids), len(self.sentinels)
+        B = n_m * n_s
+        yes = np.full(B, np.nan, np.float32)
+        no = np.full(B, np.nan, np.float32)
+        wconf = np.full(B, np.nan, np.float32)
+        cells: List[_Slot] = []
+        k = 0
+        for j, res in enumerate(results):
+            per_model = res.get("per_model", {})
+            for mid in self.model_ids:
+                row = per_model.get(mid, {})
+                if row.get("status") == "ok":
+                    t1, t2 = row.get("token_1_prob"), row.get(
+                        "token_2_prob")
+                    wc = row.get("weighted_confidence")
+                    if t1 is not None and t2 is not None:
+                        yes[k], no[k] = t1, t2
+                    if wc is not None:
+                        wconf[k] = wc
+                cells.append(_Slot(self._model_idx[mid],
+                                   slot * n_s + j))
+                k += 1
+        lp = np.zeros((B, 1), np.float32)   # no top-K map for sentinels
+        self.windows.fold(wid, jnp.asarray(yes), jnp.asarray(no),
+                          jnp.asarray(wconf), jnp.asarray(lp), cells,
+                          topk=1)
+
+    # -- window finalize + drift ---------------------------------------------
+
+    def finalize_closed(self, now: Optional[float] = None) -> List[Dict]:
+        """Finalize every folded window strictly OLDER than the current
+        one: device reduce → history record → drift check. Idempotent —
+        already-finalized windows are skipped."""
+        t = self.clock() if now is None else now
+        current = self.window_id(t)
+        out = []
+        for wid in self.windows.window_ids():
+            if wid >= current or wid in self._finalized:
+                continue
+            out.append(self._finalize(wid))
+        return out
+
+    def finalize_all(self) -> List[Dict]:
+        """Finalize everything folded (shutdown / end-of-run path)."""
+        return [self._finalize(wid)
+                for wid in self.windows.window_ids()
+                if wid not in self._finalized]
+
+    def _finalize(self, wid: int) -> Dict:
+        reduced = drift_mod.window_reduce(self.windows.device_acc(wid))
+        entry = drift_mod.window_summary(
+            reduced, self.model_ids, wid,
+            window_s=self.cfg.sentinel_window_s,
+            sweeps=self._sweeps_in_window.get(wid, 0))
+        with self._lock:
+            alert = drift_mod.detect_drift(
+                self._history, entry, sigma=self.cfg.drift_sigma,
+                min_baseline=self.cfg.drift_min_windows)
+            if alert is not None:
+                entry["drifted"] = True
+                self._alerts.append(alert)
+            self._history.append(entry)
+        self._finalized.add(wid)
+        if alert is not None:
+            if self.registry is not None:
+                self.registry.counter("drift_alerts")
+            log.warning("DRIFT ALERT window %d: %s", wid,
+                        [f"{m['metric']}"
+                         + (f"[{m['model']}]" if m.get("model") else "")
+                         for m in alert["metrics"]])
+        return entry
+
+    # -- queries (the stats endpoint) ----------------------------------------
+
+    def history(self) -> List[Dict]:
+        with self._lock:
+            return list(self._history)
+
+    def alerts(self) -> List[Dict]:
+        with self._lock:
+            return list(self._alerts)
+
+    def summary(self) -> Dict[str, object]:
+        """The observatory block of the serve ``stats`` endpoint."""
+        with self._lock:
+            history = list(self._history)
+            alerts = list(self._alerts)
+        return {
+            "models": list(self.model_ids),
+            "n_sentinels": len(self.sentinels),
+            "interval_s": self.cfg.sentinel_interval_s,
+            "window_s": self.cfg.sentinel_window_s,
+            "sigma": self.cfg.drift_sigma,
+            "sweeps": self._total_sweeps,
+            "sweeps_skipped_window_full": self._skipped_full,
+            "open_windows": [w for w in self.windows.window_ids()
+                             if w not in self._finalized],
+            "windows": history,
+            "alerts": alerts,
+        }
+
+    # -- the scheduler thread ------------------------------------------------
+
+    def start(self) -> "SentinelScheduler":
+        assert self._thread is None, "scheduler already started"
+        self._thread = threading.Thread(target=self._loop,
+                                        name="sentinel-scheduler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = None,
+             finalize: bool = True) -> None:
+        self._stop.set()
+        self._forced.set()       # wake the loop promptly
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        if finalize:
+            self.finalize_all()
+
+    def _loop(self) -> None:
+        poll = min(max(self.cfg.sentinel_interval_s / 4, 0.05), 1.0)
+        while not self._stop.is_set():
+            self._forced.wait(poll)
+            if self._stop.is_set():
+                return
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the observatory must
+                # never take the serving loop down with it
+                log.exception("sentinel sweep failed; continuing")

@@ -1,0 +1,181 @@
+"""Per-request structured trace spans + Chrome/Perfetto export.
+
+Before this module the codebase had exactly one ``jax.profiler``
+annotation (a per-model wrapper in engine/multi.py) and no host-side
+span record at all: a slow request could not be decomposed into queue
+wait vs batch formation vs device time after the fact. This is the
+one tracing seam every layer now threads through:
+
+- :func:`span` — context manager recording a completed host span into
+  the process recorder AND wrapping ``jax.profiler.TraceAnnotation``,
+  so the same names show up inside captured device traces
+  (TensorBoard/Perfetto) for correlation. With no recorder installed
+  the cost is one TraceAnnotation (nanoseconds when no profiler is
+  active) — hot paths keep their spans unconditionally.
+- :func:`add_span` — record a span from explicit begin/end timestamps
+  (``time.monotonic`` domain — the serve clock), for spans whose start
+  predates the code that observes them (queue wait: submit → dispatch).
+- :class:`TraceRecorder` — bounded ring of span events (oldest dropped,
+  drops counted) with :meth:`~TraceRecorder.export_chrome` producing
+  the Chrome trace-event JSON (``{"traceEvents": [...]}``) that
+  chrome://tracing and Perfetto load directly; ``--trace-out`` on the
+  serve/perturb CLIs writes it at exit.
+
+Span naming convention: ``layer/stage`` (``serve/dispatch``,
+``sweep/drain``, ``fleet/weight_swap``, ``weights/stream``,
+``stream/fold``) with request/model identity in ``args`` — the
+lifecycle of one request is the filter ``args.request_id == X``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional
+
+DEFAULT_CAPACITY = 65536
+
+
+class TraceRecorder:
+    """Bounded in-memory span ring. Thread-safe — every serving and
+    sweep thread appends concurrently; export snapshots under the lock.
+
+    Timestamps are ``time.monotonic`` seconds (the serve clock domain);
+    export rebases them onto the recorder's construction time so traces
+    start near zero.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = max(int(capacity), 1)
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
+        self._t0 = time.monotonic()
+
+    def add(self, name: str, t0: float, t1: float, cat: str = "host",
+            args: Optional[Dict] = None) -> None:
+        ev = {"name": str(name), "cat": str(cat), "t0": float(t0),
+              "t1": float(t1),
+              "thread": threading.current_thread().name}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self._dropped += 1
+            self._events.append(ev)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+    def summary(self) -> Dict[str, object]:
+        """Registry-facing counters (the recorder is itself a metrics
+        source: span volume and ring pressure are operator signals)."""
+        with self._lock:
+            n = len(self._events)
+            names: Dict[str, int] = {}
+            for ev in self._events:
+                names[ev["name"]] = names.get(ev["name"], 0) + 1
+            return {"spans": n, "dropped": self._dropped,
+                    "capacity": self.capacity,
+                    "per_name": dict(sorted(names.items()))}
+
+    # -- Chrome trace-event export -------------------------------------------
+
+    def export_chrome(self, path: Optional[Path] = None) -> Dict:
+        """The Chrome trace-event JSON (``ph: "X"`` complete events, µs
+        timestamps, one tid per recording thread with ``thread_name``
+        metadata). Loads directly in chrome://tracing and Perfetto;
+        device traces captured with ``jax.profiler`` carry the SAME
+        span names via TraceAnnotation, so host and device views line
+        up by name."""
+        events = self.events()
+        tids: Dict[str, int] = {}
+        trace_events: List[Dict] = []
+        for ev in events:
+            tid = tids.setdefault(ev["thread"], len(tids) + 1)
+            rec = {
+                "name": ev["name"], "cat": ev["cat"], "ph": "X",
+                "ts": (ev["t0"] - self._t0) * 1e6,
+                "dur": max(ev["t1"] - ev["t0"], 0.0) * 1e6,
+                "pid": 1, "tid": tid,
+            }
+            if "args" in ev:
+                rec["args"] = ev["args"]
+            trace_events.append(rec)
+        for name, tid in tids.items():
+            trace_events.append({
+                "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                "args": {"name": name}})
+        out = {"traceEvents": trace_events, "displayTimeUnit": "ms",
+               "otherData": {"dropped_spans": self.dropped}}
+        if path is not None:
+            Path(path).write_text(json.dumps(out), encoding="utf-8")
+        return out
+
+
+# Process-wide recorder. None (the default) keeps spans at
+# TraceAnnotation-only cost; the CLI installs one under --trace-out,
+# the bench's observatory mode and tests install their own.
+_RECORDER: Optional[TraceRecorder] = None
+
+
+def set_recorder(rec: Optional[TraceRecorder]) -> Optional[TraceRecorder]:
+    """Install (or clear, with None) the process recorder; returns the
+    previous one so tests can restore it."""
+    global _RECORDER
+    prev, _RECORDER = _RECORDER, rec
+    return prev
+
+
+def get_recorder() -> Optional[TraceRecorder]:
+    return _RECORDER
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "host", **args) -> Iterator[None]:
+    """Named span around a block: recorded host-side when a recorder is
+    installed, and ALWAYS annotated into device traces
+    (``jax.profiler.TraceAnnotation`` — effectively free when no device
+    profiler is capturing)."""
+    import jax
+
+    rec = _RECORDER
+    with jax.profiler.TraceAnnotation(name):
+        if rec is None:
+            yield
+            return
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            rec.add(name, t0, time.monotonic(), cat, args or None)
+
+
+def add_span(name: str, t0: float, t1: float, cat: str = "host",
+             **args) -> None:
+    """Record a completed span from explicit ``time.monotonic``
+    begin/end stamps (queue-wait spans start at submit time, long
+    before the dispatch path observes them). No-op without a
+    recorder."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.add(name, t0, t1, cat, args or None)

@@ -50,6 +50,7 @@ from ..engine import score as score_mod
 from ..engine import tokens as tok
 from ..engine.runner import _tail_batch
 from ..engine.sweep import _decode_complete, _parse_confidence
+from ..observe import tracing
 from ..utils.profiling import ServeStats
 from .queue import (STATUS_EXPIRED, Pending, ServeResult)
 
@@ -134,6 +135,9 @@ class ContinuousBatcher:
         NONEMPTY next bucket when the price model favors it (there must
         be work there to ride — unlike the offline planner, the online
         queue can't assume more same-bucket work is coming)."""
+        import time as _time
+
+        t_form = _time.monotonic()
         while True:
             ripe = [edge for edge, q in self._queues.items() if q
                     and (flush or len(q) >= self.batch
@@ -179,6 +183,11 @@ class ContinuousBatcher:
                     continue
                 rows.append(p)
             if rows:
+                # Batch-formation span only when a dispatch actually
+                # formed (the idle-poll None path must stay silent).
+                tracing.add_span("serve/batch_form", t_form,
+                                 _time.monotonic(), bucket=int(edge),
+                                 rows=len(rows))
                 return edge, rows
             # every candidate row expired — re-scan the other buckets
 
@@ -216,21 +225,24 @@ class ContinuousBatcher:
         lb = max(max(len(p.conf_ids) - p.lcp for p in full), 1)
         ba = tok.pick_bucket([la], sched_mod.SUFFIX_BUCKETS)
         bb = tok.pick_bucket([lb], sched_mod.SUFFIX_BUCKETS)
-        fused, cfused = engine.decode_fused_shared(
-            [p.request.binary_prompt for p in full],
-            [p.request.confidence_prompt for p in full],
-            t1, t2, new_tokens=self.new_tokens,
-            conf_tokens=self.conf_tokens, early_stop=self.early_stop,
-            pretokenized_a=[list(p.bin_ids) for p in full],
-            pretokenized_b=[list(p.conf_ids) for p in full],
-            bucket=bucket, sfx_buckets_ab=(ba, bb), reuse_cache=True,
-            use_prefix_cache=self.prefix_cache, n_real=n)
-        res = score_mod.readout_from_fused(
-            fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
-        res_h, lp_vals, lp_ids, gen_host = jax.device_get(
-            (res, fused.topk_logprobs, fused.topk_ids, fused.generated))
-        wconf, cgen_host = jax.device_get(
-            (cfused.weighted_confidence, cfused.generated))
+        with tracing.span("serve/dispatch", bucket=int(bucket), rows=n):
+            fused, cfused = engine.decode_fused_shared(
+                [p.request.binary_prompt for p in full],
+                [p.request.confidence_prompt for p in full],
+                t1, t2, new_tokens=self.new_tokens,
+                conf_tokens=self.conf_tokens, early_stop=self.early_stop,
+                pretokenized_a=[list(p.bin_ids) for p in full],
+                pretokenized_b=[list(p.conf_ids) for p in full],
+                bucket=bucket, sfx_buckets_ab=(ba, bb), reuse_cache=True,
+                use_prefix_cache=self.prefix_cache, n_real=n)
+            res = score_mod.readout_from_fused(
+                fused, jnp.asarray(t1), jnp.asarray(t2), scan_positions=1)
+        with tracing.span("serve/readout", bucket=int(bucket), rows=n):
+            res_h, lp_vals, lp_ids, gen_host = jax.device_get(
+                (res, fused.topk_logprobs, fused.topk_ids,
+                 fused.generated))
+            wconf, cgen_host = jax.device_get(
+                (cfused.weighted_confidence, cfused.generated))
         payloads: List[Dict] = []
         for j in range(n):
             conf_text = engine.decode_completion(cgen_host[j])

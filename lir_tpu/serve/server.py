@@ -49,6 +49,8 @@ from ..engine import stream_stats
 from ..engine import tokens as tok
 from ..faults import CLOSED, HALF_OPEN, CircuitBreaker, degrade_dispatch
 from ..guard import numerics
+from ..observe import registry as metrics_mod
+from ..observe import tracing
 from ..utils.logging import get_logger
 from ..utils.manifest import atomic_write_json
 from ..utils.profiling import FaultStats, ServeStats
@@ -113,6 +115,19 @@ class ScoringServer:
             failure_threshold=self.config.max_consecutive_failures,
             cooldown_s=self.config.breaker_cooldown_s,
             clock=clock, stats=self.faults)
+        # Unified telemetry spine (lir_tpu/observe): every stats object
+        # this server touches registers into ONE MetricsRegistry, read
+        # live by the {"op": "metrics"} JSONL endpoint and logged at
+        # exit. The snapshot carries the per-device HBM gauges too, so
+        # memory pressure is observable before anything OOMs.
+        self.metrics = metrics_mod.MetricsRegistry()
+        self.metrics.register("serve", self.stats)
+        self.metrics.register("serve_faults", self.faults)
+        metrics_mod.engine_registry(engine, sink=self.stream,
+                                    registry=self.metrics)
+        rec = tracing.get_recorder()
+        if rec is not None:
+            self.metrics.register("trace", rec)
         self._engine_key = engine.cache_manifest_key
         # Target-token memo: written from EVERY submitter thread (submit
         # runs client-side), so its mutations take a dedicated lock —
@@ -169,6 +184,10 @@ class ScoringServer:
         ServeResult (possibly immediately: dedup hit, shed, breaker
         open). Tokenization runs here on the caller's thread, keeping
         the supervisor loop on the device's critical path only."""
+        with tracing.span("serve/admit", request_id=request.request_id):
+            return self._submit(request)
+
+    def _submit(self, request: ServeRequest) -> ServeFuture:
         self.stats.count("submitted")
         fut = ServeFuture()
         now = self.clock()
@@ -350,6 +369,14 @@ class ScoringServer:
         else:
             dispatch_call = call
 
+        # Per-request queue-wait spans: the slice of each row's life
+        # between admission and this dispatch forming (t_submit is in
+        # the recorder's time.monotonic domain — the serve clock).
+        now0 = self.clock()
+        for p in rows:
+            tracing.add_span("serve/queue_wait", p.t_submit, now0,
+                             request_id=p.request.request_id,
+                             bucket=int(bucket))
         self._inflight = list(rows)
         try:
             try:
@@ -369,8 +396,9 @@ class ScoringServer:
                 self.faults.count("recovered_dispatches")
             self.breaker.record_success()
             now = self.clock()
-            for p, payload in zip(rows, payloads):
-                self._resolve_payload(p, payload, now)
+            with tracing.span("serve/resolve", rows=len(rows)):
+                for p, payload in zip(rows, payloads):
+                    self._resolve_payload(p, payload, now)
         finally:
             self._inflight = []
 
@@ -657,6 +685,26 @@ class FleetScoringServer:
                                     pad_full=self.config.pad_full)
         for mid in fleet.model_ids:
             fleet.engine(mid).fresh_handoff()
+        # Unified telemetry spine: the serve counters, the fleet's swap
+        # accounting, and every member engine's guard/compile/fault
+        # stats in ONE registry ({"op": "metrics"} reads it live).
+        self.metrics = metrics_mod.MetricsRegistry()
+        self.metrics.register("serve", self.stats)
+        self.metrics.register("fleet", fleet.stats)
+        for mid in fleet.model_ids:
+            eng = fleet.engine(mid)
+            if eng is not None:
+                self.metrics.register(f"model:{mid}:guard",
+                                      eng.guard_stats)
+                self.metrics.register(f"model:{mid}:compile",
+                                      eng.compile_stats)
+        rec = tracing.get_recorder()
+        if rec is not None:
+            self.metrics.register("trace", rec)
+        # Reliability observatory (observe/sentinel.SentinelScheduler):
+        # attached by the CLI/bench when a sentinel grid is configured;
+        # the stats endpoint then serves its window history + alerts.
+        self.observatory = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -670,6 +718,12 @@ class FleetScoringServer:
         """Admit one request routed to ONE fleet model. Tokenization
         runs here with THAT model's tokenizer (per-model vocabularies —
         the reason the fleet layer is model-id-aware all the way down)."""
+        with tracing.span("serve/admit", request_id=request.request_id,
+                          model=model_id):
+            return self._submit(request, model_id)
+
+    def _submit(self, request: ServeRequest, model_id: str
+                ) -> ServeFuture:
         self.stats.count("submitted")
         engine = self.fleet.engine(model_id)
         assert engine is not None, f"unknown fleet model {model_id}"
@@ -741,8 +795,31 @@ class FleetScoringServer:
                 continue
             self._dispatch(*d)
 
+    def attach_observatory(self, scheduler) -> None:
+        """Install a SentinelScheduler (observe/sentinel.py): its window
+        history and drift alerts ride the ``stats`` endpoint, and its
+        sweep/alert counters land in this server's metrics registry."""
+        self.observatory = scheduler
+        if scheduler.registry is None:
+            scheduler.registry = self.metrics
+
+    def stats_summary(self) -> Dict:
+        """The fleet ``stats`` endpoint payload: serve counters, fleet
+        swap accounting, and — when the observatory is attached — the
+        windowed drift history and alerts."""
+        out = {"serve": self.stats.summary(),
+               "fleet": self.fleet.stats.summary()}
+        if self.observatory is not None:
+            out["observatory"] = self.observatory.summary()
+        return out
+
     def _dispatch(self, model_id: str, bucket: int, rows) -> None:
         engine = self.fleet.engine(model_id)
+        now0 = self.clock()
+        for p in rows:
+            tracing.add_span("serve/queue_wait", p.t_submit, now0,
+                             request_id=p.request.request_id,
+                             model=model_id, bucket=int(bucket))
         try:
             payloads = retry_with_exponential_backoff(
                 lambda: self.batcher.score(model_id, bucket, rows),
@@ -763,6 +840,12 @@ class FleetScoringServer:
                     latency_s=now - p.t_submit))
             return
         now = self.clock()
+        with tracing.span("serve/resolve", model=model_id,
+                          rows=len(rows)):
+            self._resolve_rows(engine, model_id, rows, payloads, now)
+
+    def _resolve_rows(self, engine, model_id: str, rows, payloads,
+                      now: float) -> None:
         for p, payload in zip(rows, payloads):
             reason = None
             if engine.rt.numerics_guard:

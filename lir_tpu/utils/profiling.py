@@ -7,10 +7,16 @@ The TPU-native replacements:
 
   - ThroughputMeter: prompts/sec/chip — the BASELINE.json headline metric —
     computed from the same counters the cost table consumed.
-  - trace(): jax.profiler trace annotation around the sharded forward, so
-    sweeps show up named in TensorBoard/Perfetto traces.
   - device_memory_stats(): per-device HBM usage, replacing the reference's
-    psutil/cuda telemetry prints.
+    psutil/cuda telemetry prints (surfaced as gauges in the observe
+    metrics snapshot).
+
+Every ``*Stats`` dataclass here registers into ONE MetricsRegistry
+(lir_tpu/observe/registry.py) whose STATS_SCHEMA must list every public
+field — enforced statically by the ``metrics-drift`` lint pass, so a
+new counter that never reaches the metrics endpoint fails review.
+Trace annotations moved to lir_tpu/observe/tracing.py (structured spans
++ Chrome export, same TraceAnnotation correlation).
 """
 
 from __future__ import annotations
@@ -923,13 +929,6 @@ def scoring_step_flops(cfg, batch: int, seq: int, new_tokens: int) -> float:
     See :func:`scoring_step_flops_split` for the per-phase breakdown."""
     return float(sum(scoring_step_flops_split(
         cfg, batch, seq, new_tokens).values()))
-
-
-@contextlib.contextmanager
-def trace(name: str) -> Iterator[None]:
-    """Named jax.profiler annotation (visible in captured device traces)."""
-    with jax.profiler.TraceAnnotation(name):
-        yield
 
 
 def device_memory_stats() -> Dict[str, Dict[str, float]]:

@@ -1,0 +1,3 @@
+STATS_SCHEMA = {
+    "FooStats": ("hits", "evictions"),   # "evictions" is stale
+}

@@ -1,0 +1,16 @@
+"""Seeded metrics-drift violations: a field missing from the schema, a
+class missing entirely, and a stale schema entry (see registry.py)."""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class FooStats:
+    hits: int = 0
+    misses: int = 0          # missing from STATS_SCHEMA["FooStats"]
+    _private: int = 0        # underscore: owes nothing to the endpoint
+
+
+@dataclasses.dataclass
+class OrphanStats:           # no STATS_SCHEMA entry at all
+    count: int = 0

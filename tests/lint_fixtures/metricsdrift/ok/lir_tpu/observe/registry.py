@@ -1,0 +1,4 @@
+STATS_SCHEMA = {
+    "FooStats": ("hits", "misses"),
+    "BarStats": ("count",),
+}

@@ -1,0 +1,15 @@
+"""Clean twin: every public field declared, no stale entries."""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class FooStats:
+    hits: int = 0
+    misses: int = 0
+    _private: int = 0
+
+
+@dataclasses.dataclass
+class BarStats:
+    count: int = 0

@@ -230,10 +230,10 @@ class TestRealTree:
             f.render() for f in new)
         assert elapsed < 10.0, f"lint budget blown: {elapsed:.1f}s"
 
-    def test_all_five_passes_registered(self):
+    def test_all_six_passes_registered(self):
         assert set(ALL_PASSES) == {"donation-safety", "trace-hazard",
                                    "host-sync", "lock-discipline",
-                                   "config-drift"}
+                                   "config-drift", "metrics-drift"}
 
     def test_annotated_lock_state_is_covered(self):
         """The satellite annotations are live: the lock pass sees the

@@ -1,0 +1,526 @@
+"""Reliability observatory + unified telemetry spine (lir_tpu/observe
++ engine/stream_stats.WindowedStreamSink + lint/metricsdrift).
+
+Pins the ISSUE-11 contracts:
+
+- the windowed accumulator lattice preserves EVERY single-window
+  property per window: a single-window fold is bitwise the plain
+  StreamSink, re-folds are idempotent, kill → checkpoint → resume →
+  re-fold converges bitwise on the uninterrupted run, disjoint-shard
+  window merges are order-free unions with overlap a hard error;
+- the sentinel scheduler: clean windows raise zero alerts, a seeded
+  fault-plan NaN injection on one model raises EXACTLY one alert
+  carrying the drifted window's identity and the injected model,
+  weight-cache residency changes force a sweep, per-window kappa is
+  bitwise the analysis layer's within_group_kappa;
+- the metrics registry: the snapshot JSON round-trips, STATS_SCHEMA
+  covers every public field of every *Stats dataclass (the runtime
+  mirror of the metrics-drift lint pass), and both servers expose a
+  populated registry;
+- tracing: spans record into the ring, export is valid Chrome
+  trace-event JSON, and without a recorder spans are no-ops;
+- the metrics-drift lint pass: seeded violations fire, the clean twin
+  is silent.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import ObserveConfig, RuntimeConfig, ServeConfig
+from lir_tpu.engine import stream_stats as stream_mod
+from lir_tpu.engine.fleet import ModelFleet
+from lir_tpu.engine.runner import ScoringEngine
+from lir_tpu.faults.plan import FaultPlan, SiteSchedule
+from lir_tpu.models import decoder, weights
+from lir_tpu.models.registry import ModelConfig
+from lir_tpu.observe import drift as drift_mod
+from lir_tpu.observe import registry as reg_mod
+from lir_tpu.observe import tracing
+from lir_tpu.observe.sentinel import SentinelScheduler
+from lir_tpu.serve import FleetScoringServer, ScoringServer, ServeRequest
+from lir_tpu.stats import streaming
+from lir_tpu.stats.kappa import within_group_kappa
+
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+
+P, R = 3, 8     # lattice rows/cols for the windowed-sink tests
+
+
+class _Cell:
+    def __init__(self, p, r):
+        self.prompt_idx = p
+        self.rephrase_idx = r
+
+
+def _readouts(rng, n):
+    yes = rng.uniform(0.0, 0.6, n).astype(np.float32)
+    no = rng.uniform(0.0, 0.4, n).astype(np.float32)
+    wc = rng.uniform(0.0, 100.0, n).astype(np.float32)
+    lp = -rng.uniform(0.1, 5.0, (n, 4)).astype(np.float32)
+    return (jnp.asarray(yes), jnp.asarray(no), jnp.asarray(wc),
+            jnp.asarray(lp))
+
+
+def _dispatches(seed=3):
+    """Deterministic fold batches covering the (P, R) grid."""
+    rng = np.random.default_rng(seed)
+    cells = [_Cell(p, r) for p in range(P) for r in range(R)]
+    out = []
+    for start in range(0, len(cells), 4):
+        batch = cells[start:start + 4]
+        out.append((batch, _readouts(rng, len(batch))))
+    return out
+
+
+def _accum_equal(a, b):
+    np.testing.assert_array_equal(a.filled, b.filled)
+    np.testing.assert_array_equal(a.rel, b.rel)
+    np.testing.assert_array_equal(a.conf, b.conf)
+    np.testing.assert_array_equal(a.dec, b.dec)
+
+
+# ---------------------------------------------------------------------------
+# WindowedStreamSink: the time axis preserves the lattice contracts
+# ---------------------------------------------------------------------------
+
+
+class TestWindowedSink:
+    def test_single_window_bitwise_vs_plain_sink(self):
+        plain = stream_mod.StreamSink(P, R, seed=7)
+        windowed = stream_mod.WindowedStreamSink(P, R, seed=7)
+        for batch, (yes, no, wc, lp) in _dispatches():
+            plain.fold(yes, no, wc, lp, batch, topk=4)
+            windowed.fold(0, yes, no, wc, lp, batch, topk=4)
+        _accum_equal(plain.snapshot(), windowed.snapshot(0))
+
+    def test_refold_is_idempotent_per_window(self):
+        w = stream_mod.WindowedStreamSink(P, R)
+        disp = _dispatches()
+        for batch, arrs in disp:
+            w.fold(5, *arrs, batch, topk=4)
+        before = w.snapshot(5)
+        for batch, arrs in disp[:2]:        # re-fold a prefix
+            w.fold(5, *arrs, batch, topk=4)
+        _accum_equal(before, w.snapshot(5))
+
+    def test_checkpoint_resume_rejoins_uninterrupted_bitwise(self, tmp_path):
+        disp = _dispatches()
+        # Uninterrupted: everything folds across two windows.
+        full = stream_mod.WindowedStreamSink(P, R)
+        for i, (batch, arrs) in enumerate(disp):
+            full.fold(i % 2, *arrs, batch, topk=4)
+        # Killed: fold half, checkpoint, resume in a NEW sink, re-fold
+        # the tail (overlapping one dispatch — idempotence absorbs it).
+        a = stream_mod.WindowedStreamSink(P, R)
+        for i, (batch, arrs) in enumerate(disp[:3]):
+            a.fold(i % 2, *arrs, batch, topk=4)
+        a.checkpoint(tmp_path)
+        b = stream_mod.WindowedStreamSink(P, R)
+        assert sorted(b.load(tmp_path)) == sorted(a.window_ids())
+        for i, (batch, arrs) in enumerate(disp):
+            if i >= 2:                      # one-dispatch overlap
+                b.fold(i % 2, *arrs, batch, topk=4)
+        for wid in full.window_ids():
+            _accum_equal(full.snapshot(wid), b.snapshot(wid))
+
+    def test_merge_window_union_and_overlap_error(self):
+        disp = _dispatches()
+        a = stream_mod.WindowedStreamSink(P, R)
+        b = stream_mod.WindowedStreamSink(P, R)
+        for batch, arrs in disp[:3]:
+            a.fold(0, *arrs, batch, topk=4)
+        for batch, arrs in disp[3:]:
+            b.fold(0, *arrs, batch, topk=4)
+        merged = stream_mod.WindowedStreamSink(P, R)
+        merged.merge_window(0, a.snapshot(0))
+        merged.merge_window(0, b.snapshot(0))
+        full = stream_mod.WindowedStreamSink(P, R)
+        for batch, arrs in disp:
+            full.fold(0, *arrs, batch, topk=4)
+        _accum_equal(full.snapshot(0), merged.snapshot(0))
+        with pytest.raises(ValueError, match="overlap"):
+            merged.merge_window(0, a.snapshot(0))
+
+    def test_max_windows_drops_oldest(self):
+        dropped = []
+        w = stream_mod.WindowedStreamSink(
+            P, R, max_windows=2, on_evict=dropped.append)
+        batch, arrs = _dispatches()[0]
+        for wid in (1, 2, 3):
+            w.fold(wid, *arrs, batch, topk=4)
+        assert w.window_ids() == [2, 3]
+        assert dropped == [1]
+
+
+# ---------------------------------------------------------------------------
+# Tracing
+# ---------------------------------------------------------------------------
+
+
+class TestTracing:
+    def _with_recorder(self, capacity=100):
+        rec = tracing.TraceRecorder(capacity=capacity)
+        prev = tracing.set_recorder(rec)
+        return rec, prev
+
+    def test_span_records_and_export_is_valid_chrome_json(self, tmp_path):
+        rec, prev = self._with_recorder()
+        try:
+            with tracing.span("serve/dispatch", bucket=64, rows=3):
+                with tracing.span("serve/readout"):
+                    pass
+            tracing.add_span("serve/queue_wait", 1.0, 2.5,
+                             request_id="r1")
+        finally:
+            tracing.set_recorder(prev)
+        assert len(rec) == 3
+        out_path = tmp_path / "trace.json"
+        doc = rec.export_chrome(out_path)
+        reloaded = json.loads(out_path.read_text())
+        assert reloaded == json.loads(json.dumps(doc))
+        events = [e for e in reloaded["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in events} == {
+            "serve/dispatch", "serve/readout", "serve/queue_wait"}
+        for e in events:
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+            assert e["pid"] == 1 and e["tid"] >= 1
+        qw = next(e for e in events if e["name"] == "serve/queue_wait")
+        assert qw["args"]["request_id"] == "r1"
+        assert abs(qw["dur"] - 1.5e6) < 1.0
+        meta = [e for e in reloaded["traceEvents"] if e["ph"] == "M"]
+        assert meta and all(e["name"] == "thread_name" for e in meta)
+
+    def test_noop_without_recorder(self):
+        assert tracing.get_recorder() is None
+        with tracing.span("sweep/dispatch", rows=1):
+            pass
+        tracing.add_span("serve/queue_wait", 0.0, 1.0)
+        assert tracing.get_recorder() is None
+
+    def test_ring_bounds_and_counts_drops(self):
+        rec, prev = self._with_recorder(capacity=4)
+        try:
+            for i in range(7):
+                tracing.add_span(f"s{i}", 0.0, 1.0)
+        finally:
+            tracing.set_recorder(prev)
+        assert len(rec) == 4 and rec.dropped == 3
+        assert [e["name"] for e in rec.events()] == ["s3", "s4", "s5",
+                                                     "s6"]
+        assert rec.summary()["dropped"] == 3
+
+    def test_spans_from_threads_get_distinct_tids(self):
+        rec, prev = self._with_recorder()
+        try:
+            tracing.add_span("main-span", 0.0, 1.0)
+            t = threading.Thread(
+                target=lambda: tracing.add_span("worker-span", 0.0, 1.0),
+                name="obs-worker")
+            t.start()
+            t.join()
+        finally:
+            tracing.set_recorder(prev)
+        doc = rec.export_chrome()
+        tids = {e["name"]: e["tid"] for e in doc["traceEvents"]
+                if e["ph"] == "X"}
+        assert tids["main-span"] != tids["worker-span"]
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsRegistry:
+    def test_schema_covers_every_public_stats_field(self):
+        """Runtime mirror of the metrics-drift lint pass."""
+        import dataclasses
+
+        from lir_tpu.utils import profiling
+
+        stats_classes = [
+            obj for name, obj in vars(profiling).items()
+            if isinstance(obj, type) and name.endswith("Stats")
+            and dataclasses.is_dataclass(obj)]
+        assert stats_classes, "profiling lost its *Stats classes?"
+        for cls in stats_classes:
+            declared = reg_mod.STATS_SCHEMA.get(cls.__name__)
+            assert declared is not None, cls.__name__
+            public = {f.name for f in dataclasses.fields(cls)
+                      if not f.name.startswith("_")}
+            assert public <= set(declared), (
+                cls.__name__, public - set(declared))
+            assert set(declared) <= public, (
+                "stale schema entries", cls.__name__,
+                set(declared) - public)
+
+    def test_snapshot_roundtrips_with_live_stats(self):
+        from lir_tpu.utils.profiling import FleetStats, ServeStats
+
+        reg = reg_mod.MetricsRegistry()
+        sv, fl = ServeStats(), FleetStats()
+        sv.count("submitted", 3)
+        sv.record_latency(0.5)
+        fl.count("swap_s_hidden", 1.25)
+        reg.register("serve", sv)
+        reg.register("fleet", fl)
+        reg.counter("sentinel_sweeps", 2)
+        reg.gauge("observatory_window", 7)
+        snap = reg.snapshot(device_memory=True)
+        assert json.loads(json.dumps(snap)) == snap
+        assert snap["sources"]["serve"]["fields"]["submitted"] == 3
+        assert snap["sources"]["serve"]["summary"]["submitted"] == 3
+        assert snap["sources"]["fleet"]["fields"]["swap_s_hidden"] == 1.25
+        assert snap["counters"]["sentinel_sweeps"] == 2
+        assert snap["gauges"]["observatory_window"] == 7
+        assert "device_memory" in snap
+
+    def test_nan_gauges_sanitize_to_none(self):
+        reg = reg_mod.MetricsRegistry()
+        reg.gauge("bad", float("nan"))
+        snap = reg.snapshot(device_memory=False)
+        assert snap["gauges"]["bad"] is None
+        json.dumps(snap, allow_nan=False)   # strict JSON survives
+
+
+# ---------------------------------------------------------------------------
+# metrics-drift lint pass
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsDriftLint:
+    def _findings(self, sub):
+        from lir_tpu.lint.core import load_project, run_passes
+
+        return run_passes(load_project(FIXTURES / "metricsdrift" / sub),
+                          only=["metrics-drift"])
+
+    def test_bad_fixture_fires_all_three_ways(self):
+        fs = self._findings("bad")
+        msgs = [f.message for f in fs]
+        assert any("'misses' is missing" in m for m in msgs), msgs
+        assert any("'OrphanStats' has no" in m for m in msgs), msgs
+        assert any("stale schema entry" in m for m in msgs), msgs
+        assert len(fs) == 3
+        # Private fields owe nothing to the endpoint.
+        assert not any("_private" in m for m in msgs)
+
+    def test_ok_fixture_is_clean(self):
+        assert self._findings("ok") == []
+
+
+# ---------------------------------------------------------------------------
+# The observatory: fleet + sentinel scheduler + drift
+# ---------------------------------------------------------------------------
+
+W = 100.0     # window seconds in the scheduler tests
+
+
+def _tiny_cfg(name):
+    return ModelConfig(name=name, vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=32, n_layers=1, n_heads=2,
+                       intermediate_size=64, max_seq_len=256)
+
+
+def _tiny_engine(name, seed):
+    return ScoringEngine(
+        decoder.init_params(_tiny_cfg(name), jax.random.PRNGKey(seed)),
+        _tiny_cfg(name), FakeTokenizer(),
+        RuntimeConfig(batch_size=4, max_seq_len=256))
+
+
+SENTINELS = [
+    ServeRequest(binary_prompt=f"{q} Answer Yes or No.",
+                 confidence_prompt=f"{q} Give a confidence 0-100.",
+                 request_id=f"s{i}")
+    for i, q in enumerate(["Is a cat an animal",
+                           "Is rain considered weather"])]
+
+
+@pytest.fixture()
+def fleet_server():
+    fleet = ModelFleet.from_engines(
+        [(f"m{i}", _tiny_engine(f"m{i}", i)) for i in range(2)])
+    server = FleetScoringServer(fleet,
+                                ServeConfig(linger_s=0.005)).start()
+    yield server
+    server.stop()
+    fleet.shutdown()
+
+
+def _scheduler(server, **cfg_kw):
+    now = {"t": W}
+    cfg_kw.setdefault("sentinel_interval_s", 1.0)
+    cfg_kw.setdefault("sentinel_window_s", W)
+    cfg_kw.setdefault("drift_min_windows", 2)
+    sched = SentinelScheduler(server, SENTINELS,
+                              cfg=ObserveConfig(**cfg_kw),
+                              clock=lambda: now["t"])
+    server.attach_observatory(sched)
+    return sched, now
+
+
+class TestObservatory:
+    def test_clean_windows_no_alerts_kappa_bitwise(self, fleet_server):
+        sched, now = _scheduler(fleet_server)
+        for w in (1, 2, 3):
+            now["t"] = w * W + 1.0
+            rec = sched.tick()
+            assert rec is not None and rec["window"] == w
+        now["t"] = 4 * W + 1.0
+        sched.finalize_closed()
+        obs = sched.summary()
+        assert len(obs["windows"]) == 3
+        assert obs["alerts"] == []
+        # Deterministic greedy decode: identical clean windows.
+        kappas = [w["kappa"]["kappa"] for w in obs["windows"]]
+        assert kappas[0] == kappas[1] == kappas[2]
+        # Per-window kappa bitwise vs the analysis layer on the same
+        # contingency counts.
+        for w in obs["windows"]:
+            decisions, groups = [], []
+            for g, (n, s) in enumerate(zip(w["counts"]["n_g"],
+                                           w["counts"]["s_g"])):
+                decisions += [1] * s + [0] * (n - s)
+                groups += [g] * n
+            ref = within_group_kappa(np.asarray(decisions, int),
+                                     np.asarray(groups, int))
+            assert w["kappa"]["kappa"] == ref["kappa"]
+            assert (w["kappa"]["observed_agreement"]
+                    == ref["observed_agreement"])
+
+    def test_nan_injection_exactly_one_alert_right_window(
+            self, fleet_server):
+        sched, now = _scheduler(fleet_server)
+        for w in (1, 2):
+            now["t"] = w * W + 1.0
+            assert sched.tick() is not None
+        # Fault-plan NaN on model m0's dispatches during window 3: the
+        # numerics guard quarantines its rows, decisions go invalid.
+        plan = FaultPlan(seed=3, schedules={
+            "dispatch": SiteSchedule(rate=1.0, kind="nan",
+                                     nan_rows=(0, 1, 2, 3))})
+        victim = fleet_server.batcher.batchers["m0"]
+        orig = victim.score
+        victim.score = plan.wrap("dispatch", victim.score)
+        try:
+            now["t"] = 3 * W + 1.0
+            assert sched.tick() is not None
+        finally:
+            victim.score = orig
+        now["t"] = 4 * W + 1.0
+        sched.finalize_closed()
+        obs = sched.summary()
+        assert len(obs["alerts"]) == 1
+        alert = obs["alerts"][0]
+        assert alert["window"] == 3
+        assert any(m["metric"] == "valid_frac" and m["model"] == "m0"
+                   for m in alert["metrics"])
+        assert obs["windows"][2]["drifted"] is True
+        assert not obs["windows"][0].get("drifted")
+        assert not obs["windows"][1].get("drifted")
+        assert obs["windows"][2]["per_model"]["m0"]["valid_frac"] == 0.0
+        assert plan.injected("dispatch") > 0
+
+    def test_weight_cache_change_forces_sweep(self, fleet_server):
+        sched, now = _scheduler(fleet_server)
+        now["t"] = W + 1.0
+        assert sched.tick() is not None
+        assert sched.tick() is None        # interval not elapsed
+        # A residency change (listener set by the scheduler) forces the
+        # next tick to sweep regardless of the interval.
+        fleet_server.fleet.cache._notify("evict", "m0")
+        rec = sched.tick()
+        assert rec is not None and rec["slot"] == 1
+
+    def test_window_capacity_skips_loudly(self, fleet_server):
+        sched, now = _scheduler(fleet_server, max_sweeps_per_window=1)
+        now["t"] = W + 1.0
+        assert sched.tick() is not None
+        sched.force()
+        assert sched.tick() is None        # window full: skipped
+        assert sched.summary()["sweeps_skipped_window_full"] == 1
+
+    def test_stats_summary_and_metrics_endpoint(self, fleet_server):
+        sched, now = _scheduler(fleet_server)
+        now["t"] = W + 1.0
+        sched.tick()
+        now["t"] = 2 * W + 1.0
+        sched.tick()
+        sched.finalize_closed()
+        out = fleet_server.stats_summary()
+        assert "serve" in out and "fleet" in out
+        assert len(out["observatory"]["windows"]) == 1
+        snap = fleet_server.metrics.snapshot()
+        assert snap["counters"]["sentinel_sweeps"] == 2
+        assert snap["sources"]["serve"]["fields"]["completed"] > 0
+        assert "model:m0:guard" in snap["sources"]
+        assert json.loads(json.dumps(snap)) == snap
+
+    def test_drift_detect_excludes_drifted_baseline(self):
+        """A drifted window must not normalize into the baseline."""
+        def entry(wid, kappa, drifted=False):
+            e = {"window": wid,
+                 "kappa": {"kappa": kappa},
+                 "per_model": {}}
+            if drifted:
+                e["drifted"] = True
+            return e
+
+        history = [entry(1, 0.8), entry(2, 0.8),
+                   entry(3, 0.0, drifted=True)]
+        alert = drift_mod.detect_drift(history, entry(4, 0.0),
+                                       sigma=3.0, min_baseline=2)
+        assert alert is not None and alert["window"] == 4
+        assert alert["n_baseline_windows"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Single-model server metrics + weight-cache listener unit coverage
+# ---------------------------------------------------------------------------
+
+
+class TestServerTelemetry:
+    def test_scoring_server_registry_sources(self):
+        engine = _tiny_engine("solo", 0)
+        server = ScoringServer(engine, "solo",
+                               ServeConfig(linger_s=0.005)).start()
+        try:
+            fut = server.submit(ServeRequest(
+                binary_prompt="Is a cat an animal Answer Yes or No.",
+                confidence_prompt="Is a cat an animal Confidence 0-100.",
+                request_id="q1"))
+            assert fut.result(30.0).status == "ok"
+        finally:
+            server.stop()
+        snap = server.metrics.snapshot()
+        for name in ("serve", "serve_faults", "guard", "compile",
+                     "faults"):
+            assert name in snap["sources"], name
+        assert snap["sources"]["serve"]["fields"]["completed"] == 1
+        assert snap["sources"]["guard"]["summary"]["checked"] == {
+            "serve": 1}
+
+    def test_weight_cache_listener_fires_on_insert_and_evict(self):
+        events = []
+        p = decoder.init_params(_tiny_cfg("a"), jax.random.PRNGKey(0))
+        nb = weights.tree_bytes(p)
+        wc = weights.WeightCache(budget_bytes=nb + nb // 2)
+        wc.add_listener(lambda ev, mid: events.append((ev, mid)))
+        wc.insert("a", p, nb)
+        wc.insert("b", decoder.init_params(_tiny_cfg("b"),
+                                           jax.random.PRNGKey(1)), nb)
+        assert ("insert", "a") in events
+        assert ("evict", "a") in events
+        assert ("insert", "b") in events

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Observatory smoke: the reliability-observatory + telemetry-spine
+invariants the `make observe-smoke` CI target guards:
+
+- a 2-model fake fleet re-scores a sentinel grid across 3 time
+  windows; windows 1-2 are clean and raise NO alert (deterministic
+  greedy decode -> identical clean windows -> zero false alarms);
+- a seeded fault-plan NaN injection on ONE model's dispatches during
+  window 3 raises EXACTLY ONE drift alert, carrying window 3's
+  identity (the injected model's valid fraction collapses and the
+  alert names it);
+- per-window fleet kappa is BITWISE the analysis layer's
+  within_group_kappa over the same decisions (one contingency code
+  path everywhere);
+- the unified metrics snapshot ({"op": "metrics"} schema) is non-empty
+  for EVERY registered stats source and JSON round-trips.
+
+Runs hermetically on CPU with FakeTokenizer + tiny random decoders;
+prints the observatory summary JSON on success.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_MODELS = 2
+SENTINELS = ["Is a cat an animal", "Is rain considered weather",
+             "Is a contract binding"]
+WINDOW_S = 100.0
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import ObserveConfig, RuntimeConfig, ServeConfig
+    from lir_tpu.engine.fleet import ModelFleet
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.faults.plan import FaultPlan, SiteSchedule
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.observe import SentinelScheduler
+    from lir_tpu.serve import FleetScoringServer, ServeRequest
+    from lir_tpu.stats.kappa import within_group_kappa
+
+    names = [f"org/obs-m{i}" for i in range(N_MODELS)]
+
+    def _cfg(name):
+        return ModelConfig(name=name, vocab_size=FakeTokenizer.VOCAB,
+                           hidden_size=32, n_layers=1, n_heads=2,
+                           intermediate_size=64, max_seq_len=256)
+
+    engines = [
+        (n, ScoringEngine(
+            decoder.init_params(_cfg(n), jax.random.PRNGKey(i)),
+            _cfg(n), FakeTokenizer(),
+            RuntimeConfig(batch_size=4, max_seq_len=256)))
+        for i, n in enumerate(names)]
+    fleet = ModelFleet.from_engines(engines)
+    server = FleetScoringServer(
+        fleet, ServeConfig(linger_s=0.005)).start()
+
+    failures = []
+    now = {"t": WINDOW_S}          # start inside window 1
+    cfg = ObserveConfig(sentinel_interval_s=1.0,
+                        sentinel_window_s=WINDOW_S,
+                        drift_sigma=3.0, drift_min_windows=2)
+    sched = SentinelScheduler(
+        server,
+        [ServeRequest(binary_prompt=f"{q} Answer Yes or No.",
+                      confidence_prompt=f"{q} Give a confidence 0-100.",
+                      request_id=f"s{i}")
+         for i, q in enumerate(SENTINELS)],
+        cfg=cfg, clock=lambda: now["t"])
+    server.attach_observatory(sched)
+
+    # Windows 1 and 2: two clean sweeps each.
+    for w in (1, 2):
+        now["t"] = w * WINDOW_S + 1.0
+        assert sched.tick() is not None
+        now["t"] += 2.0
+        assert sched.tick() is not None
+
+    # Window 3: seeded NaN corruption on model 0's dispatches — the
+    # numerics guard quarantines every row, the model's sentinel
+    # decisions go invalid, valid_frac collapses.
+    plan = FaultPlan(seed=7, schedules={
+        "dispatch": SiteSchedule(rate=1.0, kind="nan",
+                                 nan_rows=(0, 1, 2, 3))})
+    victim = server.batcher.batchers[names[0]]
+    original_score = victim.score
+    victim.score = plan.wrap("dispatch", victim.score)
+    now["t"] = 3 * WINDOW_S + 1.0
+    assert sched.tick() is not None
+    now["t"] += 2.0
+    assert sched.tick() is not None
+    victim.score = original_score
+
+    # Cross into window 4 so window 3 finalizes, then close the books.
+    now["t"] = 4 * WINDOW_S + 1.0
+    sched.finalize_closed()
+    sched.finalize_all()
+    obs = sched.summary()
+
+    if len(obs["windows"]) != 3:
+        failures.append(f"expected 3 finalized windows, got "
+                        f"{len(obs['windows'])}")
+    alerts = obs["alerts"]
+    if len(alerts) != 1:
+        failures.append(f"expected exactly 1 drift alert, got "
+                        f"{len(alerts)}: {alerts}")
+    elif alerts[0]["window"] != 3:
+        failures.append(f"alert names window {alerts[0]['window']}, "
+                        f"expected 3")
+    elif not any(m.get("model") == names[0]
+                 for m in alerts[0]["metrics"]):
+        failures.append(f"alert does not name the injected model: "
+                        f"{alerts[0]['metrics']}")
+    for w in obs["windows"][:2]:
+        if w.get("drifted"):
+            failures.append(f"clean window {w['window']} false-alarmed")
+
+    # Per-window kappa bitwise vs the analysis layer on the same counts.
+    for w in obs["windows"]:
+        n_g = np.asarray(w["counts"]["n_g"], np.int64)
+        s_g = np.asarray(w["counts"]["s_g"], np.int64)
+        decisions, groups = [], []
+        for g, (n, s) in enumerate(zip(n_g, s_g)):
+            decisions += [1] * int(s) + [0] * int(n - s)
+            groups += [g] * int(n)
+        ref = within_group_kappa(np.asarray(decisions, int),
+                                 np.asarray(groups, int))
+        if w["kappa"]["kappa"] != ref["kappa"] and not (
+                np.isnan(w["kappa"]["kappa"])
+                and np.isnan(ref["kappa"])):
+            failures.append(
+                f"window {w['window']} kappa {w['kappa']['kappa']} != "
+                f"within_group_kappa {ref['kappa']}")
+
+    # Metrics snapshot: non-empty fields for every registered source,
+    # and the document survives a strict-JSON round trip.
+    snap = server.metrics.snapshot()
+    if not snap["sources"]:
+        failures.append("metrics snapshot has no sources")
+    for name, src in snap["sources"].items():
+        if not src.get("fields"):
+            failures.append(f"metrics source {name} has empty fields")
+    if json.loads(json.dumps(snap)) != snap:
+        failures.append("metrics snapshot does not JSON round-trip")
+    if snap["counters"].get("sentinel_sweeps") != 6:
+        failures.append(f"expected 6 sentinel_sweeps in the registry, "
+                        f"got {snap['counters'].get('sentinel_sweeps')}")
+
+    server.stop()
+    fleet.shutdown()
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1
+    print("observe smoke OK")
+    print(json.dumps({"windows": len(obs["windows"]),
+                      "alerts": alerts,
+                      "sweeps": obs["sweeps"]}, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
