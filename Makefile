@@ -3,7 +3,8 @@
 # recorded floor (tools/check_tier1.py — the floor lives there).
 
 .PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
-	kernel-smoke stats-smoke fleet-smoke observe-smoke install-hooks
+	kernel-smoke stats-smoke fleet-smoke observe-smoke elastic-smoke \
+	install-hooks
 
 verify: lint
 	python tools/check_tier1.py
@@ -91,6 +92,17 @@ fleet-smoke:
 # (tools/observe_smoke.py).
 observe-smoke:
 	JAX_PLATFORMS=cpu python tools/observe_smoke.py
+
+# Elastic-serving smoke: 3 in-process replicas behind the failover
+# router on the fake backend — a seeded replica_kill mid-run must lose
+# and duplicate ZERO requests (in-flight re-admitted to survivors,
+# zombie payloads dropped by resolve-once + content dedup), the killed
+# replica's breaker must walk open -> half_open -> closed across the
+# rejoin, and a shard lease abandoned by a dead holder must be stolen
+# within one TTL with the stolen shard's lattice merge bitwise-
+# identical (tools/elastic_smoke.py).
+elastic-smoke:
+	JAX_PLATFORMS=cpu python tools/elastic_smoke.py
 
 # Run graft-lint (seconds) then the tier-1 guard before every
 # `git push` — lint first so an invariant break fails in two seconds,

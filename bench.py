@@ -253,6 +253,13 @@ def main() -> None:
                          "bitwise == within_group_kappa, and goodput "
                          ">= 0.95x the off baseline — headline key "
                          "\"observatory\")")
+    ap.add_argument("--no-elastic", action="store_true",
+                    help="skip the elastic-serving mode (3 replica "
+                         "servers behind the failover router, 1 killed "
+                         "mid-run: zero dropped/double-resolved, "
+                         "goodput >= 0.6x after the kill and recovering "
+                         "on rejoin, leased sweep accumulator bitwise "
+                         "vs a static run — headline key \"elastic\")")
     ap.add_argument("--no-streaming-stats", action="store_true",
                     help="skip the streaming-statistics mode (identical "
                          "grid swept twice: device accumulator -> CIs "
@@ -641,6 +648,21 @@ def main() -> None:
                 headline["observatory"] = observatory
         except (Exception, SystemExit) as err:  # noqa: BLE001
             print(f"# observatory bench mode failed ({err!r}); headline "
+                  "is unaffected", file=sys.stderr)
+    # Elastic mode (ROADMAP item 1): 3 replica servers behind the
+    # failover router with 1 killed mid-run — zero requests dropped or
+    # double-resolved, goodput degrades proportionally to the capacity
+    # lost (>= 0.6x of 3-replica goodput) and recovers when the
+    # replica rejoins; plus the leased offline sweep whose kill/steal
+    # resume converges BITWISE on an uninterrupted static-shard run.
+    # Failures never discard the headline.
+    if not args.no_elastic:
+        try:
+            elastic = _elastic_bench(on_accel)
+            if elastic is not None:
+                headline["elastic"] = elastic
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# elastic bench mode failed ({err!r}); headline "
                   "is unaffected", file=sys.stderr)
     # Chaos mode (--chaos): the same serving layer under a seeded
     # transient fault schedule — the robustness cost (recovery work +
@@ -1878,6 +1900,239 @@ def _observatory_bench(on_accel: bool):
         "completed_off": int(off_completed),
         "trace_spans": n_spans,
         "metrics_sources": len(snap["sources"]),
+    }
+
+
+def _elastic_bench(on_accel: bool):
+    """Elastic-serving mode (ROADMAP item 1): the replica-kill chaos
+    proof, online and offline.
+
+    ONLINE — an open-loop fleet trace over 3 config-identical replica
+    servers behind the ReplicaRouter, with replica r1 KILLED mid-run by
+    a seeded ``replica_kill`` schedule (the router observes the death
+    first, then the in-flight dispatch dies — an abrupt host loss) and
+    revived two waves later. Gates asserted before reporting:
+
+    - ZERO requests dropped (every future resolves "ok") and ZERO
+      double-resolved (resolve-once futures + unique ids; the zombie's
+      late payloads are counted and dropped);
+    - goodput after the kill >= 0.6x the 3-replica goodput (capacity
+      fell 1/3; medians over per-wave client time so one scheduler
+      hiccup can't fake a failure) and RECOVERING after the rejoin
+      (>= 0.8x the post-kill goodput — on the CPU smoke the replicas
+      share cores, so the interesting content is the zero-loss
+      accounting; on a real fleet the ratios track capacity);
+    - replica-independence: the same probe scored directly on each
+      replica returns BITWISE-identical payloads (PAPER.md's axis
+      results cannot depend on which replica scored a row).
+
+    OFFLINE — the leased sweep: a static-shard run's accumulator vs a
+    leased run killed mid-sweep, whose expired leases a SECOND holder
+    steals on resume. The merged accumulator must be BITWISE-identical
+    to the uninterrupted static run (idempotent slot folds +
+    identical-overlap union)."""
+    import tempfile
+
+    import numpy as np
+
+    from lir_tpu import faults
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RouterConfig, RuntimeConfig, ServeConfig
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine import lease as lease_mod
+    from lir_tpu.engine import stream_stats as stream_mod
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import ReplicaRouter, ScoringServer, ServeRequest
+
+    n_waves, per_wave, batch = 12, 8, 4
+    mcfg = ModelConfig(name="elastic-bench",
+                       vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=64 if on_accel else 32, n_layers=1,
+                       n_heads=2, intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(mcfg, jax.random.PRNGKey(23))
+    serve_cfg = ServeConfig(queue_depth=256,
+                            classes=(("elastic", 3600.0),),
+                            default_class="elastic", linger_s=0.002)
+
+    def _server():
+        engine = ScoringEngine(params, mcfg, FakeTokenizer(),
+                               RuntimeConfig(batch_size=batch,
+                                             max_seq_len=256))
+        return ScoringServer(engine, "elastic-bench", serve_cfg)
+
+    rng = np.random.default_rng(31)
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement").split()
+
+    def request(w, j):
+        body = (" ".join(rng.choice(words) for _ in range(10))
+                + f" wave {w} q {j} ?")
+        return ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="elastic", request_id=f"w{w}q{j}")
+
+    servers = [_server().start() for _ in range(3)]
+    # Warm every replica through BOTH cache-handoff variants so the
+    # timed waves measure serving, not compiles.
+    for si, s in enumerate(servers):
+        for k in range(2):
+            assert s.submit(request(90 + si, k)).result(600) \
+                .status == "ok"
+    router = ReplicaRouter(
+        [(f"r{i}", s) for i, s in enumerate(servers)],
+        config=RouterConfig(replica_failure_threshold=1,
+                            replica_cooldown_s=0.3,
+                            cache_entries=0)).start()
+    kill_plan = faults.FaultPlan(seed=13, schedules={
+        "replica": faults.SiteSchedule.replica_kill_at(0, "r1")})
+
+    results, wave_s = [], []
+    kill_wave = n_waves // 3          # kill fires INSIDE this wave
+    revive_wave = 2 * n_waves // 3
+    try:
+        for w in range(n_waves):
+            if w == kill_wave:
+                faults.wrap_replica(router, "r1", kill_plan)
+            if w == revive_wave:
+                router.revive_replica("r1")
+                time.sleep(0.35)      # past the breaker cooldown
+            t0 = time.perf_counter()
+            futs = [router.submit(request(w, j))
+                    for j in range(per_wave)]
+            results += [f.result(600) for f in futs]
+            wave_s.append(time.perf_counter() - t0)
+        # Replica-independence: one probe through each replica
+        # directly, payloads bitwise-equal.
+        probe = request(80, 0)
+        fields = ("model_response", "model_confidence_response",
+                  "token_1_prob", "token_2_prob", "log_probabilities",
+                  "confidence_value", "weighted_confidence")
+        direct = []
+        for s in servers:
+            r = s.submit(probe).result(600)
+            assert r.status == "ok", r.status
+            direct.append(tuple(getattr(r, f) for f in fields))
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+    assert kill_plan.injected("replica") == 1, "replica_kill never fired"
+    assert all(r.status == "ok" for r in results), (
+        f"dropped requests: "
+        f"{[r.status for r in results if r.status != 'ok'][:4]}")
+    ids = [r.request_id for r in results]
+    assert len(set(ids)) == len(ids) == n_waves * per_wave, (
+        "requests dropped or double-resolved")
+    assert router.stats.completed == n_waves * per_wave
+    assert direct[0] == direct[1] == direct[2], (
+        "replicas are not result-identical")
+
+    med = lambda xs: float(np.median(xs))  # noqa: E731
+    g_before = per_wave / med(wave_s[:kill_wave])
+    g_after = per_wave / med(wave_s[kill_wave:revive_wave])
+    g_recovered = per_wave / med(wave_s[revive_wave:])
+    assert g_after >= 0.6 * g_before, (
+        f"goodput after the kill {g_after:.2f} < 0.6x the 3-replica "
+        f"{g_before:.2f}")
+    assert g_recovered >= 0.8 * g_after, (
+        f"goodput did not recover after the rejoin: {g_recovered:.2f} "
+        f"vs post-kill {g_after:.2f}")
+
+    # -- offline: leased sweep, kill + steal, accumulator bitwise -------------
+    sweep_cells = 10
+    rng2 = np.random.default_rng(37)
+
+    def _text(n):
+        return " ".join(rng2.choice(words) for _ in range(n)) + " ?"
+
+    lp = (LegalPrompt(main=_text(10),
+                      response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Give a number from 0 to 100 ."),)
+    perts = ([_text(10 if i % 2 else 20)
+              for i in range(sweep_cells - 1)],)
+
+    def _sweep_engine(lease: bool):
+        return ScoringEngine(
+            params, mcfg, FakeTokenizer(),
+            RuntimeConfig(batch_size=batch, max_seq_len=256,
+                          piggyback_prefill=False, lease_shards=lease,
+                          lease_ttl_s=0.05, lease_cells_per_shard=3))
+
+    lease_bitwise = False
+    steals = 0
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        run_perturbation_sweep(_sweep_engine(False), "elastic", lp,
+                               perts, td / "static.csv",
+                               checkpoint_every=4)
+        acc_static = stream_mod.load_accum(
+            (td / "static.csv").with_suffix(stream_mod.ACCUM_SUFFIX))
+        engine = _sweep_engine(True)
+        plan = faults.FaultPlan(seed=9, schedules={
+            "dispatch": faults.SiteSchedule.kill_at(1)})
+        faults.wrap_engine(engine, plan)
+        out = td / "leased.csv"
+        try:
+            run_perturbation_sweep(engine, "elastic", lp, perts, out,
+                                   checkpoint_every=4)
+            raise AssertionError("scheduled kill never fired")
+        except faults.InjectedPreemption:
+            pass
+        time.sleep(0.06)              # the dead holder's leases expire
+        saved_idx = jax.process_index
+        jax.process_index = lambda: 1   # the stealing holder
+        try:
+            run_perturbation_sweep(_sweep_engine(True), "elastic", lp,
+                                   perts, out, checkpoint_every=4)
+        finally:
+            jax.process_index = saved_idx
+        acc = stream_mod.load_accum(
+            out.with_suffix(stream_mod.ACCUM_SUFFIX))
+        lease_bitwise = (
+            acc is not None and acc_static is not None
+            and np.array_equal(acc_static.filled, acc.filled)
+            and np.array_equal(acc_static.rel, acc.rel, equal_nan=True)
+            and np.array_equal(acc_static.conf, acc.conf,
+                               equal_nan=True)
+            and np.array_equal(acc_static.dec, acc.dec))
+        assert lease_bitwise, (
+            "leased steal-resumed accumulator is NOT bitwise-identical "
+            "to the uninterrupted static run")
+        check = lease_mod.LeaseManager(
+            out.with_suffix(lease_mod.LEASE_SUFFIX), "checker")
+        n_shards = -(-sweep_cells // 3)
+        holders = {(check.record(s) or {}).get("holder")
+                   for s in range(n_shards)}
+        assert "host1" in holders, "no shard finished by the stealer"
+        steals = sum(1 for s in range(n_shards)
+                     if (check.record(s) or {}).get("holder") == "host1")
+
+    return {
+        "replicas": 3,
+        "waves": n_waves,
+        "requests_per_wave": per_wave,
+        "killed_replica": "r1",
+        "requests_total": n_waves * per_wave,
+        "requests_dropped": 0,
+        "requests_double_resolved": 0,
+        "re_admitted": int(router.stats.re_admitted),
+        "failovers": int(router.stats.failovers),
+        "zombie_payloads": int(router.stats.zombie_payloads),
+        "goodput_3_replicas_p_s": round(g_before, 3),
+        "goodput_after_kill_p_s": round(g_after, 3),
+        "goodput_recovered_p_s": round(g_recovered, 3),
+        "after_kill_vs_before": round(g_after / g_before, 3),
+        "recovered_vs_after_kill": round(g_recovered / g_after, 3),
+        "replica_payloads_bitwise": True,
+        "per_replica": dict(router.stats.per_replica),
+        "lease_accum_bitwise_vs_static": bool(lease_bitwise),
+        "lease_shards_stolen": int(steals),
     }
 
 

@@ -146,6 +146,25 @@ def _add_perturb(sub) -> None:
                         "the device accumulator (resume runs on the "
                         "manifest + accumulator checkpoint). CSV stays "
                         "the schema-parity default (DEPLOY.md §1j)")
+    p.add_argument("--lease-shards", action="store_true",
+                   help="lease-based work-stealing shards instead of "
+                        "the static host split: shard ownership rides "
+                        "lease records ({holder, expiry} __meta__ "
+                        "lines in a shared <results>.leases.jsonl), "
+                        "renewed at every flush; a live host steals "
+                        "shards whose lease expired, so a slow or "
+                        "dead host rebalances instead of strangling "
+                        "the shard fence (DEPLOY.md §1m; pair with "
+                        "--no-row-artifact on pods)")
+    p.add_argument("--lease-ttl", type=float, default=None,
+                   help="shard-lease time-to-live in wall-clock "
+                        "seconds (default 300): a lease older than "
+                        "this is stealable — size it a few flush "
+                        "intervals above the slowest healthy shard")
+    p.add_argument("--lease-cells", type=int, default=None,
+                   help="grid cells per leased shard (the stealing "
+                        "granularity; default 0 derives ~4 shards per "
+                        "host)")
     _add_prefix_pool_flags(p)
     _add_engine_tuning_flags(p)
     _add_guard_flags(p)
@@ -342,6 +361,72 @@ def _add_trace_flags(p) -> None:
                    help="trace-span ring capacity (default 65536; "
                         "oldest spans drop beyond it, drops counted in "
                         "the metrics snapshot)")
+
+
+def _add_router_flags(p) -> None:
+    """Elastic multi-replica router knobs (config.RouterConfig —
+    serve/router.py; DEPLOY.md §1m)."""
+    p.add_argument("--replicas", type=int, default=None,
+                   help="run N in-process replica servers behind the "
+                        "failover router (single-model serving): "
+                        "queue-depth/breaker-aware placement, "
+                        "exactly-once failover of a dead replica's "
+                        "in-flight requests, deadline-whisker hedging "
+                        "(default 1 = no router)")
+    p.add_argument("--hedge-threshold", type=float, default=None,
+                   help="hedge whisker in seconds: an in-flight "
+                        "request this close to its deadline is "
+                        "duplicated onto a second replica, first "
+                        "payload wins (default 0 = hedging off)")
+    p.add_argument("--replica-failure-threshold", type=int, default=None,
+                   help="consecutive error results from one replica "
+                        "before its router-side breaker opens "
+                        "(default 2)")
+    p.add_argument("--replica-cooldown", type=float, default=None,
+                   help="router-side replica breaker open->half-open "
+                        "cooldown in seconds (default 5; monotonic-"
+                        "clocked — wall steps can't hold it open)")
+    p.add_argument("--residency-bonus", type=float, default=None,
+                   help="placement bonus (queue-row equivalents) for a "
+                        "replica whose WeightCache already holds the "
+                        "request's model (default 8)")
+    p.add_argument("--slo-wait-weight", type=float, default=None,
+                   help="SLO placement term: weight on a replica's "
+                        "oldest queued-row wait relative to the "
+                        "request's remaining deadline (default 4; "
+                        "0 disables)")
+    p.add_argument("--router-tick", type=float, default=None,
+                   help="router supervisor tick in seconds (hedging "
+                        "scans + breaker promotion; default 0.02)")
+    p.add_argument("--router-cache-entries", type=int, default=None,
+                   help="router-level content-addressed dedup cache "
+                        "capacity — the exactly-once backstop against "
+                        "zombie-replica payloads (default 4096; "
+                        "0 disables)")
+
+
+def _router_cfg(args):
+    """RouterConfig from the flags (None = dataclass default)."""
+    from .config import RouterConfig
+
+    kw = {}
+    if getattr(args, "replicas", None) is not None:
+        kw["replicas"] = args.replicas
+    if getattr(args, "hedge_threshold", None) is not None:
+        kw["hedge_s"] = args.hedge_threshold
+    if getattr(args, "replica_failure_threshold", None) is not None:
+        kw["replica_failure_threshold"] = args.replica_failure_threshold
+    if getattr(args, "replica_cooldown", None) is not None:
+        kw["replica_cooldown_s"] = args.replica_cooldown
+    if getattr(args, "residency_bonus", None) is not None:
+        kw["residency_bonus"] = args.residency_bonus
+    if getattr(args, "slo_wait_weight", None) is not None:
+        kw["slo_wait_weight"] = args.slo_wait_weight
+    if getattr(args, "router_tick", None) is not None:
+        kw["tick_s"] = args.router_tick
+    if getattr(args, "router_cache_entries", None) is not None:
+        kw["cache_entries"] = args.router_cache_entries
+    return RouterConfig(**kw)
 
 
 def _add_observatory_flags(p) -> None:
@@ -558,6 +643,7 @@ def _add_serve(sub) -> None:
     _add_kernel_flags(p)
     _add_trace_flags(p)
     _add_observatory_flags(p)
+    _add_router_flags(p)
     _add_fleet_flags(p, with_models=True)
 
 
@@ -716,6 +802,12 @@ def cmd_perturb(args) -> None:
         rt_kw["row_artifact"] = False
     if args.barrier_timeout is not None:
         rt_kw["barrier_timeout_s"] = args.barrier_timeout
+    if args.lease_shards:
+        rt_kw["lease_shards"] = True
+    if args.lease_ttl is not None:
+        rt_kw["lease_ttl_s"] = args.lease_ttl
+    if args.lease_cells is not None:
+        rt_kw["lease_cells_per_shard"] = args.lease_cells
     factory = engine_factory(
         args.checkpoints,
         RuntimeConfig(**rt_kw),
@@ -781,6 +873,17 @@ def cmd_serve(args) -> None:
     if bool(args.model) == bool(args.fleet_models):
         raise SystemExit("serve needs exactly one of --model (single-"
                          "model) or --fleet-models (multiplexed fleet)")
+    n_replicas = args.replicas if args.replicas is not None else 1
+    if n_replicas > 1 and args.fleet_models:
+        raise SystemExit("--replicas fronts single-model replica "
+                         "servers; combine it with --model (fleet "
+                         "replicas: run N fleet serve processes behind "
+                         "an external router)")
+    if n_replicas > 1 and args.state_checkpoint is not None:
+        raise SystemExit("--state-checkpoint is per-server state; with "
+                         "--replicas the router's failover replaces it "
+                         "(a dead replica's in-flight work re-admits "
+                         "to survivors)")
     if args.sentinels is not None and not args.fleet_models:
         raise SystemExit("--sentinels needs --fleet-models: the "
                          "observatory re-scores the sentinel grid "
@@ -796,6 +899,12 @@ def cmd_serve(args) -> None:
     if args.fleet_models:
         try:
             _run_fleet_serve(args, serve_cfg, factory)
+        finally:
+            _finish_tracing(rec, args)
+        return
+    if n_replicas > 1:
+        try:
+            _run_router_serve(args, serve_cfg, factory, n_replicas)
         finally:
             _finish_tracing(rec, args)
         return
@@ -885,6 +994,79 @@ def cmd_serve(args) -> None:
                  json.dumps(engine.prefix_stats.summary()))
     log.info("serve faults: %s", json.dumps(server.faults.summary()))
     if not server.healthy:
+        sys.exit(1)
+
+
+def _run_router_serve(args, serve_cfg, factory, n_replicas: int) -> None:
+    """Elastic serving loop (``serve --model X --replicas N``): N
+    in-process replica ScoringServers behind a ReplicaRouter
+    (serve/router.py) — queue-depth/breaker-aware placement,
+    exactly-once failover of a dead replica's in-flight requests, and
+    deadline-whisker hedging. The JSONL surface is the single-model
+    one; {"op": "stats"} answers the router's per-replica health view
+    (DEPLOY.md §1m)."""
+    import json
+
+    from .data.prompts import LEGAL_PROMPTS
+    from .serve import ReplicaRouter, ScoringServer, ServeRequest
+
+    servers = []
+    for i in range(n_replicas):
+        engine = factory(args.model)
+        servers.append(ScoringServer(
+            engine, args.model, serve_cfg,
+            precompile=not args.no_precompile).start())
+    router = ReplicaRouter(
+        [(f"r{i}", s) for i, s in enumerate(servers)],
+        config=_router_cfg(args)).start()
+    log.info("router: %d replica servers for %s", n_replicas, args.model)
+    default_rf = LEGAL_PROMPTS[0].response_format
+    default_cf = LEGAL_PROMPTS[0].confidence_format
+    stream = (sys.stdin if args.requests == "-"
+              else open(args.requests, encoding="utf-8"))
+    futures = []
+    try:
+        for i, line in enumerate(stream):
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if obj.get("op") == "stats":
+                print(json.dumps({"op": "stats",
+                                  **router.stats_summary()}),
+                      flush=True)
+                continue
+            if obj.get("op") == "metrics":
+                print(json.dumps({"op": "metrics",
+                                  "metrics": router.metrics.snapshot()}),
+                      flush=True)
+                continue
+            prompt = obj.get("prompt")
+            futures.append(router.submit(ServeRequest(
+                binary_prompt=obj.get(
+                    "binary_prompt",
+                    f"{prompt} {obj.get('response_format', default_rf)}"),
+                confidence_prompt=obj.get(
+                    "confidence_prompt",
+                    f"{prompt} {obj.get('confidence_format', default_cf)}"),
+                targets=tuple(obj.get("targets", ("Yes", "No"))),
+                klass=obj.get("class", serve_cfg.default_class),
+                deadline_s=obj.get("deadline_s"),
+                request_id=str(obj.get("id", i)))))
+    finally:
+        if stream is not sys.stdin:
+            stream.close()
+    for fut in futures:
+        r = fut.result()
+        print(json.dumps({k: v for k, v in vars(r).items()
+                          if not k.startswith("_")}), flush=True)
+    router.stop()
+    for s in servers:
+        s.stop()
+    log.info("router stats: %s", json.dumps(router.stats_summary()))
+    log.info("router metrics: %s",
+             json.dumps(router.metrics.snapshot()))
+    if not router.alive_replicas():
         sys.exit(1)
 
 

@@ -210,6 +210,28 @@ class RuntimeConfig:
     # (parallel/multihost.py). <= 0 restores unbounded barriers.
     barrier_timeout_s: float = 900.0  # host-only; cli: --barrier-timeout
 
+    # Leased sweep shards (engine/lease.py; DEPLOY.md §1m). ON: the
+    # pending grid is split into small shards whose ownership is a
+    # LEASE record riding the manifest's {"__meta__": ...} lines
+    # ({holder, expiry, seq}; renewed at every flush) in a shared
+    # <results>.leases.jsonl log, instead of the static host_shard
+    # partition. A live host claims unclaimed shards, then STEALS
+    # shards whose lease expired (holder dead or straggling) — re-done
+    # rows fold into the streaming accumulator as bitwise no-ops (slot
+    # idempotence), so rebalancing can never corrupt the merged
+    # lattice, and the shard fence drains leases instead of waiting on
+    # the slowest static shard. Single-process runs work identically
+    # (one holder claims every shard in order).
+    lease_shards: bool = False        # host-only
+    # Lease time-to-live in WALL-CLOCK seconds (leases compare across
+    # hosts, so the shared clock is time.time, not monotonic). A holder
+    # renews on every flush; a lease older than this is stealable.
+    lease_ttl_s: float = 300.0        # host-only; cli: --lease-ttl
+    # Grid cells per leased shard (the stealing granularity): smaller
+    # shards rebalance finer but renew/claim more often. <= 0 derives
+    # ~4 shards per host from the grid.
+    lease_cells_per_shard: int = 0    # host-only; cli: --lease-cells
+
 
 @dataclasses.dataclass(frozen=True)
 class PerturbationConfig:
@@ -390,6 +412,57 @@ class ObserveConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Elastic multi-replica serving knobs (serve/router.py;
+    DEPLOY.md §1m).
+
+    The router is a front process spreading one request stream over N
+    replica servers. Placement reads three live signals per replica:
+    queue depth (queue + bucketed rows), the router-side circuit
+    breaker (one per replica — a replica that keeps erroring stops
+    receiving traffic until its cooldown probe), and — for fleet
+    replicas — WEIGHT RESIDENCY (WeightCache listener events feed a
+    router-side residency map, so a model's requests land on the
+    replica already holding its weights). Failover re-admits a dead or
+    erroring replica's in-flight requests to survivors exactly once
+    (ServeFuture first-resolution-wins + the content-address dedup
+    key), and requests inside the deadline whisker are HEDGED to a
+    second replica with first-payload-wins resolution.
+    """
+
+    # In-process replica count for `lir_tpu serve --replicas N`
+    # (single-model serving only; each replica is a full ScoringServer
+    # with its own breaker/ladder). 1 = no router.
+    replicas: int = 1                      # cli: --replicas
+    # Hedge whisker in seconds: an in-flight request whose deadline is
+    # closer than this is duplicated onto a second replica
+    # (first-payload-wins; the loser is dropped by resolve-once).
+    # 0 disables hedging.
+    hedge_s: float = 0.0                   # cli: --hedge-threshold
+    # Router-side per-replica breaker: consecutive error results from
+    # one replica before its breaker OPENS (routing avoids it), and how
+    # long it stays open before the half-open probe (the next routed
+    # request). Timed on time.monotonic — wall steps can't hold a
+    # breaker open.
+    replica_failure_threshold: int = 2     # cli: --replica-failure-threshold
+    replica_cooldown_s: float = 5.0        # cli: --replica-cooldown
+    # Placement score bonus (in queue-row equivalents) for a replica
+    # whose WeightCache already holds the request's model — weight
+    # residency as a first-class routing signal.
+    residency_bonus: float = 8.0           # cli: --residency-bonus
+    # SLO-aware placement: weight on a replica's oldest queued-row wait
+    # relative to the request's remaining deadline, so deadline-tight
+    # requests avoid replicas with stale backlogs. 0 disables.
+    slo_wait_weight: float = 4.0           # cli: --slo-wait-weight
+    # Router supervisor tick (hedging scans + breaker promotion).
+    tick_s: float = 0.02                   # cli: --router-tick
+    # Router-level content-addressed dedup cache (the exactly-once
+    # backstop: a late payload from a zombie replica can never
+    # double-resolve a content address). 0 disables.
+    cache_entries: int = 4096              # cli: --router-cache-entries
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Multi-model fleet knobs (engine/fleet.py over models/weights.py).
 
@@ -443,6 +516,8 @@ class Config:
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     observe: ObserveConfig = dataclasses.field(
         default_factory=ObserveConfig)
+    router: RouterConfig = dataclasses.field(
+        default_factory=RouterConfig)
 
     # Paths: everything under one results root; no personal gdrive paths.
     results_dir: Path = Path("results")

@@ -314,11 +314,15 @@ class StreamSink:
         self._mesh_placed = False   # re-colocate on the next fold
         return True
 
-    def merge_across_hosts(self):
-        """Multihost fence merge: allgather every host's (disjoint)
-        shard accumulator and union them slot-wise. A COLLECTIVE —
-        every host must call it at the same fence. Returns the merged
-        HostAccum (identical on every host)."""
+    def merge_across_hosts(self, allow_identical_overlap: bool = False):
+        """Multihost fence merge: allgather every host's shard
+        accumulator and union them slot-wise. A COLLECTIVE — every host
+        must call it at the same fence. Returns the merged HostAccum
+        (identical on every host). Static shards are disjoint (overlap
+        is a hard error); LEASED sweeps pass
+        ``allow_identical_overlap=True`` because a stolen shard's
+        re-scored rows legitimately appear in two hosts' lattices —
+        bitwise-identical by slot idempotence, asserted by the merge."""
         from ..parallel import multihost
         from ..stats import streaming
 
@@ -332,7 +336,8 @@ class StreamSink:
                 multihost.gather_stacked(mine.conf),
                 multihost.gather_stacked(mine.dec))
         ]
-        merged = streaming.merge_accums(gathered)
+        merged = streaming.merge_accums(
+            gathered, allow_identical_overlap=allow_identical_overlap)
         self.stats.count("merges")
         return merged
 

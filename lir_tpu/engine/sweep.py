@@ -202,6 +202,17 @@ def run_perturbation_sweep(
         results_path = results_path.with_name(
             f"{results_path.stem}.host{i}{results_path.suffix}")
         log.info("multihost: process %d writes %s", i, results_path)
+    # Leased shards (engine/lease.py): work distribution by lease
+    # records in a SHARED <results>.leases.jsonl log instead of the
+    # static host_shard split — every host sees the full grid, claims
+    # shards, and steals expired ones, so a slow or dead host
+    # rebalances instead of strangling the shard fence. Re-scored rows
+    # fold into the streaming lattice as bitwise no-ops (slot
+    # idempotence); pair with --no-row-artifact on pods, where a
+    # stolen shard's rows would otherwise appear in two hosts' row
+    # files (DEPLOY.md §1m).
+    lease_mode = (engine.rt.lease_shards and not reasoning
+                  and not engine.encoder_decoder)
     # Crash-consistent resume: the done-set is the UNION of the manifest
     # and the rows already in the results artifact. The flush order is
     # results-append THEN manifest-mark, so a kill between the two leaves
@@ -218,7 +229,7 @@ def run_perturbation_sweep(
     engine.occupancy = None  # set by _run_pipelined's ragged planner
     cells = grid_mod.build_grid(model_name, prompts, perturbations)
     cells = grid_mod.random_subset(cells, subset_size, seed)
-    if shard_grid:
+    if shard_grid and not lease_mode:
         cells = multihost.host_shard(cells)
     todo = grid_mod.pending_cells(cells, manifest)
     log.info("%s: %d/%d grid cells pending", model_name, len(todo), len(cells))
@@ -280,6 +291,9 @@ def run_perturbation_sweep(
                    if engine.rt.sweep_full_completions
                    else min(engine.rt.sweep_confidence_tokens,
                             engine.rt.max_new_tokens))
+    lease_mgr = None
+    lease_shards_list = None
+    score_shard = None
     if reasoning:
         for start in range(0, len(todo), B):
             batch = todo[start:start + B]
@@ -294,12 +308,61 @@ def run_perturbation_sweep(
                 pending_rows = []
     else:
         engine.compile_stats.snapshot_persistent()
+        if lease_mode and todo:
+            from . import lease as lease_mod
+
+            jx = __import__("jax")
+            lease_path = schemas.resolve_results_path(
+                base_results_path).with_suffix(lease_mod.LEASE_SUFFIX)
+            lease_mgr = lease_mod.LeaseManager(
+                lease_path, holder=f"host{jx.process_index()}",
+                ttl_s=engine.rt.lease_ttl_s)
+            # Renew-on-flush: every durable manifest flush extends the
+            # held leases — progress is the heartbeat.
+            lease_mgr.attach_manifest(manifest)
+            # Shards partition the FULL grid (not the pending subset):
+            # shard ids must be stable across resumes and across hosts,
+            # or a resumed holder's lease records would name different
+            # cells than the ones it scored. Per-shard scoring filters
+            # to pending cells, so a fully-done shard just closes out.
+            lease_shards_list = lease_mod.partition_shards(
+                cells, engine.rt.lease_cells_per_shard,
+                n_holders=jx.process_count())
+            log.info("lease mode: %d pending cells over %d shards "
+                     "(ttl %.0fs, log %s)", len(todo),
+                     len(lease_shards_list), lease_mgr.ttl_s,
+                     lease_path)
+
+            def score_shard(shard_cells):
+                pend = grid_mod.pending_cells(shard_cells, manifest)
+                if pend:
+                    _run_pipelined(
+                        engine, model_name, pend, target_ids,
+                        results_path, manifest, checkpoint_every,
+                        new_tokens, conf_tokens, rows, pending_rows,
+                        sink=sink, accum_path=accum_path,
+                        write_rows=write_rows)
+                if pending_rows:
+                    # Flush BEFORE the done-record: a shard is only
+                    # "done" once its rows/marks are durable.
+                    _flush(pending_rows, results_path, manifest,
+                           sink=sink, accum_path=accum_path)
+                    del pending_rows[:]
         try:
-            _run_pipelined(engine, model_name, todo, target_ids,
-                           results_path, manifest, checkpoint_every,
-                           new_tokens, conf_tokens, rows, pending_rows,
-                           sink=sink, accum_path=accum_path,
-                           write_rows=write_rows)
+            if lease_mgr is None:
+                _run_pipelined(engine, model_name, todo, target_ids,
+                               results_path, manifest, checkpoint_every,
+                               new_tokens, conf_tokens, rows,
+                               pending_rows, sink=sink,
+                               accum_path=accum_path,
+                               write_rows=write_rows)
+            else:
+                for sid, shard_cells in lease_mgr.claim_loop(
+                        lease_shards_list):
+                    with tracing.span("lease/shard", shard=int(sid),
+                                      cells=len(shard_cells)):
+                        score_shard(shard_cells)
+                    lease_mgr.mark_done(sid)
         finally:
             # Flush the PARTIAL accumulator on every exit path —
             # including a preemption kill (BaseException) and the chaos
@@ -319,6 +382,9 @@ def run_perturbation_sweep(
         if engine.fault_stats.recovered_dispatches:
             log.info("fault recovery: %s",
                      json.dumps(engine.fault_stats.summary()))
+        if lease_mgr is not None:
+            log.info("shard leases: %s",
+                     json.dumps(lease_mgr.stats.summary()))
         if getattr(engine, "kernel_stats", None) is not None \
                 and engine.kernel_stats.counters:
             log.info("piggyback chains: %s",
@@ -354,10 +420,33 @@ def run_perturbation_sweep(
         # HostDesyncError on the survivors — whose shard artifacts and
         # manifests are already flushed, hence resumable — instead of
         # parking every live host inside the collective forever.
-        multihost.liveness_barrier(
-            "perturbation-sweep-done",
-            timeout_s=engine.rt.barrier_timeout_s,
-            payload=len(rows), stats=engine.guard_stats)
+        if lease_mgr is not None:
+            # LEASE-AWARE fence: drain the lease log before barriering —
+            # steal and score shards whose holder's lease expired (dead
+            # or straggling peer), so the fence closes after at most
+            # one TTL of straggle instead of waiting out the slowest
+            # static shard. Stolen re-scores fold bitwise-idempotently.
+            def _steal_and_score() -> bool:
+                got = lease_mgr.steal_expired(lease_shards_list)
+                if got is None:
+                    return False
+                sid, shard_cells = got
+                with tracing.span("lease/shard", shard=int(sid),
+                                  cells=len(shard_cells), stolen=True):
+                    score_shard(shard_cells)
+                lease_mgr.mark_done(sid)
+                return True
+
+            multihost.lease_fence(
+                "perturbation-lease-drain", lease_mgr.all_done,
+                _steal_and_score,
+                timeout_s=engine.rt.barrier_timeout_s,
+                payload=len(rows), stats=engine.guard_stats)
+        else:
+            multihost.liveness_barrier(
+                "perturbation-sweep-done",
+                timeout_s=engine.rt.barrier_timeout_s,
+                payload=len(rows), stats=engine.guard_stats)
         if sink is not None:
             # Streaming-statistics fence merge: allgather every host's
             # (disjoint) shard accumulator and union slot-wise — ONE
@@ -367,7 +456,12 @@ def run_perturbation_sweep(
             # and their folds flushed. Every host computes the merged
             # lattice (the collective is symmetric); host 0 persists it
             # next to the merged row artifact.
-            merged_acc = sink.merge_across_hosts()
+            # Leased sweeps tolerate IDENTICAL overlap: a stolen
+            # shard's re-scored rows appear in two hosts' lattices,
+            # bitwise-equal by slot idempotence (asserted by the
+            # merge). Static shards stay disjoint-or-error.
+            merged_acc = sink.merge_across_hosts(
+                allow_identical_overlap=lease_mgr is not None)
             if __import__("jax").process_index() == 0:
                 merged_path = schemas.resolve_results_path(
                     base_results_path).with_suffix(

@@ -30,13 +30,15 @@ profiling.FaultStats and profiling.GuardStats.
 from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from .ladder import degrade_dispatch
 from .plan import (KINDS, SITES, FaultPlan, InjectedFault,
-                   InjectedPreemption, SiteSchedule, corrupt_result_nan,
-                   tear_jsonl_tail, wrap_engine, wrap_server)
+                   InjectedPreemption, InjectedReplicaKill, SiteSchedule,
+                   corrupt_result_nan, tear_jsonl_tail, wrap_engine,
+                   wrap_replica, wrap_server)
 
 __all__ = [
     "FaultPlan", "SiteSchedule", "InjectedFault", "InjectedPreemption",
-    "SITES", "KINDS", "wrap_engine", "wrap_server", "tear_jsonl_tail",
-    "corrupt_result_nan",
+    "InjectedReplicaKill",
+    "SITES", "KINDS", "wrap_engine", "wrap_server", "wrap_replica",
+    "tear_jsonl_tail", "corrupt_result_nan",
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "degrade_dispatch",
 ]

@@ -40,7 +40,17 @@ HALF_OPEN = "half_open"
 
 
 class CircuitBreaker:
-    """Thread-safe closed/open/half-open breaker over a monotonic clock."""
+    """Thread-safe closed/open/half-open breaker over a MONOTONIC clock.
+
+    The cooldown is an elapsed-time comparison (``clock() - opened_at``),
+    so the clock must be ``time.monotonic`` (the default), never
+    ``time.time``: an NTP step or operator clock change under a
+    wall-clock breaker either holds it open long past its cooldown
+    (backward step) or promotes it early (forward step) — on a router
+    fronting N replicas that is N breakers mis-timing at once. Injected
+    test clocks are fine; they stand in for monotonic time. Pinned by
+    tests/test_faults.py (wall-clock steps cannot move the cooldown).
+    """
 
     def __init__(self, failure_threshold: int = 3,
                  cooldown_s: float = 30.0,
@@ -86,6 +96,17 @@ class CircuitBreaker:
             self._consecutive = 0
             if self._state == HALF_OPEN:
                 self._transition(CLOSED)
+
+    def trip(self) -> None:
+        """Force the breaker OPEN now, regardless of the failure count —
+        the router's replica-kill path: a replica observed DEAD (not
+        merely erroring) must stop receiving traffic immediately, and
+        recovery still flows through the ordinary open -> half_open ->
+        closed probe once the replica rejoins."""
+        with self._lock:
+            if self._state != OPEN:
+                self._opened_at = self.clock()
+                self._transition(OPEN)
 
     def record_failure(self) -> bool:
         """One dispatch failure (retries already exhausted). Returns True

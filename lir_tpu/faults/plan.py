@@ -54,13 +54,27 @@ from typing import Callable, Dict, Optional, Tuple
 from ..utils.profiling import FaultStats
 
 SITES = ("dispatch", "compile", "tokenize", "manifest_write",
-         "checkpoint_write", "preempt")
+         "checkpoint_write", "preempt", "replica")
 
-KINDS = ("fault", "preempt", "hang", "nan")
+KINDS = ("fault", "preempt", "hang", "nan", "replica_kill",
+         "replica_lag")
 
 
 class InjectedFault(RuntimeError):
     """A scheduled transient failure (device error stand-in)."""
+
+
+class InjectedReplicaKill(InjectedFault):
+    """A scheduled replica death (serve/router.py chaos): the replica's
+    in-flight dispatch dies AND the router marks the replica dead —
+    what an abrupt process/host loss looks like from the front. An
+    ordinary Exception (unlike InjectedPreemption): the ROUTER is the
+    recovery layer under test, and it must survive the death, not die
+    with it."""
+
+    def __init__(self, msg: str, replica_id: str = ""):
+        super().__init__(msg)
+        self.replica_id = replica_id
 
 
 class InjectedPreemption(BaseException):
@@ -84,7 +98,13 @@ class SiteSchedule:
       InjectedPreemption, "hang" sleeps ``hang_s`` then raises
       InjectedFault (a stall for the watchdog), "nan" corrupts the
       wrapped call's RESULT rows ``nan_rows`` (for the numerics guard;
-      only meaningful through :meth:`FaultPlan.wrap`).
+      only meaningful through :meth:`FaultPlan.wrap`), "replica_kill"
+      raises InjectedReplicaKill carrying ``replica_id`` (through
+      :func:`wrap_replica` it also marks the replica dead in its
+      router first — the chaos proof for elastic failover), and
+      "replica_lag" sleeps ``lag_s`` BEFORE the call and then lets it
+      COMPLETE (a straggler, not a death: the late payload exercises
+      the router's hedge/zombie paths).
     """
 
     fail_calls: Tuple[int, ...] = ()
@@ -93,6 +113,8 @@ class SiteSchedule:
     kind: str = "fault"
     hang_s: float = 30.0
     nan_rows: Tuple[int, ...] = (0,)
+    replica_id: str = ""
+    lag_s: float = 1.0
 
     @classmethod
     def outage(cls, start: int, length: int) -> "SiteSchedule":
@@ -118,6 +140,25 @@ class SiteSchedule:
         """Simulated numerics corruption (SDC stand-in) at one call
         index: NaN into the named result rows' measurement fields."""
         return cls(fail_calls=(call,), kind="nan", nan_rows=rows)
+
+    @classmethod
+    def replica_kill_at(cls, call: int,
+                        replica_id: str = "") -> "SiteSchedule":
+        """Simulated replica death at one call index (the elastic
+        chaos proof: wire through :func:`wrap_replica` so the router
+        observes the death and re-admits the in-flight work)."""
+        return cls(fail_calls=(call,), kind="replica_kill",
+                   replica_id=replica_id)
+
+    @classmethod
+    def replica_lag_at(cls, call: int, seconds: float,
+                       replica_id: str = "") -> "SiteSchedule":
+        """Simulated straggler replica: its dispatch at ``call`` sleeps
+        ``seconds`` then COMPLETES — the router's hedge should win the
+        race and the late payload must be dropped, never
+        double-resolved."""
+        return cls(fail_calls=(call,), kind="replica_lag",
+                   lag_s=seconds, replica_id=replica_id)
 
 
 class FaultPlan:
@@ -176,8 +217,9 @@ class FaultPlan:
 
     def _fire(self, sched: SiteSchedule, site: str) -> None:
         """Raise the scheduled raise-style failure (fault / preempt /
-        hang). "nan" is result corruption and cannot fire here — only
-        :meth:`wrap` (which owns the call's result) handles it."""
+        hang / replica_kill). "nan" is result corruption and
+        "replica_lag" is a delay-then-complete — neither can fire here;
+        only :meth:`wrap` (which owns the call) handles them."""
         idx = self.calls(site) - 1
         if sched.kind == "preempt":
             self.stats.inject(site, preemption=True)
@@ -189,22 +231,35 @@ class FaultPlan:
             raise InjectedFault(
                 f"injected hang at {site} call {idx} released after "
                 f"{sched.hang_s:.2f}s")
+        if sched.kind == "replica_kill":
+            raise InjectedReplicaKill(
+                f"injected replica kill at {site} call {idx}"
+                + (f" (replica {sched.replica_id})"
+                   if sched.replica_id else ""),
+                replica_id=sched.replica_id)
         raise InjectedFault(f"injected fault at {site} call {idx}")
 
     def check(self, site: str) -> None:
         """The injection point: raise when the schedule says this call
         fails, else return. Every wrapped boundary calls this first.
         A scheduled "nan" corruption is a no-op here (no result to
-        corrupt) — use :meth:`wrap` for nan sites."""
+        corrupt); "replica_lag" sleeps in place then proceeds — use
+        :meth:`wrap` when the lagged call's RESULT matters."""
         sched = self._decide(site)
         if sched is None or sched.kind == "nan":
+            return
+        if sched.kind == "replica_lag":
+            self.stats.inject(site)
+            time.sleep(sched.lag_s)
             return
         self._fire(sched, site)
 
     def wrap(self, site: str, fn: Callable) -> Callable:
         """``fn`` under the site's schedule (indexed by call count at
         ``site``, not by wrapper): raise-style kinds fire BEFORE the
-        call; "nan" runs the call and corrupts its result rows."""
+        call; "nan" runs the call and corrupts its result rows;
+        "replica_lag" sleeps then runs the call to completion (the
+        straggler whose late payload the router must drop)."""
 
         def wrapped(*args, **kwargs):
             sched = self._decide(site)
@@ -213,6 +268,10 @@ class FaultPlan:
                     self.stats.inject(site)
                     return corrupt_result_nan(fn(*args, **kwargs),
                                               sched.nan_rows)
+                if sched.kind == "replica_lag":
+                    self.stats.inject(site)
+                    time.sleep(sched.lag_s)
+                    return fn(*args, **kwargs)
                 self._fire(sched, site)
             return fn(*args, **kwargs)
 
@@ -237,6 +296,51 @@ def wrap_server(server, plan: FaultPlan):
     policy, so recovery is exercised, not bypassed)."""
     server.batcher.score = plan.wrap("dispatch", server.batcher.score)
     return server
+
+
+def wrap_replica(router, replica_id: str, plan: FaultPlan,
+                 site: str = "replica"):
+    """Inject the plan's ``site`` schedule in front of ONE router
+    replica's dispatch boundary (serve/router.ReplicaRouter). The
+    replica-specific kinds get their router semantics here:
+
+    - ``replica_kill``: the ROUTER observes the death first
+      (``kill_replica`` — breaker tripped, in-flight re-admitted to
+      survivors), then the dispatch dies with InjectedReplicaKill,
+      exactly the order an abrupt host loss presents: the work is gone
+      before any error surfaces.
+    - ``replica_lag``: the dispatch sleeps ``lag_s`` then COMPLETES —
+      the straggler whose late payload must lose the hedge race and
+      never double-resolve.
+
+    Other kinds (fault/hang/nan/preempt) behave as in :meth:`wrap`, so
+    outage and corruption schedules compose onto replicas too."""
+    handle = router.handle(replica_id)
+    inner = handle.server.batcher.score
+
+    def wrapped(*args, **kwargs):
+        sched = plan._decide(site)
+        if sched is not None:
+            if sched.kind == "replica_kill":
+                plan.stats.inject(site)
+                router.kill_replica(replica_id)
+                raise InjectedReplicaKill(
+                    f"injected replica kill on {replica_id}",
+                    replica_id=replica_id)
+            if sched.kind == "replica_lag":
+                plan.stats.inject(site)
+                time.sleep(sched.lag_s)
+                return inner(*args, **kwargs)
+            if sched.kind == "nan":
+                plan.stats.inject(site)
+                return corrupt_result_nan(inner(*args, **kwargs),
+                                          sched.nan_rows)
+            plan._fire(sched, site)
+        return inner(*args, **kwargs)
+
+    wrapped.__wrapped__ = inner  # type: ignore[attr-defined]
+    handle.server.batcher.score = wrapped
+    return router
 
 
 def corrupt_result_nan(result, rows: Tuple[int, ...]):

@@ -79,6 +79,17 @@ STATS_SCHEMA: Dict[str, Tuple[str, ...]] = {
         "accum_bytes", "checkpoints", "merges", "live_queries",
         "finalize_s",
     ),
+    "RouterStats": (
+        "routed", "routed_resident", "dedup_hits", "completed",
+        "errors", "failovers", "re_admitted", "hedged", "hedge_wins",
+        "hedge_losses", "zombie_payloads", "replica_errors",
+        "replica_sheds", "no_replica_sheds", "kills", "revives",
+        "per_replica",
+    ),
+    "LeaseStats": (
+        "claims", "renews", "releases", "steals", "refused", "lost",
+        "expired_seen", "shards_done", "refreshes",
+    ),
 }
 
 

@@ -86,6 +86,17 @@ class SentinelScheduler:
         self._last_sweep_t: Optional[float] = None
         self._total_sweeps = 0
         self._skipped_full = 0
+        # Breaker gating (the elastic-router satellite): while the
+        # server's fronting CircuitBreaker is OPEN — a replica failing
+        # over, not a model drifting — sentinel sweeps PAUSE (their
+        # rows would be sheds/errors, and a capacity loss must not
+        # alert as model drift), and the first tick after recovery
+        # forces an immediate re-score so the post-failover window has
+        # fresh data. The breaker is read via ``server.breaker``
+        # (ScoringServer's own, or the router-side replica breaker the
+        # ReplicaRouter assigns onto a fleet server); None = ungated.
+        self._paused_breaker = False
+        self._skipped_breaker = 0
         self._forced = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -117,12 +128,37 @@ class SentinelScheduler:
 
     # -- the sweep -----------------------------------------------------------
 
+    def _breaker_open(self) -> bool:
+        breaker = getattr(self.server, "breaker", None)
+        if breaker is None:
+            return False
+        try:
+            return not breaker.allow()
+        except Exception:  # noqa: BLE001 — an odd breaker never
+            # silences the observatory
+            return False
+
     def tick(self, now: Optional[float] = None) -> Optional[Dict]:
         """One scheduler step: finalize any windows the clock has
-        closed, then sweep if due. Returns the sweep record (or None
-        when nothing was due)."""
+        closed, then sweep if due — unless the server's breaker is
+        OPEN (failover in progress: pause rather than alert on
+        capacity loss as drift; the first tick after recovery
+        re-scores immediately). Returns the sweep record (or None when
+        nothing was due / sweeps are paused)."""
         t = self.clock() if now is None else now
         self.finalize_closed(t)
+        if self._breaker_open():
+            if self.due(t):
+                self._skipped_breaker += 1
+                log.info("sentinel sweep paused: server breaker open "
+                         "(failover window, not drift)")
+            self._paused_breaker = True
+            return None
+        if self._paused_breaker:
+            # Recovery: re-score NOW — the post-failover window needs
+            # fresh sentinel data regardless of the interval.
+            self._paused_breaker = False
+            self._forced.set()
         if not self.due(t):
             return None
         self._forced.clear()
@@ -270,6 +306,7 @@ class SentinelScheduler:
             "sigma": self.cfg.drift_sigma,
             "sweeps": self._total_sweeps,
             "sweeps_skipped_window_full": self._skipped_full,
+            "sweeps_skipped_breaker_open": self._skipped_breaker,
             "open_windows": [w for w in self.windows.window_ids()
                              if w not in self._finalized],
             "windows": history,

@@ -216,6 +216,49 @@ def liveness_barrier(name: str, timeout_s: Optional[float] = None,
         raise
 
 
+def lease_fence(name: str, all_done, work,
+                timeout_s: Optional[float] = None,
+                poll_s: float = 0.05, payload: int = 0, stats=None):
+    """The LEASE-AWARE shard fence (engine/lease.py): instead of
+    parking at the barrier while a slow or dead peer sits on a static
+    shard, a host that finished its own shards DRAINS the lease log
+    first — ``work()`` steals-and-scores one expired shard per call
+    (returning True when it did anything) — and only enters the
+    ordinary liveness barrier once ``all_done()`` reports every shard
+    completed. A straggler thus costs at most one lease TTL of the
+    fleet's time (its shards get stolen), not the whole fence.
+
+    ``timeout_s`` bounds the WHOLE drain + barrier: if shards stay
+    unfinished with nothing stealable past the bound (a live peer
+    renewing a lease it never finishes), HostDesyncError fires with the
+    same resumable-exit contract as :func:`liveness_barrier`."""
+    import time as _time
+
+    deadline = (None if timeout_s is None or timeout_s <= 0
+                else _time.monotonic() + timeout_s)
+    waited_logged = False
+    while not all_done():
+        if work():
+            continue
+        if deadline is not None and _time.monotonic() > deadline:
+            if stats is not None:
+                stats.count("barrier_timeouts")
+            raise HostDesyncError(
+                f"lease fence {name!r}: shards still unfinished after "
+                f"{timeout_s:.0f}s with nothing left to steal — a peer "
+                f"holds a live lease it never completes. This host's "
+                f"shard artifacts and manifest are flushed; exit and "
+                f"re-launch to resume.")
+        if not waited_logged:
+            waited_logged = True
+            log.info("lease fence %s: own shards done; waiting on "
+                     "live foreign leases (stealing any that expire)",
+                     name)
+        _time.sleep(poll_s)
+    return liveness_barrier(name, timeout_s=timeout_s, payload=payload,
+                            stats=stats)
+
+
 def gather_stacked(arr: np.ndarray) -> np.ndarray:
     """All-gather one equal-shape array per host, stacked on a new
     leading host axis: returns (n_hosts, *shape) in process-index order.

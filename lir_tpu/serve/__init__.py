@@ -25,6 +25,11 @@ Components:
   after a cooldown, closed on probe success — lir_tpu/faults), a
   degradation ladder that bisects failing batches to isolate poison
   rows, and a SIGTERM state checkpoint for preemption-safe restarts.
+- router.ReplicaRouter — elastic multi-replica serving: one request
+  stream spread over N replica servers with queue-depth / breaker /
+  weight-residency placement, exactly-once failover of a dead
+  replica's in-flight requests, and deadline-whisker hedging with
+  first-payload-wins resolution (RouterConfig knobs; DEPLOY.md §1m).
 - batcher.FleetBatcher + server.FleetScoringServer — the multi-model
   fleet layer (engine/fleet.py underneath): per-model dispatch queues
   with resident-first selection and background weight prefetch, and the
@@ -41,6 +46,7 @@ from .batcher import ContinuousBatcher, FleetBatcher
 from .cache import ResultCache, content_key
 from .queue import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK, STATUS_SHED,
                     RequestQueue, ServeFuture, ServeRequest, ServeResult)
+from .router import ReplicaRouter
 from .server import (FleetScoreFuture, FleetScoringServer, ScoringServer,
                      aggregate_fleet, fleet_decision)
 
@@ -48,6 +54,7 @@ __all__ = [
     "ContinuousBatcher", "FleetBatcher", "ResultCache", "content_key",
     "RequestQueue", "ServeFuture", "ServeRequest", "ServeResult",
     "ScoringServer", "FleetScoringServer", "FleetScoreFuture",
+    "ReplicaRouter",
     "aggregate_fleet", "fleet_decision",
     "STATUS_OK", "STATUS_EXPIRED", "STATUS_SHED", "STATUS_ERROR",
 ]

@@ -102,6 +102,15 @@ class ContinuousBatcher:
     def pending_rows(self) -> int:
         return sum(len(q) for q in self._queues.values())
 
+    def oldest_wait(self, now: float) -> float:
+        """Seconds the OLDEST bucketed row has waited — the router's
+        SLO signal (serve/router.py): a replica with a stale backlog is
+        a bad home for a deadline-tight request even when its row count
+        looks shallow. 0 when nothing is queued."""
+        oldest = min((q[0].t_submit for q in self._queues.values() if q),
+                     default=None)
+        return 0.0 if oldest is None else max(now - oldest, 0.0)
+
     def snapshot(self) -> List[Pending]:
         """Non-destructive copy of every bucketed entry, bucket order
         (the serve state checkpoint reads this after the supervisor
@@ -310,6 +319,12 @@ class FleetBatcher:
     @property
     def pending_rows(self) -> int:
         return sum(b.pending_rows for b in self.batchers.values())
+
+    def oldest_wait(self, now: float) -> float:
+        """Oldest queued-row wait across every model's batcher (the
+        router's SLO signal — see ContinuousBatcher.oldest_wait)."""
+        return max((b.oldest_wait(now) for b in self.batchers.values()),
+                   default=0.0)
 
     def snapshot(self) -> List[Pending]:
         return [p for mid in sorted(self.batchers)

@@ -86,17 +86,44 @@ class ServeFuture:
     """Minimal completion handle (threading.Event + slot): the submitting
     thread blocks in :meth:`result`, the supervisor resolves exactly
     once. No cancellation — the server resolves every admitted request
-    with SOME status (that's the graceful-degradation contract)."""
+    with SOME status (that's the graceful-degradation contract).
+
+    ``add_done_callback`` is the router seam (serve/router.py): the
+    elastic router reacts to a replica's resolution (forward the
+    payload, fail over, drop a zombie/hedge loser) without a waiter
+    thread per attempt. First resolution wins remains the contract —
+    callbacks registered after resolution fire immediately with the
+    winning result; late ``resolve`` calls are dropped and fire
+    nothing."""
 
     def __init__(self) -> None:
         self._done = threading.Event()
         self._result: Optional[ServeResult] = None
+        self._lock = threading.Lock()
+        self._callbacks: List[Callable[[ServeResult], None]] = []  # guarded-by: _lock
 
     def resolve(self, result: ServeResult) -> None:
-        if self._done.is_set():        # first resolution wins
-            return
-        self._result = result
-        self._done.set()
+        with self._lock:
+            if self._done.is_set():    # first resolution wins
+                return
+            self._result = result
+            self._done.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:           # outside the lock: callbacks may
+            fn(result)                 # resolve OTHER futures
+
+    def add_done_callback(
+            self, fn: Callable[[ServeResult], None]) -> None:
+        """Run ``fn(result)`` when this future resolves (immediately if
+        it already has). Callbacks run on the resolving thread — keep
+        them short and never block on another future inside one."""
+        with self._lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+            result = self._result
+        assert result is not None
+        fn(result)
 
     def done(self) -> bool:
         return self._done.is_set()

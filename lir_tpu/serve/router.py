@@ -1,0 +1,579 @@
+"""Elastic multi-replica serving: the failover router (ROADMAP item 1).
+
+A single :class:`~lir_tpu.serve.server.ScoringServer` (or fleet server)
+is a single point of failure: PR 5's heartbeat machinery *detects* a
+dead peer, but a lost server still costs the run. Production
+disaggregated stacks (Mooncake-style separation of placement from
+execution, Orca-style continuous batching behind a router) treat replica
+death and stragglers as the steady state. This module is that front
+process: a :class:`ReplicaRouter` spreads one request stream over N
+replica servers, each wrapped in its own router-side
+:class:`~lir_tpu.faults.breaker.CircuitBreaker`.
+
+Placement reads three live signals per replica:
+
+- **queue depth** (queue + bucketed rows) — the load signal;
+- **breaker state** — a replica that keeps erroring (or was observed
+  dead) stops receiving traffic until its cooldown probe;
+- **weight residency** — for fleet replicas, the WeightCache's
+  ``add_listener`` insert/evict events feed a router-side residency
+  map, so a model's requests land on the replica already holding its
+  weights (weight residency as a first-class routing signal), with an
+  SLO term (the replica's oldest queued-row wait against the request's
+  remaining deadline) keeping deadline-tight requests away from stale
+  backlogs.
+
+Failover is the headline contract:
+
+- a replica that answers ``error`` (or sheds) triggers re-admission to
+  the next-best replica while the deadline allows — ``failovers``;
+- a replica KILLED mid-dispatch (:meth:`ReplicaRouter.kill_replica`, or
+  a ``replica_kill`` fault schedule) has its in-flight requests
+  re-admitted to survivors immediately — ``re_admitted`` — and its
+  breaker force-opens (``trip``), so recovery after a rejoin flows
+  through the ordinary open -> half_open -> closed probe;
+- EXACTLY-ONCE resolution: every request resolves through one
+  :class:`~lir_tpu.serve.queue.ServeFuture` (first resolution wins) and
+  payloads are content-addressed with the existing ResultCache key, so
+  a late payload from a zombie replica can never double-resolve — it is
+  counted (``zombie_payloads``) and dropped. Because every replica runs
+  the same engine configuration, the winning payload is bitwise the
+  payload any replica would have produced (pinned by
+  tests/test_router.py) — PAPER.md's axis results cannot depend on
+  which replica scored a row;
+- requests inside the deadline whisker (``RouterConfig.hedge_s``) are
+  HEDGED onto a second replica with first-payload-wins resolution.
+
+Everything here is host-side; replicas are ordinary servers (in-process
+today — the JSONL/network hop is a transport detail the router's
+contract does not depend on).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..config import RouterConfig
+from ..faults import CircuitBreaker
+from ..observe import registry as metrics_mod
+from ..observe import tracing
+from ..utils.logging import get_logger
+from ..utils.profiling import RouterStats, ServeStats
+from .cache import ResultCache, content_key
+from .queue import (STATUS_ERROR, STATUS_OK, STATUS_SHED, ServeFuture,
+                    ServeRequest, ServeResult)
+
+log = get_logger(__name__)
+
+# The measurement fields a payload carries — what the router's dedup
+# cache stores and what ServeResult(**payload) re-expands (the same
+# projection ScoringServer._resolve_ok caches).
+PAYLOAD_FIELDS = ("model_response", "model_confidence_response",
+                  "token_1_prob", "token_2_prob", "log_probabilities",
+                  "confidence_value", "weighted_confidence")
+
+
+def _payload_of(res: ServeResult) -> Dict:
+    return {f: getattr(res, f) for f in PAYLOAD_FIELDS}
+
+
+class _Replica:
+    """Router-side state for one replica server."""
+
+    def __init__(self, replica_id: str, server, breaker: CircuitBreaker):
+        self.replica_id = replica_id
+        self.server = server
+        self.breaker = breaker
+        self.alive = True
+        self.is_fleet = hasattr(server, "fleet")
+        self._lock = threading.Lock()
+        # Requests currently attempted on this replica, by pending id —
+        # the re-admission set when this replica dies.
+        self.inflight: Dict[int, "_Pending"] = {}  # guarded-by: _lock
+        # Residency map fed by WeightCache listener events (may fire
+        # under the cache lock: cheap set ops only).
+        self.resident: Set[str] = set()  # guarded-by: _lock
+
+    def seed_resident(self, models) -> None:
+        with self._lock:
+            self.resident = set(models)
+
+    def on_weight_event(self, event: str, model_id: str) -> None:
+        with self._lock:
+            if event == "insert":
+                self.resident.add(model_id)
+            elif event == "evict":
+                self.resident.discard(model_id)
+
+    def resident_view(self) -> Set[str]:
+        with self._lock:
+            return set(self.resident)
+
+    def track(self, pending: "_Pending") -> None:
+        with self._lock:
+            self.inflight[id(pending)] = pending
+
+    def untrack(self, pending: "_Pending") -> None:
+        with self._lock:
+            self.inflight.pop(id(pending), None)
+
+    def take_inflight(self) -> List["_Pending"]:
+        with self._lock:
+            victims = list(self.inflight.values())
+            self.inflight.clear()
+        return victims
+
+    @property
+    def depth(self) -> int:
+        try:
+            return int(self.server.queue_depth)
+        except Exception:  # noqa: BLE001 — a dying replica reads as deep
+            return 1 << 20
+
+    def oldest_wait(self, now: float) -> float:
+        fn = getattr(self.server, "oldest_wait", None)
+        if fn is None:
+            return 0.0
+        try:
+            return float(fn(now))
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+
+class _Pending:
+    """One routed request's lifecycle across attempts."""
+
+    __slots__ = ("request", "model_id", "future", "key", "t_submit",
+                 "t_deadline", "tried", "hedged", "resolved", "lock")
+
+    def __init__(self, request: ServeRequest, model_id: str, key: str,
+                 t_submit: float, t_deadline: float):
+        self.request = request
+        self.model_id = model_id
+        self.future = ServeFuture()
+        self.key = key
+        self.t_submit = t_submit
+        self.t_deadline = t_deadline
+        self.tried: Set[str] = set()   # guarded-by: lock
+        self.hedged = False            # guarded-by: lock
+        self.resolved = False          # guarded-by: lock
+        self.lock = threading.Lock()
+
+    def claim_resolution(self) -> bool:
+        """True exactly once — the winning attempt's right to resolve."""
+        with self.lock:
+            if self.resolved:
+                return False
+            self.resolved = True
+            return True
+
+
+class ReplicaRouter:
+    """Failover router over N replica servers (module docstring).
+
+    ``replicas`` is ``[(replica_id, server), ...]`` — servers are
+    started/stopped by the caller (they may be shared with other
+    routers or direct clients); :meth:`start`/:meth:`stop` only own the
+    router's tick thread (hedging scans + breaker promotion).
+    """
+
+    def __init__(self, replicas: Sequence[Tuple[str, object]],
+                 config: Optional[RouterConfig] = None,
+                 stats: Optional[RouterStats] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        assert replicas, "a router needs at least one replica"
+        self.config = config or RouterConfig()
+        self.stats = stats if stats is not None else RouterStats()
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._handles: Dict[str, _Replica] = {}
+        self._pending: Dict[int, _Pending] = {}  # guarded-by: _lock
+        self._rr = 0                             # guarded-by: _lock
+        for rid, server in replicas:
+            assert rid not in self._handles, f"duplicate replica {rid}"
+            breaker = CircuitBreaker(
+                failure_threshold=self.config.replica_failure_threshold,
+                cooldown_s=self.config.replica_cooldown_s, clock=clock)
+            handle = _Replica(str(rid), server, breaker)
+            # Residency map: seed from the current resident set, then
+            # ride the WeightCache's insert/evict listener events.
+            cache = getattr(getattr(server, "fleet", None), "cache", None)
+            if cache is not None and hasattr(cache, "add_listener"):
+                resident = getattr(server, "resident_models", None)
+                if callable(resident):
+                    handle.seed_resident(resident())
+                cache.add_listener(handle.on_weight_event)
+            # Sentinel gating (observe/sentinel.py): a fleet replica
+            # exposes the ROUTER-side breaker so the scheduler pauses
+            # sentinel sweeps while the replica is failing over.
+            if getattr(server, "breaker", "absent") is None:
+                server.breaker = breaker
+            self._handles[handle.replica_id] = handle
+        # Router-level content-addressed dedup: the exactly-once
+        # backstop. The cache's own ServeStats is private; RouterStats
+        # carries the router-visible dedup counter.
+        self.cache = ResultCache(self.config.cache_entries, ServeStats())
+        self._engine_key = self._derive_engine_key()
+        self.metrics = metrics_mod.MetricsRegistry()
+        self.metrics.register("router", self.stats)
+        for rid, handle in self._handles.items():
+            rstats = getattr(handle.server, "stats", None)
+            if rstats is not None:
+                self.metrics.register(f"replica:{rid}:serve", rstats)
+        rec = tracing.get_recorder()
+        if rec is not None:
+            self.metrics.register("trace", rec)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _derive_engine_key(self) -> str:
+        for handle in self._handles.values():
+            key = getattr(handle.server, "_engine_key", None)
+            if key is None:
+                eng = getattr(handle.server, "engine", None)
+                key = getattr(eng, "cache_manifest_key", None)
+            if key is None and handle.is_fleet:
+                key = "fleet:" + ",".join(handle.server.model_ids)
+            if key:
+                return str(key)
+        return "router"
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ReplicaRouter":
+        assert self._thread is None, "router already started"
+        self._thread = threading.Thread(target=self._loop,
+                                        name="replica-router",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.tick_s):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the tick is advisory
+                # (hedges/promotion); it must never take routing down.
+                log.exception("router tick failed; continuing")
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def replica_ids(self) -> List[str]:
+        return list(self._handles)
+
+    def handle(self, replica_id: str) -> _Replica:
+        return self._handles[replica_id]
+
+    def breaker_of(self, replica_id: str) -> CircuitBreaker:
+        return self._handles[replica_id].breaker
+
+    def alive_replicas(self) -> List[str]:
+        return [rid for rid, h in self._handles.items() if h.alive]
+
+    def stats_summary(self) -> Dict:
+        now = self.clock()
+        return {
+            "router": self.stats.summary(),
+            "replicas": {
+                rid: {
+                    "alive": h.alive,
+                    "breaker": h.breaker.state,
+                    "queue_depth": h.depth,
+                    "oldest_wait_s": round(h.oldest_wait(now), 4),
+                    "resident": sorted(h.resident_view()),
+                }
+                for rid, h in self._handles.items()
+            },
+        }
+
+    # -- placement -----------------------------------------------------------
+
+    def _pick(self, model_id: str, exclude: Set[str],
+              remaining_s: Optional[float] = None) -> Optional[_Replica]:
+        """The placement decision: among live replicas whose breaker
+        admits traffic (and not in ``exclude``), the lowest-scoring one
+        — queue depth, minus the residency bonus when the model's
+        weights are already there, plus the SLO term (oldest queued-row
+        wait against the request's remaining deadline). Round-robin
+        rotation breaks ties so equal replicas share load."""
+        now = self.clock()
+        with self._lock:
+            self._rr += 1
+            order = list(self._handles.values())
+            order = order[self._rr % len(order):] \
+                + order[:self._rr % len(order)]
+        cands = [h for h in order
+                 if h.alive and h.replica_id not in exclude
+                 and h.breaker.allow()]
+        if not cands:
+            return None
+
+        def score(h: _Replica) -> float:
+            s = float(h.depth)
+            if model_id and model_id in h.resident_view():
+                s -= self.config.residency_bonus
+            if self.config.slo_wait_weight > 0 and remaining_s:
+                s += (self.config.slo_wait_weight * h.oldest_wait(now)
+                      / max(remaining_s, 0.1))
+            return s
+
+        return min(cands, key=score)
+
+    def _deadline_for(self, request: ServeRequest) -> float:
+        if request.deadline_s is not None:
+            return float(request.deadline_s)
+        for h in self._handles.values():
+            cfg = getattr(h.server, "config", None)
+            if cfg is not None and hasattr(cfg, "deadline_for"):
+                return float(cfg.deadline_for(request.klass))
+        return 300.0
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, request: ServeRequest,
+               model_id: str = "") -> ServeFuture:
+        """Route one request: dedup, place, attempt. The returned
+        future resolves exactly once with the first winning payload
+        (primary, failover, hedge, or re-admission — whichever answers
+        first)."""
+        now = self.clock()
+        key = content_key(
+            self._engine_key if not model_id
+            else f"{self._engine_key}|{model_id}", request)
+        if self.cache.max_entries > 0:
+            hit = self.cache.get(key)
+            if hit is not None:
+                self.stats.count("dedup_hits")
+                self.stats.count("completed")
+                fut = ServeFuture()
+                fut.resolve(ServeResult(
+                    request_id=request.request_id, status=STATUS_OK,
+                    cached=True, latency_s=self.clock() - now, **hit))
+                return fut
+        deadline_s = self._deadline_for(request)
+        pending = _Pending(request, model_id, key, now,
+                           now + deadline_s)
+        with tracing.span("router/route",
+                          request_id=request.request_id):
+            handle = self._pick(model_id, exclude=set(),
+                                remaining_s=deadline_s)
+            if handle is None:
+                self.stats.count("no_replica_sheds")
+                pending.claim_resolution()
+                pending.future.resolve(ServeResult(
+                    request_id=request.request_id, status=STATUS_SHED,
+                    note="no live replica available (all dead or "
+                         "breaker-open)"))
+                return pending.future
+            self.stats.count("routed")
+            if model_id and model_id in handle.resident_view():
+                self.stats.count("routed_resident")
+            with self._lock:
+                self._pending[id(pending)] = pending
+            self._attempt(pending, handle, "primary")
+        return pending.future
+
+    # -- attempt machinery ---------------------------------------------------
+
+    def _attempt(self, pending: _Pending, handle: _Replica,
+                 kind: str) -> None:
+        with pending.lock:
+            pending.tried.add(handle.replica_id)
+        handle.track(pending)
+        self.stats.placed(handle.replica_id)
+        try:
+            if handle.is_fleet and pending.model_id:
+                inner = handle.server.submit(pending.request,
+                                             pending.model_id)
+            else:
+                inner = handle.server.submit(pending.request)
+        except Exception as err:  # noqa: BLE001 — a replica whose
+            # submit path itself raises is as dead as one that errors.
+            handle.untrack(pending)
+            self._on_result(pending, handle, kind, ServeResult(
+                request_id=pending.request.request_id,
+                status=STATUS_ERROR,
+                note=f"replica {handle.replica_id} submit raised: "
+                     f"{err!r}"))
+            return
+        inner.add_done_callback(
+            lambda res, p=pending, h=handle, k=kind:
+            self._on_result(p, h, k, res))
+
+    def _forget(self, pending: _Pending) -> None:
+        with self._lock:
+            self._pending.pop(id(pending), None)
+
+    def _on_result(self, pending: _Pending, handle: _Replica,
+                   kind: str, res: ServeResult) -> None:
+        """One attempt resolved on ``handle`` (runs on the replica's
+        resolving thread). Winner resolves the router future and feeds
+        the dedup cache; losers are classified (zombie payload / hedge
+        loss) and dropped — resolve-once is the double-resolution
+        proof."""
+        handle.untrack(pending)
+        if res.status == STATUS_OK:
+            if handle.alive:
+                # A DEAD replica's late success must not move its
+                # breaker: recovery is the revive + half-open probe's
+                # job, not a zombie payload's.
+                handle.breaker.record_success()
+            if not pending.claim_resolution():
+                # A payload for an already-resolved request: the hedge
+                # race's loser, or a zombie — late from a replica that
+                # was killed (possibly since revived) after the work
+                # was re-admitted. Either way it is dropped here —
+                # never double-resolved — and the cache.put below is
+                # idempotent by content address (replicas are
+                # config-identical, so the payload is bitwise the
+                # winner's).
+                with pending.lock:
+                    was_hedged = pending.hedged
+                self.stats.count("hedge_losses"
+                                 if handle.alive and was_hedged
+                                 else "zombie_payloads")
+                self.cache.put(pending.key, _payload_of(res))
+                return
+            self.cache.put(pending.key, _payload_of(res))
+            self.stats.count("completed")
+            if kind == "hedge":
+                self.stats.count("hedge_wins")
+            pending.future.resolve(dataclasses.replace(
+                res, latency_s=self.clock() - pending.t_submit))
+            self._forget(pending)
+            return
+        if res.status in (STATUS_ERROR, STATUS_SHED):
+            if res.status == STATUS_ERROR:
+                self.stats.count("replica_errors")
+                opened = (handle.breaker.record_failure()
+                          if handle.alive else False)
+                if opened:
+                    log.warning("router: replica %s breaker OPEN "
+                                "(cooldown %.1fs)", handle.replica_id,
+                                self.config.replica_cooldown_s)
+            else:
+                self.stats.count("replica_sheds")
+            with pending.lock:
+                if pending.resolved:
+                    return
+            now = self.clock()
+            remaining = pending.t_deadline - now
+            if remaining > 0:
+                nxt = self._pick(pending.model_id,
+                                 exclude=set(pending.tried),
+                                 remaining_s=remaining)
+                if nxt is not None:
+                    self.stats.count("failovers")
+                    tracing.add_span("router/failover", now,
+                                     self.clock(),
+                                     request_id=pending.request.request_id,
+                                     frm=handle.replica_id,
+                                     to=nxt.replica_id)
+                    self._attempt(pending, nxt, "failover")
+                    return
+            if not pending.claim_resolution():
+                return
+            self.stats.count("errors")
+            pending.future.resolve(res)
+            self._forget(pending)
+            return
+        # expired/partial statuses resolve through: the deadline is
+        # gone — another replica could only answer later still.
+        if pending.claim_resolution():
+            pending.future.resolve(res)
+            self._forget(pending)
+
+    # -- failover ------------------------------------------------------------
+
+    def kill_replica(self, replica_id: str) -> int:
+        """A replica observed DEAD (process gone, host lost, chaos
+        schedule): force its breaker open, stop placing traffic on it,
+        and re-admit its unresolved in-flight requests to survivors —
+        exactly once each (the zombie's late payloads are dropped by
+        resolve-once + content dedup). Returns how many were
+        re-admitted."""
+        handle = self._handles[replica_id]
+        handle.alive = False
+        handle.breaker.trip()
+        self.stats.count("kills")
+        victims = handle.take_inflight()
+        n = 0
+        t0 = self.clock()
+        for p in victims:
+            with p.lock:
+                if p.resolved:
+                    continue
+            nxt = self._pick(p.model_id, exclude={replica_id},
+                             remaining_s=max(p.t_deadline - t0, 0.0))
+            if nxt is None:
+                if p.claim_resolution():
+                    self.stats.count("errors")
+                    p.future.resolve(ServeResult(
+                        request_id=p.request.request_id,
+                        status=STATUS_ERROR,
+                        note=f"replica {replica_id} died with no "
+                             f"survivor to re-admit to"))
+                    self._forget(p)
+                continue
+            n += 1
+            self.stats.count("re_admitted")
+            self._attempt(p, nxt, "re_admit")
+        tracing.add_span("router/replica_kill", t0, self.clock(),
+                         replica=replica_id, re_admitted=n)
+        log.warning("router: replica %s killed; %d in-flight request(s) "
+                    "re-admitted to survivors", replica_id, n)
+        return n
+
+    def revive_replica(self, replica_id: str) -> None:
+        """The replica rejoined: mark it placeable again. Its breaker
+        stays OPEN until the cooldown elapses, so the first request it
+        sees is the ordinary half-open probe — success closes the
+        breaker, failure re-opens it."""
+        handle = self._handles[replica_id]
+        handle.alive = True
+        self.stats.count("revives")
+        log.info("router: replica %s revived (breaker %s; probe after "
+                 "cooldown)", replica_id, handle.breaker.state)
+
+    # -- the tick (hedging) --------------------------------------------------
+
+    def _tick(self) -> None:
+        now = self.clock()
+        # Reading state lazily promotes OPEN -> HALF_OPEN breakers.
+        for h in self._handles.values():
+            h.breaker.state  # noqa: B018 — promotion side effect
+        if self.config.hedge_s <= 0:
+            return
+        with self._lock:
+            pendings = list(self._pending.values())
+        for p in pendings:
+            remaining = p.t_deadline - now
+            if remaining > self.config.hedge_s:
+                continue
+            with p.lock:
+                if p.resolved or p.hedged:
+                    continue
+                tried = set(p.tried)
+            nxt = self._pick(p.model_id, exclude=tried,
+                             remaining_s=max(remaining, 0.0))
+            if nxt is None:
+                continue
+            with p.lock:
+                if p.resolved or p.hedged:
+                    continue
+                p.hedged = True
+            self.stats.count("hedged")
+            tracing.add_span("router/hedge", now, self.clock(),
+                             request_id=p.request.request_id,
+                             to=nxt.replica_id)
+            self._attempt(p, nxt, "hedge")

@@ -164,6 +164,18 @@ class ScoringServer:
         without a restart."""
         return self.breaker.state == CLOSED
 
+    @property
+    def queue_depth(self) -> int:
+        """Admitted-but-undispatched rows (queue + bucketed) — the
+        router's load signal (serve/router.py). Best-effort while the
+        supervisor runs; placement only needs relative ordering."""
+        return len(self.queue) + self.batcher.pending_rows
+
+    def oldest_wait(self, now: Optional[float] = None) -> float:
+        """Oldest bucketed-row wait in seconds (router SLO signal)."""
+        return self.batcher.oldest_wait(self.clock() if now is None
+                                        else now)
+
     # -- client side ---------------------------------------------------------
 
     def _target_ids(self, targets: Tuple[str, str]) -> Tuple[int, int]:
@@ -705,12 +717,33 @@ class FleetScoringServer:
         # attached by the CLI/bench when a sentinel grid is configured;
         # the stats endpoint then serves its window history + alerts.
         self.observatory = None
+        # Optional health gate: the elastic router (serve/router.py)
+        # assigns this replica's router-side CircuitBreaker here, and
+        # the sentinel scheduler pauses sweeps while it is OPEN (a
+        # failover window must not alert as model drift). None = no
+        # breaker fronting this server.
+        self.breaker = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     @property
     def model_ids(self):
         return self.fleet.model_ids
+
+    @property
+    def queue_depth(self) -> int:
+        """Router load signal — see ScoringServer.queue_depth."""
+        return len(self.queue) + self.batcher.pending_rows
+
+    def oldest_wait(self, now: Optional[float] = None) -> float:
+        return self.batcher.oldest_wait(self.clock() if now is None
+                                        else now)
+
+    def resident_models(self) -> List[str]:
+        """Model ids whose weights are currently in this replica's
+        WeightCache — the router's residency seed (listener events keep
+        it current afterwards)."""
+        return [m for m in self.fleet.model_ids if self.fleet.resident(m)]
 
     # -- client side ---------------------------------------------------------
 

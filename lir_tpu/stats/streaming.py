@@ -69,20 +69,46 @@ def empty_accum(n_prompts: int, n_rephrase: int, seed: int) -> HostAccum:
         seed=int(seed))
 
 
-def merge_accums(accs: Sequence[HostAccum]) -> HostAccum:
-    """Union of disjoint shard lattices (the multihost fence merge).
-    Slot-wise and order-free: each host folded its own shard's cells,
-    so for every slot at most one shard has it filled — asserted,
-    because a double-fill would mean two hosts scored one cell (the
-    exact duplicate-work bug host_shard exists to prevent)."""
+def merge_accums(accs: Sequence[HostAccum],
+                 allow_identical_overlap: bool = False) -> HostAccum:
+    """Union of shard lattices (the multihost fence merge). Slot-wise
+    and order-free.
+
+    Under STATIC host_shard partitioning each host folded its own
+    shard's cells, so for every slot at most one shard has it filled —
+    asserted, because a double-fill would mean two hosts scored one
+    cell (the exact duplicate-work bug host_shard exists to prevent).
+
+    Under LEASED shards (engine/lease.py) a stolen shard is re-scored
+    by its new holder while the slow/recovered original holder may have
+    folded part of it too — overlap is then EXPECTED, and correct
+    exactly when both holders folded bitwise-identical values
+    (deterministic greedy decode on config-identical engines makes
+    re-done rows bitwise no-ops). ``allow_identical_overlap=True``
+    admits that case and still HARD-FAILS on any overlapped slot whose
+    values differ: divergent duplicates mean non-deterministic scoring,
+    which must never merge silently."""
     assert accs, "merge_accums needs at least one accumulator"
     out = empty_accum(*accs[0].filled.shape, seed=accs[0].seed)
     for acc in accs:
         overlap = (out.filled > 0) & (acc.filled > 0)
         if overlap.any():
-            raise ValueError(
-                f"accumulator merge overlap on {int(overlap.sum())} "
-                "cells — two hosts folded the same grid cell")
+            if not allow_identical_overlap:
+                raise ValueError(
+                    f"accumulator merge overlap on {int(overlap.sum())} "
+                    "cells — two hosts folded the same grid cell")
+            same = (
+                np.array_equal(out.rel[overlap], acc.rel[overlap],
+                               equal_nan=True)
+                and np.array_equal(out.conf[overlap], acc.conf[overlap],
+                                   equal_nan=True)
+                and np.array_equal(out.dec[overlap], acc.dec[overlap]))
+            if not same:
+                raise ValueError(
+                    f"accumulator merge overlap on {int(overlap.sum())} "
+                    "cells with DIVERGENT values — two holders scored "
+                    "one cell differently (non-deterministic scoring); "
+                    "refusing to merge")
         m = acc.filled > 0
         out.filled[m] = acc.filled[m]
         out.rel[m] = acc.rel[m]

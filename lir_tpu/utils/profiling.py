@@ -791,6 +791,156 @@ class FleetStats:
 
 
 @dataclasses.dataclass
+class RouterStats:
+    """Elastic-router counters (serve/router.py): how requests spread
+    over the replica set and what the failure path did. Thread-safe —
+    submitter threads, replica supervisor threads (future callbacks),
+    and the router tick thread all mutate it concurrently.
+
+    Definitions (reported by ``summary()``, bench.py's "elastic" key,
+    and ``make elastic-smoke``):
+
+    - ``routed``: requests admitted through the router (dedup hits
+      excluded); ``routed_resident``: requests whose placement followed
+      the weight-residency signal (the model was already in the chosen
+      replica's WeightCache); ``per_replica`` histograms placements.
+    - ``dedup_hits``: requests answered from the router's own
+      content-addressed cache without touching any replica.
+    - ``failovers``: attempts re-admitted to a DIFFERENT replica after
+      an error/shed result; ``re_admitted``: in-flight requests
+      re-admitted because their replica was killed or its breaker
+      opened mid-dispatch. Exactly-once: a re-admitted request resolves
+      from whichever replica answers first (ServeFuture first-
+      resolution-wins + content-address dedup).
+    - ``hedged`` / ``hedge_wins`` / ``hedge_losses``: requests
+      duplicated onto a second replica inside the deadline whisker, and
+      which copy won the first-payload race.
+    - ``zombie_payloads``: payloads that arrived from a DEAD replica
+      after the request already resolved elsewhere — dropped by the
+      resolve-once/dedup discipline, never double-resolved.
+    - ``replica_errors`` / ``replica_sheds``: per-attempt outcomes that
+      triggered the failover path; ``no_replica_sheds``: requests shed
+      because no live replica would admit them.
+    - ``kills`` / ``revives``: replica death/rejoin events observed.
+    """
+
+    routed: int = 0
+    routed_resident: int = 0
+    dedup_hits: int = 0
+    completed: int = 0
+    errors: int = 0
+    failovers: int = 0
+    re_admitted: int = 0
+    hedged: int = 0
+    hedge_wins: int = 0
+    hedge_losses: int = 0
+    zombie_payloads: int = 0
+    replica_errors: int = 0
+    replica_sheds: int = 0
+    no_replica_sheds: int = 0
+    kills: int = 0
+    revives: int = 0
+    per_replica: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def placed(self, replica_id: str) -> None:
+        with self._lock:
+            self.per_replica[replica_id] = (
+                self.per_replica.get(replica_id, 0) + 1)
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "routed": self.routed,
+                "routed_resident": self.routed_resident,
+                "dedup_hits": self.dedup_hits,
+                "completed": self.completed,
+                "errors": self.errors,
+                "failovers": self.failovers,
+                "re_admitted": self.re_admitted,
+                "hedged": self.hedged,
+                "hedge_wins": self.hedge_wins,
+                "hedge_losses": self.hedge_losses,
+                "zombie_payloads": self.zombie_payloads,
+                "replica_errors": self.replica_errors,
+                "replica_sheds": self.replica_sheds,
+                "no_replica_sheds": self.no_replica_sheds,
+                "kills": self.kills,
+                "revives": self.revives,
+                "per_replica": dict(self.per_replica),
+            }
+
+
+@dataclasses.dataclass
+class LeaseStats:
+    """Shard-lease counters (engine/lease.py): how leased offline-sweep
+    shards moved between holders. Thread-safe for symmetry with the
+    other stats objects (the lease manager itself runs on one sweep
+    thread per host).
+
+    Definitions (reported by ``summary()``, logged per leased sweep,
+    and in bench.py's "elastic" key):
+
+    - ``claims``: shards claimed fresh (unclaimed, or re-claimed by
+      their own holder on resume); ``renews``: expiry extensions (one
+      per manifest flush — renew-on-flush); ``releases``: leases marked
+      done.
+    - ``steals``: expired leases taken over from a DEAD or slow holder
+      — the work-stealing event; re-scored rows fold into the streaming
+      lattice as bitwise no-ops (slot idempotence), so a steal can
+      never corrupt the merged accumulator.
+    - ``refused``: claim attempts refused because another holder's
+      lease was still live (double-claim refusal); ``lost``: renews
+      refused because the lease had expired and been stolen out from
+      under the holder.
+    - ``expired_seen``: expired foreign leases observed (steal
+      candidates); ``shards_done``: shards this holder completed;
+      ``refreshes``: lease-log re-reads.
+    """
+
+    claims: int = 0
+    renews: int = 0
+    releases: int = 0
+    steals: int = 0
+    refused: int = 0
+    lost: int = 0
+    expired_seen: int = 0
+    shards_done: int = 0
+    refreshes: int = 0
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "claims": self.claims,
+                "renews": self.renews,
+                "releases": self.releases,
+                "steals": self.steals,
+                "refused": self.refused,
+                "lost": self.lost,
+                "expired_seen": self.expired_seen,
+                "shards_done": self.shards_done,
+                "refreshes": self.refreshes,
+            }
+
+
+@dataclasses.dataclass
 class StreamStats:
     """Streaming-statistics sink counters (engine/stream_stats.py): how
     much of the grid folded on device, how many host bytes the streaming

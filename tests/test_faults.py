@@ -101,6 +101,31 @@ def test_fault_plan_wrap_indexes_by_site_not_wrapper():
         f()                     # call 2
 
 
+def test_replica_kill_and_lag_schedule_kinds():
+    """The elastic chaos kinds: replica_kill raises InjectedReplicaKill
+    (an ordinary Exception — the router is the recovery layer under
+    test and must survive it); replica_lag delays the call and lets it
+    COMPLETE (the straggler whose late payload must lose the race)."""
+    plan = faults.FaultPlan(schedules={
+        "replica": faults.SiteSchedule.replica_kill_at(1, "r1")})
+    f = plan.wrap("replica", lambda: "ok")
+    assert f() == "ok"
+    with pytest.raises(faults.InjectedReplicaKill) as exc:
+        f()
+    assert exc.value.replica_id == "r1"
+    assert isinstance(exc.value, Exception)   # NOT a BaseException kill
+    assert plan.stats.injected == {"replica": 1}
+
+    lag = faults.FaultPlan(schedules={
+        "replica": faults.SiteSchedule.replica_lag_at(0, 0.02)})
+    g = lag.wrap("replica", lambda: "late")
+    t0 = time.monotonic()
+    assert g() == "late"          # delayed, then completed
+    assert time.monotonic() - t0 >= 0.02
+    assert g() == "late"          # schedule exhausted -> instant
+    assert lag.stats.injected == {"replica": 1}
+
+
 # ---------------------------------------------------------------------------
 # CircuitBreaker lifecycle
 # ---------------------------------------------------------------------------
@@ -135,6 +160,59 @@ def test_breaker_lifecycle_closed_open_half_open_closed():
     assert stats.breaker_opens == 2
     assert stats.breaker_probes == 2
     assert stats.breaker_closes == 1
+
+
+def test_breaker_cooldown_is_monotonic_not_wall_clock():
+    """The cooldown must be timed on time.monotonic, never time.time:
+    a wall-clock step (NTP correction, operator clock change) must not
+    hold a per-replica breaker open past its cooldown or promote it
+    early. Pinned by faking BOTH clocks: the breaker runs on an
+    injected monotonic stand-in while the wall clock jumps around it —
+    only monotonic elapsed time may move the state."""
+    import time as _time
+
+    # The default clock IS time.monotonic — the contract itself.
+    assert faults.CircuitBreaker().clock is _time.monotonic
+
+    mono = [100.0]
+    wall = [1_700_000_000.0]
+    b = faults.CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                              clock=lambda: mono[0])
+    assert b.record_failure() and b.state == faults.OPEN
+
+    # Wall clock leaps a day FORWARD; monotonic barely moves: a
+    # wall-clocked breaker would promote immediately — ours must not.
+    wall[0] += 86_400.0
+    mono[0] += 0.5
+    assert b.state == faults.OPEN
+
+    # Wall clock steps BACKWARD an hour; monotonic crosses the
+    # cooldown: a wall-clocked breaker would stay open ~an hour — ours
+    # promotes on schedule.
+    wall[0] -= 3_600.0
+    mono[0] += 5.0
+    assert b.state == faults.HALF_OPEN
+    b.record_success()
+    assert b.state == faults.CLOSED
+    del wall  # the wall clock never entered a single comparison
+
+
+def test_breaker_trip_forces_open_then_ordinary_recovery():
+    """trip() (the router's replica-kill path) opens the breaker NOW
+    regardless of the failure count, and recovery still runs the
+    ordinary open -> half_open -> closed probe."""
+    t = [0.0]
+    stats = FaultStats()
+    b = faults.CircuitBreaker(failure_threshold=3, cooldown_s=2.0,
+                              clock=lambda: t[0], stats=stats)
+    b.trip()
+    assert b.state == faults.OPEN and not b.allow()
+    b.trip()                                # idempotent while open
+    assert stats.breaker_opens == 1
+    t[0] += 2.1
+    assert b.state == faults.HALF_OPEN
+    b.record_success()
+    assert b.state == faults.CLOSED
 
 
 def test_breaker_success_resets_consecutive_count():

@@ -1,0 +1,343 @@
+"""Shard-lease semantics tests (engine/lease.py + the leased sweep).
+
+Pins the work-stealing tentpole's contracts:
+
+- claim / renew / expire / steal ordering over a shared lease log,
+  including double-claim refusal while a foreign lease is live;
+- a torn trailing ``__meta__`` lease line (the kill-mid-append
+  artifact) is tolerated on resume and truncated by the next append;
+- a stolen shard's re-folded rows are BITWISE no-ops on the streaming
+  lattice, and the identical-overlap-tolerant merge reproduces an
+  uninterrupted run's accumulator exactly (divergent overlap still
+  hard-fails);
+- the leased sweep driver produces the same rows and the same
+  accumulator as a static run, across a mid-sweep kill + resume.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from lir_tpu import faults
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import RuntimeConfig
+from lir_tpu.engine import lease as lease_mod
+from lir_tpu.engine import stream_stats as stream_mod
+from lir_tpu.stats import streaming
+from lir_tpu.utils.profiling import LeaseStats
+
+
+def _mgr(path, holder, ttl=10.0, t0=0.0):
+    now = {"t": t0}
+    m = lease_mod.LeaseManager(path, holder, ttl_s=ttl,
+                               clock=lambda: now["t"],
+                               stats=LeaseStats())
+    return m, now
+
+
+# ---------------------------------------------------------------------------
+# Claim / renew / expire / steal over one shared log
+# ---------------------------------------------------------------------------
+
+def test_claim_renew_expire_steal_ordering(tmp_path):
+    log = tmp_path / "sweep.leases.jsonl"
+    a, a_now = _mgr(log, "hostA", ttl=10.0)
+    b, b_now = _mgr(log, "hostB", ttl=10.0)
+
+    # A claims shard 0; B's claim is refused while the lease is live.
+    assert a.claim(0)
+    assert not b.claim(0)
+    assert b.stats.refused == 1
+
+    # A renews at t=8 -> expiry moves to 18; B still refused at t=12.
+    a_now["t"] = 8.0
+    assert a.renew(0)
+    b_now["t"] = 12.0
+    assert not b.claim(0)
+
+    # Expiry passes with no renewal (A died): B observes the expired
+    # lease but a plain claim still refuses — stealing is explicit.
+    b_now["t"] = 19.0
+    assert not b.claim(0, steal=False)
+    assert b.stats.expired_seen >= 1
+    assert b.claim(0, steal=True)
+    assert b.stats.steals == 1
+    rec = b.record(0)
+    assert rec["holder"] == "hostB" and rec["seq"] >= 2
+
+    # A comes back and renews: the lease is LOST (B holds it live) —
+    # A must abandon the shard, not keep scoring it blind.
+    a_now["t"] = 19.5
+    assert not a.renew(0)
+    assert a.stats.lost == 1
+    assert 0 not in a.held
+
+    # B finishes: done-records are terminal for everyone.
+    b.mark_done(0)
+    a_now["t"] = 100.0
+    assert not a.claim(0, steal=True)
+    assert b.is_done(0) and a.is_done(0)
+
+
+def test_own_reclaim_after_resume_is_not_a_steal(tmp_path):
+    log = tmp_path / "l.jsonl"
+    a, a_now = _mgr(log, "hostA", ttl=10.0)
+    assert a.claim(0)
+    # The same holder resumes (fresh manager, same identity): its own
+    # live lease re-claims without a steal.
+    a2, now2 = _mgr(log, "hostA", ttl=10.0, t0=5.0)
+    assert a2.claim(0)
+    assert a2.stats.steals == 0 and a2.stats.claims == 1
+
+
+def test_all_done_and_claim_loop(tmp_path):
+    log = tmp_path / "l.jsonl"
+    a, _ = _mgr(log, "hostA")
+    shards = [["c0", "c1"], ["c2"], ["c3", "c4"]]
+    seen = []
+    for sid, cells in a.claim_loop(shards):
+        seen.append((sid, list(cells)))
+        a.mark_done(sid)
+    assert sorted(s for s, _ in seen) == [0, 1, 2]
+    assert a.all_done()
+    assert a.stats.shards_done == 3
+
+
+def test_steal_expired_skips_live_and_done(tmp_path):
+    log = tmp_path / "l.jsonl"
+    a, a_now = _mgr(log, "hostA", ttl=10.0)
+    b, b_now = _mgr(log, "hostB", ttl=10.0)
+    shards = [["c0"], ["c1"], ["c2"]]
+    a.register_shards(3)
+    b.register_shards(3)
+    assert a.claim(0) and a.claim(1)
+    a.mark_done(0)
+    # shard 1 live (held by A), shard 2 unclaimed: B's steal pass takes
+    # shard 2 first, then nothing (1 is live, 0 done).
+    got = b.steal_expired(shards)
+    assert got is not None and got[0] == 2
+    b.mark_done(2)
+    assert b.steal_expired(shards) is None
+    assert not b.all_done()
+    # A's lease on shard 1 expires -> B steals it within one TTL.
+    b_now["t"] = 11.0
+    got = b.steal_expired(shards)
+    assert got is not None and got[0] == 1
+    b.mark_done(1)
+    assert b.all_done()
+
+
+def test_torn_trailing_lease_line_tolerated_on_resume(tmp_path):
+    log = tmp_path / "l.jsonl"
+    a, _ = _mgr(log, "hostA")
+    assert a.claim(0)
+    a.mark_done(0)
+    # Kill mid-append: a torn, newline-free __meta__ fragment tails the
+    # log — exactly what SweepManifest's crash mode leaves behind.
+    faults.tear_jsonl_tail(log, fragment='{"__meta__": {"lease:1": {"ho')
+    b, _ = _mgr(log, "hostB")
+    assert b.is_done(0)          # intact records survive
+    assert b.record(1) is None   # the torn record reads as absent
+    assert b.claim(1)            # ... and the next append truncates it
+    # The log stays parseable end-to-end after the truncating append.
+    c, _ = _mgr(log, "hostC")
+    assert c.record(1)["holder"] == "hostB"
+
+
+def test_renew_on_flush_via_attach_manifest(tmp_path):
+    from lir_tpu.utils.manifest import SweepManifest
+
+    log = tmp_path / "l.jsonl"
+    a, a_now = _mgr(log, "hostA", ttl=10.0)
+    assert a.claim(0)
+    man = SweepManifest(tmp_path / "m.jsonl", ("k",))
+    a.attach_manifest(man)
+    a_now["t"] = 9.0
+    man.mark_done_many([{"k": "row1"}])   # a flush IS a heartbeat
+    rec = a.record(0)
+    assert rec["expiry"] == pytest.approx(19.0)
+    assert a.stats.renews == 1
+
+
+# ---------------------------------------------------------------------------
+# Stolen-shard re-folds: bitwise no-ops on the lattice
+# ---------------------------------------------------------------------------
+
+class _Cell:
+    def __init__(self, p, r):
+        self.prompt_idx, self.rephrase_idx = p, r
+
+
+def _fold_cells(sink, cells, seed=0):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    # Deterministic per-cell values keyed by slot — what a re-score of
+    # the same cells on a config-identical engine produces.
+    for c in cells:
+        v = (c.prompt_idx * 31 + c.rephrase_idx * 7) % 97 / 97.0
+        yes = np.float32(0.1 + 0.8 * v)
+        sink.fold(jnp.asarray([yes]), jnp.asarray([1 - yes],
+                                                  jnp.float32),
+                  jnp.asarray([100 * v], jnp.float32),
+                  jnp.zeros((1, 1), jnp.float32), [c], topk=1)
+    del rng
+
+
+def test_stolen_shard_refold_is_bitwise_noop():
+    cells = [_Cell(0, r) for r in range(6)]
+    sink = stream_mod.StreamSink(1, 6, seed=3)
+    _fold_cells(sink, cells)
+    once = sink.snapshot()
+    _fold_cells(sink, cells)      # the steal re-scores the whole shard
+    twice = sink.snapshot()
+    assert np.array_equal(once.filled, twice.filled)
+    assert np.array_equal(once.rel, twice.rel, equal_nan=True)
+    assert np.array_equal(once.conf, twice.conf, equal_nan=True)
+    assert np.array_equal(once.dec, twice.dec)
+
+
+def test_identical_overlap_merge_matches_uninterrupted_run():
+    # Uninterrupted run: one holder folds everything.
+    full = stream_mod.StreamSink(1, 8, seed=5)
+    _fold_cells(full, [_Cell(0, r) for r in range(8)])
+    want = full.snapshot()
+
+    # Leased run: host A folded rows 0-4 then died mid-shard (rows 0-2
+    # were its shard, 3-4 the start of shard 2); host B steals shard 2
+    # and re-scores ALL of it (3-5) plus its own shard (6-7).
+    a = stream_mod.StreamSink(1, 8, seed=5)
+    _fold_cells(a, [_Cell(0, r) for r in range(5)])
+    b = stream_mod.StreamSink(1, 8, seed=5)
+    _fold_cells(b, [_Cell(0, r) for r in range(3, 8)])
+
+    with pytest.raises(ValueError):
+        streaming.merge_accums([a.snapshot(), b.snapshot()])
+    merged = streaming.merge_accums(
+        [a.snapshot(), b.snapshot()], allow_identical_overlap=True)
+    assert np.array_equal(merged.filled, want.filled)
+    assert np.array_equal(merged.rel, want.rel, equal_nan=True)
+    assert np.array_equal(merged.conf, want.conf, equal_nan=True)
+    assert np.array_equal(merged.dec, want.dec)
+
+
+def test_divergent_overlap_refuses_even_when_allowed():
+    a = stream_mod.StreamSink(1, 4, seed=5)
+    _fold_cells(a, [_Cell(0, r) for r in range(3)])
+    b = stream_mod.StreamSink(1, 4, seed=5)
+    _fold_cells(b, [_Cell(0, r) for r in range(2, 4)])
+    acc_b = b.snapshot()
+    rel = np.array(acc_b.rel)     # snapshots are read-only buffers
+    rel[0, 2] += 0.25             # a non-deterministic "re-score"
+    acc_b = streaming.HostAccum(filled=acc_b.filled, rel=rel,
+                                conf=acc_b.conf, dec=acc_b.dec,
+                                seed=acc_b.seed)
+    with pytest.raises(ValueError, match="DIVERGENT"):
+        streaming.merge_accums([a.snapshot(), acc_b],
+                               allow_identical_overlap=True)
+
+
+# ---------------------------------------------------------------------------
+# The leased sweep driver: rows + accumulator == a static run
+# ---------------------------------------------------------------------------
+
+N_CELLS = 10
+BATCH = 4
+
+
+def _make_engine(lease=False, seed=11, **rt_kw):
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="lease-t", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=1, n_heads=2,
+                      intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(seed))
+    rt = RuntimeConfig(batch_size=BATCH, max_seq_len=256,
+                       piggyback_prefill=False, lease_shards=lease,
+                       lease_ttl_s=30.0, lease_cells_per_shard=3,
+                       **rt_kw)
+    return ScoringEngine(params, cfg, FakeTokenizer(), rt)
+
+
+def _grid(n_cells, seed=21):
+    from lir_tpu.data.prompts import LegalPrompt
+
+    rng = np.random.default_rng(seed)
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible").split()
+
+    def text(n):
+        return " ".join(rng.choice(words) for _ in range(n)) + " ?"
+
+    lp = (LegalPrompt(main=text(10), response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Give a number from 0 to 100 ."),)
+    return lp, ([text(10 if i % 2 else 20) for i in range(n_cells - 1)],)
+
+
+def _accum(path):
+    return stream_mod.load_accum(path.with_suffix(stream_mod.ACCUM_SUFFIX))
+
+
+def _assert_accums_equal(a, b):
+    assert a is not None and b is not None
+    assert np.array_equal(a.filled, b.filled)
+    assert np.array_equal(a.rel, b.rel, equal_nan=True)
+    assert np.array_equal(a.conf, b.conf, equal_nan=True)
+    assert np.array_equal(a.dec, b.dec)
+
+
+def test_leased_sweep_matches_static_run_bitwise(tmp_path):
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+
+    lp, perts = _grid(N_CELLS)
+    static = run_perturbation_sweep(
+        _make_engine(), "lease", lp, perts, tmp_path / "static.csv",
+        checkpoint_every=4)
+    leased = run_perturbation_sweep(
+        _make_engine(lease=True), "lease", lp, perts,
+        tmp_path / "leased.csv", checkpoint_every=4)
+    assert len(leased) == len(static) == N_CELLS
+    by_key = {r.rephrased_main: (r.token_1_prob, r.token_2_prob,
+                                 r.confidence_value,
+                                 r.weighted_confidence)
+              for r in static}
+    for r in leased:
+        assert (r.token_1_prob, r.token_2_prob, r.confidence_value,
+                r.weighted_confidence) == by_key[r.rephrased_main]
+    _assert_accums_equal(_accum(tmp_path / "static.csv"),
+                         _accum(tmp_path / "leased.csv"))
+    # The lease log exists and records the full claim/done history.
+    log = (tmp_path / "leased.csv").with_suffix(lease_mod.LEASE_SUFFIX)
+    check, _ = _mgr(log, "checker", t0=1e12)
+    n_shards = -(-N_CELLS // 3)
+    assert all(check.is_done(s) for s in range(n_shards))
+
+
+def test_leased_sweep_kill_resume_accumulator_bitwise(tmp_path):
+    """A leased sweep killed mid-run (rows folded but shards unfinished)
+    resumes — re-claiming its own leases — and converges on the static
+    run's accumulator EXACTLY (the acceptance gate for the elastic
+    bench's offline half)."""
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+
+    lp, perts = _grid(N_CELLS)
+    run_perturbation_sweep(_make_engine(), "lease", lp, perts,
+                           tmp_path / "static.csv", checkpoint_every=4)
+
+    engine = _make_engine(lease=True)
+    plan = faults.FaultPlan(seed=9, schedules={
+        "dispatch": faults.SiteSchedule.kill_at(1)})
+    faults.wrap_engine(engine, plan)
+    out = tmp_path / "leased.csv"
+    with pytest.raises(faults.InjectedPreemption):
+        run_perturbation_sweep(engine, "lease", lp, perts, out,
+                               checkpoint_every=4)
+    # Resume (same holder identity: its own live leases re-claim).
+    leased = run_perturbation_sweep(_make_engine(lease=True), "lease",
+                                    lp, perts, out, checkpoint_every=4)
+    keys = [r.rephrased_main for r in leased]
+    assert len(set(keys)) == len(keys)
+    _assert_accums_equal(_accum(tmp_path / "static.csv"), _accum(out))

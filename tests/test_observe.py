@@ -444,6 +444,43 @@ class TestObservatory:
         rec = sched.tick()
         assert rec is not None and rec["slot"] == 1
 
+    def test_breaker_open_pauses_sweeps_and_rescores_on_recovery(
+            self, fleet_server):
+        """The elastic-router satellite: while the server's fronting
+        breaker is OPEN (a replica failing over), sentinel sweeps pause
+        — a capacity loss must not alert as model drift — and the
+        first tick after recovery re-scores IMMEDIATELY, interval or
+        not."""
+        from lir_tpu.faults import CircuitBreaker
+
+        sched, now = _scheduler(fleet_server)
+        now["t"] = W + 1.0
+        assert sched.tick() is not None
+        # The router assigns its replica breaker onto the server; here
+        # we drive one directly with a fake clock.
+        t = {"b": 0.0}
+        breaker = CircuitBreaker(failure_threshold=1, cooldown_s=5.0,
+                                 clock=lambda: t["b"])
+        fleet_server.breaker = breaker
+        try:
+            breaker.trip()
+            now["t"] = W + 3.0           # interval elapsed: due...
+            assert sched.tick() is None  # ...but paused (breaker open)
+            now["t"] = W + 5.0
+            assert sched.tick() is None
+            assert (sched.summary()["sweeps_skipped_breaker_open"]
+                    >= 2)
+            n_before = sched.summary()["sweeps"]
+            # Recovery: cooldown elapses (half-open admits traffic) —
+            # the very next tick re-scores even though the last
+            # ATTEMPTED sweep was recent.
+            t["b"] = 6.0
+            rec = sched.tick()
+            assert rec is not None
+            assert sched.summary()["sweeps"] == n_before + 1
+        finally:
+            fleet_server.breaker = None
+
     def test_window_capacity_skips_loudly(self, fleet_server):
         sched, now = _scheduler(fleet_server, max_sweeps_per_window=1)
         now["t"] = W + 1.0

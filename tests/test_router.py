@@ -1,0 +1,372 @@
+"""Elastic multi-replica router tests (serve/router.py).
+
+Pins the failover tentpole's contracts:
+
+- placement follows the live signals: queue depth, router-side breaker
+  state, weight residency, and the SLO term (oldest queued-row wait vs
+  remaining deadline);
+- an erroring replica's request fails over to a survivor and resolves
+  exactly once; the breaker opens after the configured threshold and
+  recovers through open -> half_open -> closed;
+- a KILLED replica's in-flight requests are re-admitted to survivors,
+  and a zombie's late payload is dropped — never double-resolved;
+- hedged requests resolve first-payload-wins, the loser is dropped;
+- the router's content-addressed dedup answers repeats without
+  touching any replica;
+- with real engines, the winning payload is bitwise the payload any
+  replica would have produced (replica-independence — the paper's
+  results cannot depend on which replica scored a row).
+"""
+
+import threading
+
+import jax
+
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import RouterConfig, RuntimeConfig, ServeConfig
+from lir_tpu.faults import CLOSED, HALF_OPEN, OPEN
+from lir_tpu.serve import (ReplicaRouter, ScoringServer, ServeFuture,
+                           ServeRequest, ServeResult)
+from lir_tpu.serve.queue import STATUS_ERROR, STATUS_OK, STATUS_SHED
+
+
+def _req(i, rid=None, deadline_s=None, klass="t"):
+    body = f"clause {i} covers wind damage under policy {i * 7}"
+    return ServeRequest(
+        binary_prompt=f"{body} Answer Yes or No .",
+        confidence_prompt=f"{body} Give a number from 0 to 100 .",
+        klass=klass, deadline_s=deadline_s, request_id=rid or str(i))
+
+
+def _ok(request, marker=0.5):
+    return ServeResult(
+        request_id=request.request_id, status=STATUS_OK,
+        model_response="Yes", model_confidence_response="80",
+        token_1_prob=marker, token_2_prob=1 - marker,
+        log_probabilities="{}", confidence_value=80,
+        weighted_confidence=80.0)
+
+
+class FakeReplica:
+    """Duck-typed replica server: depth signal + scripted submit
+    behavior (a callable returning a ServeResult to resolve with, or
+    None to leave the future pending)."""
+
+    def __init__(self, depth=0, behavior=None):
+        self.config = ServeConfig(classes=(("t", 600.0),),
+                                  default_class="t")
+        self.queue_depth = depth
+        self.wait = 0.0
+        self.behavior = behavior or _ok
+        self.submitted = []
+
+    def oldest_wait(self, now):
+        return self.wait
+
+    def submit(self, request):
+        fut = ServeFuture()
+        self.submitted.append((request, fut))
+        res = self.behavior(request)
+        if res is not None:
+            fut.resolve(res)
+        return fut
+
+
+def _router(replicas, clock=None, **cfg_kw):
+    cfg = RouterConfig(**cfg_kw)
+    kw = {} if clock is None else {"clock": clock}
+    return ReplicaRouter(replicas, config=cfg, **kw)
+
+
+# ---------------------------------------------------------------------------
+# ServeFuture callbacks (the router seam)
+# ---------------------------------------------------------------------------
+
+def test_future_callbacks_fire_once_first_resolution_wins():
+    fut = ServeFuture()
+    got = []
+    fut.add_done_callback(lambda r: got.append(r.status))
+    fut.resolve(ServeResult(request_id="a", status=STATUS_OK))
+    fut.resolve(ServeResult(request_id="a", status=STATUS_ERROR))
+    assert got == ["ok"]
+    # Registered after resolution: fires immediately with the winner.
+    fut.add_done_callback(lambda r: got.append(r.status))
+    assert got == ["ok", "ok"]
+    assert fut.result(0).status == STATUS_OK
+
+
+# ---------------------------------------------------------------------------
+# Placement
+# ---------------------------------------------------------------------------
+
+def test_routes_to_least_loaded_replica():
+    shallow, deep = FakeReplica(depth=1), FakeReplica(depth=50)
+    router = _router([("shallow", shallow), ("deep", deep)])
+    for i in range(4):
+        assert router.submit(_req(i)).result(1).status == STATUS_OK
+    assert len(deep.submitted) == 0
+    assert router.stats.per_replica == {"shallow": 4}
+    assert router.stats.routed == 4
+    assert router.stats.completed == 4
+
+
+def test_residency_is_a_routing_signal():
+    # b is DEEPER but holds the model's weights — within the bonus, it
+    # wins; without a model id, depth decides.
+    a, b = FakeReplica(depth=1), FakeReplica(depth=5)
+    router = _router([("a", a), ("b", b)], residency_bonus=8.0)
+    router.handle("b").seed_resident(["m1"])
+    assert router.submit(_req(0, "r0"), model_id="m1") \
+        .result(1).status == STATUS_OK
+    assert len(b.submitted) == 1 and len(a.submitted) == 0
+    assert router.stats.routed_resident == 1
+    assert router.submit(_req(1, "r1")).result(1).status == STATUS_OK
+    assert len(a.submitted) == 1
+
+
+def test_slo_term_avoids_stale_backlogs_for_tight_deadlines():
+    # Equal depths, but a's oldest queued row has waited 30s: a
+    # deadline-tight request must land on b.
+    a, b = FakeReplica(depth=3), FakeReplica(depth=3)
+    a.wait = 30.0
+    router = _router([("a", a), ("b", b)], slo_wait_weight=4.0)
+    assert router.submit(_req(0, deadline_s=1.0)) \
+        .result(1).status == STATUS_OK
+    assert len(b.submitted) == 1 and len(a.submitted) == 0
+
+
+def test_no_replica_available_sheds():
+    a = FakeReplica()
+    router = _router([("a", a)])
+    router.kill_replica("a")
+    res = router.submit(_req(0)).result(1)
+    assert res.status == STATUS_SHED
+    assert router.stats.no_replica_sheds == 1
+
+
+# ---------------------------------------------------------------------------
+# Failover + breaker
+# ---------------------------------------------------------------------------
+
+def _err(request):
+    return ServeResult(request_id=request.request_id,
+                       status=STATUS_ERROR, note="device error")
+
+
+def test_error_fails_over_and_resolves_exactly_once():
+    bad = FakeReplica(depth=0, behavior=_err)
+    good = FakeReplica(depth=10)
+    router = _router([("bad", bad), ("good", good)])
+    res = router.submit(_req(0)).result(1)
+    assert res.status == STATUS_OK
+    assert router.stats.failovers == 1
+    assert router.stats.replica_errors == 1
+    assert router.stats.completed == 1
+    assert len(bad.submitted) == 1 and len(good.submitted) == 1
+
+
+def test_breaker_opens_avoids_then_recovers():
+    now = {"t": 0.0}
+    clock = lambda: now["t"]  # noqa: E731
+    flaky = FakeReplica(depth=0, behavior=_err)
+    good = FakeReplica(depth=10)
+    router = _router([("flaky", flaky), ("good", good)], clock=clock,
+                     replica_failure_threshold=1,
+                     replica_cooldown_s=5.0)
+    assert router.submit(_req(0)).result(1).status == STATUS_OK
+    assert router.breaker_of("flaky").state == OPEN
+    # While open, traffic avoids the flaky replica entirely.
+    assert router.submit(_req(1)).result(1).status == STATUS_OK
+    assert len(flaky.submitted) == 1
+    # Cooldown elapses -> half-open; the replica recovered -> the next
+    # routed probe closes the breaker.
+    now["t"] = 6.0
+    flaky.behavior = _ok
+    flaky.queue_depth = 0
+    assert router.breaker_of("flaky").state == HALF_OPEN
+    assert router.submit(_req(2)).result(1).status == STATUS_OK
+    assert len(flaky.submitted) == 2
+    assert router.breaker_of("flaky").state == CLOSED
+
+
+def test_all_replicas_error_resolves_error():
+    a = FakeReplica(behavior=_err)
+    b = FakeReplica(behavior=_err)
+    router = _router([("a", a), ("b", b)])
+    res = router.submit(_req(0)).result(1)
+    assert res.status == STATUS_ERROR
+    assert router.stats.errors == 1
+    # Both were tried exactly once: failover never loops.
+    assert len(a.submitted) == 1 and len(b.submitted) == 1
+
+
+# ---------------------------------------------------------------------------
+# Kill / zombie / hedge
+# ---------------------------------------------------------------------------
+
+def test_kill_readmits_inflight_and_drops_zombie_payload():
+    hang = FakeReplica(depth=0, behavior=lambda r: None)  # never answers
+    good = FakeReplica(depth=10)
+    router = _router([("hang", hang), ("good", good)])
+    fut = router.submit(_req(0, "x"))
+    assert not fut.done()
+    assert router.kill_replica("hang") == 1
+    res = fut.result(1)
+    assert res.status == STATUS_OK
+    assert router.stats.re_admitted == 1
+    assert router.stats.kills == 1
+    assert router.breaker_of("hang").state == OPEN
+    # The zombie replica answers LATE with a divergent-looking payload:
+    # dropped, counted, and the client's result is unchanged.
+    _, zombie_fut = hang.submitted[0]
+    zombie_fut.resolve(_ok(_req(0, "x"), marker=0.999))
+    assert router.stats.zombie_payloads == 1
+    assert fut.result(0).token_1_prob == res.token_1_prob
+
+
+def test_revive_places_probe_after_cooldown():
+    now = {"t": 0.0}
+    clock = lambda: now["t"]  # noqa: E731
+    a = FakeReplica(depth=0, behavior=lambda r: None)
+    b = FakeReplica(depth=10)
+    router = _router([("a", a), ("b", b)], clock=clock,
+                     replica_cooldown_s=2.0)
+    router.submit(_req(0))
+    router.kill_replica("a")
+    router.revive_replica("a")
+    a.behavior = _ok
+    # Before the cooldown the breaker is still open -> b serves.
+    assert router.submit(_req(1)).result(1).status == STATUS_OK
+    assert len(b.submitted) >= 1
+    # After the cooldown the half-open probe lands on a (depth 0) and
+    # closes its breaker.
+    now["t"] = 3.0
+    assert router.submit(_req(2)).result(1).status == STATUS_OK
+    assert router.breaker_of("a").state == CLOSED
+    assert router.stats.revives == 1
+
+
+def test_hedge_first_payload_wins_and_loser_is_dropped():
+    slow = FakeReplica(depth=0, behavior=lambda r: None)
+    fast = FakeReplica(depth=10)
+    router = _router([("slow", slow), ("fast", fast)], hedge_s=100.0)
+    fut = router.submit(_req(0, "h", deadline_s=1.0))
+    assert not fut.done()
+    router._tick()      # the whisker check (no thread in tests)
+    res = fut.result(1)
+    assert res.status == STATUS_OK
+    assert router.stats.hedged == 1
+    assert router.stats.hedge_wins == 1
+    # The straggler completes late: hedge loss, not a second result.
+    _, late = slow.submitted[0]
+    late.resolve(_ok(_req(0, "h"), marker=0.123))
+    assert router.stats.hedge_losses == 1
+    assert fut.result(0).token_1_prob == res.token_1_prob
+    # A request is hedged at most once.
+    router._tick()
+    assert router.stats.hedged == 1
+
+
+def test_dedup_answers_repeats_without_touching_replicas():
+    a = FakeReplica()
+    router = _router([("a", a)])
+    r1 = router.submit(_req(0, "d0")).result(1)
+    assert r1.status == STATUS_OK and not r1.cached
+    r2 = router.submit(_req(0, "d0-again")).result(1)
+    assert r2.status == STATUS_OK and r2.cached
+    assert r2.token_1_prob == r1.token_1_prob
+    assert router.stats.dedup_hits == 1
+    assert len(a.submitted) == 1
+
+
+def test_concurrent_submits_resolve_exactly_once_each():
+    replicas = [(f"r{i}", FakeReplica(depth=i)) for i in range(3)]
+    router = _router(replicas)
+    futs = {}
+    lock = threading.Lock()
+
+    def client(tid):
+        for j in range(20):
+            rid = f"c{tid}-{j}"
+            f = router.submit(_req(1000 + tid * 100 + j, rid))
+            with lock:
+                futs[rid] = f
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(futs) == 80
+    for rid, f in futs.items():
+        assert f.result(1).request_id == rid
+    assert router.stats.completed == 80
+
+
+# ---------------------------------------------------------------------------
+# Real engines: replica-independence + end-to-end failover
+# ---------------------------------------------------------------------------
+
+_SERVE_CFG = ServeConfig(queue_depth=64, classes=(("t", 600.0),),
+                         default_class="t", linger_s=0.0)
+
+
+def _tiny_server(seed=2, batch=4):
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="router-t", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=1, n_heads=2,
+                      intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(seed))
+    rt = RuntimeConfig(batch_size=batch, max_seq_len=256)
+    engine = ScoringEngine(params, cfg, FakeTokenizer(), rt)
+    return ScoringServer(engine, "router-t", _SERVE_CFG)
+
+
+_PAYLOAD_FIELDS = ("model_response", "model_confidence_response",
+                   "token_1_prob", "token_2_prob", "log_probabilities",
+                   "confidence_value", "weighted_confidence")
+
+
+def test_router_end_to_end_replica_independent_bitwise():
+    """Config-identical replicas produce BITWISE-identical payloads, so
+    the router's answer cannot depend on which replica scored a row —
+    and a mid-run kill re-admits with zero dropped or double-resolved
+    requests."""
+    servers = [_tiny_server(seed=2) for _ in range(3)]
+    for s in servers:
+        s.start()
+    router = ReplicaRouter(
+        [(f"r{i}", s) for i, s in enumerate(servers)],
+        config=RouterConfig(replica_cooldown_s=0.2,
+                            cache_entries=0))  # dedup off: every
+    # request must genuinely dispatch so placement spreads.
+    try:
+        futs = [router.submit(_req(i, f"a{i}")) for i in range(8)]
+        res = [f.result(60) for f in futs]
+        assert all(r.status == STATUS_OK for r in res)
+        # Same probe through each replica directly: bitwise equal.
+        probe = _req(99, "probe")
+        direct = []
+        for s in servers:
+            r = s.submit(probe).result(60)
+            assert r.status == STATUS_OK
+            direct.append(tuple(getattr(r, f) for f in _PAYLOAD_FIELDS))
+        assert direct[0] == direct[1] == direct[2]
+        # Kill one replica with traffic in flight: everything still
+        # resolves ok, exactly once.
+        futs2 = [router.submit(_req(200 + i, f"b{i}"))
+                 for i in range(8)]
+        router.kill_replica("r1")
+        res2 = [f.result(60) for f in futs2]
+        assert all(r.status == STATUS_OK for r in res2)
+        assert len({r.request_id for r in res2}) == 8
+        assert router.stats.kills == 1
+        assert sorted(router.alive_replicas()) == ["r0", "r2"]
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()

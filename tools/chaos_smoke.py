@@ -35,6 +35,15 @@ The guard layer (lir_tpu/guard) adds the SILENT failure modes:
 6. MULTIHOST LIVENESS — a simulated dead peer (collectives that never
    complete) must raise HostDesyncError on the survivor within the
    liveness timeout (resumable exit) instead of hanging forever.
+7. STREAMING ACCUMULATOR — a mid-sweep kill with rows folded but not
+   checkpointed must resume to an accumulator bitwise-identical to an
+   uninterrupted run (idempotent slot folds).
+8. ELASTIC — a LEASED sweep killed mid-run is finished by a different
+   holder stealing the expired leases (accumulator bitwise vs the
+   static run), and a straggler replica behind the failover router
+   loses the hedge race with its late payload dropped: zero requests
+   lost or double-resolved (lir_tpu/serve/router.py +
+   lir_tpu/engine/lease.py).
 
 Runs hermetically on CPU (FakeTokenizer + tiny random decoder); prints
 the FaultStats/GuardStats summaries as JSON on success.
@@ -632,6 +641,190 @@ def multihost_chaos(failures):
     return {"desync_detect_s": round(elapsed, 2)}
 
 
+def elastic_chaos(failures):
+    """Scenario 8 (elastic serving): (a) a LEASED sweep killed mid-run
+    is finished by a DIFFERENT holder stealing the expired leases, and
+    the final accumulator is bitwise-identical to an uninterrupted
+    static run; (b) a straggler replica behind the router
+    (replica_lag) loses the hedge race and its late payload is dropped
+    — zero requests lost or double-resolved."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from lir_tpu import faults
+    from lir_tpu.config import RouterConfig, RuntimeConfig, ServeConfig
+    from lir_tpu.engine import lease as lease_mod
+    from lir_tpu.engine import stream_stats as stream_mod
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.serve import ReplicaRouter, ServeRequest
+
+    lp, perts = _grid(N_CELLS)
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        # (a) static baseline, then a leased run killed mid-sweep; a
+        # SECOND holder (host1 via a patched process_index) resumes
+        # after the short TTL expired and STEALS the dead holder's
+        # shards.
+        run_perturbation_sweep(_make_engine(), "elastic", lp, perts,
+                               td / "static.csv", checkpoint_every=4)
+        acc_static = stream_mod.load_accum(
+            (td / "static.csv").with_suffix(stream_mod.ACCUM_SUFFIX))
+
+        def leased_engine():
+            from lir_tpu.backends.fake import FakeTokenizer
+            from lir_tpu.engine.runner import ScoringEngine
+            from lir_tpu.models import decoder
+            from lir_tpu.models.registry import ModelConfig
+
+            mcfg = ModelConfig(name="chaos-smoke",
+                               vocab_size=FakeTokenizer.VOCAB,
+                               hidden_size=32, n_layers=1, n_heads=2,
+                               intermediate_size=64, max_seq_len=256)
+            params = decoder.init_params(mcfg, jax.random.PRNGKey(11))
+            return ScoringEngine(
+                params, mcfg, FakeTokenizer(),
+                RuntimeConfig(batch_size=BATCH, max_seq_len=256,
+                              piggyback_prefill=False,
+                              lease_shards=True, lease_ttl_s=0.05,
+                              lease_cells_per_shard=3))
+
+        engine = leased_engine()
+        plan = faults.FaultPlan(seed=9, schedules={
+            "dispatch": faults.SiteSchedule.kill_at(1)})
+        faults.wrap_engine(engine, plan)
+        leased_out = td / "leased.csv"
+        try:
+            run_perturbation_sweep(engine, "elastic", lp, perts,
+                                   leased_out, checkpoint_every=4)
+            failures.append("elastic: scheduled kill never fired")
+            return {}
+        except faults.InjectedPreemption:
+            pass
+        time.sleep(0.06)            # the dead holder's leases expire
+        saved_idx = jax.process_index
+        jax.process_index = lambda: 1       # the stealing holder
+        try:
+            run_perturbation_sweep(leased_engine(), "elastic", lp,
+                                   perts, leased_out,
+                                   checkpoint_every=4)
+        finally:
+            jax.process_index = saved_idx
+        acc = stream_mod.load_accum(
+            leased_out.with_suffix(stream_mod.ACCUM_SUFFIX))
+        same = (acc is not None and acc_static is not None
+                and np.array_equal(acc_static.filled, acc.filled)
+                and np.array_equal(acc_static.rel, acc.rel,
+                                   equal_nan=True)
+                and np.array_equal(acc_static.conf, acc.conf,
+                                   equal_nan=True)
+                and np.array_equal(acc_static.dec, acc.dec))
+        if not same:
+            failures.append("elastic: leased steal-resumed accumulator "
+                            "NOT bitwise-identical to the static run")
+        check = lease_mod.LeaseManager(
+            leased_out.with_suffix(lease_mod.LEASE_SUFFIX), "checker")
+        n_shards = -(-N_CELLS // 3)
+        if not all(check.is_done(s) for s in range(n_shards)):
+            failures.append("elastic: lease log does not show every "
+                            "shard done after the steal-resume")
+        holders = {(check.record(s) or {}).get("holder")
+                   for s in range(n_shards)}
+        if "host1" not in holders:
+            failures.append(f"elastic: no shard finished by the "
+                            f"stealing holder ({holders})")
+        out["lease_holders"] = sorted(h for h in holders if h)
+
+    # (b) straggler replica: r0 lags 1.5s on a dispatch; the hedge
+    # fires within the deadline whisker, the fast replica wins, and
+    # the straggler's late payload is dropped.
+    serve_cfg = ServeConfig(queue_depth=64, classes=(("smoke", 600.0),),
+                            default_class="smoke", linger_s=0.0)
+    servers = [_serve_server(serve_cfg, seed) for seed in (11, 11)]
+    for s in servers:
+        s.start()
+    body = "clause 9 covers wind damage under policy 63"
+
+    def lag_req(tag, i, deadline_s=None):
+        return ServeRequest(
+            binary_prompt=f"{body} {i} Answer Yes or No .",
+            confidence_prompt=f"{body} {i} Give a number from 0 to "
+                              f"100 .",
+            klass="smoke", deadline_s=deadline_s,
+            request_id=f"{tag}{i}")
+
+    # Warm both replicas DIRECTLY — two requests each, so BOTH
+    # cache-handoff variants (cold + warm donated) compile before the
+    # timed phase and the lagged run measures the lag, not a trace.
+    for si, s in enumerate(servers):
+        for w in (97, 99):
+            if s.submit(lag_req(f"warm{si}-", w)).result(60) \
+                    .status != "ok":
+                failures.append("elastic: straggler warmup failed")
+    router = ReplicaRouter(
+        [("r0", servers[0]), ("r1", servers[1])],
+        config=RouterConfig(hedge_s=1.9, tick_s=0.01,
+                            cache_entries=0)).start()
+    lag_plan = faults.FaultPlan(seed=4, schedules={
+        "replica": faults.SiteSchedule.replica_lag_at(0, 1.5, "r0")})
+    faults.wrap_replica(router, "r0", lag_plan)
+    try:
+        futs = [router.submit(lag_req("lag", i, deadline_s=2.0))
+                for i in range(4)]
+        res = [f.result(timeout=60) for f in futs]
+        # Wait for the straggler to finish and resolve LATE (observed
+        # and dropped), bounded well past the lag.
+        deadline = time.monotonic() + 10.0
+        while (router.stats.hedge_losses + router.stats.zombie_payloads
+               < 1 and time.monotonic() < deadline):
+            time.sleep(0.05)
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+    if not all(r.status == "ok" for r in res):
+        failures.append(f"elastic: straggler run statuses "
+                        f"{[r.status for r in res]}")
+    if len({r.request_id for r in res}) != 4:
+        failures.append("elastic: duplicated straggler results")
+    if lag_plan.injected("replica") != 1:
+        failures.append("elastic: replica_lag never fired")
+    if router.stats.hedged < 1:
+        failures.append("elastic: straggler was never hedged")
+    if router.stats.hedge_losses + router.stats.zombie_payloads < 1:
+        failures.append("elastic: the straggler's late payload was "
+                        "never observed-and-dropped")
+    if router.stats.completed != 4:
+        failures.append(f"elastic: router completed "
+                        f"{router.stats.completed} != 4")
+    out["router"] = router.stats.summary()
+    return out
+
+
+def _serve_server(cfg, seed):
+    import jax
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    mcfg = ModelConfig(name="elastic-serve",
+                       vocab_size=FakeTokenizer.VOCAB, hidden_size=32,
+                       n_layers=1, n_heads=2, intermediate_size=64,
+                       max_seq_len=256)
+    params = decoder.init_params(mcfg, jax.random.PRNGKey(seed))
+    from lir_tpu.serve import ScoringServer
+
+    engine = ScoringEngine(params, mcfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=BATCH,
+                                         max_seq_len=256))
+    return ScoringServer(engine, "elastic-serve", cfg)
+
+
 def main() -> int:
     failures = []
     sweep_summary = sweep_chaos(failures)
@@ -640,6 +833,7 @@ def main() -> int:
     serve_guard_summary = serve_guard_chaos(failures)
     mh_summary = multihost_chaos(failures)
     stream_summary = stream_accum_chaos(failures)
+    elastic_summary = elastic_chaos(failures)
     if failures:
         for f in failures:
             print(f"CHAOS-SMOKE FAIL: {f}")
@@ -648,7 +842,8 @@ def main() -> int:
                       "guard": guard_summary,
                       "serve_guard": serve_guard_summary,
                       "multihost": mh_summary,
-                      "stream": stream_summary}))
+                      "stream": stream_summary,
+                      "elastic": elastic_summary}))
     print("chaos smoke: OK (sweep resumed bitwise-identical after "
           "injected kill + torn manifest; breaker tripped and recovered "
           "via half-open probe; poison row isolated; checkpoint resume "
@@ -656,7 +851,10 @@ def main() -> int:
           "and recovered; NaN rows quarantined as error:numerics with "
           "clean rows bitwise-identical; dead peer detected within the "
           "liveness timeout; resume-merged streaming accumulators "
-          "bitwise-identical to an uninterrupted run)")
+          "bitwise-identical to an uninterrupted run; leased shards "
+          "stolen by a live holder converge bitwise on the static run "
+          "and a straggler replica's late payload is dropped, never "
+          "double-resolved)")
     return 0
 
 

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Elastic-serving smoke: the failover-router + shard-lease invariants
+the `make elastic-smoke` CI target guards:
+
+- 3 in-process replica servers (config-identical tiny engines) behind
+  a ReplicaRouter serve an open-loop request stream; a seeded
+  ``replica_kill`` schedule kills replica r1 mid-run — ZERO requests
+  dropped (every future resolves ok) and ZERO double-resolved (unique
+  request ids, resolve-once futures, zombie payloads dropped);
+- the killed replica's router-side breaker walks the survivor path:
+  open on the kill -> half_open after the cooldown once the replica
+  rejoins -> closed on the probe success;
+- a shard lease abandoned by a dead holder is STOLEN by a live holder
+  within one TTL of expiry, double-claims are refused while the lease
+  is live, and the stolen shard's re-folded rows merge bitwise
+  (identical-overlap union) with the dead holder's partial lattice.
+
+Runs hermetically on CPU (FakeTokenizer + tiny random decoders);
+prints the router/lease summaries as JSON on success.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BATCH = 4
+N_WAVES = 6
+PER_WAVE = 4
+
+
+def _tiny_server(cfg_serve, seed=2):
+    import jax
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import ScoringServer
+
+    cfg = ModelConfig(name="elastic-smoke",
+                      vocab_size=FakeTokenizer.VOCAB, hidden_size=32,
+                      n_layers=1, n_heads=2, intermediate_size=64,
+                      max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(seed))
+    engine = ScoringEngine(params, cfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=BATCH,
+                                         max_seq_len=256))
+    return ScoringServer(engine, "elastic-smoke", cfg_serve)
+
+
+def router_smoke(failures):
+    from lir_tpu import faults
+    from lir_tpu.config import RouterConfig, ServeConfig
+    from lir_tpu.serve import ReplicaRouter, ServeRequest
+
+    serve_cfg = ServeConfig(queue_depth=64, classes=(("smoke", 600.0),),
+                            default_class="smoke", linger_s=0.0)
+    servers = [_tiny_server(serve_cfg).start() for _ in range(3)]
+    router = ReplicaRouter(
+        [(f"r{i}", s) for i, s in enumerate(servers)],
+        config=RouterConfig(replica_failure_threshold=1,
+                            replica_cooldown_s=0.3,
+                            cache_entries=0)).start()
+    # Seeded kill: r1's SECOND dispatch dies (mid-run, with the router
+    # loaded) — the router observes the death first, then the dispatch
+    # raises, exactly like an abrupt host loss.
+    plan = faults.FaultPlan(seed=7, schedules={
+        "replica": faults.SiteSchedule.replica_kill_at(1, "r1")})
+    faults.wrap_replica(router, "r1", plan)
+
+    def request(i):
+        body = f"clause {i} covers wind damage under policy {i * 7}"
+        return ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="smoke", request_id=f"q{i}")
+
+    results = []
+    revived = False
+    try:
+        for w in range(N_WAVES):
+            futs = [router.submit(request(w * PER_WAVE + j))
+                    for j in range(PER_WAVE)]
+            results += [f.result(timeout=60) for f in futs]
+            if plan.injected("replica") and not revived:
+                # The kill has fired: the replica is out of placement
+                # (alive=False, breaker tripped). Let it rejoin for
+                # the recovery half; the breaker's full
+                # open -> half_open -> closed walk is asserted from
+                # its transition log below.
+                if "r1" in router.alive_replicas():
+                    failures.append("r1 still alive after the kill")
+                router.revive_replica("r1")
+                revived = True
+                time.sleep(0.35)      # past the cooldown -> half-open
+    finally:
+        router.stop()
+
+    if not plan.injected("replica"):
+        failures.append("scheduled replica_kill never fired")
+    # Zero dropped: every request resolved ok. Zero duplicated: ids
+    # unique and the router completed exactly len(results).
+    bad = [r for r in results if r.status != "ok"]
+    if bad:
+        failures.append(f"{len(bad)} requests not served ok after the "
+                        f"kill: {[r.status for r in bad[:4]]}")
+    ids = [r.request_id for r in results]
+    if len(set(ids)) != len(ids) or len(ids) != N_WAVES * PER_WAVE:
+        failures.append(f"dropped/duplicated requests: {len(ids)} "
+                        f"results, {len(set(ids))} unique")
+    if router.stats.completed != N_WAVES * PER_WAVE:
+        failures.append(f"router completed {router.stats.completed} != "
+                        f"{N_WAVES * PER_WAVE}")
+    # Survivor-path breaker story: open (kill) -> half_open (cooldown
+    # after rejoin) -> closed (probe success).
+    transitions = [f"{a}->{b}"
+                   for a, b in router.breaker_of("r1").stats.transitions]
+    for want in ("closed->open", "open->half_open",
+                 "half_open->closed"):
+        if want not in transitions:
+            failures.append(f"r1 breaker transition {want} missing "
+                            f"({transitions})")
+    for s in servers:
+        s.stop()
+    return {"router": router.stats.summary(),
+            "r1_breaker_transitions": transitions}
+
+
+def lease_smoke(failures):
+    import tempfile
+
+    import numpy as np
+
+    from lir_tpu.engine import lease as lease_mod
+    from lir_tpu.engine import stream_stats as stream_mod
+    from lir_tpu.stats import streaming
+
+    ttl = 10.0
+    with tempfile.TemporaryDirectory() as td:
+        log = Path(td) / "sweep.leases.jsonl"
+        now_a, now_b = {"t": 0.0}, {"t": 0.0}
+        a = lease_mod.LeaseManager(log, "hostA", ttl_s=ttl,
+                                   clock=lambda: now_a["t"])
+        b = lease_mod.LeaseManager(log, "hostB", ttl_s=ttl,
+                                   clock=lambda: now_b["t"])
+        if not a.claim(0):
+            failures.append("hostA could not claim an unclaimed shard")
+        now_b["t"] = 1.0
+        if b.claim(0, steal=True):
+            failures.append("live lease was double-claimed")
+        # hostA dies (no renewals). Within ONE TTL of expiry, hostB's
+        # steal succeeds.
+        now_b["t"] = ttl + 1.0
+        if not b.claim(0, steal=True):
+            failures.append("expired lease was not stolen within one "
+                            "TTL")
+        if b.stats.steals != 1:
+            failures.append(f"steal counter {b.stats.steals} != 1")
+        stolen_after = now_b["t"] - ttl     # seconds past expiry
+        if stolen_after > ttl:
+            failures.append("steal took longer than one TTL")
+
+        # The stolen shard's re-folded rows: hostA folded rows 0-3
+        # before dying; hostB re-scores the WHOLE shard (0-5). The
+        # identical-overlap union equals an uninterrupted fold.
+        import jax.numpy as jnp
+
+        class _Cell:
+            def __init__(self, p, r):
+                self.prompt_idx, self.rephrase_idx = p, r
+
+        def fold(sink, rng_rows):
+            for r in rng_rows:
+                yes = np.float32(0.2 + 0.1 * r)
+                sink.fold(jnp.asarray([yes]),
+                          jnp.asarray([1 - yes], jnp.float32),
+                          jnp.asarray([10.0 * r], jnp.float32),
+                          jnp.zeros((1, 1), jnp.float32),
+                          [_Cell(0, r)], topk=1)
+
+        full = stream_mod.StreamSink(1, 6, seed=1)
+        fold(full, range(6))
+        sa = stream_mod.StreamSink(1, 6, seed=1)
+        fold(sa, range(4))
+        sb = stream_mod.StreamSink(1, 6, seed=1)
+        fold(sb, range(6))
+        merged = streaming.merge_accums(
+            [sa.snapshot(), sb.snapshot()],
+            allow_identical_overlap=True)
+        want = full.snapshot()
+        same = (np.array_equal(merged.filled, want.filled)
+                and np.array_equal(merged.rel, want.rel, equal_nan=True)
+                and np.array_equal(merged.conf, want.conf,
+                                   equal_nan=True)
+                and np.array_equal(merged.dec, want.dec))
+        if not same:
+            failures.append("stolen-shard merge is not bitwise equal "
+                            "to the uninterrupted lattice")
+        return {"lease_a": a.stats.summary(),
+                "lease_b": b.stats.summary(),
+                "stolen_s_after_expiry": stolen_after}
+
+
+def main() -> int:
+    failures = []
+    router_summary = router_smoke(failures)
+    lease_summary = lease_smoke(failures)
+    if failures:
+        for f in failures:
+            print(f"ELASTIC-SMOKE FAIL: {f}")
+        return 1
+    print(json.dumps({"router": router_summary, "lease": lease_summary}))
+    print("elastic smoke: OK (replica killed mid-run with zero "
+          "dropped/duplicated requests; breaker open->half_open->closed"
+          " across the rejoin; expired lease stolen within one TTL; "
+          "stolen-shard lattice merge bitwise-identical)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
