@@ -4,7 +4,7 @@
 
 .PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
 	kernel-smoke stats-smoke fleet-smoke observe-smoke elastic-smoke \
-	install-hooks
+	spec-smoke install-hooks
 
 verify: lint
 	python tools/check_tier1.py
@@ -92,6 +92,15 @@ fleet-smoke:
 # (tools/observe_smoke.py).
 observe-smoke:
 	JAX_PLATFORMS=cpu python tools/observe_smoke.py
+
+# Speculative-decode smoke: confidence-tail grid on the fake backend,
+# scored twice — pass 2 drafts each row's whole continuation from the
+# radix tree's token history and verifies it in one multi-query
+# forward. Asserts nonzero accepted tokens, >= 2x fewer decode
+# dispatches per row on the warm pass, and speculation-ON == OFF
+# payloads bitwise (tools/spec_smoke.py; DEPLOY.md §1n).
+spec-smoke:
+	JAX_PLATFORMS=cpu python tools/spec_smoke.py
 
 # Elastic-serving smoke: 3 in-process replicas behind the failover
 # router on the fake backend — a seeded replica_kill mid-run must lose

@@ -253,6 +253,13 @@ def main() -> None:
                          "bitwise == within_group_kappa, and goodput "
                          ">= 0.95x the off baseline — headline key "
                          "\"observatory\")")
+    ap.add_argument("--no-speculative", action="store_true",
+                    help="skip the speculative-decode mode (identical "
+                         "confidence-tail grid swept speculation-ON vs "
+                         "OFF: >= 2x fewer decode dispatches per row on "
+                         "the warm pass, per-cell results bitwise, CPU "
+                         "interpret-mode kernel parity included — "
+                         "headline key \"speculative\")")
     ap.add_argument("--no-elastic", action="store_true",
                     help="skip the elastic-serving mode (3 replica "
                          "servers behind the failover router, 1 killed "
@@ -663,6 +670,19 @@ def main() -> None:
                 headline["elastic"] = elastic
         except (Exception, SystemExit) as err:  # noqa: BLE001
             print(f"# elastic bench mode failed ({err!r}); headline "
+                  "is unaffected", file=sys.stderr)
+    # Speculative mode (ROADMAP item 3): the identical grid swept
+    # speculation-ON vs OFF — >= 2x fewer decode dispatches per row on
+    # the warm (prompt-lookup-drafted) pass, per-cell results bitwise,
+    # interpret-mode verify-kernel parity included. Failures never
+    # discard the headline.
+    if not args.no_speculative:
+        try:
+            speculative = _spec_bench(on_accel)
+            if speculative is not None:
+                headline["speculative"] = speculative
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# speculative bench mode failed ({err!r}); headline "
                   "is unaffected", file=sys.stderr)
     # Chaos mode (--chaos): the same serving layer under a seeded
     # transient fault schedule — the robustness cost (recovery work +
@@ -1900,6 +1920,173 @@ def _observatory_bench(on_accel: bool):
         "completed_off": int(off_completed),
         "trace_spans": n_spans,
         "metrics_sources": len(snap["sources"]),
+    }
+
+
+def _spec_bench(on_accel: bool):
+    """Speculative-decode mode (ROADMAP item 3): the identical
+    confidence-tail grid swept twice on a speculation-ON engine (pass 2
+    drafts every row's continuation from the radix tree's token
+    history, recorded during pass 1) and twice on a speculation-OFF
+    engine. Gates asserted before reporting:
+
+    - PARITY: every per-cell result (the full value-column row —
+      probabilities, confidence, top-20 map, response text) is
+      bitwise-identical between ON and OFF, on both the cold and the
+      warm pass — speculation is a pure perf lever;
+    - the warm pass runs >= 2x FEWER decode dispatches per row than
+      the sequential scan (SpecStats decode_forwards vs seq_forwards
+      — the verify window replaces spec_k sequential steps when drafts
+      land);
+    - CPU interpret-mode parity: the SAME comparison with the Pallas
+      multi-query verify kernel engaged under the interpreter
+      (flash_decode_mq — the kernel that runs compiled on the chip),
+      so the fused verify route is covered off-TPU too.
+    """
+    import tempfile
+
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data import schemas
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.models import decoder as decoder_mod
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="spec-bench", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                      intermediate_size=64, max_seq_len=512)
+    params = decoder_mod.init_params(cfg, jax.random.PRNGKey(37))
+    rng = np.random.default_rng(41)
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible adjuster").split()
+
+    def text(n):
+        return " ".join(rng.choice(words) for _ in range(n))
+
+    lp = (LegalPrompt(main=text(40) + " ?",
+                      response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Give a number from 0 to 100 ."),)
+    perts = ([text(40) for _ in range(11)],)
+
+    def engine(spec_on):
+        return ScoringEngine(params, cfg, FakeTokenizer(), RuntimeConfig(
+            batch_size=4, max_seq_len=512, spec_decode=spec_on, spec_k=4,
+            piggyback_prefill=False, prefix_cache=True,
+            prefix_cache_pages=256))
+
+    value_cols = ["Token_1_Prob", "Token_2_Prob", "Confidence Value",
+                  "Weighted Confidence", "Log Probabilities",
+                  "Model Response", "Model Confidence Response"]
+
+    def rows_by_key(path):
+        df = schemas.read_results_frame(path)
+        return {
+            (r["Rephrased Main Part"], r["Response Format"]): tuple(
+                r[c] for c in value_cols)
+            for _, r in df.iterrows()}
+
+    def sweep_twice(spec_on, td):
+        eng = engine(spec_on)
+        run_perturbation_sweep(eng, "spec-bench", lp, perts,
+                               td / f"{spec_on}-cold.csv",
+                               checkpoint_every=6)
+        eng.spec_flush()
+        cold_fwd = eng.spec_stats.decode_forwards
+        cold_seq = eng.spec_stats.seq_forwards
+        run_perturbation_sweep(eng, "spec-bench", lp, perts,
+                               td / f"{spec_on}-warm.csv",
+                               checkpoint_every=6)
+        eng.spec_flush()
+        return eng, cold_fwd, cold_seq
+
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        eng_on, cold_fwd, cold_seq = sweep_twice(True, td)
+        eng_off, _, _ = sweep_twice(False, td)
+        parity_ok = True
+        for leg in ("cold", "warm"):
+            on = rows_by_key(td / f"True-{leg}.csv")
+            off = rows_by_key(td / f"False-{leg}.csv")
+            for k, want in off.items():
+                got = on.get(k)
+                if got is None:
+                    parity_ok = False
+                    continue
+                for g, w in zip(got, want):
+                    if pd.isna(g) and pd.isna(w):
+                        continue
+                    if g != w:
+                        parity_ok = False
+        assert parity_ok, "speculative ON vs OFF per-cell results diverged"
+
+        s = eng_on.spec_stats
+        warm_fwd = s.decode_forwards - cold_fwd
+        warm_seq = s.seq_forwards - cold_seq
+        ratio = warm_seq / max(warm_fwd, 1)
+        assert s.accepted_tokens > 0, "no draft was ever accepted"
+        assert ratio >= 2.0, (
+            f"warm pass ran only {ratio:.2f}x fewer decode dispatches")
+
+    # Interpret-mode leg: the Pallas multi-query verify kernel under the
+    # interpreter (the compiled-kernel route, off-chip) — consumed
+    # readouts must still match the sequential fused path exactly.
+    interp_ok = True
+    if not on_accel:
+        prev = decoder_mod.FUSED_DECODE_INTERPRET_ON_CPU
+        decoder_mod.FUSED_DECODE_INTERPRET_ON_CPU = True
+        try:
+            fcfg = ModelConfig(name="spec-bench-interp",
+                               vocab_size=FakeTokenizer.VOCAB,
+                               hidden_size=32, n_layers=1, n_heads=2,
+                               intermediate_size=64, max_seq_len=256,
+                               fused_decode=True)
+            fparams = decoder_mod.init_params(fcfg, jax.random.PRNGKey(5))
+            tokz = FakeTokenizer()
+            bp = [text(20) + " yes or no" for _ in range(3)]
+            cp = [p + " give confidence" for p in bp]
+
+            def one(spec_on):
+                eng = ScoringEngine(fparams, fcfg, tokz, RuntimeConfig(
+                    batch_size=4, max_seq_len=256, spec_decode=spec_on,
+                    spec_k=3, piggyback_prefill=False, fused_decode=True))
+                yes = np.full((3,), eng.yes_id, np.int32)
+                no = np.full((3,), eng.no_id, np.int32)
+                return jax.device_get(eng.decode_fused_shared(
+                    bp, cp, yes, no, new_tokens=3, conf_tokens=4,
+                    reuse_cache=True))
+
+            a_on, c_on = one(True)
+            a_off, c_off = one(False)
+            for on_o, off_o in ((a_on, a_off), (c_on, c_off)):
+                interp_ok &= np.array_equal(np.asarray(on_o.generated),
+                                            np.asarray(off_o.generated))
+                interp_ok &= np.array_equal(
+                    np.asarray(on_o.p_yes)[:, 0],
+                    np.asarray(off_o.p_yes)[:, 0])
+                interp_ok &= np.array_equal(
+                    np.asarray(on_o.topk_logprobs),
+                    np.asarray(off_o.topk_logprobs))
+            assert interp_ok, "interpret-mode speculative parity failed"
+        finally:
+            decoder_mod.FUSED_DECODE_INTERPRET_ON_CPU = prev
+
+    return {
+        "dispatches_per_row_ratio": round(ratio, 2),
+        "warm_decode_forwards": int(warm_fwd),
+        "warm_seq_forwards": int(warm_seq),
+        "accept_rate": round(s.accept_rate, 4),
+        "accepted_tokens": int(s.accepted_tokens),
+        "rejected_tokens": int(s.rejected_tokens),
+        "draft_source": s.summary()["draft_source"],
+        "parity_ok": bool(parity_ok),
+        "interp_parity_ok": bool(interp_ok),
     }
 
 

@@ -169,6 +169,7 @@ def _add_perturb(sub) -> None:
     _add_engine_tuning_flags(p)
     _add_guard_flags(p)
     _add_kernel_flags(p)
+    _add_spec_flags(p)
     _add_trace_flags(p)
     p.add_argument("--barrier-timeout", type=float, default=None,
                    help="multihost liveness bound in seconds: a shard-"
@@ -344,6 +345,60 @@ def _kernel_rt_kw(args, rt_kw: dict) -> None:
         rt_kw["fused_decode"] = False
     if getattr(args, "no_piggyback", False):
         rt_kw["piggyback_prefill"] = False
+
+
+def _add_spec_flags(p) -> None:
+    """Speculative-decode knobs (engine/spec.py + RuntimeConfig.
+    spec_decode/spec_k/spec_draft_model, Config.spec SpecConfig),
+    shared by perturb and serve."""
+    p.add_argument("--no-spec-decode", action="store_true",
+                   help="disable speculative scoring decode (draft k "
+                        "tokens, verify in one multi-query forward; ON "
+                        "by default for self-drafting — consumed "
+                        "results are bitwise either way, DEPLOY.md §1n)")
+    p.add_argument("--spec-k", type=_positive_int, default=None,
+                   help="speculative verify window: tokens checked per "
+                        "verify forward (1 emission + up to k-1 drafts; "
+                        "default 4, < 2 disables)")
+    p.add_argument("--spec-draft-model", type=str, default=None,
+                   help="fleet model id that DRAFTS for the scored "
+                        "model (same tokenizer required; acquired "
+                        "through the weight cache so drafting never "
+                        "evicts the verifier). Empty = self-drafting "
+                        "(radix-tree + n-gram prompt lookup)")
+    p.add_argument("--spec-ngram", type=_positive_int, default=None,
+                   help="n-gram match length for the prompt-lookup "
+                        "fallback drafter (default 2)")
+    p.add_argument("--no-spec-tree-probe", action="store_true",
+                   help="skip the radix prefix tree's token-history "
+                        "continuation probe when drafting (n-gram "
+                        "lookup only)")
+    p.add_argument("--spec-tree-tails", type=_positive_int, default=None,
+                   help="continuation tails recorded per radix node for "
+                        "drafting, LRU beyond this (default 32; host "
+                        "memory only)")
+
+
+def _spec_rt_kw(args, rt_kw: dict) -> None:
+    if getattr(args, "no_spec_decode", False):
+        rt_kw["spec_decode"] = False
+    if getattr(args, "spec_k", None) is not None:
+        rt_kw["spec_k"] = args.spec_k
+    if getattr(args, "spec_draft_model", None) is not None:
+        rt_kw["spec_draft_model"] = args.spec_draft_model
+
+
+def _spec_config_from_args(args):
+    from .config import SpecConfig
+
+    kw = {}
+    if getattr(args, "spec_ngram", None) is not None:
+        kw["ngram"] = args.spec_ngram
+    if getattr(args, "no_spec_tree_probe", False):
+        kw["tree_probe"] = False
+    if getattr(args, "spec_tree_tails", None) is not None:
+        kw["tree_tails_per_node"] = args.spec_tree_tails
+    return SpecConfig(**kw)
 
 
 def _add_trace_flags(p) -> None:
@@ -641,6 +696,7 @@ def _add_serve(sub) -> None:
     _add_engine_tuning_flags(p)
     _add_guard_flags(p)
     _add_kernel_flags(p)
+    _add_spec_flags(p)
     _add_trace_flags(p)
     _add_observatory_flags(p)
     _add_router_flags(p)
@@ -797,6 +853,7 @@ def cmd_perturb(args) -> None:
     _engine_rt_kw(args, rt_kw)
     _guard_rt_kw(args, rt_kw)
     _kernel_rt_kw(args, rt_kw)
+    _spec_rt_kw(args, rt_kw)
     _prefix_rt_kw(args, rt_kw)
     if args.no_row_artifact:
         rt_kw["row_artifact"] = False
@@ -814,6 +871,7 @@ def cmd_perturb(args) -> None:
         _parse_mesh(args.mesh), cache_root=args.param_cache,
         quantize_int8=args.int8, int8_dynamic=args.int8_dynamic,
         kv_cache_int8=args.kv_cache_int8,
+        spec_config=_spec_config_from_args(args),
     )
     entries = load_or_generate_perturbations(
         args.perturbations, LEGAL_PROMPTS, None
@@ -847,6 +905,7 @@ def cmd_serve(args) -> None:
     _engine_rt_kw(args, rt_kw)
     _guard_rt_kw(args, rt_kw)
     _kernel_rt_kw(args, rt_kw)
+    _spec_rt_kw(args, rt_kw)
     _prefix_rt_kw(args, rt_kw)
     classes = dict(ServeConfig().classes)
     for spec in args.deadline or ():
@@ -895,7 +954,8 @@ def cmd_serve(args) -> None:
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
         cache_root=args.param_cache, quantize_int8=args.int8,
-        int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8)
+        int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8,
+        spec_config=_spec_config_from_args(args))
     if args.fleet_models:
         try:
             _run_fleet_serve(args, serve_cfg, factory)
@@ -1237,7 +1297,8 @@ def cmd_precompile(args) -> None:
     factory = engine_factory(
         args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
         cache_root=args.param_cache, quantize_int8=args.int8,
-        int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8)
+        int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8,
+        spec_config=_spec_config_from_args(args))
     engine = factory(args.model)
     specs = compile_plan.sweep_specs_for_ladder(engine, sfx_buckets=sfx)
     t0 = time.perf_counter()

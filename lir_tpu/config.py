@@ -223,6 +223,28 @@ class RuntimeConfig:
     # the slowest static shard. Single-process runs work identically
     # (one holder claims every shard in order).
     lease_shards: bool = False        # host-only
+    # Speculative scoring decode (engine/spec.py + generate.
+    # greedy_decode_fused_shared_spec; DEPLOY.md §1n). ON: shared-path
+    # dispatches draft up to spec_k tokens ahead (prompt-lookup from
+    # the radix tree's token history + n-gram self-lookup, or a small
+    # fleet draft model when spec_draft_model names one) and VERIFY
+    # them in one multi-query pass through the decode attention path —
+    # the ≤10-token sequential scan collapses to ~T/k verify forwards
+    # when drafts land. Greedy acceptance keeps every consumed result
+    # (scored rows, serve payloads: position-0 readouts + generated
+    # text) BITWISE identical to the sequential scan (pinned by
+    # tests/test_spec_decode.py); a rejected draft only costs
+    # re-verification. Piggyback chains take precedence offline
+    # (--no-piggyback makes every shared dispatch eligible); the
+    # drafting-policy knobs live on Config.spec (SpecConfig).
+    spec_decode: bool = True
+    # Verify window: tokens checked per verify forward (1 emission + up
+    # to spec_k-1 accepted drafts). < 2 disables speculation.
+    spec_k: int = 4
+    # Fleet model id that drafts for this engine (acquired through the
+    # PR-10 WeightCache so drafting never evicts the verifier
+    # mid-dispatch). Empty = self-drafting (tree + n-gram lookup).
+    spec_draft_model: str = ""
     # Lease time-to-live in WALL-CLOCK seconds (leases compare across
     # hosts, so the shared clock is time.time, not monotonic). A holder
     # renews on every flush; a lease older than this is stealable.
@@ -231,6 +253,32 @@ class RuntimeConfig:
     # shards rebalance finer but renew/claim more often. <= 0 derives
     # ~4 shards per host from the grid.
     lease_cells_per_shard: int = 0    # host-only; cli: --lease-cells
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decode DRAFTING policy (engine/spec.py; DEPLOY.md
+    §1n). These knobs steer where draft tokens come from — they can
+    change speed, never results (greedy acceptance keeps every accepted
+    token identical to the sequential scan's, so outputs are bitwise
+    regardless of draft quality). The on/off switch and verify-window
+    size live on RuntimeConfig (``spec_decode``/``spec_k``/
+    ``spec_draft_model``) because those change compiled shapes."""
+
+    # N-gram match length for the prompt-lookup fallback drafter: the
+    # verify scan drafts the tokens that followed the most recent
+    # earlier occurrence of the last `ngram` context tokens (prompt +
+    # already-accepted emissions).
+    ngram: int = 2                    # cli: --spec-ngram
+    # Probe the radix prefix tree's token history for a whole-window
+    # draft of the dispatch's continuation (prefix_tree.continuation)
+    # before falling back to n-gram matching. Needs the prefix cache
+    # (the tree) to be enabled on the engine; silently off otherwise.
+    tree_probe: bool = True           # cli: --no-spec-tree-probe
+    # Continuation tails recorded per radix node (host memory only, LRU
+    # beyond this): each completed dispatch records its prompt's
+    # observed continuation so a repeat visit drafts the whole reply.
+    tree_tails_per_node: int = 32     # cli: --spec-tree-tails
 
 
 @dataclasses.dataclass(frozen=True)
@@ -509,6 +557,7 @@ class Config:
     backend: str = "tpu"  # "tpu" (local JAX inference) | "api" (remote, optional)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     runtime: RuntimeConfig = dataclasses.field(default_factory=RuntimeConfig)
+    spec: SpecConfig = dataclasses.field(default_factory=SpecConfig)
     perturbation: PerturbationConfig = dataclasses.field(default_factory=PerturbationConfig)
     stats: StatsConfig = dataclasses.field(default_factory=StatsConfig)
     retry: RetryConfig = dataclasses.field(default_factory=RetryConfig)

@@ -64,7 +64,12 @@ class ShapeSpec:
     selects the donated-KV-cache variant (every dispatch after the
     first of a bucket queue donates the previous cache —
     runner._CacheHandoff; paged and unpaged variants of one shape
-    return the same cache aval, so the chain crosses them freely)."""
+    return the same cache aval, so the chain crosses them freely).
+    ``spec_k`` > 0 selects the SPECULATIVE-decode executable for that
+    verify-window size (generate.greedy_decode_fused_shared_spec /
+    _paged_spec — the verify executables are planned per (bucket,
+    batch, k)); ``spec_draft`` its fleet-draft-model variant (the
+    draft model's params ride the traced pytree)."""
 
     kind: str
     bucket: int
@@ -77,6 +82,8 @@ class ShapeSpec:
     stops_armed: bool
     scratch: bool
     window: int = 0
+    spec_k: int = 0
+    spec_draft: bool = False
 
     @property
     def label(self) -> str:
@@ -85,16 +92,22 @@ class ShapeSpec:
                else str(self.sfx_a))
         var = "donated" if self.scratch else "fresh"
         win = f"/win{self.window}" if self.window else ""
+        spec = ""
+        if self.spec_k:
+            spec = f"/spec{self.spec_k}" + ("+draft" if self.spec_draft
+                                            else "")
         return (f"{self.kind}/b{self.bucket}x{self.batch}/sfx{sfx}"
-                f"/new{self.new_tokens}-{self.conf_tokens}{win}/{var}")
+                f"/new{self.new_tokens}-{self.conf_tokens}{win}{spec}/{var}")
 
 
 def shared_spec(bucket: int, batch: int, sfx_a: int, sfx_b: int,
                 new_tokens: int, conf_tokens: int, stops_armed: bool,
-                scratch: bool) -> ShapeSpec:
+                scratch: bool, spec_k: int = 0,
+                spec_draft: bool = False) -> ShapeSpec:
     return ShapeSpec("shared", int(bucket), int(batch), 0, int(sfx_a),
                      int(sfx_b), int(new_tokens), int(conf_tokens),
-                     bool(stops_armed), bool(scratch))
+                     bool(stops_armed), bool(scratch),
+                     spec_k=int(spec_k), spec_draft=bool(spec_draft))
 
 
 def grouped_spec(bucket: int, groups: int, batch: int, sfx: int,
@@ -107,11 +120,12 @@ def grouped_spec(bucket: int, groups: int, batch: int, sfx: int,
 
 def shared_paged_spec(bucket: int, batch: int, window: int, sfx_a: int,
                       sfx_b: int, new_tokens: int, conf_tokens: int,
-                      stops_armed: bool, scratch: bool) -> ShapeSpec:
+                      stops_armed: bool, scratch: bool,
+                      spec_k: int = 0) -> ShapeSpec:
     return ShapeSpec("shared_paged", int(bucket), int(batch), 0,
                      int(sfx_a), int(sfx_b), int(new_tokens),
                      int(conf_tokens), bool(stops_armed), bool(scratch),
-                     int(window))
+                     int(window), spec_k=int(spec_k))
 
 
 def grouped_paged_spec(bucket: int, groups: int, batch: int, window: int,
@@ -171,6 +185,7 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
                prefix_page_size: int = 0,
                piggyback: bool = False,
                stream_shape: Optional[Tuple[int, int, bool]] = None,
+               spec_k: int = 0, spec_draft: bool = False,
                ) -> List[ShapeSpec]:
     """Distinct executables a dispatch plan will call, in first-use order
     (the precompile pool works the list front-to-back, so the first
@@ -225,6 +240,15 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
             add(shared_spec(d.bucket, m_pad, d.sfx_bucket_a,
                             d.sfx_bucket_b, new_tokens, conf_tokens,
                             stops_armed, scratch=scratch))
+            if spec_k:
+                # Speculative verify executables, planned per
+                # (bucket, batch, k) alongside the sequential shape
+                # (the runner falls back to it on a spec-ineligible
+                # dispatch).
+                add(shared_spec(d.bucket, m_pad, d.sfx_bucket_a,
+                                d.sfx_bucket_b, new_tokens, conf_tokens,
+                                stops_armed, scratch=scratch,
+                                spec_k=spec_k, spec_draft=spec_draft))
             if piggyback and scratch:
                 # A repeat of the previous shared shape — the sweep will
                 # chain these dispatches: plan all three chain stages.
@@ -243,6 +267,15 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
                         d.bucket, m_pad, w, d.sfx_bucket_a, d.sfx_bucket_b,
                         new_tokens, conf_tokens, stops_armed,
                         scratch=scratch))
+                    if spec_k and not spec_draft:
+                        # Paged + speculative composes for self-drafting
+                        # only (the paged front binds slot tables, not
+                        # prefix tokens — nothing for a draft model to
+                        # prefill from).
+                        add(shared_paged_spec(
+                            d.bucket, m_pad, w, d.sfx_bucket_a,
+                            d.sfx_bucket_b, new_tokens, conf_tokens,
+                            stops_armed, scratch=scratch, spec_k=spec_k))
         else:
             sfx = max(d.sfx_bucket_a, d.sfx_bucket_b)
             max_new = max(new_tokens, conf_tokens)
@@ -263,11 +296,45 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
 # Lowering: exact aval reconstruction of the runner's call sites
 # ---------------------------------------------------------------------------
 
+def _spec_avals(engine, spec: ShapeSpec):
+    """The eight drafting-array avals (SpecPlan.dyn_args order) appended
+    to a speculative executable's argument list."""
+    import jax
+    import jax.numpy as jnp
+
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    B = spec.batch
+    return (i32(B, spec.bucket + spec.sfx_a + spec.new_tokens), i32(B),
+            i32(B, spec.new_tokens), i32(B),
+            i32(B, spec.bucket + spec.sfx_b + spec.conf_tokens), i32(B),
+            i32(B, spec.conf_tokens), i32(B))
+
+
+def _spec_statics(engine, spec: ShapeSpec) -> dict:
+    out = dict(spec_k=spec.spec_k, ngram=int(engine.spec_cfg.ngram))
+    return out
+
+
+def _spec_draft_kwargs(engine, spec: ShapeSpec):
+    """(dynamic kwargs, statics) arming the fleet draft model in a
+    speculative executable's signature."""
+    if not spec.spec_draft:
+        return {"draft_params": None}, {"draft_cfg": None}
+    draft_params, draft_cfg, _ = engine._spec_draft
+    import jax
+
+    avals = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype),
+        draft_params)
+    return {"draft_params": avals}, {"draft_cfg": draft_cfg}
+
+
 def _avals_shared(engine, spec: ShapeSpec):
     """(args, kwargs) ShapeDtypeStructs matching runner.decode_fused_shared's
-    call into generate.greedy_decode_fused_shared — one canonical layout
-    shared with :func:`_registry_call` so lowering and dispatch can never
-    drift apart."""
+    call into generate.greedy_decode_fused_shared (or its speculative
+    sibling when ``spec.spec_k``) — one canonical layout shared with
+    :func:`_registry_call` so lowering and dispatch can never drift
+    apart."""
     import jax
     import jax.numpy as jnp
 
@@ -288,6 +355,11 @@ def _avals_shared(engine, spec: ShapeSpec):
     statics = dict(max_new_a=spec.new_tokens, max_new_b=spec.conf_tokens,
                    topk=TOPK, prefill_fn=engine._prefill_fn,
                    return_cache=True)
+    if spec.spec_k:
+        args = args + _spec_avals(engine, spec)
+        dk, ds = _spec_draft_kwargs(engine, spec)
+        kwargs.update(dk)
+        statics.update(_spec_statics(engine, spec), **ds)
     return args, kwargs, statics
 
 
@@ -352,6 +424,9 @@ def _avals_shared_paged(engine, spec: ShapeSpec):
     )
     statics = dict(max_new_a=spec.new_tokens, max_new_b=spec.conf_tokens,
                    topk=TOPK, return_cache=True)
+    if spec.spec_k:
+        args = args + _spec_avals(engine, spec)
+        statics.update(_spec_statics(engine, spec))
     return args, kwargs, statics
 
 
@@ -443,10 +518,12 @@ def _lower_compile(engine, spec: ShapeSpec):
         return fn.lower(engine.params, engine.cfg, *args, **kwargs,
                         **statics).compile()
     if spec.kind == "shared":
-        fn = generate.greedy_decode_fused_shared
+        fn = (generate.greedy_decode_fused_shared_spec if spec.spec_k
+              else generate.greedy_decode_fused_shared)
         args, kwargs, statics = _avals_shared(engine, spec)
     elif spec.kind == "shared_paged":
-        fn = generate.greedy_decode_fused_shared_paged
+        fn = (generate.greedy_decode_fused_shared_paged_spec
+              if spec.spec_k else generate.greedy_decode_fused_shared_paged)
         args, kwargs, statics = _avals_shared_paged(engine, spec)
     elif spec.kind == "grouped_paged":
         fn = generate.greedy_decode_fused_grouped_paged
@@ -661,6 +738,11 @@ def sweep_specs_for_ladder(engine, sfx_buckets: Sequence[int] = (8, 16),
 
         windows = lambda b: paged_mod.window_edges(  # noqa: E731
             b, engine.prefix_cache.page_size)
+    sk = 0
+    sdraft = False
+    if getattr(engine, "spec_supported", lambda: False)():
+        sk = rt.spec_k
+        sdraft = getattr(engine, "_spec_draft", None) is not None
     specs = []
     for bucket in engine.buckets:
         for sfx in sfx_buckets:
@@ -670,6 +752,11 @@ def sweep_specs_for_ladder(engine, sfx_buckets: Sequence[int] = (8, 16),
                     specs.append(shared_spec(
                         bucket, batch, sfx, sfx, new_tokens,
                         conf_tokens, stops_armed, scratch))
+                    if sk:
+                        specs.append(shared_spec(
+                            bucket, batch, sfx, sfx, new_tokens,
+                            conf_tokens, stops_armed, scratch,
+                            spec_k=sk, spec_draft=sdraft))
                     if windows:
                         # Block-table variants: one per remainder-window
                         # edge, so a warm serve dispatch resuming from
@@ -678,6 +765,11 @@ def sweep_specs_for_ladder(engine, sfx_buckets: Sequence[int] = (8, 16),
                             specs.append(shared_paged_spec(
                                 bucket, batch, w, sfx, sfx, new_tokens,
                                 conf_tokens, stops_armed, scratch))
+                            if sk and not sdraft:
+                                specs.append(shared_paged_spec(
+                                    bucket, batch, w, sfx, sfx,
+                                    new_tokens, conf_tokens, stops_armed,
+                                    scratch, spec_k=sk))
     return specs
 
 

@@ -232,6 +232,39 @@ class ModelFleet:
     def release(self, model_id: str) -> None:
         self.cache.release(model_id)
 
+    # -- speculative drafting (engine/spec.py) -------------------------------
+
+    def acquire_spec_draft(self, engine, model_id: str) -> Optional[str]:
+        """When ``engine.rt.spec_draft_model`` names ANOTHER fleet
+        model, make that model's weights resident and REFCOUNTED
+        (WeightCache.acquire — unevictable for the dispatch window, so
+        drafting can never evict the verifier mid-dispatch, nor the
+        verifier the drafter) and arm the verifier's fleet drafting
+        (ScoringEngine.set_spec_draft). Returns the draft model id to
+        hand back to :meth:`release_spec_draft`, or None when fleet
+        drafting doesn't apply (self-draft mode, unknown draft id,
+        drafting for itself)."""
+        draft_id = getattr(engine.rt, "spec_draft_model", "")
+        if (not draft_id or draft_id == model_id
+                or draft_id not in self._slots
+                or not getattr(engine, "spec_supported", lambda: False)()):
+            return None
+        dengine = self.acquire(draft_id)
+        try:
+            engine.set_spec_draft(dengine.params, dengine.cfg, draft_id)
+        except BaseException:
+            self.release(draft_id)
+            raise
+        return draft_id
+
+    def release_spec_draft(self, engine, draft_id: Optional[str]) -> None:
+        """Disarm fleet drafting and drop the draft weights' dispatch
+        reference (the LRU cache decides residency from here)."""
+        if draft_id is None:
+            return
+        engine.clear_spec_draft()
+        self.release(draft_id)
+
     def pin(self, model_id: str) -> None:
         self.cache.pin(model_id)
 

@@ -458,6 +458,506 @@ def greedy_decode_fused_grouped_paged(params, cfg: ModelConfig, pool,
 
 
 # ---------------------------------------------------------------------------
+# Speculative scoring decode (prompt-lookup / fleet drafting, fused verify)
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SpecOut:
+    """Per-branch speculative-decode accounting, read out host-side into
+    profiling.SpecStats. ``drafted``/``accepted`` are (3,) int32 token
+    counts by draft source — 0 = radix-tree continuation, 1 = n-gram
+    prompt-lookup (including fallback filler), 2 = fleet draft model.
+    ``chunks`` counts the verify forwards actually run; ``seq_steps``
+    the forwards the sequential scan would have run on the same rows
+    (its all-done early exit included), so chunks vs seq_steps IS the
+    dispatch-reduction headline."""
+
+    drafted: jax.Array    # (3,) int32
+    accepted: jax.Array   # (3,) int32
+    chunks: jax.Array     # () int32
+    seq_steps: jax.Array  # () int32
+
+
+def _stop_transition(emit, done, digit_run, prev_ew, stop_mask, eos_id):
+    """One emission's stop-state transition — EXACTLY _fused_tail's rules
+    (shared so the speculative scan's done/digit-run evolution can never
+    drift from the sequential scan's)."""
+    cls = stop_mask[emit]
+    pure = (cls & _tok.STOP_PURE) != 0
+    prefix = (cls & _tok.STOP_PREFIX) != 0
+    glue = (cls & _tok.STOP_STARTS_WORD) != 0
+    ends_w = (cls & _tok.STOP_ENDS_WORD) != 0
+    transp = (cls & _tok.STOP_TRANSPARENT) != 0
+    new_done = done | (emit == eos_id) | (digit_run & ~glue & ~transp)
+    new_run = jnp.where(
+        transp, digit_run,
+        (pure & (prefix | ~prev_ew)) | (digit_run & pure & ~prefix))
+    new_ew = jnp.where(transp, prev_ew, ends_w)
+    return new_done, new_run, new_ew
+
+
+def _spec_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
+               cache_mask0: jax.Array, pos0: jax.Array, slot0: int,
+               yes_ids: jax.Array, no_ids: jax.Array, digit_ids: jax.Array,
+               digit_vals: jax.Array, max_new_tokens: int, topk: int,
+               spec_k: int, ctx0: jax.Array, ctx0_len: jax.Array,
+               draft_tokens: jax.Array, draft_len: jax.Array,
+               stop_mask: jax.Array = None, eos_id: jax.Array = None,
+               ngram: int = 2, draft_params=None, draft_cfg=None,
+               dcache=None):
+    """The speculative counterpart of :func:`_fused_tail`: instead of T
+    sequential decode steps, scan up to T verify WINDOWS of ``spec_k``
+    teacher-forced positions each — [pending emission, draft, draft, ...]
+    — through ONE multi-query forward (decoder.verify_extend), then
+    greedily accept the draft prefix the verifier's own argmax confirms.
+    A window emits between 1 and spec_k tokens and consumes exactly
+    spec_k cache slots (rejected tails stay mask-0 garbage, the
+    early-stop discipline), so the cache is sized slot0 + T*spec_k.
+
+    Parity contract (pinned by tests/test_spec_decode.py): every
+    CONSUMED result is bitwise the sequential scan's, and the per-step
+    float rows match within float tolerance —
+
+    - an accepted draft is accepted BECAUSE it equals the verifier's
+      argmax at that position, so the emitted token stream, the top-2
+      stream, and every position-0 readout (target probabilities,
+      top-20 logprob map, weighted confidence — the whole shared-path
+      readout surface, sweep rows and serve payloads alike) are
+      bitwise-identical; interior per-step probabilities come from the
+      verify forward, whose logits are argmax-identical and
+      tolerance-equal to decode_step's (decoder.verify_extend — the
+      window cache's extra masked slots can regroup reduction lanes,
+      the same bar PR-7's fused-vs-dense kernels cleared);
+    - done rows advance on forced-EOS "drafts", reproducing the
+      sequential scan's EOS-fed evolution, and once every row is done
+      the positions past the global stop step are rewritten with the
+      stop-step values — the sequential scan's all-done freeze,
+      recovered exactly.
+
+    Draft sources, per position (quality-only — a bad draft is simply
+    rejected): the host-probed radix-tree continuation ``draft_tokens``
+    (B, T) valid below ``draft_len``; an in-scan ``ngram``-gram lookup
+    over ``ctx0`` (the row's compacted prompt, right-padded to
+    ctx-width >= prompt + T) extended with accepted emissions; or, when
+    ``draft_params`` is given, a fleet draft model running spec_k
+    sequential small steps per window over its own ``dcache`` (same
+    slot layout, same masks). Returns (FusedDecodeOut, final cache,
+    final draft cache, SpecOut)."""
+    assert spec_k >= 2, "speculation needs a draft window of >= 2"
+    early = stop_mask is not None and eos_id is not None
+    fleet = draft_params is not None
+    T = max_new_tokens
+    B = logits0.shape[0]
+    W = ctx0.shape[1]
+
+    # Position-0 extras — identical to _fused_tail.
+    logp0 = logits0 - jax.scipy.special.logsumexp(
+        logits0, axis=-1, keepdims=True)
+    tk_vals, tk_ids = lax.top_k(logp0, topk)
+    p_digits = jnp.exp(logp0[:, digit_ids])
+    mass = jnp.maximum(p_digits.sum(axis=-1), 1e-10)
+    wconf = (p_digits * digit_vals[None, :]).sum(axis=-1) / mass
+
+    rows = jnp.arange(B)
+    i32 = jnp.int32
+    zeros_b = jnp.zeros((B,), bool)
+
+    carry0 = dict(
+        logits=logits0, cache=cache, cache_mask=cache_mask0,
+        done=zeros_b, digit_run=zeros_b, prev_ew=zeros_b,
+        filled=jnp.zeros((B,), i32), done_step=jnp.full((B,), T, i32),
+        ctx=ctx0, ctx_n=ctx0_len.astype(i32),
+        gen=jnp.zeros((B, T), i32),
+        p_yes=jnp.zeros((B, T), jnp.float32),
+        p_no=jnp.zeros((B, T), jnp.float32),
+        top2=jnp.zeros((B, T, 2), i32),
+        drafted=jnp.zeros((3,), i32), accepted=jnp.zeros((3,), i32),
+        chunks=jnp.zeros((), i32),
+    )
+    if fleet:
+        carry0["dcache"] = dcache
+
+    def _scatter_row(buf, idx, val, ok):
+        """Per-row scatter at (row, idx) where ``ok`` (dropped rows index
+        out of range)."""
+        eff = jnp.where(ok, idx, T)
+        return buf.at[rows, eff].set(val, mode="drop")
+
+    def _gather_ctx(ctx, idx):
+        return jnp.take_along_axis(
+            ctx, jnp.clip(idx, 0, W - 1)[:, None], axis=1)[:, 0]
+
+    def _window(carry, c):
+        all_done = jnp.all(carry["done"])
+        tstar = jnp.max(carry["done_step"])
+        needed = jnp.where(all_done, jnp.minimum(T, tstar + 1), T)
+        go = jnp.min(carry["filled"]) < needed
+
+        def run(carry):
+            logits = carry["logits"]
+            cache_mask = carry["cache_mask"]
+            done = carry["done"]
+            digit_run = carry["digit_run"]
+            prev_ew = carry["prev_ew"]
+            filled = carry["filled"]
+            done_step = carry["done_step"]
+            ctx, ctx_n = carry["ctx"], carry["ctx_n"]
+            gen_b, py_b = carry["gen"], carry["p_yes"]
+            pn_b, t2_b = carry["p_no"], carry["top2"]
+            drafted, accepted = carry["drafted"], carry["accepted"]
+            base = slot0 + c * spec_k
+            live0 = filled < T
+            done0 = done
+
+            # -- emission 0: the pending token, from the carried logits.
+            nxt = jnp.argmax(logits, axis=-1).astype(i32)
+            e0 = jnp.where(done, eos_id, nxt) if early else nxt
+            py0, pn0, t20 = _small_readout(logits, yes_ids, no_ids)
+            gen_b = _scatter_row(gen_b, filled, e0, live0)
+            py_b = _scatter_row(py_b, filled, py0, live0)
+            pn_b = _scatter_row(pn_b, filled, pn0, live0)
+            t2_b = _scatter_row(t2_b, filled, t20, live0)
+            if early:
+                nd, nr, ne = _stop_transition(e0, done, digit_run, prev_ew,
+                                              stop_mask, eos_id)
+                done_step = jnp.where(live0 & nd & ~done, filled, done_step)
+                done = jnp.where(live0, nd, done)
+                digit_run = jnp.where(live0, nr, digit_run)
+                prev_ew = jnp.where(live0, ne, prev_ew)
+            eff = jnp.where(live0, jnp.clip(ctx_n, 0, W - 1),
+                            jnp.full((B,), W, i32))
+            ctx = ctx.at[rows, eff].set(e0, mode="drop")
+            ctx_n = ctx_n + live0.astype(i32)
+
+            # -- drafts for window positions 1..spec_k-1 ------------------
+            drafts, src_tree = [], []
+            if fleet:
+                dc = carry["dcache"]
+                dm = cache_mask
+                tok = e0
+                for j in range(spec_k):
+                    dm = lax.dynamic_update_slice(
+                        dm, jnp.ones((B, 1), dm.dtype), (0, base + j))
+                    dl, dc = decoder.decode_step(
+                        draft_params, draft_cfg, dc, tok,
+                        pos0 + filled + j, base + j, dm)
+                    if j < spec_k - 1:
+                        d = jnp.argmax(dl, axis=-1).astype(i32)
+                        if early:
+                            d = jnp.where(done, eos_id, d)
+                        drafts.append(d)
+                        src_tree.append(jnp.zeros((B,), bool))
+                        tok = d
+                new_dcache = dc
+            else:
+                # n-gram pattern: the last `ngram` context tokens
+                # (prompt + emissions, e0 included).
+                n_pos = W - ngram + 1
+                pidx = jnp.arange(n_pos)
+                eq = jnp.ones((B, n_pos), bool)
+                for m in range(ngram):
+                    pat_m = _gather_ctx(ctx, ctx_n - ngram + m)
+                    eq = eq & (ctx[:, m:m + n_pos] == pat_m[:, None])
+                ok_pos = (pidx[None, :] + ngram <= ctx_n[:, None] - 1)
+                ok_pos = ok_pos & (ctx_n >= ngram)[:, None]
+                best = jnp.where(eq & ok_pos, pidx[None, :], -1).max(axis=1)
+                for j in range(1, spec_k):
+                    t_idx = filled + j
+                    tval = jnp.take_along_axis(
+                        draft_tokens, jnp.clip(t_idx, 0, T - 1)[:, None],
+                        axis=1)[:, 0]
+                    t_ok = t_idx < draft_len
+                    ng_idx = best + ngram + (j - 1)
+                    ngval = _gather_ctx(ctx, ng_idx)
+                    ng_ok = (best >= 0) & (ng_idx < ctx_n)
+                    d = jnp.where(t_ok, tval,
+                                  jnp.where(ng_ok, ngval, jnp.zeros((), i32)))
+                    if early:
+                        d = jnp.where(done, eos_id, d)
+                    drafts.append(d)
+                    src_tree.append(t_ok & ~done)
+
+            # -- ONE fused verify over [e0, drafts...] --------------------
+            X = jnp.stack([e0] + drafts, axis=1)           # (B, spec_k)
+            cm_run = lax.dynamic_update_slice(
+                cache_mask, jnp.ones((B, spec_k), cache_mask.dtype),
+                (0, base))
+            V, new_cache = decoder.verify_extend(
+                params, cfg, carry["cache"], X, cm_run, base)
+
+            # -- greedy acceptance + per-position emissions ---------------
+            acc = live0
+            n_new = live0.astype(i32)
+            d_state, r_state, e_state = done, digit_run, prev_ew
+            for j in range(1, spec_k):
+                Vj = V[:, j - 1]
+                rj = jnp.argmax(Vj, axis=-1).astype(i32)
+                if early:
+                    rj = jnp.where(d_state, eos_id, rj)
+                can = acc & (filled + j < T)
+                ok = can & (X[:, j] == rj)
+                pyj, pnj, t2j = _small_readout(Vj, yes_ids, no_ids)
+                gen_b = _scatter_row(gen_b, filled + j, rj, ok)
+                py_b = _scatter_row(py_b, filled + j, pyj, ok)
+                pn_b = _scatter_row(pn_b, filled + j, pnj, ok)
+                t2_b = _scatter_row(t2_b, filled + j, t2j, ok)
+                eff = jnp.where(ok, jnp.clip(ctx_n - 1 + j, 0, W - 1),
+                                jnp.full((B,), W, i32))
+                ctx = ctx.at[rows, eff].set(rj, mode="drop")
+                if early:
+                    nd, nr, ne = _stop_transition(rj, d_state, r_state,
+                                                  e_state, stop_mask, eos_id)
+                    done_step = jnp.where(ok & nd & ~d_state, filled + j,
+                                          done_step)
+                    d_state = jnp.where(ok, nd, d_state)
+                    r_state = jnp.where(ok, nr, r_state)
+                    e_state = jnp.where(ok, ne, e_state)
+                counted = can & ~done0
+                if fleet:
+                    drafted = drafted.at[2].add(jnp.sum(counted, dtype=i32))
+                    accepted = accepted.at[2].add(
+                        jnp.sum(ok & ~done0, dtype=i32))
+                else:
+                    tr = src_tree[j - 1]
+                    drafted = drafted.at[0].add(
+                        jnp.sum(counted & tr, dtype=i32))
+                    drafted = drafted.at[1].add(
+                        jnp.sum(counted & ~tr, dtype=i32))
+                    accepted = accepted.at[0].add(
+                        jnp.sum(ok & ~done0 & tr, dtype=i32))
+                    accepted = accepted.at[1].add(
+                        jnp.sum(ok & ~done0 & ~tr, dtype=i32))
+                n_new = n_new + ok.astype(i32)
+                acc = ok
+
+            # Next pending logits = after the LAST emitted token.
+            last = jnp.clip(n_new - 1, 0, spec_k - 1)
+            nl = jnp.take_along_axis(V, last[:, None, None], axis=1)[:, 0]
+            new_logits = jnp.where(live0[:, None], nl, logits)
+            # Shrink the window's validity to the emitted prefix.
+            cols = (jnp.arange(spec_k)[None, :]
+                    < n_new[:, None]).astype(cache_mask.dtype)
+            new_mask = lax.dynamic_update_slice(cm_run, cols, (0, base))
+            ctx_n = ctx_n + (n_new - live0.astype(i32))
+
+            out = dict(carry)
+            out.update(logits=new_logits, cache=new_cache,
+                       cache_mask=new_mask, done=d_state,
+                       digit_run=r_state, prev_ew=e_state,
+                       filled=filled + n_new, done_step=done_step,
+                       ctx=ctx, ctx_n=ctx_n, gen=gen_b, p_yes=py_b,
+                       p_no=pn_b, top2=t2_b, drafted=drafted,
+                       accepted=accepted,
+                       chunks=carry["chunks"] + jnp.ones((), i32))
+            if fleet:
+                out["dcache"] = new_dcache
+            return out
+
+        return lax.cond(go, run, lambda car: car, carry), None
+
+    carry, _ = lax.scan(_window, carry0, jnp.arange(T))
+
+    gen_b, py_b = carry["gen"], carry["p_yes"]
+    pn_b, t2_b = carry["p_no"], carry["top2"]
+    if early:
+        # The sequential scan's all-done freeze: once EVERY row is done
+        # (global stop step t*), it skips the model forward and repeats
+        # the t*-step values to the end of the budget. Recover exactly
+        # that tail from the evolved buffers.
+        all_done = jnp.all(carry["done"])
+        tstar = jnp.max(carry["done_step"])
+        fr = jnp.clip(tstar, 0, T - 1)
+        pos = jnp.arange(T)[None, :]
+        tail = all_done & (pos > tstar)
+        gen_b = jnp.where(tail, eos_id, gen_b)
+        py_b = jnp.where(tail, py_b[:, fr][:, None], py_b)
+        pn_b = jnp.where(tail, pn_b[:, fr][:, None], pn_b)
+        t2_b = jnp.where(tail[..., None], t2_b[:, fr][:, None, :], t2_b)
+        seq_steps = jnp.where(all_done, jnp.minimum(tstar, T),
+                              jnp.full((), T, i32)).astype(i32)
+    else:
+        seq_steps = jnp.full((), T, i32)
+
+    out = FusedDecodeOut(
+        generated=gen_b, p_yes=py_b, p_no=pn_b, top2_ids=t2_b,
+        topk_logprobs=tk_vals, topk_ids=tk_ids, weighted_confidence=wconf)
+    spec = SpecOut(drafted=carry["drafted"], accepted=carry["accepted"],
+                   chunks=carry["chunks"], seq_steps=seq_steps)
+    return out, carry["cache"], carry.get("dcache"), spec
+
+
+def _shared_spec_branches(params, cfg: ModelConfig, cache, dcache,
+                          prefix_mask, sfx_a, sfx_a_mask, sfx_b, sfx_b_mask,
+                          yes_ids, no_ids, digit_ids, digit_vals,
+                          ctx_a, ctx_a_len, draft_a, draft_a_len,
+                          ctx_b, ctx_b_len, draft_b, draft_b_len,
+                          T0: int, max_new_a: int, max_new_b: int,
+                          spec_k: int, ngram: int, topk: int,
+                          stop_mask_a, stop_mask_b, eos_id,
+                          draft_params, draft_cfg, return_cache: bool):
+    """Both format branches of a shared-prefix dispatch through the
+    speculative tail — branch B consumes branch A's cache buffer exactly
+    as the sequential path does (masks keep the branches disjoint).
+
+    The suffix extension that produces each branch's position-0 logits
+    runs over a cache VIEW truncated to the SEQUENTIAL path's extent
+    (``T0_seq``), its suffix k/v written back into the full speculative
+    cache afterward: reduction lane grouping follows the attention
+    extent, so extending at the inflated spec extent would wobble the
+    position-0 readouts' low bits — truncation keeps the whole CONSUMED
+    readout surface bitwise the sequential path's, and only the verify
+    windows (whose interior floats are tolerance-bound anyway) reduce
+    at the longer extent."""
+    B, S = prefix_mask.shape
+    empty_ids = jnp.zeros((0,), jnp.int32)
+    empty_vals = jnp.zeros((0,), jnp.float32)
+    T0_seq = S + max(sfx_a.shape[1] + max_new_a,
+                     sfx_b.shape[1] + max_new_b)
+
+    def _extend_seq_extent(ext_params, ext_cfg, cache_in, sfx, sfx_mask):
+        S2 = sfx.shape[1]
+        cm_seq = jnp.concatenate(
+            [prefix_mask, sfx_mask,
+             jnp.zeros((B, T0_seq - S - S2), prefix_mask.dtype)], axis=1)
+        view = jax.tree.map(
+            lambda a: lax.slice_in_dim(a, 0, T0_seq, axis=2), cache_in)
+        logits_l, view2, pos = decoder.extend(
+            ext_params, ext_cfg, view, sfx, sfx_mask, cm_seq, S)
+        # Write only the suffix slots back — the extension touched
+        # nothing else.
+        cache2 = jax.tree.map(
+            lambda full, v: lax.dynamic_update_slice_in_dim(
+                full, lax.slice_in_dim(v, S, S + S2, axis=2), S, axis=2),
+            cache_in, view2)
+        return logits_l, cache2, pos
+
+    def branch(cache_in, dcache_in, sfx, sfx_mask, new_tokens, d_ids,
+               d_vals, ctx, ctx_len, dr, dr_len, stop_mask):
+        S2 = sfx.shape[1]
+        cm = jnp.concatenate(
+            [prefix_mask, sfx_mask,
+             jnp.zeros((B, T0 - S - S2), prefix_mask.dtype)], axis=1)
+        logits_l, cache2, pos = _extend_seq_extent(
+            params, cfg, cache_in, sfx, sfx_mask)
+        dcache2 = None
+        if dcache_in is not None:
+            _, dcache2, _ = _extend_seq_extent(
+                draft_params, draft_cfg, dcache_in, sfx, sfx_mask)
+        return _spec_tail(
+            params, cfg, logits_l, cache2, cm, pos, S + S2, yes_ids,
+            no_ids, d_ids, d_vals, new_tokens, topk, spec_k, ctx, ctx_len,
+            dr, dr_len, stop_mask=stop_mask, eos_id=eos_id, ngram=ngram,
+            draft_params=draft_params, draft_cfg=draft_cfg, dcache=dcache2)
+
+    out_a, cache_a, dcache_a, spec_a = branch(
+        cache, dcache, sfx_a, sfx_a_mask, max_new_a, empty_ids, empty_vals,
+        ctx_a, ctx_a_len, draft_a, draft_a_len, stop_mask_a)
+    out_b, cache_b, _, spec_b = branch(
+        cache_a, dcache_a, sfx_b, sfx_b_mask, max_new_b, digit_ids,
+        digit_vals, ctx_b, ctx_b_len, draft_b, draft_b_len, stop_mask_b)
+    if return_cache:
+        return out_a, out_b, spec_a, spec_b, cache_b
+    return out_a, out_b, spec_a, spec_b
+
+
+def spec_total_len(bucket: int, sfx_a: int, sfx_b: int, max_new_a: int,
+                   max_new_b: int, spec_k: int) -> int:
+    """Cache length a speculative shared dispatch allocates: each of the
+    T decode windows owns spec_k slots (rejected tails stay masked), so
+    the decode region is budget * spec_k instead of budget."""
+    return bucket + max(sfx_a + max_new_a * spec_k,
+                        sfx_b + max_new_b * spec_k)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_a", "max_new_b", "topk",
+                                    "spec_k", "ngram", "draft_cfg",
+                                    "prefill_fn", "return_cache"),
+                   donate_argnames=("scratch_cache",))
+def greedy_decode_fused_shared_spec(
+        params, cfg: ModelConfig, prefix: jax.Array, prefix_mask: jax.Array,
+        sfx_a: jax.Array, sfx_a_mask: jax.Array, sfx_b: jax.Array,
+        sfx_b_mask: jax.Array, yes_ids: jax.Array, no_ids: jax.Array,
+        digit_ids: jax.Array, digit_vals: jax.Array,
+        ctx_a: jax.Array, ctx_a_len: jax.Array, draft_a: jax.Array,
+        draft_a_len: jax.Array, ctx_b: jax.Array, ctx_b_len: jax.Array,
+        draft_b: jax.Array, draft_b_len: jax.Array,
+        max_new_a: int, max_new_b: int, spec_k: int, ngram: int = 2,
+        topk: int = 20, prefill_fn=None, stop_mask_b: jax.Array = None,
+        stop_mask_a: jax.Array = None, eos_id: jax.Array = None,
+        draft_params=None, draft_cfg: ModelConfig = None,
+        return_cache: bool = False, scratch_cache=None):
+    """:func:`greedy_decode_fused_shared` with SPECULATIVE decode tails:
+    one shared-prefix prefill, two suffix extensions, then each branch's
+    sequential greedy scan is replaced by the draft-and-verify window
+    scan (:func:`_spec_tail` — per-row accept lengths, per-row stop
+    conditions, consumed results bitwise the sequential path's,
+    per-step float rows to tolerance). ``ctx_*`` carry
+    each branch's compacted prompt tokens for the in-scan n-gram
+    drafter; ``draft_*`` the host-probed radix-tree continuations;
+    ``draft_params``/``draft_cfg`` arm fleet-model drafting instead
+    (same tokenizer/vocab as the verifier — the engine enforces it).
+    Returns (binary out, confidence out, binary SpecOut, confidence
+    SpecOut[, final cache])."""
+    del scratch_cache  # donated scratch: memory reuse only, never read
+    B, S = prefix.shape
+    S2a, S2b = sfx_a.shape[1], sfx_b.shape[1]
+    T0 = spec_total_len(S, S2a, S2b, max_new_a, max_new_b, spec_k)
+    pf = prefill_fn or decoder.prefill
+    _, cache, _ = pf(params, cfg, prefix, prefix_mask, T0)
+    dcache = None
+    if draft_params is not None:
+        _, dcache, _ = decoder.prefill(draft_params, draft_cfg, prefix,
+                                       prefix_mask, T0)
+    return _shared_spec_branches(
+        params, cfg, cache, dcache, prefix_mask, sfx_a, sfx_a_mask, sfx_b,
+        sfx_b_mask, yes_ids, no_ids, digit_ids, digit_vals,
+        ctx_a, ctx_a_len, draft_a, draft_a_len, ctx_b, ctx_b_len, draft_b,
+        draft_b_len, T0, max_new_a, max_new_b, spec_k, ngram, topk,
+        stop_mask_a, stop_mask_b, eos_id, draft_params, draft_cfg,
+        return_cache)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "max_new_a", "max_new_b", "topk",
+                                    "spec_k", "ngram", "return_cache"),
+                   donate_argnames=("scratch_cache",))
+def greedy_decode_fused_shared_paged_spec(
+        params, cfg: ModelConfig, pool, slot_src: jax.Array,
+        win_start: jax.Array, prefix_mask: jax.Array, rem: jax.Array,
+        rem_mask: jax.Array, sfx_a: jax.Array, sfx_a_mask: jax.Array,
+        sfx_b: jax.Array, sfx_b_mask: jax.Array, yes_ids: jax.Array,
+        no_ids: jax.Array, digit_ids: jax.Array, digit_vals: jax.Array,
+        ctx_a: jax.Array, ctx_a_len: jax.Array, draft_a: jax.Array,
+        draft_a_len: jax.Array, ctx_b: jax.Array, ctx_b_len: jax.Array,
+        draft_b: jax.Array, draft_b_len: jax.Array,
+        max_new_a: int, max_new_b: int, spec_k: int, ngram: int = 2,
+        topk: int = 20, stop_mask_b: jax.Array = None,
+        stop_mask_a: jax.Array = None, eos_id: jax.Array = None,
+        return_cache: bool = False, scratch_cache=None):
+    """Speculative decode over the radix-paged prefill front: cached
+    prefix pages gather from the pool and only the remainder window
+    recomputes (:func:`_paged_prefix`), then both branches run the
+    speculative tail — prefill savings AND decode savings on one warm
+    dispatch (self-drafting only: the paged executable binds slot
+    tables, not prefix tokens, so there is nothing for a draft model to
+    prefill from)."""
+    del scratch_cache  # donated scratch: memory reuse only, never read
+    B, S = prefix_mask.shape
+    S2a, S2b = sfx_a.shape[1], sfx_b.shape[1]
+    T0 = spec_total_len(S, S2a, S2b, max_new_a, max_new_b, spec_k)
+    cache = _paged_prefix(params, cfg, pool, slot_src, win_start,
+                          prefix_mask, rem, rem_mask, T0)
+    return _shared_spec_branches(
+        params, cfg, cache, None, prefix_mask, sfx_a, sfx_a_mask, sfx_b,
+        sfx_b_mask, yes_ids, no_ids, digit_ids, digit_vals,
+        ctx_a, ctx_a_len, draft_a, draft_a_len, ctx_b, ctx_b_len, draft_b,
+        draft_b_len, T0, max_new_a, max_new_b, spec_k, ngram, topk,
+        stop_mask_a, stop_mask_b, eos_id, None, None, return_cache)
+
+
+# ---------------------------------------------------------------------------
 # Chunked prefill/decode piggybacking (Sarathi-Serve-style)
 # ---------------------------------------------------------------------------
 

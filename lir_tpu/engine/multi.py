@@ -203,9 +203,14 @@ def run_model_comparison_sweep(
     for i, spec in enumerate(specs):
         log.info("=== %s (%s) ===", spec.name, spec.base_or_instruct)
         acquired = False
+        draft_id = None
         try:
             engine = fleet.acquire(spec.name)
             acquired = True
+            # Fleet drafting (engine/spec.py): a co-resident small
+            # model drafts for this verifier, both weight refcounts
+            # held for the model's whole dispatch stream.
+            draft_id = fleet.acquire_spec_draft(engine, spec.name)
             if i + 1 < len(specs):
                 # The prefetch pipeline: the next model's weights stream
                 # on the background worker while this model's dispatches
@@ -270,6 +275,7 @@ def run_model_comparison_sweep(
             # co-resident (budget headroom -> free re-acquire later) or
             # reclaims its HBM under pressure.
             if acquired:
+                fleet.release_spec_draft(engine, draft_id)
                 fleet.release(spec.name)
         all_rows.extend(rows)
         mem = device_memory_stats()

@@ -58,9 +58,15 @@ log = get_logger(__name__)
 
 class _Node:
     """One cached page: ``key`` is the page's token-id chunk (within the
-    parent's context), ``page`` its pool page id."""
+    parent's context), ``page`` its pool page id. ``tails`` is the
+    node's TOKEN HISTORY for speculative drafting: observed
+    continuations of sequences ending at this node, keyed by the
+    sub-page remainder between the node's depth and the recording
+    sequence's end ({remainder tuple -> continuation tuple}, insertion-
+    ordered for LRU capping). Host memory only — no pool pages, no
+    HBM."""
 
-    __slots__ = ("key", "page", "children", "parent", "clock")
+    __slots__ = ("key", "page", "children", "parent", "clock", "tails")
 
     def __init__(self, key: Tuple[int, ...], page: int,
                  parent: Optional["_Node"]):
@@ -69,6 +75,7 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.parent = parent
         self.clock = 0
+        self.tails: Dict[Tuple[int, ...], Tuple[int, ...]] = {}
 
 
 @dataclasses.dataclass
@@ -162,6 +169,77 @@ class RadixPrefixCache:
         the pages cached; they merely become evictable again (a pinned
         node can never leave the tree — :meth:`_evictable_leaves`)."""
         self.pool.decref(match.pages)
+
+    # -- token history (speculative drafting) --------------------------------
+
+    def continuation(self, bucket: int, ids: Sequence[int],
+                     k: int) -> Tuple[int, ...]:
+        """READ-ONLY probe: up to ``k`` tokens the tree's own token
+        history predicts will follow ``ids`` in the ``bucket``
+        namespace — the prompt-lookup self-drafting source
+        (engine/spec.py). Two histories compose, page-key descent
+        first:
+
+        - deeper PAGE KEYS: another sequence cached with ``ids`` as a
+          proper prefix contributes its next chunks (most-recently-
+          touched child wins — the workload's rephrasings make the
+          hottest continuation the likeliest);
+        - recorded TAILS (:meth:`record_tail`): a previously completed
+          dispatch of this exact prompt contributes its observed
+          continuation (suffix + emissions) beyond the paged prefix.
+
+        Takes no references, touches no clocks, and is advisory by
+        construction: a wrong continuation is merely a draft the
+        verifier rejects (bitwise results regardless —
+        tests/test_spec_decode.py)."""
+        ids = [int(t) for t in ids]
+        path = self._walk(bucket, ids, touch=False)
+        depth = len(path)
+        node = path[-1] if path else self._roots.get(int(bucket))
+        if node is None:
+            return ()
+        rem = tuple(ids[depth * self.page_size:])
+        out: List[int] = []
+        while len(out) < k:
+            cands = [c for key, c in node.children.items()
+                     if key[:len(rem)] == rem]
+            if not cands:
+                break
+            child = max(cands, key=lambda n: n.clock)
+            out.extend(child.key[len(rem):])
+            node, rem = child, ()
+        if len(out) < k:
+            tail = node.tails.get(rem)
+            if tail:
+                out.extend(tail)
+        return tuple(out[:k])
+
+    def record_tail(self, bucket: int, ids: Sequence[int],
+                    tail: Sequence[int], max_tails: int = 32,
+                    max_tokens: int = 512) -> bool:
+        """Record that ``ids`` was observed continuing with ``tail``
+        (the dispatch's format suffix + emitted tokens): the token-
+        history side of the tree, host memory only. The record lands on
+        the deepest node whose pages cover ``ids`` (or the namespace
+        root), keyed by the sub-page remainder; per-node entries are
+        LRU-capped at ``max_tails`` and a remainder+tail longer than
+        ``max_tokens`` is refused (a sequence that shares no pages
+        with anything cached is not worth remembering whole)."""
+        ids = [int(t) for t in ids]
+        tail = tuple(int(t) for t in tail)
+        if not tail:
+            return False
+        path = self._walk(bucket, ids, touch=False)
+        depth = len(path)
+        node = path[-1] if path else self._root(bucket)
+        rem = tuple(ids[depth * self.page_size:])
+        if len(rem) + len(tail) > max_tokens:
+            return False
+        node.tails.pop(rem, None)           # re-insert = most recent
+        node.tails[rem] = tail
+        while len(node.tails) > max_tails:
+            node.tails.pop(next(iter(node.tails)))
+        return True
 
     # -- write side ----------------------------------------------------------
 

@@ -22,13 +22,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import RuntimeConfig
+from ..config import RuntimeConfig, SpecConfig
 from ..guard.watchdog import DispatchWatchdog
 from ..models import decoder, paged, quant
 from ..utils.profiling import (CompileStats, FaultStats, GuardStats,
-                               KernelStats, PrefixCacheStats)
+                               KernelStats, PrefixCacheStats, SpecStats)
 from . import (compile_plan, generate, prefix_tree,
-               scheduler as scheduler_mod, score, tokens as tok)
+               scheduler as scheduler_mod, score, spec as spec_mod,
+               tokens as tok)
 
 
 class PiggybackIneligible(RuntimeError):
@@ -118,11 +119,22 @@ class ScoringEngine:
                  runtime: Optional[RuntimeConfig] = None,
                  encoder_decoder: bool = False,
                  yes_text: str = "Yes", no_text: str = "No",
-                 seq_mesh: Any = None, seq_impl: str = "ring"):
+                 seq_mesh: Any = None, seq_impl: str = "ring",
+                 spec_config: Optional[SpecConfig] = None):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.rt = runtime or RuntimeConfig()
+        # Speculative scoring decode (engine/spec.py): drafting policy,
+        # per-dispatch SpecOut readouts pending their deferred host
+        # fold, the optional fleet draft model (set_spec_draft), and
+        # the fault hook a wrapped plan uses to corrupt drafts
+        # (faults/plan.wrap_engine).
+        self.spec_cfg = spec_config or SpecConfig()
+        self.spec_stats = SpecStats()
+        self._spec_draft = None
+        self._spec_pending: List[Any] = []
+        self.spec_fault_plan = None
         self.encoder_decoder = encoder_decoder
         # Fused decode kernels are a RUNTIME choice surfaced through the
         # static model config (the decode executables specialize on it):
@@ -230,9 +242,16 @@ class ScoringEngine:
         # this engine's own dispatch rate) and the counters it shares
         # with the numerics guard and the multihost liveness barrier.
         self.guard_stats = GuardStats()
+        # Speculating engines price dispatches at the spec decode floor,
+        # so their watchdog seeds with the wider UNFUSED/SPEC headroom
+        # (a zero-accept dispatch degenerating to sequential cost must
+        # never trip a spec-calibrated deadline — scheduler.
+        # watchdog_seed_headroom).
         self.watchdog = DispatchWatchdog(
             multiple=self.rt.watchdog_multiple,
-            floor_s=self.rt.watchdog_floor_s, stats=self.guard_stats)
+            floor_s=self.rt.watchdog_floor_s, stats=self.guard_stats,
+            seed_headroom=scheduler_mod.watchdog_seed_headroom(
+                self.rt.spec_decode and self.rt.spec_k >= 2))
         self._seq_mesh_note = (
             None if seq_mesh is None
             else (repr(getattr(seq_mesh, "shape", seq_mesh)), seq_impl))
@@ -266,6 +285,51 @@ class ScoringEngine:
         pool.ensure(self._cache_aval())
         self.prefix_cache = prefix_tree.RadixPrefixCache(
             pool, stats=self.prefix_stats)
+
+    # -- speculative decode (engine/spec.py) --------------------------------
+
+    def spec_supported(self) -> bool:
+        """Engine-level gate for speculative decode: on by config with a
+        verify window of at least 2, plain decoder engines only (T5 and
+        seq-parallel prefills keep their own paths). Per-dispatch
+        eligibility (layout fallbacks, fleet-draft x paged exclusion)
+        is decided where the dispatch forms."""
+        return (self.rt.spec_decode and self.rt.spec_k >= 2
+                and not self.encoder_decoder
+                and self._prefill_fn is None)
+
+    def set_spec_draft(self, params: Any, cfg: Any, name: str = "") -> None:
+        """Arm fleet-model drafting: the small model's (params, cfg)
+        draft for this engine's verifier. The caller owns the weights'
+        lifetime — the fleet layer acquires them through the PR-10
+        WeightCache around every dispatch window so drafting can never
+        evict the verifier mid-dispatch. Same tokenizer/vocab as the
+        verifier is the caller's contract (enforced here by vocab)."""
+        if cfg.vocab_size != self.cfg.vocab_size:
+            raise ValueError(
+                f"spec draft model {name or cfg.name!r} vocab "
+                f"{cfg.vocab_size} != verifier vocab "
+                f"{self.cfg.vocab_size} — draft and verifier must share "
+                f"a tokenizer")
+        self._spec_draft = (params, cfg, name)
+
+    def clear_spec_draft(self) -> None:
+        self._spec_draft = None
+
+    def spec_record(self, bucket: int,
+                    prompt_ids: Sequence[Sequence[int]], gen_rows: Any,
+                    n_real: Optional[int] = None) -> int:
+        """Record observed completions into the radix tree's token
+        history (prompt-lookup drafting warms itself — spec.py)."""
+        return spec_mod.record_tails(
+            self, bucket, prompt_ids, gen_rows,
+            len(prompt_ids) if n_real is None else n_real,
+            max_tails=self.spec_cfg.tree_tails_per_node)
+
+    def spec_flush(self) -> None:
+        """Fold pending device-side SpecOut counters into spec_stats
+        (deferred off the dispatch path — spec.flush_pending)."""
+        spec_mod.flush_pending(self)
 
     def _cache_aval(self):
         """ShapeDtypeStruct tree of this engine's decode cache (leaf
@@ -678,14 +742,50 @@ class ScoringEngine:
             plan = self._prefix_plan_or_none(
                 bucket, [a[:n] for a, n in zip(bin_ids, lcp)], n_real,
                 len(bin_ids), use_prefix_cache)
+            # Speculative decode (engine/spec.py): draft each branch's
+            # continuation and verify the window in one multi-query
+            # forward. Results are bitwise the sequential executable's;
+            # a fleet draft model can't ride the paged front (the paged
+            # executable binds slot tables, not prefix tokens), so that
+            # combination falls back to the sequential paged path.
+            splan = spec_mod.build_plan(self, bin_ids, conf_ids, bucket,
+                                        ba, bb, new_tokens, conf_tokens)
+            paged_warm = plan is not None and plan.window is not None
+            if splan is not None and paged_warm and splan.fleet:
+                splan = None
+                self.spec_stats.count("fallbacks")
             # Paged and unpaged dispatches of one shape return the same
             # cache aval, so they share one handoff key — the donation
-            # chain runs unbroken across cold and warm dispatches.
+            # chain runs unbroken across cold and warm dispatches. The
+            # speculative cache is LONGER (spec_k slots per decode
+            # window), so speculative dispatches chain on their own key.
             key = ("shared", bucket, len(bin_ids), ba, bb, new_tokens,
-                   conf_tokens, early_stop)
+                   conf_tokens, early_stop,
+                   None if splan is None else (splan.k, splan.fleet))
             scratch = self._handoff.take(key)
             stop_kwargs = {k: kwargs[k] for k in
                            ("stop_mask_a", "stop_mask_b", "eos_id")}
+            if splan is not None:
+                try:
+                    out = self._dispatch_shared_spec(
+                        splan, plan, paged_warm, bucket, prefix,
+                        prefix_mask, sfx_a, sfx_a_mask, sfx_b, sfx_b_mask,
+                        yes_ids, no_ids, digit_ids, digit_vals,
+                        new_tokens, conf_tokens, stop_kwargs, scratch,
+                        ba, bb)
+                except BaseException:
+                    if plan is not None:
+                        self._abort_prefix_resume(plan)
+                    raise
+                fused, cfused, spec_a, spec_b, cache = out
+                self._spec_pending.append((spec_a, spec_b))
+                self.spec_stats.count("spec_dispatches")
+                self.spec_stats.count(
+                    "spec_rows", len(bin_ids) if n_real is None else n_real)
+                self._handoff.put(key, cache)
+                if plan is not None:
+                    self._finish_prefix_resume(plan, cache)
+                return fused, cfused
             try:
                 if plan is not None and plan.window is not None:
                     dyn_args = (self.params, self.prefix_cache.pool.leaves,
@@ -757,6 +857,79 @@ class ScoringEngine:
             jnp.asarray(sfx_b_mask),
             jnp.asarray(yes_ids, jnp.int32), jnp.asarray(no_ids, jnp.int32),
             jnp.asarray(digit_ids), jnp.asarray(digit_vals), **kwargs)
+
+    def _dispatch_shared_spec(self, splan, plan, paged_warm: bool,
+                              bucket: int, prefix, prefix_mask, sfx_a,
+                              sfx_a_mask, sfx_b, sfx_b_mask, yes_ids,
+                              no_ids, digit_ids, digit_vals,
+                              new_tokens: int, conf_tokens: int,
+                              stop_kwargs: dict, scratch, ba: int,
+                              bb: int):
+        """One SPECULATIVE shared dispatch (registry executable when
+        planned, lazy jit otherwise): the unpaged prefill front or the
+        radix-paged resume front, then both branches' draft-and-verify
+        tails. Returns (fused, cfused, SpecOut_a, SpecOut_b, cache)."""
+        armed = stop_kwargs.get("eos_id") is not None
+        spec_args = tuple(jnp.asarray(x) for x in splan.dyn_args())
+        if paged_warm:
+            dyn_args = (self.params, self.prefix_cache.pool.leaves,
+                        jnp.asarray(plan.slot_src), jnp.int32(plan.w0),
+                        jnp.asarray(prefix_mask), jnp.asarray(plan.rem),
+                        jnp.asarray(plan.rem_mask),
+                        jnp.asarray(sfx_a), jnp.asarray(sfx_a_mask),
+                        jnp.asarray(sfx_b), jnp.asarray(sfx_b_mask),
+                        jnp.asarray(yes_ids, jnp.int32),
+                        jnp.asarray(no_ids, jnp.int32),
+                        jnp.asarray(digit_ids),
+                        jnp.asarray(digit_vals)) + spec_args
+            exe = None
+            if self.exec_registry is not None:
+                exe = self.exec_registry.get(compile_plan.shared_paged_spec(
+                    bucket, len(prefix_mask), plan.window, ba, bb,
+                    new_tokens, conf_tokens, stops_armed=armed,
+                    scratch=scratch is not None, spec_k=splan.k))
+            if exe is not None:
+                out = compile_plan.registry_call(exe, dyn_args,
+                                                 stop_kwargs, scratch)
+            else:
+                out = generate.greedy_decode_fused_shared_paged_spec(
+                    dyn_args[0], self.cfg, *dyn_args[1:],
+                    max_new_a=new_tokens, max_new_b=conf_tokens,
+                    spec_k=splan.k, ngram=splan.ngram, return_cache=True,
+                    scratch_cache=scratch, **stop_kwargs)
+        else:
+            draft_params, draft_cfg = None, None
+            if splan.fleet:
+                draft_params, draft_cfg, _ = self._spec_draft
+            dyn_args = (self.params, jnp.asarray(prefix),
+                        jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
+                        jnp.asarray(sfx_a_mask), jnp.asarray(sfx_b),
+                        jnp.asarray(sfx_b_mask),
+                        jnp.asarray(yes_ids, jnp.int32),
+                        jnp.asarray(no_ids, jnp.int32),
+                        jnp.asarray(digit_ids),
+                        jnp.asarray(digit_vals)) + spec_args
+            exe = None
+            if self.exec_registry is not None:
+                exe = self.exec_registry.get(compile_plan.shared_spec(
+                    bucket, len(prefix_mask), ba, bb, new_tokens,
+                    conf_tokens, stops_armed=armed,
+                    scratch=scratch is not None,
+                    spec_k=splan.k, spec_draft=splan.fleet))
+            if exe is not None:
+                out = compile_plan.registry_call(
+                    exe, dyn_args,
+                    dict(stop_kwargs, draft_params=draft_params), scratch)
+            else:
+                out = generate.greedy_decode_fused_shared_spec(
+                    dyn_args[0], self.cfg, *dyn_args[1:],
+                    max_new_a=new_tokens, max_new_b=conf_tokens,
+                    spec_k=splan.k, ngram=splan.ngram,
+                    prefill_fn=self._prefill_fn,
+                    draft_params=draft_params, draft_cfg=draft_cfg,
+                    return_cache=True, scratch_cache=scratch,
+                    **stop_kwargs)
+        return out
 
     # -- chunked prefill/decode piggybacking --------------------------------
 

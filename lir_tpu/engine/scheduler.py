@@ -62,20 +62,42 @@ SUFFIX_BUCKETS = (8, 16, 32, 64, 128, 256)
 # dense decodes timed against a fused-kernel calibration.
 DECODE_TOKEN_COST_FUSED = 1.0
 DECODE_TOKEN_COST_UNFUSED = 3.0
+# Speculative decode (engine/spec.py): a verify forward checks spec_k
+# positions at once, so with healthy accept rates a decode token costs a
+# fraction of a sequential step. 0.5 prices the conservative ≥2x
+# dispatch-reduction target rather than the full-accept best case; a
+# zero-accept dispatch legitimately falls back to ~sequential cost,
+# which is why watchdog_seed_headroom() covers the UNFUSED/SPEC spread.
+DECODE_TOKEN_COST_SPEC = 0.5
 
 
-def decode_token_cost(fused_decode: bool = True) -> float:
-    """The decode-floor constant for a kernel mode (see above)."""
+def decode_token_cost(fused_decode: bool = True,
+                      spec_decode: bool = False) -> float:
+    """The decode-floor constant for a kernel mode (see above).
+    ``spec_decode`` prices a speculating dispatch; the default keeps
+    every pre-existing (non-spec) plan byte-identical."""
+    if spec_decode:
+        return DECODE_TOKEN_COST_SPEC
     return (DECODE_TOKEN_COST_FUSED if fused_decode
             else DECODE_TOKEN_COST_UNFUSED)
 
 
-def watchdog_seed_headroom() -> float:
+def watchdog_seed_headroom(spec_decode: bool = False) -> float:
     """EWMA seed headroom for the dispatch watchdog (guard/watchdog.py):
-    the fused/unfused kernel spread. The watchdog's first calibration
+    the spread between the decode pricing a deadline is calibrated on
+    and the most expensive mode a dispatch may legitimately fall back
+    to (the unfused dense path). The watchdog's first calibration
     sample is inflated by this ratio so a deadline calibrated on
-    fused-kernel dispatches never fires spuriously on a dispatch that
-    legitimately falls back to the slower dense decode path."""
+    fused-kernel dispatches never fires spuriously on a dense
+    fallback. A SPECULATING engine (``spec_decode``) widens the seed
+    to the UNFUSED/SPEC spread: its dispatches are priced at the
+    speculative decode floor, and a zero-accept dispatch that
+    degenerates to the sequential scan — possibly on the dense
+    fallback path — must never trip a spec-calibrated deadline.
+    Non-spec engines keep the original fused/unfused spread (their
+    deadlines owe speculation nothing)."""
+    if spec_decode:
+        return DECODE_TOKEN_COST_UNFUSED / DECODE_TOKEN_COST_SPEC
     return DECODE_TOKEN_COST_UNFUSED / DECODE_TOKEN_COST_FUSED
 
 
@@ -88,19 +110,22 @@ def _tail_batch(n: int, cap: int) -> int:
 
 
 def decode_floor(n_rows: int, batch_size: int, decode_cost: int,
-                 fused_decode: bool = True) -> float:
+                 fused_decode: bool = True,
+                 spec_decode: bool = False) -> float:
     """The decode-scan floor of a dispatch's price: every padded slot runs
     the full decode budget whether it carries work or padding, priced at
     the kernel mode's decode-floor constant. Cached prefill can never
     push a dispatch below this (bucket_cost); the piggyback path prices
-    a parked dispatch's pending scans with exactly this term."""
+    a parked dispatch's pending scans with exactly this term.
+    ``spec_decode`` prices a speculating dispatch's verify forwards."""
     return (_tail_batch(n_rows, batch_size) * decode_cost
-            * decode_token_cost(fused_decode))
+            * decode_token_cost(fused_decode, spec_decode))
 
 
 def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
                 decode_cost: int, cached_tokens: int = 0,
-                fused_decode: bool = True) -> float:
+                fused_decode: bool = True,
+                spec_decode: bool = False) -> float:
     """Row-token cost of dispatching ``n_rows`` cells at ``bucket_edge``:
     a padded power-of-two batch prefilled at the edge, plus the fixed
     decode floor (:func:`decode_floor` — the steps run whether the slots
@@ -123,7 +148,7 @@ def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
     slots = _tail_batch(n_rows, batch_size)
     prefill = max(slots * bucket_edge - int(cached_tokens), 0)
     return prefill + decode_floor(n_rows, batch_size, decode_cost,
-                                  fused_decode)
+                                  fused_decode, spec_decode)
 
 
 @dataclasses.dataclass(frozen=True)

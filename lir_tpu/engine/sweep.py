@@ -389,6 +389,11 @@ def run_perturbation_sweep(
                 and engine.kernel_stats.counters:
             log.info("piggyback chains: %s",
                      json.dumps(engine.kernel_stats.counters))
+        if getattr(engine, "spec_stats", None) is not None:
+            engine.spec_flush()
+            if engine.spec_stats.spec_dispatches:
+                log.info("speculative decode: %s",
+                         json.dumps(engine.spec_stats.summary()))
         if sink is not None:
             # Cheap finalize (counts + kappa; CIs on demand via
             # sink.finalize(n_boot=...)) — the live-estimate readout.
@@ -622,7 +627,11 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 piggyback=engine.piggyback_supported(),
                 stream_shape=(None if sink is None else
                               (sink.n_prompts, sink.n_rephrase,
-                               sink.guard)))
+                               sink.guard)),
+                spec_k=(engine.rt.spec_k
+                        if engine.spec_supported() else 0),
+                spec_draft=getattr(engine, "_spec_draft", None)
+                is not None)
             engine.exec_registry = compile_plan.precompile_async(
                 engine, specs, max_workers=engine.rt.precompile_workers)
             log.info("compile plan: precompiling %d executable shapes "
@@ -640,11 +649,11 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
 
             sink.registry_get = _stream_exec
 
-    def _drain(batch, fused, res, cfused):
+    def _drain(batch, fused, res, cfused, spec_rec=None):
         with tracing.span("sweep/drain", rows=len(batch)):
-            _drain_inner(batch, fused, res, cfused)
+            _drain_inner(batch, fused, res, cfused, spec_rec)
 
-    def _drain_inner(batch, fused, res, cfused):
+    def _drain_inner(batch, fused, res, cfused, spec_rec=None):
         if sink is not None:
             # THE tentpole hot-loop step: fold this dispatch's device
             # readouts into the donated accumulator with one fused XLA
@@ -669,6 +678,14 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
             (res, fused.topk_logprobs, fused.topk_ids, fused.generated))
         wconf, cgen_host = jax.device_get(
             (cfused.weighted_confidence, cfused.generated))
+        if spec_rec is not None:
+            # Prompt-lookup self-drafting warms itself: record each real
+            # row's observed continuation into the radix tree's token
+            # history, so a repeat visit (re-run grid, sentinel sweep)
+            # drafts the whole reply (engine/spec.py).
+            b_ids, c_ids, rec_bucket, rec_n = spec_rec
+            engine.spec_record(rec_bucket, b_ids, gen_host, rec_n)
+            engine.spec_record(rec_bucket, c_ids, cgen_host, rec_n)
         if occupancy is not None and stop_armed:
             # Decode-step occupancy: rows retired by the early stop idle
             # until the batch's slowest row (profiling.OccupancyStats).
@@ -832,6 +849,11 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                  and getattr(engine, "piggyback_supported",
                              lambda: False)())
     fused_dec = engine.rt.fused_decode
+    # Speculative dispatches price their decode floor at the verify-
+    # window constant (scheduler.DECODE_TOKEN_COST_SPEC); the watchdog's
+    # widened seed headroom covers a zero-accept dispatch degenerating
+    # to sequential cost.
+    spec_on = getattr(engine, "spec_supported", lambda: False)()
     piggy_keys = []
     if ragged:
         for d in dispatches:
@@ -854,7 +876,12 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
         res = score_mod.readout_from_fused(
             fused, jnp.asarray(meta["t1"]), jnp.asarray(meta["t2"]),
             scan_positions=1)
-        work_q.put((meta["batch"], fused, res, cfused))
+        spec_rec = None
+        if engine.spec_supported() and engine.prefix_cache is not None:
+            spec_rec = ([it.bin_ids for it in meta["full_items"]],
+                        [it.conf_ids for it in meta["full_items"]],
+                        meta["bucket"], meta["n"])
+        work_q.put((meta["batch"], fused, res, cfused, spec_rec))
 
     def _plain_shared(meta):
         full_items, t1, t2 = meta["full_items"], meta["t1"], meta["t2"]
@@ -872,7 +899,8 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                     reuse_cache=True, n_real=meta["n"]),
                 cost=sched_mod.bucket_cost(
                     meta["n"], meta["bucket"], B,
-                    new_tokens + conf_tokens, fused_decode=fused_dec))
+                    new_tokens + conf_tokens, fused_decode=fused_dec,
+                    spec_decode=spec_on))
         _emit(meta, fused, cfused)
 
     def _redispatch_pending():

@@ -104,7 +104,12 @@ class SiteSchedule:
       router first — the chaos proof for elastic failover), and
       "replica_lag" sleeps ``lag_s`` BEFORE the call and then lets it
       COMPLETE (a straggler, not a death: the late payload exercises
-      the router's hedge/zombie paths).
+      the router's hedge/zombie paths), and "draft_corrupt" overwrites
+      the speculative-decode draft tokens of rows ``nan_rows`` with
+      seeded in-vocab garbage BEFORE the verify dispatch (through
+      :meth:`FaultPlan.corrupt_draft` — the chaos proof that a bad
+      draft only costs re-verification: results stay bitwise and
+      SpecStats.rejected_tokens counts the injections).
     """
 
     fail_calls: Tuple[int, ...] = ()
@@ -140,6 +145,15 @@ class SiteSchedule:
         """Simulated numerics corruption (SDC stand-in) at one call
         index: NaN into the named result rows' measurement fields."""
         return cls(fail_calls=(call,), kind="nan", nan_rows=rows)
+
+    @classmethod
+    def draft_corrupt_at(cls, call: int,
+                         rows: Tuple[int, ...] = (0,)) -> "SiteSchedule":
+        """Corrupt the named rows' speculative draft tokens at one
+        ``corrupt_draft`` call index (site "draft" by convention).
+        Row indices ride ``nan_rows`` — the same per-row selector the
+        nan kind uses."""
+        return cls(fail_calls=(call,), kind="draft_corrupt", nan_rows=rows)
 
     @classmethod
     def replica_kill_at(cls, call: int,
@@ -246,13 +260,45 @@ class FaultPlan:
         corrupt); "replica_lag" sleeps in place then proceeds — use
         :meth:`wrap` when the lagged call's RESULT matters."""
         sched = self._decide(site)
-        if sched is None or sched.kind == "nan":
+        if sched is None or sched.kind in ("nan", "draft_corrupt"):
             return
         if sched.kind == "replica_lag":
             self.stats.inject(site)
             time.sleep(sched.lag_s)
             return
         self._fire(sched, site)
+
+    def corrupt_draft(self, drafts, vocab_size: int,
+                      site: str = "draft") -> int:
+        """The speculative-decode injection point (engine/spec.
+        build_plan): when the ``site`` schedule fires with kind
+        "draft_corrupt", overwrite the scheduled rows' draft tokens —
+        ``drafts`` is a list of (tokens (B, T) int32, lens (B,) int32)
+        host arrays, mutated in place — with seeded IN-VOCAB garbage
+        (corrupted tokens are teacher-forced into the verify pass, so
+        they must embed; wrongness, not invalidity, is the fault).
+        Rows without a draft gain a short forced one so the injection
+        always reaches the verifier. Returns tokens corrupted."""
+        sched = self._decide(site)
+        if sched is None or sched.kind != "draft_corrupt":
+            return 0
+        self.stats.inject(site)
+        idx = self.calls(site) - 1
+        rng = random.Random(f"{self.seed}:{site}:{idx}")
+        corrupted = 0
+        for toks, lens in drafts:
+            budget = toks.shape[1]
+            for r in sched.nan_rows:
+                if r >= toks.shape[0]:
+                    continue
+                if lens[r] == 0:
+                    lens[r] = min(2, budget)
+                for t in range(int(lens[r])):
+                    toks[r, t] = (int(toks[r, t]) + 1
+                                  + rng.randrange(max(vocab_size - 1, 1))
+                                  ) % vocab_size
+                    corrupted += 1
+        return corrupted
 
     def wrap(self, site: str, fn: Callable) -> Callable:
         """``fn`` under the site's schedule (indexed by call count at
@@ -281,12 +327,15 @@ class FaultPlan:
 
 def wrap_engine(engine, plan: FaultPlan):
     """Inject the plan's ``dispatch`` site in front of the engine's fused
-    decode entry points (the sweep's device boundary). Instance-level
+    decode entry points (the sweep's device boundary), and hand the plan
+    to the speculative drafter (site ``draft`` — engine/spec.build_plan
+    calls :meth:`FaultPlan.corrupt_draft` per dispatch). Instance-level
     shadowing only — the class stays clean and other engines untouched."""
     engine.decode_fused_shared = plan.wrap("dispatch",
                                            engine.decode_fused_shared)
     engine.decode_fused_grouped = plan.wrap("dispatch",
                                             engine.decode_fused_grouped)
+    engine.spec_fault_plan = plan
     return engine
 
 

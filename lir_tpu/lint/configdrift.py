@@ -43,7 +43,7 @@ CONFIG_REL = "lir_tpu/config.py"
 CLI_REL = "lir_tpu/cli.py"
 RUNNER_REL = "lir_tpu/engine/runner.py"
 DEPLOY_REL = "DEPLOY.md"
-CLASSES = ("RuntimeConfig", "ServeConfig", "ObserveConfig",
+CLASSES = ("RuntimeConfig", "ServeConfig", "ObserveConfig", "SpecConfig",
            "RouterConfig")
 
 CLI_COMMENT_RE = re.compile(r"#\s*cli:\s*(--[A-Za-z0-9-]+)")

@@ -291,6 +291,41 @@ def _attention_cached_flash(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(B, S, H * hd)
 
 
+def _fused_decode_mq_ok(cfg: ModelConfig, S: int, fused_ctx) -> bool:
+    """Static routing decision for the MULTI-QUERY fused decode kernel
+    (the speculative verify window): same gates as :func:`_fused_decode_ok`
+    but for a window of S > 1 teacher-forced queries carrying per-query
+    positions (fused_ctx positions shaped (B, S))."""
+    return (cfg.fused_decode
+            and not cfg.kv_cache_int8
+            and fused_ctx is not None
+            and S > 1
+            and getattr(fused_ctx[0], "ndim", 1) == 2
+            and (jax.default_backend() == "tpu"
+                 or FUSED_DECODE_INTERPRET_ON_CPU))
+
+
+def _attention_cached_flash_mq(q: jax.Array, k: jax.Array, v: jax.Array,
+                               cfg: ModelConfig, fused_ctx) -> jax.Array:
+    """Verify-window attention through the multi-query fused kernel
+    (ops/flash_decode.flash_decode_mq): S teacher-forced queries per row
+    attend over the cache (the window's own k/v already written) in one
+    launch, each query's reduction bitwise the single-query kernel's —
+    the speculative verify path's decode-step parity contract."""
+    from ..ops.flash_decode import flash_decode_mq
+
+    B, S, H, hd = q.shape
+    q_pos, key_mask, key_positions = fused_ctx
+    interpret = (FUSED_DECODE_INTERPRET_ON_CPU
+                 and jax.default_backend() != "tpu")
+    slopes = (alibi_slopes(cfg.n_heads) if cfg.pos_embedding == "alibi"
+              else None)
+    out = flash_decode_mq(q, k, v, q_pos, key_mask,
+                          key_positions=key_positions, alibi_slopes=slopes,
+                          interpret=interpret)
+    return out.reshape(B, S, H * hd)
+
+
 def _attention_cached(q: jax.Array, k: jax.Array, v: jax.Array,
                       bias: jax.Array, cfg: ModelConfig) -> jax.Array:
     """Decode-step attention over the CACHE layout (K, T, B, hd).
@@ -374,6 +409,8 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
                                           (0, cache_index, 0, 0))
             if _fused_decode_ok(cfg, S, fused_ctx):
                 attn = _attention_cached_flash(q, ck, cv, cfg, fused_ctx)
+            elif _fused_decode_mq_ok(cfg, S, fused_ctx):
+                attn = _attention_cached_flash_mq(q, ck, cv, cfg, fused_ctx)
             else:
                 attn = _attention_cached(q, ck, cv, bias, cfg)
     elif attn_impl is not None:
@@ -627,6 +664,54 @@ def extend(params: Params, cfg: ModelConfig, cache, suffix_tokens: jax.Array,
     logits = _unembed(params, cfg, x_last)[:, 0, :]
     next_positions = jnp.take_along_axis(qpos, last[:, None], axis=1)[:, 0] + 1
     return logits, new_cache, next_positions
+
+
+def verify_extend(params: Params, cfg: ModelConfig, cache,
+                  chunk_tokens: jax.Array, cache_mask: jax.Array,
+                  start_index: jax.Array):
+    """Teacher-forced VERIFY window (speculative decode): run the S-token
+    draft window [current emission, drafts...] through the layers in one
+    forward, writing its k/v at cache slots [start_index, start_index+S)
+    and returning the logits at EVERY window position — the multi-query
+    sibling of :func:`decode_step` that checks S sequential-scan steps in
+    one dispatch.
+
+    Every window row is real (teacher forcing; acceptance is decided by
+    the caller from the returned logits), so the query mask is all-ones;
+    ``cache_mask`` is the FULL cache validity with the window's S slots
+    already set (rejected slots of earlier windows stay 0 — masked
+    garbage, exactly the early-stop discipline). Positions derive from
+    the mask's cumsum, so each query sits at its row's next logical
+    position: the attention reduction runs over the same valid
+    (token, position) set in the same slot order as the sequential
+    decode_step, masked slots contributing exact zeros (the paged-path
+    argument), and the fused route goes through the multi-query flash
+    kernel whose per-query ops are the single-query kernel's
+    (ops/flash_decode.flash_decode_mq). Results are argmax/top-k
+    identical to the sequential step and logits-equal within float
+    tolerance (the window cache is longer — T*spec_k decode slots — so
+    XLA may group the reduction's masked-zero lanes differently; the
+    same bar PR-7's fused-vs-dense kernels cleared), which is what the
+    speculative tail needs: every CONSUMED readout (position-0 floats,
+    the emitted token stream) stays bitwise.
+
+    Returns (logits (B, S, V) fp32, new_cache)."""
+    B, S2 = chunk_tokens.shape
+    key_positions = mask_positions(cache_mask)
+    qpos = lax.dynamic_slice_in_dim(key_positions, start_index, S2, axis=1)
+    x = _embed(params, cfg, chunk_tokens, qpos)
+    sin = cos = None
+    if cfg.pos_embedding == "rotary":
+        sin, cos = _rope_sincos(qpos, cfg.rotary_dim, cfg.rope_theta)
+    ones = jnp.ones((B, S2), jnp.int32)
+    bias = _causal_bias(ones, qpos, cfg,
+                        key_positions=key_positions, key_mask=cache_mask)
+    x, new_cache = _scan_blocks(params, cfg, x, sin, cos, bias,
+                                cache=cache, cache_index=start_index,
+                                fused_ctx=(qpos, cache_mask,
+                                           key_positions))
+    logits = _unembed(params, cfg, x)
+    return logits, new_cache
 
 
 def decode_step(params: Params, cfg: ModelConfig, cache, token: jax.Array,

@@ -90,6 +90,13 @@ STATS_SCHEMA: Dict[str, Tuple[str, ...]] = {
         "claims", "renews", "releases", "steals", "refused", "lost",
         "expired_seen", "shards_done", "refreshes",
     ),
+    "SpecStats": (
+        "drafted_tokens", "accepted_tokens", "rejected_tokens",
+        "draft_tree", "draft_ngram", "draft_fleet", "accepted_tree",
+        "accepted_ngram", "accepted_fleet", "decode_forwards",
+        "seq_forwards", "dispatches_saved", "spec_dispatches",
+        "spec_rows", "fallbacks",
+    ),
 }
 
 
@@ -241,6 +248,8 @@ def engine_registry(engine, sink=None,
         reg.register("prefix_cache", engine.prefix_stats)
     if getattr(engine, "occupancy", None) is not None:
         reg.register("occupancy", engine.occupancy)
+    if getattr(engine, "spec_stats", None) is not None:
+        reg.register("spec", engine.spec_stats)
     if sink is not None and getattr(sink, "stats", None) is not None:
         reg.register("stream", sink.stats)
     return reg

@@ -22,7 +22,8 @@ from .flash_attention import (  # noqa: F401
     DEFAULT_BLOCK_Q,
     flash_attention,
 )
-from .flash_decode import flash_decode, pick_split  # noqa: F401
+from .flash_decode import (flash_decode, flash_decode_mq,  # noqa: F401
+                           pick_split)
 from ..parallel.ring_attention import (  # noqa: F401
     reference_attention,
     ring_attention,

@@ -177,3 +177,124 @@ def flash_decode(
     o = (w[..., None] * o_p).sum(axis=2)                  # (B, K, G, hd)
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.reshape(B, H, hd).astype(q.dtype)
+
+
+def _decode_kernel_mq(qpos_ref, slope_ref, mask_ref, kpos_ref, q_ref, k_ref,
+                      v_ref, o_ref, m_ref, l_ref, *, sm_scale: float,
+                      alibi: bool, n_groups: int):
+    """Multi-query sibling of :func:`_decode_kernel` for the speculative
+    verify pass: S teacher-forced queries per row, each with its OWN
+    mask-aware position, reduced with exactly the single-query kernel's
+    per-row ops — every (query, group) row's score/softmax/weighted-sum
+    arithmetic is independent of S, which is what keeps a verified
+    position bitwise the sequential decode step's."""
+    b = pl.program_id(0)
+    kh = pl.program_id(1)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale        # (S, G, hd)
+    S, G, hd = q.shape
+    k = k_ref[0, :, 0, :].astype(jnp.float32)             # (bs, hd)
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    s = jnp.dot(q.reshape(S * G, hd), k.T,
+                preferred_element_type=jnp.float32)       # (S*G, bs)
+    s = s.reshape(S, G, -1)
+    kmask = mask_ref[0, 0] > 0                            # (bs,)
+    kp = kpos_ref[0, 0]                                   # (bs,)
+    qp = qpos_ref[b]                                      # (S,)
+    if alibi:
+        slope = slope_ref[pl.ds(kh * n_groups, n_groups), 0]  # (G,)
+        s = s + slope[None, :, None] * kp.astype(jnp.float32)[None, None, :]
+    valid = (kmask[None, :] & (kp[None, :] <= qp[:, None]))[:, None, :]
+    s = jnp.where(valid, s, -jnp.inf)                     # (S, G, bs)
+
+    m = s.max(axis=-1)                                    # (S, G)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)                # all-masked split
+    o = jnp.dot(p.reshape(S * G, -1), v,
+                preferred_element_type=jnp.float32)
+    o_ref[0, 0, 0] = o.reshape(S, G, hd)
+    m_ref[0, 0, 0] = m
+    l_ref[0, 0, 0] = p.sum(axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def flash_decode_mq(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    key_mask: jnp.ndarray,
+    key_positions: jnp.ndarray | None = None,
+    alibi_slopes: jnp.ndarray | None = None,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Multi-query fused decode attention: S queries per row over the KV
+    cache in ONE kernel launch — the speculative-decode verify path
+    (ROADMAP item 3: the k drafted positions verify in one dispatch).
+
+    ``q``: (B, S, H, hd) post-RoPE queries — the teacher-forced draft
+    window, already written into the cache at their slots. ``q_positions``:
+    (B, S) per-query mask-aware positions: causality (``kp <= qp`` per
+    query) is what keeps a query from seeing later drafts, exactly as
+    ``decoder._causal_bias`` orders the dense path. Other arguments as
+    :func:`flash_decode`. Per-query results are bitwise the single-query
+    kernel's for the same cache state (pinned by tests/test_spec_decode):
+    the per-(query, group) row reductions never mix queries, and the
+    split ladder is chosen from T alone.
+    """
+    B, S, H, hd = q.shape
+    K, T = k.shape[0], k.shape[1]
+    G = H // K
+    sm_scale = 1.0 / np.sqrt(hd)
+    alibi = alibi_slopes is not None
+    if key_positions is None:
+        key_positions = jnp.maximum(jnp.cumsum(key_mask, axis=-1) - 1, 0)
+    key_mask = jnp.asarray(key_mask, jnp.int32)
+    key_positions = jnp.asarray(key_positions, jnp.int32)
+    if alibi_slopes is None:
+        slopes = jnp.zeros((H, 1), jnp.float32)
+    else:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(H, 1)
+
+    split = pick_split(T, block_k)
+    n_splits = T // split
+    qg = q.reshape(B, S, K, G, hd).transpose(0, 2, 1, 3, 4)  # (B, K, S, G, hd)
+
+    kernel = functools.partial(_decode_kernel_mq, sm_scale=sm_scale,
+                               alibi=alibi, n_groups=G)
+    f32 = jnp.float32
+    o_p, m_p, l_p = pl.pallas_call(
+        kernel,
+        grid=(B, K, n_splits),
+        in_specs=[
+            pl.BlockSpec(index_map=lambda b, h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(index_map=lambda b, h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, split), lambda b, h, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, split), lambda b, h, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, S, G, hd), lambda b, h, j: (b, h, 0, 0, 0)),
+            pl.BlockSpec((1, split, 1, hd), lambda b, h, j: (h, j, b, 0)),
+            pl.BlockSpec((1, split, 1, hd), lambda b, h, j: (h, j, b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, S, G, hd),
+                         lambda b, h, j: (b, h, j, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 1, S, G), lambda b, h, j: (b, h, j, 0, 0)),
+            pl.BlockSpec((1, 1, 1, S, G), lambda b, h, j: (b, h, j, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, K, n_splits, S, G, hd), f32),
+            jax.ShapeDtypeStruct((B, K, n_splits, S, G), f32),
+            jax.ShapeDtypeStruct((B, K, n_splits, S, G), f32),
+        ],
+        interpret=interpret,
+    )(q_positions.astype(jnp.int32), slopes,
+      key_mask[:, None, :], key_positions[:, None, :], qg, k, v)
+
+    # Same log-sum-exp combine as flash_decode, with the query axis along.
+    m = m_p.max(axis=2)                                   # (B, K, S, G)
+    w = jnp.where(jnp.isfinite(m_p),
+                  jnp.exp(m_p - m[:, :, None, :, :]), 0.0)
+    l = (w * l_p).sum(axis=2)                             # (B, K, S, G)
+    o = (w[..., None] * o_p).sum(axis=2)                  # (B, K, S, G, hd)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3, 4).reshape(B, S, H, hd).astype(q.dtype)

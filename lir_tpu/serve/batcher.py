@@ -87,9 +87,12 @@ class ContinuousBatcher:
                            and not rt.sweep_full_completions)
         self.decode_cost = self.new_tokens + self.conf_tokens
         # Price dispatches with the engine's kernel mode: the decode
-        # floor constant differs between the fused flash-decode kernels
-        # and the dense fallback (scheduler.decode_token_cost).
+        # floor constant differs between the fused flash-decode kernels,
+        # the dense fallback, and the speculative verify windows
+        # (scheduler.decode_token_cost).
         self.fused_decode = bool(getattr(rt, "fused_decode", True))
+        self.spec_decode = bool(
+            getattr(engine, "spec_supported", lambda: False)())
         self._queues: Dict[int, Deque[Pending]] = {
             int(b): deque() for b in engine.buckets}
 
@@ -165,7 +168,8 @@ class ContinuousBatcher:
                 per_row = sched_mod.bucket_cost(
                     self._dispatch_rows(n), edge, self.batch,
                     self.decode_cost, cached_tokens=cached,
-                    fused_decode=self.fused_decode) / n
+                    fused_decode=self.fused_decode,
+                    spec_decode=self.spec_decode) / n
                 return per_row, q[0].t_submit
 
             edge = min(ripe, key=price)
@@ -178,7 +182,8 @@ class ContinuousBatcher:
                         and n * nxt < sched_mod.bucket_cost(
                             self._dispatch_rows(n), edge, self.batch,
                             self.decode_cost,
-                            fused_decode=self.fused_decode)):
+                            fused_decode=self.fused_decode,
+                            spec_decode=self.spec_decode)):
                     promoted = [q.popleft() for _ in range(n)]
                     for p in reversed(promoted):
                         self._queues[nxt].appendleft(p)
@@ -252,6 +257,16 @@ class ContinuousBatcher:
                  fused.generated))
             wconf, cgen_host = jax.device_get(
                 (cfused.weighted_confidence, cfused.generated))
+        if self.spec_decode:
+            # Prompt-lookup drafting warms itself: record the observed
+            # continuations into the radix tree's token history and fold
+            # the dispatch's SpecOut counters (we just synchronized on
+            # the payload device_get, so the flush costs nothing extra).
+            engine.spec_record(bucket, [list(p.bin_ids) for p in full],
+                               gen_host, n)
+            engine.spec_record(bucket, [list(p.conf_ids) for p in full],
+                               cgen_host, n)
+            engine.spec_flush()
         payloads: List[Dict] = []
         for j in range(n):
             conf_text = engine.decode_completion(cgen_host[j])
@@ -360,9 +375,15 @@ class FleetBatcher:
     def score(self, model_id: str, bucket: int,
               rows: List[Pending]) -> List[Dict]:
         """One dispatch on ``model_id``'s engine with its weights held
-        resident (fleet refcount) for the duration."""
-        self.fleet.acquire(model_id)
+        resident (fleet refcount) for the duration — and, when
+        RuntimeConfig.spec_draft_model names a co-resident model, that
+        draft model's weights too (engine/spec.py fleet drafting:
+        both refcounts held across the dispatch, so neither side can
+        evict the other mid-verify)."""
+        engine = self.fleet.acquire(model_id)
+        draft_id = self.fleet.acquire_spec_draft(engine, model_id)
         try:
             return self.batchers[model_id].score(bucket, rows)
         finally:
+            self.fleet.release_spec_draft(engine, draft_id)
             self.fleet.release(model_id)

@@ -1002,6 +1002,114 @@ class StreamStats:
             }
 
 
+@dataclasses.dataclass
+class SpecStats:
+    """Speculative-decode counters (engine/spec.py over generate.
+    greedy_decode_fused_shared_spec): how many tokens were drafted,
+    where the drafts came from, how many survived greedy verification,
+    and how many sequential decode forwards the verify windows
+    replaced. Thread-safe — the sweep dispatch thread folds while the
+    metrics endpoint reads.
+
+    Definitions (reported by ``summary()``, logged per sweep, and in
+    bench.py's "speculative" key):
+
+    - ``drafted_tokens`` / ``accepted_tokens`` / ``rejected_tokens``:
+      draft tokens proposed per verify window, the prefix of them the
+      verifier's own argmax confirmed, and the remainder (a rejected
+      draft costs only its share of the verify forward — results are
+      bitwise either way). ``accept_rate`` = accepted / drafted.
+    - ``draft_tree`` / ``draft_ngram`` / ``draft_fleet`` (and their
+      ``accepted_*`` twins): per-source token counts — radix-tree
+      continuation probes, n-gram prompt-lookup, and fleet draft
+      models.
+    - ``decode_forwards`` / ``seq_forwards``: verify forwards actually
+      run vs the forwards the sequential scan would have run on the
+      same rows; ``dispatches_saved`` is their difference — the
+      headline ≥2x target is seq_forwards / decode_forwards.
+    - ``spec_dispatches`` / ``spec_rows``: dispatches and rows that ran
+      the speculative path; ``fallbacks`` counts spec-eligible
+      dispatches that ran sequentially (layout fallback, k < 2, missing
+      draft source).
+    """
+
+    drafted_tokens: int = 0
+    accepted_tokens: int = 0
+    rejected_tokens: int = 0
+    draft_tree: int = 0
+    draft_ngram: int = 0
+    draft_fleet: int = 0
+    accepted_tree: int = 0
+    accepted_ngram: int = 0
+    accepted_fleet: int = 0
+    decode_forwards: int = 0
+    seq_forwards: int = 0
+    dispatches_saved: int = 0
+    spec_dispatches: int = 0
+    spec_rows: int = 0
+    fallbacks: int = 0
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def add_branch(self, drafted, accepted, chunks: int,
+                   seq_steps: int) -> None:
+        """Fold one branch's SpecOut readout: ``drafted``/``accepted``
+        are (tree, ngram, fleet) token counts."""
+        dt, dn, df = (int(x) for x in drafted)
+        at, an, af = (int(x) for x in accepted)
+        with self._lock:
+            self.draft_tree += dt
+            self.draft_ngram += dn
+            self.draft_fleet += df
+            self.accepted_tree += at
+            self.accepted_ngram += an
+            self.accepted_fleet += af
+            self.drafted_tokens += dt + dn + df
+            self.accepted_tokens += at + an + af
+            self.rejected_tokens += (dt + dn + df) - (at + an + af)
+            self.decode_forwards += int(chunks)
+            self.seq_forwards += int(seq_steps)
+            self.dispatches_saved += max(int(seq_steps) - int(chunks), 0)
+
+    @property
+    def accept_rate(self) -> float:
+        return (self.accepted_tokens / self.drafted_tokens
+                if self.drafted_tokens else 0.0)
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            drafted = self.drafted_tokens
+            out: Dict[str, object] = {
+                "drafted_tokens": drafted,
+                "accepted_tokens": self.accepted_tokens,
+                "rejected_tokens": self.rejected_tokens,
+                "accept_rate": round(
+                    self.accepted_tokens / drafted, 4) if drafted else 0.0,
+                "decode_forwards": self.decode_forwards,
+                "seq_forwards": self.seq_forwards,
+                "dispatches_saved": self.dispatches_saved,
+                "spec_dispatches": self.spec_dispatches,
+                "spec_rows": self.spec_rows,
+                "fallbacks": self.fallbacks,
+                "draft_source": {
+                    "tree": {"drafted": self.draft_tree,
+                             "accepted": self.accepted_tree},
+                    "ngram": {"drafted": self.draft_ngram,
+                              "accepted": self.accepted_ngram},
+                    "fleet": {"drafted": self.draft_fleet,
+                              "accepted": self.accepted_fleet},
+                },
+            }
+        return out
+
+
 # Published peak dense-matmul throughput per chip (bf16 FLOPS). Weight-only
 # int8 still computes in bf16 on the MXU, so bf16 peak is the MFU denominator
 # there; dynamic int8 (s8 x s8 -> s32 dots) gets 2x this on every listed

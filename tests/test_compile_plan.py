@@ -137,7 +137,13 @@ def test_plan_specs_padded_rows_match_runner_tail():
 def test_sweep_specs_for_ladder_covers_every_edge():
     engine = _tiny_engine(RuntimeConfig(batch_size=4, max_seq_len=256))
     specs = compile_plan.sweep_specs_for_ladder(engine, sfx_buckets=(8, 16))
-    assert len(specs) == len(engine.buckets) * 2 * 2
+    # Every (edge, sfx, handoff) combination plans BOTH the sequential
+    # executable and its speculative sibling (spec_k-keyed).
+    seq = [s for s in specs if not s.spec_k]
+    spec = [s for s in specs if s.spec_k]
+    assert len(seq) == len(engine.buckets) * 2 * 2
+    assert len(spec) == len(seq)
+    assert all(s.spec_k == engine.rt.spec_k for s in spec)
     assert {s.bucket for s in specs} == set(engine.buckets)
     assert all(s.batch == 4 and s.kind == "shared" for s in specs)
     # FakeTokenizer exposes no per-token strings -> stops can't arm.
@@ -193,12 +199,14 @@ def test_same_shape_dispatches_reuse_one_executable(tmp_path):
                                   checkpoint_every=100)
     assert len(rows) == 12
     reg = engine.exec_registry
-    assert reg is not None and len(reg) == 3
+    # fresh + donated handoff variants of the sequential AND speculative
+    # shared executables, plus the streaming-stats fold.
+    assert reg is not None and len(reg) == 5
     assert {s.kind for s in reg._futures} == {"shared", "stream_fold"}
     # 3 dispatch hits + 3 accumulator-fold hits.
     assert engine.compile_stats.aot_hits == 6
     assert engine.compile_stats.lazy_misses == 0
-    assert len(engine.compile_stats.shapes) == 3
+    assert len(engine.compile_stats.shapes) == 5
     assert all(t > 0 for t in engine.compile_stats.shapes.values())
     # Registry is namespaced by the engine's manifest key.
     assert reg.manifest_key == engine.cache_manifest_key
@@ -219,9 +227,10 @@ def test_piggyback_chain_runs_precompiled(tmp_path):
                                   checkpoint_every=100)
     assert len(rows) == 12
     reg = engine.exec_registry
-    # 2 plain (fresh + donated, kept for the recovery fallback) + the
-    # piggyback chain's 3 stages + the streaming-stats fold width.
-    assert reg is not None and len(reg) == 6
+    # 2 plain + 2 speculative (fresh + donated each, kept for the
+    # unchained/recovery fallback) + the piggyback chain's 3 stages +
+    # the streaming-stats fold width.
+    assert reg is not None and len(reg) == 8
     kinds = {s.kind for s in reg._futures}
     assert {"piggy_prefill", "piggy_step", "piggy_drain",
             "stream_fold"} <= kinds

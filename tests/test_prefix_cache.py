@@ -374,11 +374,14 @@ def test_aot_paged_executable_matches_lazy_bitwise():
     from lir_tpu.engine import compile_plan
 
     bps, cps = _legal_prompts(4)
-    eng_lazy = _engine(True)
+    eng_lazy = _engine(True, spec_decode=False)
     _shared(eng_lazy, bps, cps, True)
     r_lazy = _shared(eng_lazy, bps, cps, True)
 
-    eng = _engine(True)
+    # Pin the SEQUENTIAL paged executables specifically — speculative
+    # dispatches look up their own spec_k-keyed registry entries
+    # (tests/test_spec_decode.py covers those).
+    eng = _engine(True, spec_decode=False)
     _shared(eng, bps, cps, True)              # warm the radix cache
     specs = [compile_plan.shared_paged_spec(128, 4, w, 16, 16, 4, 6,
                                             stops_armed=False,

@@ -235,7 +235,10 @@ def test_serve_batches_and_ladder_specs():
     engine = _tiny_setup()()
     specs = compile_plan.sweep_specs_for_ladder(
         engine, sfx_buckets=(8,), batches=(1, 2, 4))
-    assert len(specs) == len(engine.buckets) * 1 * 3 * 2
+    # Sequential + speculative sibling per (edge, sfx, batch, handoff).
+    seq = [s for s in specs if not s.spec_k]
+    assert len(seq) == len(engine.buckets) * 1 * 3 * 2
+    assert len(specs) == 2 * len(seq)
     assert {s.batch for s in specs} == {1, 2, 4}
     assert {s.bucket for s in specs} == set(engine.buckets)
 

@@ -441,12 +441,19 @@ def guard_chaos(failures):
     # piggyback OFF: the clean pass must run the same (plain) path the
     # fault-wrapped chaos pass runs, or the bitwise clean-vs-chaos
     # comparison measures the chain's ulp-level reduction drift instead
-    # of recovery correctness (see _engine above).
+    # of recovery correctness (see _engine above). spec OFF for the
+    # same reason serve_guard_chaos sets it: the hair-trigger deadline
+    # is calibrated from the clean pass's handful of dispatches, and
+    # the speculative executables' first-trace time (x the widened
+    # spec seed headroom) would inflate that one-shot calibration past
+    # the injected hang — smoke-scale compile noise, not a recovery
+    # property. Speculative chaos is scenario 9 (spec_chaos).
     engine = ScoringEngine(params, cfg, FakeTokenizer(),
                            RuntimeConfig(batch_size=BATCH, max_seq_len=256,
                                          watchdog_multiple=2.0,
                                          watchdog_floor_s=0.2,
-                                         piggyback_prefill=False))
+                                         piggyback_prefill=False,
+                                         spec_decode=False))
     lp, perts = _grid(N_CELLS)
     with tempfile.TemporaryDirectory() as td:
         td = Path(td)
@@ -550,10 +557,19 @@ def serve_guard_chaos(failures):
                        hidden_size=32, n_layers=1, n_heads=2,
                        intermediate_size=64, max_seq_len=256)
     params = decoder.init_params(mcfg, jax.random.PRNGKey(13))
+    # spec OFF: this scenario calibrates the watchdog from a SINGLE warm
+    # dispatch and then requires its hair-trigger deadline (floor 0.3s,
+    # multiple 3) to shoot a 60s hang well inside the request window.
+    # The speculative executables' first-trace time would land in that
+    # one calibration sample (multiplied by the widened spec seed
+    # headroom), inflating the deadline past the hang — a compile
+    # artifact of the smoke's tiny scale, not a recovery property.
+    # Speculative chaos has its own scenario (spec_chaos, #9).
     engine = ScoringEngine(params, mcfg, FakeTokenizer(),
                            RuntimeConfig(batch_size=BATCH, max_seq_len=256,
                                          watchdog_multiple=3.0,
-                                         watchdog_floor_s=0.3))
+                                         watchdog_floor_s=0.3,
+                                         spec_decode=False))
     server = ScoringServer(engine, "guard-serve", cfg)
     plan = faults.FaultPlan(seed=9, schedules={
         "dispatch": faults.SiteSchedule(fail_calls=(1,), kind="hang",
@@ -825,6 +841,107 @@ def _serve_server(cfg, seed):
     return ScoringServer(engine, "elastic-serve", cfg)
 
 
+def spec_chaos(failures):
+    """Mechanism 9 (speculative decode): a seeded ``draft_corrupt``
+    fault poisons the tree-probed draft tokens BEFORE verification —
+    a bad draft must only cost re-verification: sweep rows stay
+    bitwise equal to the fault-free run, and SpecStats.rejected_tokens
+    counts the injected garbage."""
+    import tempfile
+
+    import jax
+
+    from lir_tpu import faults
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.data import schemas
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="chaos-smoke", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=1, n_heads=2,
+                      intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(11))
+
+    def spec_engine():
+        # prefix cache ON so the tree-continuation drafter has a token
+        # history to draft (and corrupt) from; piggyback OFF as in
+        # _make_engine (bitwise comparisons need the plain path).
+        return ScoringEngine(params, cfg, FakeTokenizer(),
+                             RuntimeConfig(batch_size=BATCH,
+                                           max_seq_len=256,
+                                           piggyback_prefill=False,
+                                           prefix_cache=True,
+                                           prefix_cache_pages=128))
+
+    lp, perts = _grid(N_CELLS)
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        eng_clean = spec_engine()
+        run_perturbation_sweep(eng_clean, "chaos", lp, perts,
+                               td / "warm.csv", checkpoint_every=4)
+        # Same engine, same grid again: the tree now drafts every row's
+        # whole continuation — the speculation-friendly repeat pass.
+        clean = run_perturbation_sweep(eng_clean, "chaos", lp, perts,
+                                       td / "clean.csv",
+                                       checkpoint_every=4)
+        eng_clean.spec_flush()
+        if eng_clean.spec_stats.accepted_tokens <= 0:
+            failures.append("spec: warm repeat pass accepted no drafts")
+            return {}
+        clean_df = schemas.read_results_frame(td / "clean.csv")
+        clean_by_key = {
+            (row["Rephrased Main Part"], row["Response Format"],
+             row["Confidence Format"]): tuple(
+                row[c] for c in _VALUE_COLUMNS)
+            for _, row in clean_df.iterrows()}
+
+        eng = spec_engine()
+        run_perturbation_sweep(eng, "chaos", lp, perts, td / "warm2.csv",
+                               checkpoint_every=4)
+        plan = faults.FaultPlan(seed=31, schedules={
+            "draft": faults.SiteSchedule.draft_corrupt_at(0, rows=(0, 1)),
+        }, stats=eng.fault_stats)
+        faults.wrap_engine(eng, plan)
+        chaos = run_perturbation_sweep(eng, "chaos", lp, perts,
+                                       td / "chaos.csv",
+                                       checkpoint_every=4)
+        eng.spec_flush()
+        if plan.injected("draft") < 1:
+            failures.append("spec: scheduled draft_corrupt never fired")
+            return {}
+        if eng.spec_stats.rejected_tokens < 1:
+            failures.append("spec: corrupted drafts were never rejected")
+        if len(chaos) != len(clean):
+            failures.append(
+                f"spec: corrupted run produced {len(chaos)} rows vs "
+                f"{len(clean)} clean")
+        df = schemas.read_results_frame(td / "chaos.csv")
+        import pandas as pd
+
+        for _, row in df.iterrows():
+            k = (row["Rephrased Main Part"], row["Response Format"],
+                 row["Confidence Format"])
+            want = clean_by_key.get(k)
+            if want is None:
+                failures.append(f"spec: invented row {k[0][:40]}")
+                continue
+            got = tuple(row[c] for c in _VALUE_COLUMNS)
+            for g, w in zip(got, want):
+                if pd.isna(g) and pd.isna(w):
+                    continue
+                if g != w:
+                    failures.append(
+                        f"spec: corrupted-draft row differs from the "
+                        f"fault-free run: {g!r} != {w!r} for {k[0][:40]}")
+                    break
+        return {"injected_draft": plan.injected("draft"),
+                "rejected_tokens": int(eng.spec_stats.rejected_tokens),
+                "accept_rate": round(eng.spec_stats.accept_rate, 4)}
+
+
 def main() -> int:
     failures = []
     sweep_summary = sweep_chaos(failures)
@@ -834,6 +951,7 @@ def main() -> int:
     mh_summary = multihost_chaos(failures)
     stream_summary = stream_accum_chaos(failures)
     elastic_summary = elastic_chaos(failures)
+    spec_summary = spec_chaos(failures)
     if failures:
         for f in failures:
             print(f"CHAOS-SMOKE FAIL: {f}")
@@ -843,7 +961,8 @@ def main() -> int:
                       "serve_guard": serve_guard_summary,
                       "multihost": mh_summary,
                       "stream": stream_summary,
-                      "elastic": elastic_summary}))
+                      "elastic": elastic_summary,
+                      "spec": spec_summary}))
     print("chaos smoke: OK (sweep resumed bitwise-identical after "
           "injected kill + torn manifest; breaker tripped and recovered "
           "via half-open probe; poison row isolated; checkpoint resume "
@@ -854,7 +973,8 @@ def main() -> int:
           "bitwise-identical to an uninterrupted run; leased shards "
           "stolen by a live holder converge bitwise on the static run "
           "and a straggler replica's late payload is dropped, never "
-          "double-resolved)")
+          "double-resolved; corrupted speculative drafts cost only "
+          "re-verification — rows bitwise, rejections counted)")
     return 0
 
 

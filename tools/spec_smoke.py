@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Speculative-decode smoke: prompt-lookup drafting + fused verification
+on the fake backend — the `make spec-smoke` CI target.
+
+Runs the production-shaped confidence-tail workload (variations of a few
+long legal bases, each scored under the binary + confidence formats)
+through the shared dispatch path twice per engine — the second pass is
+the speculation-friendly one (the radix tree's token history holds every
+prompt's observed continuation after pass 1). Asserts the PR's
+load-bearing claims:
+
+- nonzero accepted tokens: the tree-continuation drafts actually land
+  (pass 2 accept rate is high on a repeat grid by construction);
+- >= 2x fewer decode dispatches per row on pass 2: verify forwards vs
+  the forwards the sequential scan would have run (SpecStats
+  decode_forwards vs seq_forwards), the headline target;
+- ON == OFF payloads bitwise: every consumed readout (position-0
+  probabilities, top-20 logprob map, weighted confidence, generated
+  token streams) is identical between the speculative and sequential
+  engines, cold and warm — speculation is a pure perf lever.
+
+Runs hermetically on CPU with the FakeTokenizer + a tiny random decoder;
+prints the SpecStats summary JSON on success.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_BASES = 3
+N_VARIANTS = 4
+BASE_WORDS = 60
+NEW_TOKENS = 4
+CONF_TOKENS = 8
+SPEC_K = 4
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine import tokens as tok
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    cfg = ModelConfig(name="spec-smoke", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=1, n_heads=2,
+                      intermediate_size=64, max_seq_len=512)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(13))
+    tokz = FakeTokenizer()
+
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible adjuster settle "
+             "liability clause binding interpret statute meaning").split()
+    rng = np.random.default_rng(29)
+    bases = [" ".join(rng.choice(words) for _ in range(BASE_WORDS))
+             for _ in range(N_BASES)]
+    cells = [(f"{b} case {v} Answer Yes or No .",
+              f"{b} case {v} Give your confidence 0 to 100 .")
+             for b in bases for v in range(N_VARIANTS)]
+    B = len(cells)
+
+    def make_engine(spec_on: bool) -> ScoringEngine:
+        rt = RuntimeConfig(spec_decode=spec_on, spec_k=SPEC_K,
+                           batch_size=B, piggyback_prefill=False,
+                           prefix_cache=True, prefix_cache_pages=256)
+        return ScoringEngine(params, cfg, tokz, runtime=rt)
+
+    def dispatch(eng: ScoringEngine, record: bool):
+        bps = [c[0] for c in cells]
+        cps = [c[1] for c in cells]
+        yes = np.full((B,), eng.yes_id, np.int32)
+        no = np.full((B,), eng.no_id, np.int32)
+        fused, cfused = eng.decode_fused_shared(
+            bps, cps, yes, no, new_tokens=NEW_TOKENS,
+            conf_tokens=CONF_TOKENS, reuse_cache=True)
+        fused, cfused = jax.device_get((fused, cfused))
+        if record:
+            with eng._tok_lock:
+                bin_ids = [tokz(p).input_ids for p in bps]
+                conf_ids = [tokz(p).input_ids for p in cps]
+            lcp = [tok.shared_prefix_len(a, b)
+                   for a, b in zip(bin_ids, conf_ids)]
+            bucket = tok.pick_bucket([max(n, 1) for n in lcp], eng.buckets)
+            eng.spec_record(bucket, bin_ids, np.asarray(fused.generated), B)
+            eng.spec_record(bucket, conf_ids, np.asarray(cfused.generated),
+                            B)
+        return fused, cfused
+
+    eng_on = make_engine(True)
+    eng_off = make_engine(False)
+
+    on1 = dispatch(eng_on, record=True)
+    eng_on.spec_flush()
+    pass1_fwd = eng_on.spec_stats.decode_forwards
+    on2 = dispatch(eng_on, record=False)
+    eng_on.spec_flush()
+    off1 = dispatch(eng_off, record=False)
+    off2 = dispatch(eng_off, record=False)
+
+    # -- claim 3: ON == OFF payloads bitwise, cold and warm ------------------
+    def assert_consumed_bitwise(tag, on, off):
+        for pair_name, a, b in (("binary", on[0], off[0]),
+                                ("confidence", on[1], off[1])):
+            for field in ("generated", "top2_ids", "topk_logprobs",
+                          "topk_ids", "weighted_confidence"):
+                av = np.asarray(getattr(a, field))
+                bv = np.asarray(getattr(b, field))
+                assert np.array_equal(av, bv), \
+                    f"{tag}/{pair_name}.{field} diverged ON vs OFF"
+            for field in ("p_yes", "p_no"):
+                av = np.asarray(getattr(a, field))[:, 0]
+                bv = np.asarray(getattr(b, field))[:, 0]
+                assert np.array_equal(av, bv), \
+                    f"{tag}/{pair_name}.{field}[pos0] diverged ON vs OFF"
+
+    assert_consumed_bitwise("cold", on1, off1)
+    assert_consumed_bitwise("warm", on2, off2)
+
+    s = eng_on.spec_stats
+    summary = s.summary()
+    print(json.dumps(summary, indent=2))
+
+    # -- claim 1: drafts landed ----------------------------------------------
+    assert s.accepted_tokens > 0, "no draft token was ever accepted"
+    assert s.draft_tree > 0, "the tree-continuation drafter never fired"
+
+    # -- claim 2: >= 2x fewer decode forwards on the warm pass ---------------
+    warm_fwd = s.decode_forwards - pass1_fwd
+    warm_seq = s.seq_forwards - pass1_fwd  # pass 1 ran ~sequential counts
+    ratio = warm_seq / max(warm_fwd, 1)
+    print(f"warm decode forwards: {warm_fwd} vs sequential {warm_seq} "
+          f"({ratio:.2f}x fewer)")
+    assert ratio >= 2.0, \
+        f"expected >= 2x fewer decode dispatches, got {ratio:.2f}x"
+
+    print("spec smoke OK: drafts accepted, >= 2x fewer decode dispatches, "
+          "ON == OFF payloads bitwise")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
