@@ -4,7 +4,7 @@
 
 .PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
 	kernel-smoke stats-smoke fleet-smoke observe-smoke elastic-smoke \
-	spec-smoke install-hooks
+	spec-smoke mem-smoke install-hooks
 
 verify: lint
 	python tools/check_tier1.py
@@ -101,6 +101,16 @@ observe-smoke:
 # payloads bitwise (tools/spec_smoke.py; DEPLOY.md §1n).
 spec-smoke:
 	JAX_PLATFORMS=cpu python tools/spec_smoke.py
+
+# Memory-governance smoke: the unified HBM governor under a seeded
+# hbm_squeeze on the fake backend — the degradation ladder must walk
+# down during the squeeze and back up after it (rung_downs == rung_ups,
+# level 0) in BOTH the sweep and serve paths, with zero crashed
+# dispatches and rows/payloads bitwise-identical to unpressured runs;
+# governor gauges must ride the metrics snapshot (tools/mem_smoke.py;
+# DEPLOY.md §1o).
+mem-smoke:
+	JAX_PLATFORMS=cpu python tools/mem_smoke.py
 
 # Elastic-serving smoke: 3 in-process replicas behind the failover
 # router on the fake backend — a seeded replica_kill mid-run must lose

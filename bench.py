@@ -267,6 +267,15 @@ def main() -> None:
                          "goodput >= 0.6x after the kill and recovering "
                          "on rejoin, leased sweep accumulator bitwise "
                          "vs a static run — headline key \"elastic\")")
+    ap.add_argument("--no-memory", action="store_true",
+                    help="skip the memory-governance mode (identical "
+                         "grid swept unpressured vs with a seeded "
+                         "mid-run hbm_squeeze shrinking the HBM "
+                         "governor's budget: goodput >= 0.6x "
+                         "unpressured, zero crashed dispatches, "
+                         "degradation-ladder rung counters nonzero in "
+                         "BOTH directions, per-cell rows bitwise — "
+                         "headline key \"memory\")")
     ap.add_argument("--no-streaming-stats", action="store_true",
                     help="skip the streaming-statistics mode (identical "
                          "grid swept twice: device accumulator -> CIs "
@@ -683,6 +692,18 @@ def main() -> None:
                 headline["speculative"] = speculative
         except (Exception, SystemExit) as err:  # noqa: BLE001
             print(f"# speculative bench mode failed ({err!r}); headline "
+                  "is unaffected", file=sys.stderr)
+    # Memory-governance mode: the identical grid swept unpressured vs
+    # under a seeded mid-run hbm_squeeze (engine/hbm.py degradation
+    # ladder) — the memory-robustness cost tracked like perf. Failures
+    # never discard the headline.
+    if not args.no_memory:
+        try:
+            memory = _memory_bench(on_accel)
+            if memory is not None:
+                headline["memory"] = memory
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# memory bench mode failed ({err!r}); headline "
                   "is unaffected", file=sys.stderr)
     # Chaos mode (--chaos): the same serving layer under a seeded
     # transient fault schedule — the robustness cost (recovery work +
@@ -2321,6 +2342,147 @@ def _elastic_bench(on_accel: bool):
         "lease_accum_bitwise_vs_static": bool(lease_bitwise),
         "lease_shards_stolen": int(steals),
     }
+
+
+def _memory_bench(on_accel: bool):
+    """Memory-governance mode (engine/hbm.py): the OOM-squeeze proof as
+    a measured ratio. ONE grid is swept twice on config-identical
+    engines — unpressured, then with a seeded ``hbm_squeeze`` cutting
+    the HBM governor's ledger budget to 5% for a few dispatch ticks
+    mid-run (faults.wrap_governor). Gates asserted before reporting:
+
+    - ZERO crashed dispatches: the squeezed sweep completes the full
+      grid (no lost/duplicated cells, no quarantines);
+    - every engaged degradation rung is REVERSIBLE: rung_downs ==
+      rung_ups once the squeeze clears, ladder back at level 0;
+    - per-cell rows BITWISE-identical to the unpressured run — no
+      rung is allowed to change results;
+    - goodput under the squeeze >= 0.6x unpressured (the ladder's
+      rungs — pages evicted, piggyback/spec off — cost throughput,
+      never correctness; on the CPU smoke the ratio is dominated by
+      noise, so the gate is deliberately loose — the content is the
+      zero-crash + bitwise accounting)."""
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from lir_tpu import faults
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import GovernorConfig, RuntimeConfig
+    from lir_tpu.data import schemas
+    from lir_tpu.data.prompts import LegalPrompt
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+
+    n_cells, batch = 24, 4
+    mcfg = ModelConfig(name="memory-bench",
+                       vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=64 if on_accel else 32, n_layers=1,
+                       n_heads=2, intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(mcfg, jax.random.PRNGKey(41))
+    rng = np.random.default_rng(43)
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement").split()
+
+    def _text(n):
+        return " ".join(rng.choice(words) for _ in range(n)) + " ?"
+
+    lp = (LegalPrompt(main=_text(10),
+                      response_format="Answer Yes or No .",
+                      target_tokens=("Yes", "No"),
+                      confidence_format="Give a number from 0 to 100 ."),)
+    perts = ([_text(10 if i % 2 else 24) for i in range(n_cells - 1)],)
+
+    def _engine():
+        # piggyback OFF: the squeezed pass is compared BITWISE against
+        # the unpressured pass, so both must run the plain dispatch
+        # path (chaos_smoke's rule); sustain 1 so the grid's handful
+        # of dispatch ticks walks the ladder.
+        return ScoringEngine(
+            params, mcfg, FakeTokenizer(),
+            RuntimeConfig(batch_size=batch, max_seq_len=256,
+                          piggyback_prefill=False),
+            governor_config=GovernorConfig(sustain_ticks=1))
+
+    value_cols = ("Token_1_Prob", "Token_2_Prob", "Confidence Value",
+                  "Weighted Confidence", "Model Response",
+                  "Model Confidence Response", "Log Probabilities")
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        # Warm pass compiles every bucket executable so both timed
+        # passes measure dispatching, not traces.
+        run_perturbation_sweep(_engine(), "memory", lp, perts,
+                               td / "warm.csv", checkpoint_every=8)
+        t0 = time.perf_counter()
+        run_perturbation_sweep(_engine(), "memory", lp, perts,
+                               td / "base.csv", checkpoint_every=8)
+        base_s = time.perf_counter() - t0
+        base_df = schemas.read_results_frame(td / "base.csv")
+        base_by_key = {
+            (r["Rephrased Main Part"], r["Response Format"],
+             r["Confidence Format"]): tuple(r[c] for c in value_cols)
+            for _, r in base_df.iterrows()}
+
+        engine = _engine()
+        plan = faults.FaultPlan(seed=47, schedules={
+            "hbm": faults.SiteSchedule.hbm_squeeze_at(1, frac=0.05,
+                                                      calls=4)})
+        faults.wrap_governor(engine.governor, plan)
+        t0 = time.perf_counter()
+        run_perturbation_sweep(engine, "memory", lp, perts,
+                               td / "squeezed.csv", checkpoint_every=8)
+        squeezed_s = time.perf_counter() - t0
+        gov = engine.governor
+
+        assert plan.injected("hbm") == 1, "hbm_squeeze never fired"
+        assert gov.stats.rung_downs, "squeeze never walked the ladder"
+        for _ in range(16):          # the next dispatches of a longer
+            if gov.level == 0:       # session re-arm the ladder
+                break
+            gov.tick()
+        assert gov.level == 0, f"ladder stuck at level {gov.level}"
+        assert gov.stats.rung_ups == gov.stats.rung_downs, (
+            f"rungs not reversible: downs {gov.stats.rung_downs} vs "
+            f"ups {gov.stats.rung_ups}")
+
+        df = schemas.read_results_frame(td / "squeezed.csv")
+        keys = list(zip(df["Rephrased Main Part"],
+                        df["Response Format"], df["Confidence Format"]))
+        assert len(keys) == n_cells and len(set(keys)) == n_cells, (
+            f"squeezed sweep crashed dispatches: {len(keys)} rows, "
+            f"{len(set(keys))} unique, expected {n_cells}")
+        for _, row in df.iterrows():
+            k = (row["Rephrased Main Part"], row["Response Format"],
+                 row["Confidence Format"])
+            want = base_by_key[k]
+            got = tuple(row[c] for c in value_cols)
+            for g, w in zip(got, want):
+                if pd.isna(g) and pd.isna(w):
+                    continue
+                assert g == w, (
+                    f"squeezed row differs from unpressured: {g!r} != "
+                    f"{w!r} for {k[0][:40]}")
+
+        g_base = n_cells / base_s
+        g_squeezed = n_cells / squeezed_s
+        assert g_squeezed >= 0.6 * g_base, (
+            f"goodput under the squeeze {g_squeezed:.2f} p/s < 0.6x "
+            f"unpressured {g_base:.2f} p/s")
+        return {
+            "cells": n_cells,
+            "goodput_unpressured_p_s": round(g_base, 3),
+            "goodput_squeezed_p_s": round(g_squeezed, 3),
+            "squeezed_vs_unpressured": round(g_squeezed / g_base, 3),
+            "crashed_dispatches": 0,
+            "rows_bitwise": True,
+            "squeezes": int(gov.stats.squeezes),
+            "rung_downs": dict(gov.stats.rung_downs),
+            "rung_ups": dict(gov.stats.rung_ups),
+            "ladder_level_final": int(gov.level),
+        }
 
 
 def _stream_stats_bench(params, cfg, on_accel: bool, tokenizer=None,

@@ -168,6 +168,7 @@ def _add_perturb(sub) -> None:
     _add_prefix_pool_flags(p)
     _add_engine_tuning_flags(p)
     _add_guard_flags(p)
+    _add_governor_flags(p)
     _add_kernel_flags(p)
     _add_spec_flags(p)
     _add_trace_flags(p)
@@ -445,6 +446,11 @@ def _add_router_flags(p) -> None:
                    help="placement bonus (queue-row equivalents) for a "
                         "replica whose WeightCache already holds the "
                         "request's model (default 8)")
+    p.add_argument("--pressure-weight", type=float, default=None,
+                   help="placement penalty (queue-row equivalents) per "
+                        "unit of a replica's HBM-governor pressure — "
+                        "memory as a routing signal (default 6; "
+                        "0 disables)")
     p.add_argument("--slo-wait-weight", type=float, default=None,
                    help="SLO placement term: weight on a replica's "
                         "oldest queued-row wait relative to the "
@@ -477,6 +483,8 @@ def _router_cfg(args):
         kw["residency_bonus"] = args.residency_bonus
     if getattr(args, "slo_wait_weight", None) is not None:
         kw["slo_wait_weight"] = args.slo_wait_weight
+    if getattr(args, "pressure_weight", None) is not None:
+        kw["pressure_weight"] = args.pressure_weight
     if getattr(args, "router_tick", None) is not None:
         kw["tick_s"] = args.router_tick
     if getattr(args, "router_cache_entries", None) is not None:
@@ -558,6 +566,63 @@ def _finish_tracing(rec, args) -> None:
     rec.export_chrome(args.trace_out)
     log.info("trace: wrote %d spans (%d dropped) -> %s", len(rec),
              rec.dropped, args.trace_out)
+
+
+def _add_governor_flags(p) -> None:
+    """Unified HBM-governor knobs (config.GovernorConfig —
+    engine/hbm.py; DEPLOY.md §1o), shared by perturb and serve."""
+    p.add_argument("--no-hbm-governor", action="store_true",
+                   help="disable the unified HBM governor (enabled): "
+                        "no ledger, no degradation ladder, OOMs "
+                        "re-raise raw — the pre-governor baseline")
+    p.add_argument("--hbm-budget-gb", type=float, default=None,
+                   help="governed HBM budget in GiB (hbm_budget_gb; "
+                        "default 0 derives it from the device "
+                        "bytes_limit minus the reserve; on CPU 0 "
+                        "means unbounded — the ladder never engages)")
+    p.add_argument("--hbm-reserve-frac", type=float, default=None,
+                   help="fraction of the device limit held back from "
+                        "a derived budget (hbm_reserve_frac, default "
+                        "0.08 — runtime scratch + fragmentation slack)")
+    p.add_argument("--hbm-engage-pressure", type=float, default=None,
+                   help="ledger/budget pressure at which the "
+                        "degradation ladder engages its next rung "
+                        "(engage_pressure, default 0.9)")
+    p.add_argument("--hbm-hysteresis", type=float, default=None,
+                   help="release band below the engage pressure "
+                        "(hysteresis, default 0.15): rungs re-arm "
+                        "below engage - hysteresis, so the ladder "
+                        "can never flap on one threshold")
+    p.add_argument("--hbm-sustain-ticks", type=int, default=None,
+                   help="consecutive over-pressure dispatch ticks "
+                        "before a rung engages (sustain_ticks, "
+                        "default 2 — spikes don't walk the ladder, "
+                        "sustained pressure does)")
+    p.add_argument("--hbm-evict-pages", type=int, default=None,
+                   help="radix pages evicted per evict_pages rung "
+                        "engagement (evict_pages_per_step, default 32)")
+
+
+def _governor_cfg(args):
+    """GovernorConfig from the flags (None = dataclass default)."""
+    from .config import GovernorConfig
+
+    kw = {}
+    if getattr(args, "no_hbm_governor", False):
+        kw["enabled"] = False
+    if getattr(args, "hbm_budget_gb", None) is not None:
+        kw["hbm_budget_gb"] = args.hbm_budget_gb
+    if getattr(args, "hbm_reserve_frac", None) is not None:
+        kw["hbm_reserve_frac"] = args.hbm_reserve_frac
+    if getattr(args, "hbm_engage_pressure", None) is not None:
+        kw["engage_pressure"] = args.hbm_engage_pressure
+    if getattr(args, "hbm_hysteresis", None) is not None:
+        kw["hysteresis"] = args.hbm_hysteresis
+    if getattr(args, "hbm_sustain_ticks", None) is not None:
+        kw["sustain_ticks"] = args.hbm_sustain_ticks
+    if getattr(args, "hbm_evict_pages", None) is not None:
+        kw["evict_pages_per_step"] = args.hbm_evict_pages
+    return GovernorConfig(**kw)
 
 
 def _add_guard_flags(p) -> None:
@@ -695,6 +760,7 @@ def _add_serve(sub) -> None:
     _add_prefix_pool_flags(p)
     _add_engine_tuning_flags(p)
     _add_guard_flags(p)
+    _add_governor_flags(p)
     _add_kernel_flags(p)
     _add_spec_flags(p)
     _add_trace_flags(p)
@@ -872,6 +938,7 @@ def cmd_perturb(args) -> None:
         quantize_int8=args.int8, int8_dynamic=args.int8_dynamic,
         kv_cache_int8=args.kv_cache_int8,
         spec_config=_spec_config_from_args(args),
+        governor_config=_governor_cfg(args),
     )
     entries = load_or_generate_perturbations(
         args.perturbations, LEGAL_PROMPTS, None
@@ -955,7 +1022,8 @@ def cmd_serve(args) -> None:
         args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
         cache_root=args.param_cache, quantize_int8=args.int8,
         int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8,
-        spec_config=_spec_config_from_args(args))
+        spec_config=_spec_config_from_args(args),
+        governor_config=_governor_cfg(args))
     if args.fleet_models:
         try:
             _run_fleet_serve(args, serve_cfg, factory)

@@ -460,6 +460,54 @@ class ObserveConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class GovernorConfig:
+    """Unified HBM governor knobs (engine/hbm.py; DEPLOY.md §1o).
+
+    Every HBM consumer (weight cache, KV page pool, dispatch/handoff
+    caches, spec-draft pins, accumulator lattice) registers projected
+    bytes into ONE ledger; sustained pressure against the budget walks
+    a reversible degradation ladder (evict idle weights → evict cold
+    radix pages → disable piggyback chaining → disable spec drafting →
+    step the batch ladder down → shed), each rung re-arming with
+    hysteresis once pressure clears. Real device OOMs route through
+    the governor's reclaim-and-retry instead of killing the run or
+    feeding the circuit breaker.
+    """
+
+    # Master switch: OFF leaves every consumer self-governed exactly as
+    # before the governor existed (measurement baseline).
+    enabled: bool = True                 # cli: --no-hbm-governor
+    # Governed HBM budget in GiB. 0 derives the budget from the
+    # device's reported bytes_limit (with `hbm_reserve_frac` held
+    # back); on backends without memory stats (CPU) 0 means unbounded
+    # — the ladder then never engages and behavior is identical to
+    # governor-off.
+    hbm_budget_gb: float = 0.0           # cli: --hbm-budget-gb
+    # Fraction of the device bytes_limit held back from a derived
+    # budget (runtime scratch, fragmentation slack).
+    hbm_reserve_frac: float = 0.08       # cli: --hbm-reserve-frac
+    # Ledger pressure (ledger_bytes / budget) at which the ladder
+    # engages its next rung, and the hysteresis band below it at which
+    # the most recent rung re-arms (releases). engage 0.9 / hysteresis
+    # 0.15 means: walk down above 0.9, walk back up below 0.75 — a
+    # rung can never flap on the threshold itself.
+    engage_pressure: float = 0.9         # cli: --hbm-engage-pressure
+    hysteresis: float = 0.15             # cli: --hbm-hysteresis
+    # Consecutive over-pressure ticks (one tick per dispatch) before a
+    # rung engages — transient spikes (one oversized dispatch) don't
+    # walk the ladder; sustained pressure does. The same count of
+    # under-pressure ticks releases.
+    sustain_ticks: int = 2               # cli: --hbm-sustain-ticks
+    # Radix pages evicted per evict_pages rung engagement.
+    evict_pages_per_step: int = 32       # cli: --hbm-evict-pages
+
+    @property
+    def budget_bytes(self) -> Optional[int]:
+        return (int(self.hbm_budget_gb * 2**30)
+                if self.hbm_budget_gb > 0 else None)
+
+
+@dataclasses.dataclass(frozen=True)
 class RouterConfig:
     """Elastic multi-replica serving knobs (serve/router.py;
     DEPLOY.md §1m).
@@ -498,6 +546,12 @@ class RouterConfig:
     # whose WeightCache already holds the request's model — weight
     # residency as a first-class routing signal.
     residency_bonus: float = 8.0           # cli: --residency-bonus
+    # Memory-pressure placement penalty (queue-row equivalents per unit
+    # of HBM-governor pressure): a replica whose ledger is squeezed
+    # reads as a worse home than an equally-loaded replica with
+    # headroom — the governor's pressure gauge as a routing signal,
+    # the seam ROADMAP item 2's page migration stands on. 0 disables.
+    pressure_weight: float = 6.0           # cli: --pressure-weight
     # SLO-aware placement: weight on a replica's oldest queued-row wait
     # relative to the request's remaining deadline, so deadline-tight
     # requests avoid replicas with stale backlogs. 0 disables.
@@ -567,6 +621,8 @@ class Config:
         default_factory=ObserveConfig)
     router: RouterConfig = dataclasses.field(
         default_factory=RouterConfig)
+    governor: GovernorConfig = dataclasses.field(
+        default_factory=GovernorConfig)
 
     # Paths: everything under one results root; no personal gdrive paths.
     results_dir: Path = Path("results")

@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..models import weights
 from ..utils.profiling import FleetStats
+from . import hbm
 
 # An engine factory maps model id -> ready ScoringEngine (models/
 # factory.engine_factory is the checkpoint-backed one; tests inject
@@ -73,7 +74,8 @@ class ModelFleet:
     def __init__(self, cache_budget_bytes: Optional[int] = None,
                  prefetch: bool = True, mesh=None,
                  stage_reloads: bool = True,
-                 stats: Optional[FleetStats] = None):
+                 stats: Optional[FleetStats] = None,
+                 governor: Optional[hbm.HbmGovernor] = None):
         self.stats = stats if stats is not None else FleetStats()
         self.mesh = mesh
         self.prefetch_enabled = bool(prefetch)
@@ -91,8 +93,37 @@ class ModelFleet:
         self._order: List[str] = []
         self._active: Optional[str] = None
         self._lock = threading.RLock()
+        # Unified HBM governor (engine/hbm.py): the weight cache's
+        # residency rides the ledger via the same listener events the
+        # router's residency map uses, and the ladder's evict_weights
+        # rung drops one idle LRU model through the cache's own
+        # refcount discipline (in-flight/pinned models unevictable).
+        self.governor = governor
+        if governor is not None:
+            governor.register("weights", 0)
+            governor.set_action("evict_weights",
+                                engage=self.evict_idle)
+            self.cache.add_listener(self._on_residency_event)
 
     # -- construction --------------------------------------------------------
+
+    def attach_governor(self, governor: hbm.HbmGovernor) -> None:
+        """Adopt an HBM governor after construction (the fleet server
+        shares its first engine's governor so weights, pages, pins and
+        dispatch caches land in ONE ledger). Re-validates every sized
+        slot against the budget and seeds the weights ledger entry."""
+        if self.governor is governor:
+            return
+        self.governor = governor
+        governor.set_action("evict_weights", engage=self.evict_idle)
+        self.cache.add_listener(self._on_residency_event)
+        governor.update("weights", self.cache.resident_bytes)
+        with self._lock:
+            for slot in self._slots.values():
+                if slot.nbytes:
+                    hbm.validate_fleet_budget(
+                        slot.model_id, slot.nbytes,
+                        self.cache.budget_bytes, governor=governor)
 
     def add_model(self, model_id: str, engine: Any = None,
                   make_engine: Optional[EngineFactory] = None) -> None:
@@ -109,9 +140,22 @@ class ModelFleet:
             if engine is not None:
                 params = engine.params
                 slot.nbytes = weights.tree_bytes(params)
+                # Boot-time budget validation: a budget smaller than
+                # this model can NEVER hold it — fail construction
+                # with the full HBM arithmetic instead of surfacing as
+                # a WeightCacheOOM mid-sweep (engine/hbm.py).
+                hbm.validate_fleet_budget(model_id, slot.nbytes,
+                                          self.cache.budget_bytes,
+                                          governor=self.governor)
                 if self.stage_reloads:
                     slot.staged = weights.host_stage(params)
                 self.cache.insert(model_id, params, slot.nbytes)
+                # The cache now owns these bytes: drop the engine-level
+                # params ledger entry so a shared governor counts them
+                # once, under "weights".
+                release = getattr(engine, "release_params_ledger", None)
+                if release is not None:
+                    release()
             self._slots[model_id] = slot
             self._order.append(model_id)
 
@@ -148,6 +192,19 @@ class ModelFleet:
 
     # -- load path -----------------------------------------------------------
 
+    def _on_residency_event(self, event: str, model_id: str) -> None:
+        """WeightCache listener: mirror resident bytes into the HBM
+        governor's ledger. Fired possibly under the cache lock — cheap
+        gauge write only, never touches the cache."""
+        if self.governor is not None:
+            self.governor.update("weights", self.cache.resident_bytes)
+
+    def evict_idle(self) -> bool:
+        """Governor evict_weights rung: drop ONE idle LRU model (its
+        staged host copy survives, so a re-acquire streams it back
+        bitwise). True when a model was actually evicted."""
+        return self.cache.evict_idle() is not None
+
     def _on_evict(self, model_id: str) -> None:
         slot = self._slots.get(model_id)
         if slot is None or slot.engine is None:
@@ -172,6 +229,16 @@ class ModelFleet:
         params = engine.params
         slot.engine = engine
         slot.nbytes = weights.tree_bytes(params)
+        # Factory slots learn their size at first load — run the same
+        # budget arithmetic add_model runs for pre-built engines, so a
+        # mis-sized fleet fails its FIRST load loudly instead of
+        # thrashing into WeightCacheOOM mid-sweep.
+        hbm.validate_fleet_budget(slot.model_id, slot.nbytes,
+                                  self.cache.budget_bytes,
+                                  governor=self.governor)
+        release = getattr(engine, "release_params_ledger", None)
+        if release is not None:
+            release()    # the cache owns the bytes from here
         if self.stage_reloads:
             slot.staged = weights.host_stage(params)
         return params

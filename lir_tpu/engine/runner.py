@@ -22,12 +22,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import RuntimeConfig, SpecConfig
+from ..config import GovernorConfig, RuntimeConfig, SpecConfig
 from ..guard.watchdog import DispatchWatchdog
 from ..models import decoder, paged, quant
 from ..utils.profiling import (CompileStats, FaultStats, GuardStats,
                                KernelStats, PrefixCacheStats, SpecStats)
-from . import (compile_plan, generate, prefix_tree,
+from . import (compile_plan, generate, hbm, prefix_tree,
                scheduler as scheduler_mod, score, spec as spec_mod,
                tokens as tok)
 
@@ -120,7 +120,9 @@ class ScoringEngine:
                  encoder_decoder: bool = False,
                  yes_text: str = "Yes", no_text: str = "No",
                  seq_mesh: Any = None, seq_impl: str = "ring",
-                 spec_config: Optional[SpecConfig] = None):
+                 spec_config: Optional[SpecConfig] = None,
+                 governor: Optional["hbm.HbmGovernor"] = None,
+                 governor_config: Optional[GovernorConfig] = None):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -217,6 +219,33 @@ class ScoringEngine:
         # Per-phase kernel accounting + piggyback counters
         # (profiling.KernelStats; bench.py fills the phase rows).
         self.kernel_stats = KernelStats()
+        # Unified HBM governor (engine/hbm.py): one ledger every HBM
+        # consumer registers with, the pressure-driven degradation
+        # ladder, and reclaim-and-retry OOM routing. With no configured
+        # budget and no device memory stats (CPU) the ladder never
+        # engages — behavior is identical to pre-governor. Built BEFORE
+        # the prefix cache so the pool reservation lands in the ledger.
+        if governor is not None:
+            self.governor: Optional[hbm.HbmGovernor] = governor
+        else:
+            self.governor = hbm.HbmGovernor(governor_config)
+        # Ledger keys are namespaced by model so engines sharing one
+        # fleet governor never collide (and the fleet can hand params
+        # accounting over to the weight cache — release_params_ledger).
+        self._ledger_key = f"params:{getattr(cfg, 'name', 'model')}"
+        if self.governor is not None and params is not None:
+            try:
+                self.governor.register(self._ledger_key,
+                                       quant.param_bytes(params))
+            except Exception:  # noqa: BLE001 — ledger accounting must
+                # never block engine construction (exotic test params)
+                pass
+            self.governor.set_action(
+                "evict_pages",
+                engage=lambda: self._evict_cold_pages())
+            self.governor.set_action(
+                "no_piggyback",
+                engage=lambda: self._drop_handoff_scratch())
         # Cross-request radix prefix cache (engine/prefix_tree.py) over
         # the paged KV allocator (models/paged.py): a dispatch resumes
         # each row's prefix from the deepest cached radix node and pays
@@ -265,6 +294,58 @@ class ScoringEngine:
         same two executables a warmup over the same shapes compiles, so
         steady-state timing never hits a fresh compile mid-stream."""
         self._handoff = _CacheHandoff()
+        if getattr(self, "governor", None) is not None:
+            self.governor.unregister(
+                f"handoff:{getattr(self.cfg, 'name', 'model')}")
+
+    def release_params_ledger(self) -> None:
+        """The fleet weight cache now owns this engine's param bytes —
+        drop the engine-level ledger entry so a shared governor never
+        double-counts them under both ``params:<model>`` and the
+        cache's ``weights`` entry (engine/fleet.py calls this when a
+        model moves under cache ownership)."""
+        if self.governor is not None:
+            self.governor.unregister(self._ledger_key)
+
+    def _drop_handoff_scratch(self) -> bool:
+        """Governor no_piggyback rung: beyond refusing new chains, give
+        back the donation-chain scratch buffer the handoff retains
+        between dispatches — real HBM freed NOW (the next dispatch
+        simply runs the scratchless executable signature, which every
+        bucket already compiled as its first dispatch). True when a
+        parked buffer was actually released."""
+        had = self._handoff.pending
+        self.fresh_handoff()
+        return had
+
+    def _evict_cold_pages(self) -> bool:
+        """Governor evict_pages rung: drop the coldest radix pages
+        (tree-driven LRU — models/paged refcounts keep in-flight pages
+        unevictable). Returns True when any page was actually freed."""
+        if self.prefix_cache is None:
+            return False
+        n = self.prefix_cache.evict(
+            self.governor.cfg.evict_pages_per_step
+            if self.governor is not None else paged.DEFAULT_PAGE_SIZE)
+        return n > 0
+
+    def _note_handoff(self, cache: Any) -> None:
+        """Ledger the donation-chain scratch cache the engine keeps
+        live between dispatches (shape metadata only — no device
+        sync). One entry: the chain holds at most one parked cache."""
+        if self.governor is None or cache is None:
+            return
+        nbytes = 0
+        for leaf in jax.tree.leaves(cache):
+            size = getattr(leaf, "size", None)
+            dtype = getattr(leaf, "dtype", None)
+            if size is None or dtype is None:
+                continue
+            # .size/.itemsize are static shape METADATA (host ints on
+            # an async jax array) — no device round-trip happens here.
+            nbytes += int(size) * int(jnp.dtype(dtype).itemsize)  # lint: allow(host-sync)
+        self.governor.register(
+            f"handoff:{getattr(self.cfg, 'name', 'model')}", nbytes)
 
     def enable_prefix_cache(self) -> None:
         """Build the paged KV pool + radix index (idempotent). The pool
@@ -285,6 +366,12 @@ class ScoringEngine:
         pool.ensure(self._cache_aval())
         self.prefix_cache = prefix_tree.RadixPrefixCache(
             pool, stats=self.prefix_stats)
+        if self.governor is not None:
+            # The pool materializes at full size up front — the ledger
+            # carries the whole reservation, not current occupancy.
+            self.governor.register(
+                f"kv_pages:{getattr(self.cfg, 'name', 'model')}",
+                pool.nbytes)
 
     # -- speculative decode (engine/spec.py) --------------------------------
 
@@ -312,8 +399,18 @@ class ScoringEngine:
                 f"{self.cfg.vocab_size} — draft and verifier must share "
                 f"a tokenizer")
         self._spec_draft = (params, cfg, name)
+        if self.governor is not None:
+            try:
+                self.governor.register(
+                    f"spec_draft:{name or cfg.name}",
+                    quant.param_bytes(params))
+            except Exception:  # noqa: BLE001 — accounting only
+                pass
 
     def clear_spec_draft(self) -> None:
+        if self._spec_draft is not None and self.governor is not None:
+            _, dcfg, dname = self._spec_draft
+            self.governor.unregister(f"spec_draft:{dname or dcfg.name}")
         self._spec_draft = None
 
     def spec_record(self, bucket: int,
@@ -750,6 +847,14 @@ class ScoringEngine:
             # combination falls back to the sequential paged path.
             splan = spec_mod.build_plan(self, bin_ids, conf_ids, bucket,
                                         ba, bb, new_tokens, conf_tokens)
+            if (splan is not None and self.governor is not None
+                    and not self.governor.allows("spec")):
+                # Governor no_spec rung: the sequential executable is
+                # bitwise-identical, so shedding speculation is a pure
+                # HBM reclaim (the spec cache runs spec_k extra slots
+                # per window). Re-arms when pressure clears.
+                splan = None
+                self.spec_stats.count("fallbacks")
             paged_warm = plan is not None and plan.window is not None
             if splan is not None and paged_warm and splan.fleet:
                 splan = None
@@ -783,6 +888,7 @@ class ScoringEngine:
                 self.spec_stats.count(
                     "spec_rows", len(bin_ids) if n_real is None else n_real)
                 self._handoff.put(key, cache)
+                self._note_handoff(cache)
                 if plan is not None:
                     self._finish_prefix_resume(plan, cache)
                 return fused, cfused
@@ -847,6 +953,7 @@ class ScoringEngine:
                     self._abort_prefix_resume(plan)
                 raise
             self._handoff.put(key, cache)
+            self._note_handoff(cache)
             if plan is not None:
                 self._finish_prefix_resume(plan, cache)
             return fused, cfused
@@ -949,9 +1056,25 @@ class ScoringEngine:
     def _piggyback_fits(self, bsz: int, total_len: int) -> bool:
         """HBM headroom gate: a piggybacked pair keeps TWO dispatch caches
         live (the parked carry + the riding dispatch's own), where the
-        sequential path holds one. Engage only when params + two caches
-        clear the device budget; backends without memory stats (CPU) are
-        governed by host RAM and always pass."""
+        sequential path holds one. With a governed budget the check is
+        an admission against the governor's LEDGER (params, pool, pins
+        and the parked carry all already counted); otherwise it falls
+        back to the raw device bytes_limit. Backends without either
+        (CPU) are governed by host RAM and always pass."""
+        aval = self._cache_aval()  # built at batch 1, 8 slots
+        per_row_slot = sum(
+            leaf.size * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(aval)) / 8
+        cache_bytes = per_row_slot * bsz * total_len
+        if self.governor is not None:
+            if not self.governor.allows("piggyback"):
+                return False
+            headroom = self.governor.headroom()
+            if headroom is not None:
+                # The ledger already carries the parked carry under
+                # "handoff"; the riding dispatch's own cache (plus
+                # fragmentation slack) must fit what is left.
+                return 1.2 * cache_bytes < headroom
         try:
             stats = jax.devices()[0].memory_stats() or {}
             limit = stats.get("bytes_limit")
@@ -959,11 +1082,6 @@ class ScoringEngine:
             limit = None
         if not limit:
             return True
-        aval = self._cache_aval()  # built at batch 1, 8 slots
-        per_row_slot = sum(
-            leaf.size * jnp.dtype(leaf.dtype).itemsize
-            for leaf in jax.tree.leaves(aval)) / 8
-        cache_bytes = per_row_slot * bsz * total_len
         return (quant.param_bytes(self.params) + 2.2 * cache_bytes
                 < 0.92 * limit)
 
@@ -1014,6 +1132,14 @@ class ScoringEngine:
             # (disjoint branch regions), so its learned-position ceiling
             # binds earlier than the plain path's.
             raise PiggybackIneligible("learned-position table overrun")
+        if (self.governor is not None
+                and not self.governor.allows("piggyback")):
+            # Governor no_piggyback rung engaged: the chain's second
+            # live cache is the cheapest reversible HBM to give back.
+            # The sweep keeps asking per dispatch, so chaining resumes
+            # the moment the rung re-arms.
+            raise PiggybackIneligible(
+                "memory governor: piggyback disabled under pressure")
         if not self._piggyback_fits(len(bin_ids), total_len):
             raise PiggybackIneligible("no HBM headroom for two caches")
 
@@ -1252,6 +1378,7 @@ class ScoringEngine:
                     self._abort_prefix_resume(plan)
                 raise
             self._handoff.put(key, cache)
+            self._note_handoff(cache)
             if plan is not None:
                 self._finish_prefix_resume(plan, cache,
                                            row_map=first_member)

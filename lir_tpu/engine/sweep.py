@@ -79,14 +79,39 @@ def _dispatch_with_recovery(engine, call, cost=None):
         inner = call
         call = lambda: wd.watch(inner, cost=cost, site="sweep")  # noqa: E731
 
+    gov = getattr(engine, "governor", None)
     try:
-        return call()
+        out = call()
+        if gov is not None:
+            gov.tick()      # one ladder tick per dispatch boundary
+        return out
     except (KeyboardInterrupt, SystemExit):
         raise
     except Exception as err:  # noqa: BLE001 — retried below
         if is_oom_error(err):
-            raise  # capacity, not transience: the caller's batch
-            # ladder (bench/tools) owns OOM fallback
+            # Capacity, not transience — the retry/backoff ladder would
+            # only re-OOM. Route through the governor: force-engage the
+            # reclaim rungs (idle weights, cold pages, the piggyback
+            # carry) and retry ONCE against the freed headroom. A
+            # second OOM is the irreducible dispatch: raise with the
+            # full ledger arithmetic (the bench/tools batch ladder
+            # still owns the final fallback).
+            from . import hbm
+
+            if gov is not None and gov.handle_oom("sweep"):
+                log.warning("sweep dispatch OOMed (%r); governor "
+                            "reclaimed — retrying once", err)
+                try:
+                    return call()
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as err2:  # noqa: BLE001
+                    if is_oom_error(err2):
+                        gov.stats.count("oom_exhausted")
+                        raise hbm.HbmExhausted(
+                            gov.oom_message("sweep", err2)) from err2
+                    raise
+            raise
         log.warning("sweep dispatch failed (%r); degrading AOT registry "
                     "-> lazy jit and retrying", err)
         engine.degrade_to_lazy()
@@ -265,6 +290,10 @@ def run_perturbation_sweep(
                          sink.snapshot().rows_folded)
         write_rows = bool(engine.rt.row_artifact)
     engine.stream_sink = sink
+    if sink is not None and getattr(engine, "governor", None) is not None:
+        # Accumulator lattice: a small but real device-resident
+        # consumer — the ledger carries it so pressure math is honest.
+        engine.governor.register("stream_accum", sink.accum_bytes)
 
     # Pre-resolve per-prompt target token ids once (SURVEY §7 hard part 1).
     target_ids = {
@@ -869,8 +898,12 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
     def _watched(call, cost):
         wd = getattr(engine, "watchdog", None)
         if wd is not None and wd.enabled:
-            return wd.watch(call, cost=cost, site="sweep")
-        return call()
+            out = wd.watch(call, cost=cost, site="sweep")
+        else:
+            out = call()
+        if getattr(engine, "governor", None) is not None:
+            engine.governor.tick()   # piggyback chain dispatch boundary
+        return out
 
     def _emit(meta, fused, cfused):
         res = score_mod.readout_from_fused(

@@ -32,13 +32,13 @@ from .ladder import degrade_dispatch
 from .plan import (KINDS, SITES, FaultPlan, InjectedFault,
                    InjectedPreemption, InjectedReplicaKill, SiteSchedule,
                    corrupt_result_nan, tear_jsonl_tail, wrap_engine,
-                   wrap_replica, wrap_server)
+                   wrap_governor, wrap_replica, wrap_server)
 
 __all__ = [
     "FaultPlan", "SiteSchedule", "InjectedFault", "InjectedPreemption",
     "InjectedReplicaKill",
     "SITES", "KINDS", "wrap_engine", "wrap_server", "wrap_replica",
-    "tear_jsonl_tail", "corrupt_result_nan",
+    "wrap_governor", "tear_jsonl_tail", "corrupt_result_nan",
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "degrade_dispatch",
 ]

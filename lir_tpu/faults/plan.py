@@ -54,10 +54,10 @@ from typing import Callable, Dict, Optional, Tuple
 from ..utils.profiling import FaultStats
 
 SITES = ("dispatch", "compile", "tokenize", "manifest_write",
-         "checkpoint_write", "preempt", "replica")
+         "checkpoint_write", "preempt", "replica", "hbm")
 
 KINDS = ("fault", "preempt", "hang", "nan", "replica_kill",
-         "replica_lag")
+         "replica_lag", "hbm_squeeze")
 
 
 class InjectedFault(RuntimeError):
@@ -120,6 +120,8 @@ class SiteSchedule:
     nan_rows: Tuple[int, ...] = (0,)
     replica_id: str = ""
     lag_s: float = 1.0
+    squeeze_frac: float = 0.5
+    squeeze_calls: int = 8
 
     @classmethod
     def outage(cls, start: int, length: int) -> "SiteSchedule":
@@ -154,6 +156,19 @@ class SiteSchedule:
         Row indices ride ``nan_rows`` — the same per-row selector the
         nan kind uses."""
         return cls(fail_calls=(call,), kind="draft_corrupt", nan_rows=rows)
+
+    @classmethod
+    def hbm_squeeze_at(cls, call: int, frac: float = 0.5,
+                       calls: int = 8) -> "SiteSchedule":
+        """Shrink the HBM governor's ledger budget to ``frac`` of its
+        base at governor tick ``call`` for the next ``calls`` ticks,
+        then auto-restore (site "hbm" by convention; wire through
+        :func:`wrap_governor`) — the OOM-squeeze chaos proof: the
+        degradation ladder must walk down under the squeeze and back
+        up after it, with zero crashed dispatches and every consumed
+        row bitwise-identical to an unpressured run."""
+        return cls(fail_calls=(call,), kind="hbm_squeeze",
+                   squeeze_frac=frac, squeeze_calls=calls)
 
     @classmethod
     def replica_kill_at(cls, call: int,
@@ -260,7 +275,8 @@ class FaultPlan:
         corrupt); "replica_lag" sleeps in place then proceeds — use
         :meth:`wrap` when the lagged call's RESULT matters."""
         sched = self._decide(site)
-        if sched is None or sched.kind in ("nan", "draft_corrupt"):
+        if sched is None or sched.kind in ("nan", "draft_corrupt",
+                                           "hbm_squeeze"):
             return
         if sched.kind == "replica_lag":
             self.stats.inject(site)
@@ -390,6 +406,33 @@ def wrap_replica(router, replica_id: str, plan: FaultPlan,
     wrapped.__wrapped__ = inner  # type: ignore[attr-defined]
     handle.server.batcher.score = wrapped
     return router
+
+
+def wrap_governor(governor, plan: FaultPlan, site: str = "hbm"):
+    """Inject the plan's ``site`` schedule in front of an HBM
+    governor's tick (engine/hbm.HbmGovernor — one tick per dispatch
+    boundary). A firing ``hbm_squeeze`` shrinks the governed budget to
+    ``squeeze_frac`` of its base for the next ``squeeze_calls`` ticks,
+    then auto-restores — seeded and counter-indexed like every other
+    kind, so the squeeze lands at exactly the same dispatch on every
+    run. Other kinds behave as in :meth:`FaultPlan.check` (a "fault"
+    here stands in for a failing memory-stats probe)."""
+    inner = governor.tick
+
+    def wrapped(*args, **kwargs):
+        sched = plan._decide(site)
+        if sched is not None:
+            if sched.kind == "hbm_squeeze":
+                plan.stats.inject(site)
+                governor.squeeze(sched.squeeze_frac,
+                                 calls=sched.squeeze_calls)
+            else:
+                plan._fire(sched, site)
+        return inner(*args, **kwargs)
+
+    wrapped.__wrapped__ = inner  # type: ignore[attr-defined]
+    governor.tick = wrapped
+    return governor
 
 
 def corrupt_result_nan(result, rows: Tuple[int, ...]):

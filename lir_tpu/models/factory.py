@@ -104,6 +104,7 @@ def load_engine(
     int8_dynamic: bool = False,
     kv_cache_int8: bool = False,
     spec_config=None,
+    governor_config=None,
 ) -> ScoringEngine:
     """Build a ready ScoringEngine from a local HF checkpoint directory.
 
@@ -196,7 +197,7 @@ def load_engine(
     return ScoringEngine(
         params, cfg, tokenizer, runtime or RuntimeConfig(),
         encoder_decoder=encdec, seq_mesh=seq_mesh,
-        spec_config=spec_config,
+        spec_config=spec_config, governor_config=governor_config,
     )
 
 
@@ -209,6 +210,7 @@ def engine_factory(
     int8_dynamic: bool = False,
     kv_cache_int8: bool = False,
     spec_config=None,
+    governor_config=None,
 ):
     """EngineFactory for engine.multi: maps an HF repo id to
     ``checkpoint_root/<org>__<name>`` or ``checkpoint_root/<name>``."""
@@ -227,7 +229,8 @@ def engine_factory(
                                    quantize_int8=quantize_int8,
                                    int8_dynamic=int8_dynamic,
                                    kv_cache_int8=kv_cache_int8,
-                                   spec_config=spec_config)
+                                   spec_config=spec_config,
+                                   governor_config=governor_config)
         raise FileNotFoundError(
             f"no local checkpoint for {model_name} under {checkpoint_root} "
             f"(tried {[str(c) for c in candidates]})"

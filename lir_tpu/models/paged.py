@@ -213,6 +213,18 @@ class KVPagePool:
     # -- host-side allocator -------------------------------------------------
 
     @property
+    def nbytes(self) -> int:
+        """HBM bytes of the materialized pool leaves (0 before
+        :meth:`ensure`) — the figure the HBM governor's ledger carries
+        for the whole page reservation (engine/hbm.py)."""
+        if self.leaves is None:
+            return 0
+        import numpy as np
+
+        return sum(int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+                   for leaf in jax.tree.leaves(self.leaves))
+
+    @property
     def free_pages(self) -> int:
         return len(self._free)
 
@@ -271,6 +283,12 @@ class CacheHandoff:
     def __init__(self) -> None:
         self._key = None
         self._cache = None
+
+    @property
+    def pending(self) -> bool:
+        """True while a parked cache buffer is held (the HBM governor's
+        reclaim path frees it under OOM — engine/hbm.py)."""
+        return self._cache is not None
 
     def take(self, key: Tuple):
         cache, k = self._cache, self._key

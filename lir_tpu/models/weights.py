@@ -378,6 +378,29 @@ class WeightCache:
             f"{used / 2**30:.2f} GB resident is pinned or in use, "
             f"budget leaves {max(budget_left, 0) / 2**30:.2f} GB")
 
+    def evict_idle(self) -> Optional[str]:
+        """Evict the LRU model that is idle (refcount 0, not pinned) —
+        the HBM governor's ``evict_weights`` rung (engine/hbm.py).
+        Returns the evicted model id, or None when every resident model
+        is pinned or under an in-flight dispatch (nothing reclaimable
+        without breaking the refcount contract)."""
+        with self._lock:
+            for mid in list(self._entries):   # OrderedDict = LRU first
+                e = self._entries[mid]
+                if e.refcount > 0 or e.pinned:
+                    continue
+                del self._entries[mid]
+                if self.stats is not None:
+                    self.stats.count("evictions")
+                if self.on_evict is not None:
+                    self.on_evict(mid)
+                self._notify("evict", mid)
+                self._gauge()
+                log.info("weight cache: governor evicted idle %s "
+                         "(%.2f GB)", mid, e.nbytes / 2**30)
+                return mid
+        return None
+
     def drop(self, model_id: str) -> None:
         """Explicitly evict one model (must be unreferenced/unpinned)."""
         with self._lock:

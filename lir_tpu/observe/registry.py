@@ -97,6 +97,11 @@ STATS_SCHEMA: Dict[str, Tuple[str, ...]] = {
         "seq_forwards", "dispatches_saved", "spec_dispatches",
         "spec_rows", "fallbacks",
     ),
+    "MemStats": (
+        "ledger_bytes", "budget_bytes", "pressure", "rung",
+        "rung_downs", "rung_ups", "admits", "denials", "oom_events",
+        "oom_reclaims", "oom_exhausted", "squeezes", "sheds",
+    ),
 }
 
 
@@ -250,6 +255,11 @@ def engine_registry(engine, sink=None,
         reg.register("occupancy", engine.occupancy)
     if getattr(engine, "spec_stats", None) is not None:
         reg.register("spec", engine.spec_stats)
+    if getattr(engine, "governor", None) is not None:
+        # HBM-governor gauges (engine/hbm.py): ledger/pressure/rung
+        # land in the snapshot next to device_memory_stats(), so budget
+        # pressure is visible BEFORE anything OOMs.
+        reg.register("mem", engine.governor.stats)
     if sink is not None and getattr(sink, "stats", None) is not None:
         reg.register("stream", sink.stats)
     return reg

@@ -132,11 +132,21 @@ class ContinuousBatcher:
                  f"(waited {now - p.t_submit:.3f}s)",
             latency_s=now - p.t_submit))
 
+    def _batch_cap(self) -> int:
+        """Dispatch-row cap: the configured batch, halved while the HBM
+        governor's batch_down rung is engaged (engine/hbm.py — smaller
+        dispatch caches under pressure; per-row results are unchanged,
+        batch composition is masked out of every readout). Restores to
+        the full batch when the rung re-arms."""
+        gov = getattr(self.engine, "governor", None)
+        return self.batch if gov is None else gov.batch_cap(self.batch)
+
     def _dispatch_rows(self, n: int) -> int:
         """Padded batch rows a dispatch of ``n`` real rows pays for:
-        the full batch under ``pad_full`` (shape stability), else the
-        offline sweep's power-of-two tail."""
-        return self.batch if self.pad_full else _tail_batch(n, self.batch)
+        the full batch cap under ``pad_full`` (shape stability), else
+        the offline sweep's power-of-two tail."""
+        cap = self._batch_cap()
+        return cap if self.pad_full else _tail_batch(n, cap)
 
     def next_dispatch(self, now: float, flush: bool = False
                       ) -> Optional[Tuple[int, List[Pending]]]:
@@ -190,7 +200,8 @@ class ContinuousBatcher:
                     self.stats.count("promoted", n)
                     continue    # re-select (promotion may cascade)
             rows: List[Pending] = []
-            while q and len(rows) < self.batch:
+            cap = self._batch_cap()
+            while q and len(rows) < cap:
                 p = q.popleft()
                 if now >= p.t_deadline:
                     self._expire(p, now)  # slot refills from the queue
@@ -231,8 +242,11 @@ class ContinuousBatcher:
         measurement payload per REAL row (padding rows are dropped)."""
         engine = self.engine
         n = len(rows)
-        bsz = self._dispatch_rows(n)
+        bsz = max(self._dispatch_rows(n), _tail_batch(n, self.batch))
         full = list(rows) + [rows[-1]] * (bsz - n)
+        gov = getattr(engine, "governor", None)
+        if gov is not None:
+            gov.tick()      # one ladder tick per serve dispatch
         t1 = np.asarray([p.t1 for p in full], np.int32)
         t2 = np.asarray([p.t2 for p in full], np.int32)
         la = max(max(len(p.bin_ids) - p.lcp for p in full), 1)

@@ -142,6 +142,17 @@ class _Replica:
         except Exception:  # noqa: BLE001
             return 0.0
 
+    @property
+    def pressure(self) -> float:
+        """The replica's HBM-governor ledger pressure (engine/hbm.py) —
+        memory as a placement signal beside queue depth and weight
+        residency: a squeezed replica is a worse home for new work even
+        when its queue looks shallow. 0 when ungoverned/unbounded."""
+        try:
+            return float(getattr(self.server, "hbm_pressure", 0.0))
+        except Exception:  # noqa: BLE001
+            return 0.0
+
 
 class _Pending:
     """One routed request's lifecycle across attempts."""
@@ -290,6 +301,7 @@ class ReplicaRouter:
                     "breaker": h.breaker.state,
                     "queue_depth": h.depth,
                     "oldest_wait_s": round(h.oldest_wait(now), 4),
+                    "hbm_pressure": round(h.pressure, 4),
                     "resident": sorted(h.resident_view()),
                 }
                 for rid, h in self._handles.items()
@@ -325,6 +337,12 @@ class ReplicaRouter:
             if self.config.slo_wait_weight > 0 and remaining_s:
                 s += (self.config.slo_wait_weight * h.oldest_wait(now)
                       / max(remaining_s, 0.1))
+            if self.config.pressure_weight > 0:
+                # Memory pressure as a placement input (the HBM
+                # governor's gauge): a replica mid-squeeze — ladder
+                # walking, batches halved — should absorb LESS new
+                # work than an equally-deep replica with headroom.
+                s += self.config.pressure_weight * h.pressure
             return s
 
         return min(cands, key=score)

@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..config import ServeConfig
 from ..engine import compile_plan
+from ..engine import hbm
 from ..engine import scheduler as sched_mod
 from ..engine import stream_stats
 from ..engine import tokens as tok
@@ -176,6 +177,13 @@ class ScoringServer:
         return self.batcher.oldest_wait(self.clock() if now is None
                                         else now)
 
+    @property
+    def hbm_pressure(self) -> float:
+        """HBM-governor ledger pressure (router placement signal —
+        serve/router.py; 0.0 when ungoverned/unbounded)."""
+        gov = getattr(self.engine, "governor", None)
+        return 0.0 if gov is None else float(gov.pressure())
+
     # -- client side ---------------------------------------------------------
 
     def _target_ids(self, targets: Tuple[str, str]) -> Tuple[int, int]:
@@ -219,6 +227,19 @@ class ScoringServer:
                 request_id=request.request_id, status=STATUS_SHED,
                 note="server unhealthy — circuit breaker open "
                      f"(cooldown {self.config.breaker_cooldown_s:.1f}s)"))
+            return fut
+        gov = getattr(self.engine, "governor", None)
+        if gov is not None and gov.should_shed():
+            # Terminal backpressure rung of the HBM degradation ladder
+            # (engine/hbm.py): memory is not coming back this tick, so
+            # refuse loudly instead of queueing behind it. Re-arms
+            # (stops shedding) the moment pressure clears.
+            self.stats.count("shed")
+            fut.resolve(ServeResult(
+                request_id=request.request_id, status=STATUS_SHED,
+                note=f"memory pressure — HBM governor shedding "
+                     f"(pressure {gov.pressure():.2f}, engaged rungs: "
+                     f"{','.join(gov.engaged_rungs())})"))
             return fut
         with self.engine._tok_lock:
             bin_ids = tuple(int(i) for i in self.engine.tokenizer(
@@ -359,10 +380,22 @@ class ScoringServer:
     def _dispatch(self, bucket: int, rows) -> None:
         probing = self.breaker.state == HALF_OPEN
         attempts = {"n": 0}
+        gov = getattr(self.engine, "governor", None)
 
         def call():
             attempts["n"] += 1
-            return self.batcher.score(bucket, rows)
+            try:
+                return self.batcher.score(bucket, rows)
+            except Exception as err:  # noqa: BLE001 — classified below
+                from ..utils.profiling import is_oom_error
+
+                if gov is not None and is_oom_error(err):
+                    # Capacity, not transience: lift the OOM out of the
+                    # generic retry loop (BaseException marker) so it
+                    # reaches the governor's reclaim-and-retry without
+                    # burning retries or feeding the breaker.
+                    raise hbm.OomSignal(err) from err
+                raise
 
         # Watched executor (guard/watchdog): the dispatch runs on a
         # watched thread priced by the SAME bucket_cost model the
@@ -400,6 +433,15 @@ class ScoringServer:
                     clock=self.clock)
             except (KeyboardInterrupt, SystemExit):
                 raise
+            except hbm.OomSignal as sig:
+                # Device OOM: governor reclaim + ONE retry; the breaker
+                # never hears about it either way (capacity is not
+                # device death — the same bypass guard/numerics errors
+                # get). A second OOM quarantines only this dispatch.
+                payloads = self._dispatch_oom(bucket, rows, sig.err,
+                                              gov)
+                if payloads is None:
+                    return
             except Exception as err:  # noqa: BLE001 — degrade, never crash
                 self._dispatch_failed(bucket, rows, err, probing)
                 return
@@ -413,6 +455,41 @@ class ScoringServer:
                     self._resolve_payload(p, payload, now)
         finally:
             self._inflight = []
+
+    def _dispatch_oom(self, bucket: int, rows, err: BaseException,
+                      gov) -> Optional[List[Dict]]:
+        """Serve-path OOM routing (engine/hbm.py): force-engage the
+        governor's reclaim rungs and retry the dispatch ONCE against
+        the freed headroom. Success returns the payloads (the caller
+        resolves them normally — the breaker sees a success). Failure
+        quarantines ONLY this dispatch: its rows resolve as errors
+        carrying the full ledger arithmetic, and the breaker's
+        consecutive-failure count is NOT advanced — an undersized
+        budget must not walk the server into an outage drain the way
+        three unlucky big dispatches otherwise would."""
+        log.warning("serve: dispatch OOMed (%r); routing through the "
+                    "HBM governor", err)
+        if gov.handle_oom("serve"):
+            try:
+                payloads = self.batcher.score(bucket, rows)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as err2:  # noqa: BLE001 — quarantined below
+                err = err2
+                gov.stats.count("oom_exhausted")
+            else:
+                self.faults.count("recovered_dispatches")
+                self.breaker.record_success()
+                return payloads
+        note = gov.oom_message("serve", err)
+        now = self.clock()
+        self.stats.count("errors", len(rows))
+        log.error("serve: %s", note)
+        for p in rows:
+            p.future.resolve(ServeResult(
+                request_id=p.request.request_id, status=STATUS_ERROR,
+                note=note, latency_s=now - p.t_submit))
+        return None
 
     def _dispatch_failed(self, bucket: int, rows, err: BaseException,
                          probing: bool) -> None:
@@ -697,12 +774,32 @@ class FleetScoringServer:
                                     pad_full=self.config.pad_full)
         for mid in fleet.model_ids:
             fleet.engine(mid).fresh_handoff()
+        # One ledger for the whole replica (engine/hbm.py): the fleet
+        # adopts the first engine's governor so weight residency, page
+        # pools, pins and dispatch caches all press on ONE budget — and
+        # every member engine reports into it.
+        if fleet.governor is None:
+            for mid in fleet.model_ids:
+                eng = fleet.engine(mid)
+                gov = getattr(eng, "governor", None)
+                if gov is not None:
+                    fleet.attach_governor(gov)
+                    break
+        if fleet.governor is not None:
+            for mid in fleet.model_ids:
+                eng = fleet.engine(mid)
+                if eng is not None:
+                    eng.governor = fleet.governor
         # Unified telemetry spine: the serve counters, the fleet's swap
         # accounting, and every member engine's guard/compile/fault
         # stats in ONE registry ({"op": "metrics"} reads it live).
         self.metrics = metrics_mod.MetricsRegistry()
         self.metrics.register("serve", self.stats)
         self.metrics.register("fleet", fleet.stats)
+        if fleet.governor is not None:
+            # The shared HBM ledger's gauges ride the metrics endpoint
+            # next to device_memory_stats().
+            self.metrics.register("mem", fleet.governor.stats)
         for mid in fleet.model_ids:
             eng = fleet.engine(mid)
             if eng is not None:
@@ -738,6 +835,13 @@ class FleetScoringServer:
     def oldest_wait(self, now: Optional[float] = None) -> float:
         return self.batcher.oldest_wait(self.clock() if now is None
                                         else now)
+
+    @property
+    def hbm_pressure(self) -> float:
+        """Shared-ledger pressure of this fleet replica (router
+        placement signal; 0.0 when ungoverned/unbounded)."""
+        gov = self.fleet.governor
+        return 0.0 if gov is None else float(gov.pressure())
 
     def resident_models(self) -> List[str]:
         """Model ids whose weights are currently in this replica's

@@ -791,6 +791,88 @@ class FleetStats:
 
 
 @dataclasses.dataclass
+class MemStats:
+    """HBM-governor counters and gauges (engine/hbm.py): the one-look
+    view of who holds HBM, how close the ledger is to its budget, and
+    what the pressure-driven degradation ladder did about it.
+    Thread-safe — the sweep dispatch loop, the serve supervisor, and
+    fleet weight-cache listeners all mutate it concurrently.
+
+    Definitions (reported by ``summary()``, the ``{"op": "metrics"}``
+    endpoint's ``mem`` source, bench.py's "memory" key, and
+    ``make mem-smoke``):
+
+    - ``ledger_bytes`` / ``budget_bytes`` / ``pressure``: the ledger
+      total across registered consumers, the governed budget (0 =
+      unbounded), and their ratio — the gauge the degradation ladder
+      and the router's placement signal both read.
+    - ``rung``: currently-engaged ladder depth (0 = fully armed).
+    - ``rung_downs`` / ``rung_ups``: per-rung engage/release
+      transitions — a reversible squeeze shows BOTH nonzero.
+    - ``admits`` / ``denials``: admission checks passed/refused
+      (projected bytes vs budget at consumer registration time).
+    - ``oom_events``: real device OOMs routed through the governor,
+      per site ("sweep"/"serve"); ``oom_reclaims``: OOMs where the
+      ladder freed something and the dispatch retried;
+      ``oom_exhausted``: OOMs nothing could be reclaimed for — the
+      irreducible dispatch the caller quarantines.
+    - ``squeezes``: injected ``hbm_squeeze`` budget shrinks observed
+      (the chaos proof's ground truth); ``sheds``: submits refused by
+      the terminal backpressure rung.
+    """
+
+    ledger_bytes: int = 0
+    budget_bytes: int = 0
+    pressure: float = 0.0
+    rung: int = 0
+    rung_downs: Dict[str, int] = dataclasses.field(default_factory=dict)
+    rung_ups: Dict[str, int] = dataclasses.field(default_factory=dict)
+    admits: int = 0
+    denials: int = 0
+    oom_events: Dict[str, int] = dataclasses.field(default_factory=dict)
+    oom_reclaims: int = 0
+    oom_exhausted: int = 0
+    squeezes: int = 0
+    sheds: int = 0
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def gauge(self, field: str, value) -> None:
+        with self._lock:
+            setattr(self, field, value)
+
+    def site(self, field: str, site: str, n: int = 1) -> None:
+        with self._lock:
+            d = getattr(self, field)
+            d[site] = d.get(site, 0) + n
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "ledger_bytes": self.ledger_bytes,
+                "budget_bytes": self.budget_bytes,
+                "pressure": round(float(self.pressure), 4),
+                "rung": self.rung,
+                "rung_downs": dict(self.rung_downs),
+                "rung_ups": dict(self.rung_ups),
+                "admits": self.admits,
+                "denials": self.denials,
+                "oom_events": dict(self.oom_events),
+                "oom_reclaims": self.oom_reclaims,
+                "oom_exhausted": self.oom_exhausted,
+                "squeezes": self.squeezes,
+                "sheds": self.sheds,
+            }
+
+
+@dataclasses.dataclass
 class RouterStats:
     """Elastic-router counters (serve/router.py): how requests spread
     over the replica set and what the failure path did. Thread-safe —

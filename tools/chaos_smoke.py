@@ -44,6 +44,14 @@ The guard layer (lir_tpu/guard) adds the SILENT failure modes:
    loses the hedge race with its late payload dropped: zero requests
    lost or double-resolved (lir_tpu/serve/router.py +
    lir_tpu/engine/lease.py).
+9. SPECULATIVE DRAFT CORRUPTION — seeded garbage drafts must only cost
+   re-verification: rows bitwise, rejections counted (spec_chaos).
+10. OOM SQUEEZE — a seeded ``hbm_squeeze`` shrinks the HBM governor's
+   budget mid-sweep and mid-serve (lir_tpu/engine/hbm.py): zero
+   crashed dispatches, every degradation rung reversible (down AND up
+   counters), rows/payloads bitwise vs unpressured runs, governor
+   gauges in the metrics snapshot, and an injected device OOM
+   reclaim-and-retried without feeding the circuit breaker.
 
 Runs hermetically on CPU (FakeTokenizer + tiny random decoder); prints
 the FaultStats/GuardStats summaries as JSON on success.
@@ -942,6 +950,212 @@ def spec_chaos(failures):
                 "accept_rate": round(eng.spec_stats.accept_rate, 4)}
 
 
+def hbm_chaos(failures):
+    """Scenario 10 (OOM squeeze — engine/hbm.py): a seeded
+    ``hbm_squeeze`` shrinks the HBM governor's ledger budget mid-sweep
+    AND mid-serve. The contract: zero crashed dispatches, every
+    engaged degradation rung REVERSIBLE (counters show down AND up
+    transitions, ladder back at level 0), consumed rows and serve
+    payloads bitwise-identical to an unpressured run, and the governor
+    gauges visible in the metrics snapshot. A real-OOM stand-in
+    (RESOURCE_EXHAUSTED raised once mid-serve) must route through the
+    governor's reclaim-and-retry: the request still serves ok and the
+    circuit breaker never hears about it."""
+    import tempfile
+
+    import jax
+    import pandas as pd
+
+    from lir_tpu import faults
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import (GovernorConfig, RetryConfig,
+                                RuntimeConfig, ServeConfig)
+    from lir_tpu.data import schemas
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.engine.sweep import run_perturbation_sweep
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import ScoringServer, ServeRequest
+
+    mcfg = ModelConfig(name="chaos-smoke", vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=32, n_layers=1, n_heads=2,
+                       intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(mcfg, jax.random.PRNGKey(11))
+
+    def gov_engine():
+        # piggyback OFF: squeeze-vs-clean comparisons are bitwise (see
+        # _make_engine); sustain 1 so the smoke's handful of dispatch
+        # ticks is enough ladder walking.
+        return ScoringEngine(
+            params, mcfg, FakeTokenizer(),
+            RuntimeConfig(batch_size=BATCH, max_seq_len=256,
+                          piggyback_prefill=False),
+            governor_config=GovernorConfig(sustain_ticks=1))
+
+    def drain(gov, max_ticks=16):
+        # the ticks a longer-running session's next dispatches supply
+        for _ in range(max_ticks):
+            if gov.level == 0:
+                return
+            gov.tick()
+
+    def check_reversible(gov, leg):
+        if not gov.stats.rung_downs:
+            failures.append(f"hbm[{leg}]: squeeze never walked the "
+                            f"ladder down")
+        drain(gov)
+        if gov.level != 0:
+            failures.append(f"hbm[{leg}]: ladder stuck at level "
+                            f"{gov.level}")
+        if gov.stats.rung_ups != gov.stats.rung_downs:
+            failures.append(
+                f"hbm[{leg}]: rungs not reversible (downs "
+                f"{gov.stats.rung_downs} vs ups {gov.stats.rung_ups})")
+
+    out = {}
+    lp, perts = _grid(N_CELLS)
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        run_perturbation_sweep(gov_engine(), "chaos", lp, perts,
+                               td / "clean.csv", checkpoint_every=4)
+        clean_df = schemas.read_results_frame(td / "clean.csv")
+        clean_by_key = {
+            (r["Rephrased Main Part"], r["Response Format"],
+             r["Confidence Format"]): tuple(
+                r[c] for c in _VALUE_COLUMNS)
+            for _, r in clean_df.iterrows()}
+
+        engine = gov_engine()
+        plan = faults.FaultPlan(seed=19, schedules={
+            "hbm": faults.SiteSchedule.hbm_squeeze_at(1, frac=0.05,
+                                                      calls=3)})
+        faults.wrap_governor(engine.governor, plan)
+        run_perturbation_sweep(engine, "chaos", lp, perts,
+                               td / "squeezed.csv", checkpoint_every=4)
+        if plan.injected("hbm") != 1:
+            failures.append("hbm: scheduled mid-sweep squeeze never "
+                            "fired")
+        check_reversible(engine.governor, "sweep")
+        df = schemas.read_results_frame(td / "squeezed.csv")
+        keys = list(zip(df["Rephrased Main Part"],
+                        df["Response Format"], df["Confidence Format"]))
+        if len(keys) != N_CELLS or len(set(keys)) != N_CELLS:
+            failures.append(
+                f"hbm: squeezed sweep crashed/duplicated dispatch rows "
+                f"({len(keys)} rows, {len(set(keys))} unique)")
+        for _, row in df.iterrows():
+            k = (row["Rephrased Main Part"], row["Response Format"],
+                 row["Confidence Format"])
+            want = clean_by_key.get(k)
+            got = tuple(row[c] for c in _VALUE_COLUMNS)
+            if want is None:
+                failures.append(f"hbm: invented row {k[0][:40]}")
+                continue
+            for g, w in zip(got, want):
+                if pd.isna(g) and pd.isna(w):
+                    continue
+                if g != w:
+                    failures.append(
+                        f"hbm: squeezed row differs from the "
+                        f"unpressured run: {g!r} != {w!r} for "
+                        f"{k[0][:40]}")
+                    break
+        # Governor gauges in the per-sweep metrics snapshot — the same
+        # canonical document the serve metrics endpoint answers.
+        from lir_tpu.observe import registry as metrics_mod
+
+        snap = metrics_mod.engine_registry(engine).snapshot(
+            device_memory=False)
+        if snap["sources"].get("mem", {}).get("type") != "MemStats":
+            failures.append("hbm: governor gauges missing from the "
+                            "sweep metrics snapshot")
+        out["sweep_mem"] = engine.governor.summary()
+
+    # -- mid-serve squeeze + one real-OOM stand-in ---------------------------
+    cfg = ServeConfig(
+        queue_depth=64, classes=(("smoke", 600.0),),
+        default_class="smoke", linger_s=0.0, cache_entries=0,
+        max_consecutive_failures=2,
+        retry=RetryConfig(max_retries=1, initial_delay=0.001,
+                          max_delay=0.002, full_jitter=True,
+                          max_elapsed=0.5))
+
+    def request(i, rid=None):
+        body = f"clause {i} covers wind damage under policy {i * 7}"
+        return ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="smoke", request_id=rid or str(i))
+
+    fields = ("model_response", "model_confidence_response",
+              "token_1_prob", "token_2_prob", "log_probabilities",
+              "confidence_value", "weighted_confidence")
+
+    def serve_all(server, tag):
+        payloads = {}
+        for i in range(10):
+            r = server.submit(request(i, f"{tag}{i}")).result(timeout=60)
+            if r.status != "ok":
+                failures.append(f"hbm[serve]: request {i} resolved "
+                                f"{r.status} ({r.note!r})")
+                continue
+            payloads[i] = tuple(getattr(r, f) for f in fields)
+        return payloads
+
+    base = ScoringServer(gov_engine(), "chaos", cfg).start()
+    try:
+        baseline = serve_all(base, "b")
+    finally:
+        base.stop()
+
+    engine = gov_engine()
+    plan = faults.FaultPlan(seed=29, schedules={
+        "hbm": faults.SiteSchedule.hbm_squeeze_at(2, frac=0.05,
+                                                  calls=3)})
+    faults.wrap_governor(engine.governor, plan)
+    server = ScoringServer(engine, "chaos", cfg)
+    # One real-OOM stand-in on dispatch call 6 (after the squeeze
+    # cleared): must reclaim-and-retry, never feed the breaker.
+    real_score = server.batcher.score
+    state = {"n": 0}
+
+    def oom_once(bucket, rows):
+        state["n"] += 1
+        if state["n"] == 7:
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: injected device OOM (chaos 10)")
+        return real_score(bucket, rows)
+
+    server.batcher.score = oom_once
+    server.start()
+    try:
+        squeezed = serve_all(server, "s")
+        snap = server.metrics.snapshot(device_memory=False)
+    finally:
+        server.stop()
+    gov = engine.governor
+    if plan.injected("hbm") != 1:
+        failures.append("hbm: scheduled mid-serve squeeze never fired")
+    if gov.stats.oom_events.get("serve", 0) != 1:
+        failures.append("hbm: injected serve OOM never reached the "
+                        "governor")
+    if gov.stats.oom_reclaims != 1:
+        failures.append("hbm: serve OOM was not reclaim-and-retried")
+    if server.breaker.consecutive_failures != 0 or not server.healthy:
+        failures.append("hbm: a device OOM fed the circuit breaker")
+    check_reversible(gov, "serve")
+    if "mem" not in snap.get("sources", {}):
+        failures.append("hbm: governor gauges missing from the serve "
+                        "metrics snapshot")
+    for i, want in baseline.items():
+        got = squeezed.get(i)
+        if got is not None and got != want:
+            failures.append(f"hbm: squeezed serve payload {i} differs "
+                            f"from the unpressured server")
+    out["serve_mem"] = gov.summary()
+    return out
+
+
 def main() -> int:
     failures = []
     sweep_summary = sweep_chaos(failures)
@@ -952,6 +1166,7 @@ def main() -> int:
     stream_summary = stream_accum_chaos(failures)
     elastic_summary = elastic_chaos(failures)
     spec_summary = spec_chaos(failures)
+    hbm_summary = hbm_chaos(failures)
     if failures:
         for f in failures:
             print(f"CHAOS-SMOKE FAIL: {f}")
@@ -962,7 +1177,8 @@ def main() -> int:
                       "multihost": mh_summary,
                       "stream": stream_summary,
                       "elastic": elastic_summary,
-                      "spec": spec_summary}))
+                      "spec": spec_summary,
+                      "hbm": hbm_summary}))
     print("chaos smoke: OK (sweep resumed bitwise-identical after "
           "injected kill + torn manifest; breaker tripped and recovered "
           "via half-open probe; poison row isolated; checkpoint resume "
@@ -974,7 +1190,11 @@ def main() -> int:
           "stolen by a live holder converge bitwise on the static run "
           "and a straggler replica's late payload is dropped, never "
           "double-resolved; corrupted speculative drafts cost only "
-          "re-verification — rows bitwise, rejections counted)")
+          "re-verification — rows bitwise, rejections counted; an "
+          "hbm_squeeze walked the degradation ladder down and back up "
+          "mid-sweep and mid-serve with zero crashed dispatches, rows "
+          "and payloads bitwise vs unpressured runs, and a device OOM "
+          "reclaim-and-retried without feeding the breaker)")
     return 0
 
 
