@@ -4,7 +4,7 @@
 
 .PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
 	kernel-smoke stats-smoke fleet-smoke observe-smoke elastic-smoke \
-	spec-smoke mem-smoke install-hooks
+	spec-smoke mem-smoke disagg-smoke install-hooks
 
 verify: lint
 	python tools/check_tier1.py
@@ -122,6 +122,16 @@ mem-smoke:
 # identical (tools/elastic_smoke.py).
 elastic-smoke:
 	JAX_PLATFORMS=cpu python tools/elastic_smoke.py
+
+# Disaggregated-serving smoke: 1 prefill-role + 2 decode-role replicas
+# behind the router on the fake backend — scoring lands only on decode
+# replicas, a nonzero number of KV pages migrates (prefill -> export ->
+# transfer -> import), every payload is bitwise-identical to a
+# colocated single server's, and a replica killed mid-migration falls
+# back to local re-prefill with nothing dropped (tools/disagg_smoke.py;
+# DEPLOY.md §1p).
+disagg-smoke:
+	JAX_PLATFORMS=cpu python tools/disagg_smoke.py
 
 # Run graft-lint (seconds) then the tier-1 guard before every
 # `git push` — lint first so an invariant break fails in two seconds,

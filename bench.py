@@ -267,6 +267,16 @@ def main() -> None:
                          "goodput >= 0.6x after the kill and recovering "
                          "on rejoin, leased sweep accumulator bitwise "
                          "vs a static run — headline key \"elastic\")")
+    ap.add_argument("--no-disagg", action="store_true",
+                    help="skip the disaggregated-serving mode (one "
+                         "prefill-heavy open-loop trace served "
+                         "colocated vs 1 prefill + 2 decode replicas "
+                         "with KV-page migration at equal chip count: "
+                         "p99 interactive decode latency >= 1.3x "
+                         "better disaggregated, zero dropped, "
+                         "payloads bitwise across the two servers, "
+                         "migration seconds hidden vs exposed — "
+                         "headline key \"disagg\")")
     ap.add_argument("--no-memory", action="store_true",
                     help="skip the memory-governance mode (identical "
                          "grid swept unpressured vs with a seeded "
@@ -679,6 +689,19 @@ def main() -> None:
                 headline["elastic"] = elastic
         except (Exception, SystemExit) as err:  # noqa: BLE001
             print(f"# elastic bench mode failed ({err!r}); headline "
+                  "is unaffected", file=sys.stderr)
+    # Disaggregated mode (ROADMAP item 2): the prefill-heavy trace
+    # served colocated vs prefill/decode-split at equal chip count —
+    # p99 interactive decode latency >= 1.3x better disaggregated,
+    # payloads bitwise, nonzero pages migrated. Failures never discard
+    # the headline.
+    if not args.no_disagg:
+        try:
+            disagg = _disagg_bench(on_accel)
+            if disagg is not None:
+                headline["disagg"] = disagg
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# disagg bench mode failed ({err!r}); headline "
                   "is unaffected", file=sys.stderr)
     # Speculative mode (ROADMAP item 3): the identical grid swept
     # speculation-ON vs OFF — >= 2x fewer decode dispatches per row on
@@ -2341,6 +2364,191 @@ def _elastic_bench(on_accel: bool):
         "per_replica": dict(router.stats.per_replica),
         "lease_accum_bitwise_vs_static": bool(lease_bitwise),
         "lease_shards_stolen": int(steals),
+    }
+
+
+def _disagg_bench(on_accel: bool):
+    """Disaggregated prefill/decode mode (ROADMAP item 2; serve/migrate
+    .py): the SAME prefill-heavy open-loop trace served twice at EQUAL
+    chip count — 3 colocated replicas vs 1 prefill-role + 2 decode-role
+    replicas with KV-page migration — and the interactive tail compared.
+
+    The trace is the paper's production shape: a stream of short
+    interactive probes (warm shared trunk, decode-dominated) with long
+    fresh-trunk batch prompts arriving between them. Colocated, a batch
+    prompt's full-bucket quadratic prefill occupies whichever replica
+    it lands on, and every interactive request arriving there during
+    the dispatch waits it out — prefill queueing IS the interactive
+    tail. Disaggregated, the prefill runs on the prefill replica, only
+    the migrated-page remainder window reaches the decode replicas, and
+    the interactive tail collapses.
+
+    Gates asserted before reporting:
+
+    - p99 interactive (decode-path) latency at least 1.3x better
+      disaggregated than colocated (CPU smoke gate; on real chips the
+      ratio tracks the prefill/decode cost gap);
+    - ZERO dropped requests in both runs, every future "ok";
+    - per-request payloads BITWISE-identical across the two servers
+      (migrated-page decode == local-prefill decode);
+    - nonzero pages migrated, with the hidden/exposed transfer-second
+      split reported."""
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import (MigrationConfig, RouterConfig,
+                                RuntimeConfig, ServeConfig)
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import ReplicaRouter, ScoringServer, ServeRequest
+
+    batch = 4
+    n_heavy, inter_per_heavy = 6, 6
+    n_interactive = n_heavy * inter_per_heavy
+    # Big enough that a full-bucket prefill visibly occupies a replica
+    # on the CPU smoke (the contrast under test is prefill-dispatch
+    # occupancy vs decode-path work, the same shape it takes on chips).
+    mcfg = ModelConfig(name="disagg-bench",
+                       vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=128, n_layers=4, n_heads=4,
+                       intermediate_size=256, max_seq_len=512)
+    params = decoder.init_params(mcfg, jax.random.PRNGKey(29))
+    serve_cfg = ServeConfig(queue_depth=256, cache_entries=0,
+                            classes=(("interactive", 3600.0),
+                                     ("batch", 3600.0)),
+                            default_class="batch", linger_s=0.002)
+
+    def _server():
+        # spec decode OFF: orthogonal to the disagg contrast, and it
+        # doubles the executable surface the warmup must cover.
+        engine = ScoringEngine(params, mcfg, FakeTokenizer(),
+                               RuntimeConfig(batch_size=batch,
+                                             max_seq_len=512,
+                                             spec_decode=False))
+        return ScoringServer(engine, "disagg-bench", serve_cfg)
+
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible").split()
+    rng = np.random.default_rng(41)
+    # Interactive probes: ONE shared short trunk (warm after the first
+    # ask, below the migration threshold so they always score directly
+    # on a decode replica); heavy batch prompts: a FRESH long trunk
+    # each (full prefill somewhere, every time). Fixed word counts keep
+    # every request of a kind the same token shape, so the warmup
+    # compiles cover the whole timed trace.
+    inter_trunk = " ".join(rng.choice(words) for _ in range(24))
+
+    def interactive(i):
+        body = f"{inter_trunk} probe {i}"
+        return ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="interactive", request_id=f"i{i}")
+
+    def heavy(i, tag=""):
+        trunk = " ".join(rng.choice(words) for _ in range(300))
+        body = f"{trunk} matter {tag}{i}"
+        return ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="batch", request_id=f"h{tag}{i}")
+
+    # One deterministic arrival schedule, replayed for both configs:
+    # each fresh-trunk heavy arrives, then a burst of interactive
+    # probes lands WHILE its prefill dispatch is (colocated) occupying
+    # a replica — prefill queueing as the interactive tail's cause.
+    events = []
+    for h in range(n_heavy):
+        events.append(("h", heavy(h), 0.25))
+        for j in range(inter_per_heavy):
+            events.append(("i", interactive(h * inter_per_heavy + j),
+                           0.04))
+    mig_cfg = MigrationConfig(min_prefix_tokens=48, chunk_pages=8,
+                              timeout_s=60.0)
+
+    def run(roles):
+        servers = [_server().start() for _ in range(3)]
+        ids = ["pre", "d0", "d1"] if roles else ["r0", "r1", "r2"]
+        router = ReplicaRouter(
+            list(zip(ids, servers)),
+            config=RouterConfig(cache_entries=0, tick_s=0.01),
+            roles=({"pre": "prefill", "d0": "decode", "d1": "decode"}
+                   if roles else None),
+            migrate=(mig_cfg if roles
+                     else MigrationConfig(enabled=False))).start()
+        try:
+            # Warm every executable shape out of the timed window —
+            # in BURSTS, so each replica forms consecutive same-shape
+            # dispatches and compiles both cache-handoff variants
+            # (scratchless AND donated-scratch); on the disagg config
+            # the bursts also compile the prefill-only program and the
+            # migrated-page window executables on every decode replica.
+            for w in range(2):
+                hf = [router.submit(heavy(10 * w + k, tag="w"))
+                      for k in range(6)]
+                assert all(f.result(900).status == "ok" for f in hf)
+                jf = [router.submit(interactive(900 + 20 * w + k))
+                      for k in range(12)]
+                assert all(f.result(900).status == "ok" for f in jf)
+            futs = []
+            for kind, req, gap in events:
+                time.sleep(float(gap))
+                futs.append((kind, req.request_id, router.submit(req)))
+            res = [(kind, rid, f.result(900)) for kind, rid, f in futs]
+        finally:
+            router.stop()
+            for s in servers:
+                s.stop()
+        assert all(r.status == "ok" for _, _, r in res), (
+            [r.status for _, _, r in res if r.status != "ok"][:4])
+        inter_lat = [r.latency_s for kind, _, r in res if kind == "i"]
+        payloads = {rid: tuple(
+            getattr(r, f) for f in ("model_response",
+                                    "model_confidence_response",
+                                    "token_1_prob", "token_2_prob",
+                                    "log_probabilities",
+                                    "confidence_value",
+                                    "weighted_confidence"))
+            for _, rid, r in res}
+        return inter_lat, payloads, router.migrate_stats.summary()
+
+    colo_lat, colo_payloads, _ = run(roles=False)
+    dis_lat, dis_payloads, mig = run(roles=True)
+
+    assert set(colo_payloads) == set(dis_payloads)
+    mismatched = [rid for rid in colo_payloads
+                  if colo_payloads[rid] != dis_payloads[rid]]
+    assert not mismatched, (
+        f"payloads differ between colocated and disaggregated servers: "
+        f"{mismatched[:4]}")
+    assert mig["pages_migrated"] > 0, "no pages migrated"
+    p99_colo = float(np.percentile(colo_lat, 99))
+    p99_dis = float(np.percentile(dis_lat, 99))
+    ratio = p99_colo / max(p99_dis, 1e-9)
+    assert ratio >= 1.3, (
+        f"disaggregated p99 decode latency {p99_dis:.3f}s is only "
+        f"{ratio:.2f}x better than colocated {p99_colo:.3f}s (< 1.3x)")
+    return {
+        "replicas": 3,
+        "prefill_replicas": 1,
+        "interactive_requests": n_interactive,
+        "heavy_requests": n_heavy,
+        "requests_dropped": 0,
+        "p99_decode_latency_colocated_s": round(p99_colo, 4),
+        "p99_decode_latency_disagg_s": round(p99_dis, 4),
+        "p99_decode_latency_ratio": round(ratio, 2),
+        "p50_decode_latency_colocated_s": round(
+            float(np.percentile(colo_lat, 50)), 4),
+        "p50_decode_latency_disagg_s": round(
+            float(np.percentile(dis_lat, 50)), 4),
+        "pages_migrated": mig["pages_migrated"],
+        "migrations": mig["migrations"],
+        "migration_s_hidden": mig["migration_s_hidden"],
+        "migration_s_exposed": mig["migration_s_exposed"],
+        "refetch_fallbacks": mig["refetch_fallbacks"],
+        "cluster_tree_hits": mig["cluster_tree_hits"],
+        "payloads_bitwise": True,
     }
 
 

@@ -492,6 +492,66 @@ def _router_cfg(args):
     return RouterConfig(**kw)
 
 
+def _add_migrate_flags(p) -> None:
+    """Disaggregated prefill/decode serving knobs
+    (config.MigrationConfig — serve/migrate.py; DEPLOY.md §1p)."""
+    p.add_argument("--no-migrate", action="store_true",
+                   help="disable KV-page migration + disaggregated "
+                        "placement entirely (MigrationConfig.enabled; "
+                        "restores the role-less replica router)")
+    p.add_argument("--migrate-prefill-replicas", type=int, default=None,
+                   help="of --replicas N, dedicate the first K to the "
+                        "PREFILL role: long prompts prefill there and "
+                        "their KV pages migrate to decode-role "
+                        "replicas (default 0 = colocated)")
+    p.add_argument("--migrate-chunk-pages", type=int, default=None,
+                   help="KV pages per transfer chunk of the double-"
+                        "buffered page migration (default 8)")
+    p.add_argument("--migrate-inflight-chunks", type=int, default=None,
+                   help="transfer chunks kept in flight (default 2 = "
+                        "double buffering)")
+    p.add_argument("--migrate-min-prefix", type=int, default=None,
+                   help="minimum tokenized shared-prefix length worth "
+                        "a remote prefill + migration; shorter prompts "
+                        "score colocated (default 32)")
+    p.add_argument("--migrate-page-bonus", type=float, default=None,
+                   help="placement bonus (queue-row equivalents) per "
+                        "cluster-index-matched page a replica already "
+                        "holds for the request's prefix (default 0.5)")
+    p.add_argument("--no-migrate-verify", action="store_true",
+                   help="skip the per-chunk transfer checksums "
+                        "(MigrationConfig.verify) — corruption then "
+                        "lands undetected; only for measurement")
+    p.add_argument("--migrate-timeout", type=float, default=None,
+                   help="wall-clock budget in seconds for one whole "
+                        "migration chain before the router falls back "
+                        "to local re-prefill (default 30)")
+
+
+def _migrate_cfg(args):
+    """MigrationConfig from the flags (None = dataclass default)."""
+    from .config import MigrationConfig
+
+    kw = {}
+    if getattr(args, "no_migrate", False):
+        kw["enabled"] = False
+    if getattr(args, "migrate_prefill_replicas", None) is not None:
+        kw["prefill_replicas"] = args.migrate_prefill_replicas
+    if getattr(args, "migrate_chunk_pages", None) is not None:
+        kw["chunk_pages"] = args.migrate_chunk_pages
+    if getattr(args, "migrate_inflight_chunks", None) is not None:
+        kw["inflight_chunks"] = args.migrate_inflight_chunks
+    if getattr(args, "migrate_min_prefix", None) is not None:
+        kw["min_prefix_tokens"] = args.migrate_min_prefix
+    if getattr(args, "migrate_page_bonus", None) is not None:
+        kw["page_bonus"] = args.migrate_page_bonus
+    if getattr(args, "no_migrate_verify", False):
+        kw["verify"] = False
+    if getattr(args, "migrate_timeout", None) is not None:
+        kw["timeout_s"] = args.migrate_timeout
+    return MigrationConfig(**kw)
+
+
 def _add_observatory_flags(p) -> None:
     """Reliability-observatory knobs (lir_tpu/observe; fleet serving
     only — the sentinel grid fans across every fleet model)."""
@@ -766,6 +826,7 @@ def _add_serve(sub) -> None:
     _add_trace_flags(p)
     _add_observatory_flags(p)
     _add_router_flags(p)
+    _add_migrate_flags(p)
     _add_fleet_flags(p, with_models=True)
 
 
@@ -1010,6 +1071,11 @@ def cmd_serve(args) -> None:
                          "--replicas the router's failover replaces it "
                          "(a dead replica's in-flight work re-admits "
                          "to survivors)")
+    n_prefill = args.migrate_prefill_replicas or 0
+    if n_prefill and n_prefill >= n_replicas:
+        raise SystemExit("--migrate-prefill-replicas must leave at "
+                         "least one decode-role replica (got "
+                         f"{n_prefill} of {n_replicas})")
     if args.sentinels is not None and not args.fleet_models:
         raise SystemExit("--sentinels needs --fleet-models: the "
                          "observatory re-scores the sentinel grid "
@@ -1144,10 +1210,18 @@ def _run_router_serve(args, serve_cfg, factory, n_replicas: int) -> None:
         servers.append(ScoringServer(
             engine, args.model, serve_cfg,
             precompile=not args.no_precompile).start())
+    # Disaggregated roles (serve/migrate.py; DEPLOY.md §1p): the first
+    # --migrate-prefill-replicas servers take the prefill role, the
+    # rest decode; 0 keeps every replica colocated ("both").
+    n_prefill = getattr(args, "migrate_prefill_replicas", None) or 0
+    roles = {f"r{i}": ("prefill" if i < n_prefill else "decode")
+             for i in range(n_replicas)} if n_prefill else None
     router = ReplicaRouter(
         [(f"r{i}", s) for i, s in enumerate(servers)],
-        config=_router_cfg(args)).start()
-    log.info("router: %d replica servers for %s", n_replicas, args.model)
+        config=_router_cfg(args), roles=roles,
+        migrate=_migrate_cfg(args)).start()
+    log.info("router: %d replica servers for %s (%d prefill-role)",
+             n_replicas, args.model, n_prefill)
     default_rf = LEGAL_PROMPTS[0].response_format
     default_cf = LEGAL_PROMPTS[0].confidence_format
     stream = (sys.stdin if args.requests == "-"

@@ -565,6 +565,62 @@ class RouterConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class MigrationConfig:
+    """Disaggregated prefill/decode serving knobs (serve/migrate.py;
+    DEPLOY.md §1p).
+
+    The router splits its replica pool into PREFILL-role and
+    DECODE-role replicas: a long prompt prefills on a prefill replica,
+    its KV pages stream to a decode replica as chunked double-buffered
+    transfers (the weight-streaming discipline of models/weights.
+    stream_params applied to the §1g page pool), and decode resumes
+    there bitwise-identically to a colocated run. The cluster-wide
+    prefix index (engine/prefix_tree.ClusterPrefixIndex) makes a
+    prefix prefilled ANYWHERE warm EVERYWHERE: page residency joins
+    weight residency and HBM pressure as a placement signal, and a
+    migration pulls matching pages instead of re-prefilling. A stalled
+    or corrupted transfer falls back to local re-prefill on the decode
+    replica — never a wrong answer, never a dropped request.
+    """
+
+    # Master switch for page migration + disaggregated placement. OFF
+    # restores the PR-12 role-less router exactly.
+    enabled: bool = True                # cli: --no-migrate
+    # Replicas (of `--replicas N`) dedicated to the PREFILL role: they
+    # absorb long-prompt prefills and never serve decode traffic while
+    # a decode-role replica survives. 0 = colocated (every replica
+    # does both phases — the pre-disaggregation behavior).
+    prefill_replicas: int = 0           # cli: --migrate-prefill-replicas
+    # KV pages per transfer chunk: the unit of the double-buffered
+    # device<->host hop (page bytes: models/paged.kv_page_bytes).
+    chunk_pages: int = 8                # cli: --migrate-chunk-pages
+    # Transfer chunks kept in flight (2 = classic double buffering:
+    # chunk i+1 streams while chunk i lands).
+    inflight_chunks: int = 2            # cli: --migrate-inflight-chunks
+    # Minimum tokenized shared-prefix length worth a remote prefill +
+    # migration; shorter prompts score colocated on a decode replica
+    # (the handoff overhead would exceed the prefill saved).
+    min_prefix_tokens: int = 32         # cli: --migrate-min-prefix
+    # Placement bonus (queue-row equivalents) per cluster-index-matched
+    # PAGE a replica already holds for the request's prefix — page
+    # residency as a first-class routing signal beside weight residency
+    # and hbm_pressure (serve/router.ReplicaRouter._pick).
+    page_bonus: float = 0.5             # cli: --migrate-page-bonus
+    # Verify a per-chunk checksum at import: a corrupted transfer is
+    # detected BEFORE its pages enter the decode replica's radix tree
+    # and falls back to local re-prefill (chaos kind
+    # ``migration_corrupt``). Disabling trades the integrity check for
+    # one CRC pass per chunk.
+    verify: bool = True                 # cli: --no-migrate-verify
+    # Wall-clock budget for one whole migration chain (prefill ->
+    # export -> transfer -> import). Past it the router abandons the
+    # chain and the decode replica re-prefills locally (chaos kind
+    # ``migration_stall``); a late-landing import is harmless (it only
+    # warms the pool with verified pages).
+    timeout_s: float = 30.0             # cli: --migrate-timeout
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Multi-model fleet knobs (engine/fleet.py over models/weights.py).
 
@@ -621,6 +677,8 @@ class Config:
         default_factory=ObserveConfig)
     router: RouterConfig = dataclasses.field(
         default_factory=RouterConfig)
+    migrate: MigrationConfig = dataclasses.field(
+        default_factory=MigrationConfig)
     governor: GovernorConfig = dataclasses.field(
         default_factory=GovernorConfig)
 

@@ -286,6 +286,26 @@ def greedy_decode_fused_grouped(params, cfg: ModelConfig, prefix: jax.Array,
     return out
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "prefill_fn"))
+def prefill_cache(params, cfg: ModelConfig, tokens: jax.Array,
+                  attn_mask: jax.Array, prefill_fn=None):
+    """PREFILL-ONLY pass: run the prompt, return the KV cache, decode
+    nothing — the prefill-role dispatch of disaggregated serving
+    (serve/migrate.py). ``tokens``/``attn_mask`` are (B, S)
+    RIGHT-padded at the bucket extent, exactly the canonical
+    slot == position layout the shared-prefix paths prefill with, and
+    the cache is allocated at S slots: ``decoder.prefill`` computes
+    every slot's k/v at the S-wide attention extent and pads the cache
+    afterwards, so the page values extracted from this cache are
+    BITWISE the values a full scoring dispatch of the same bucket would
+    have inserted (pinned by tests/test_migrate.py) — which is what
+    lets a decode replica resume from migrated pages identically to a
+    colocated run."""
+    pf = prefill_fn or decoder.prefill
+    _, cache, _ = pf(params, cfg, tokens, attn_mask, tokens.shape[1])
+    return cache
+
+
 def _paged_prefix(params, cfg: ModelConfig, pool, slot_src: jax.Array,
                   win_start: jax.Array, prefix_mask: jax.Array,
                   rem: jax.Array, rem_mask: jax.Array, total_len: int):

@@ -48,12 +48,20 @@ Design notes:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..utils.logging import get_logger
 from ..utils.profiling import PrefixCacheStats
 
 log = get_logger(__name__)
+
+# Page-pool index events (the cluster-wide prefix index rides these the
+# same way the router's residency map rides WeightCache listener
+# events): fn(event, bucket, ids) with event "insert" (ids = the full
+# page-aligned token prefix now cached) or "evict" (ids = the removed
+# node's full token path — that page and everything under it is gone).
+PageListener = Callable[[str, int, Tuple[int, ...]], None]
 
 
 class _Node:
@@ -66,7 +74,8 @@ class _Node:
     ordered for LRU capping). Host memory only — no pool pages, no
     HBM."""
 
-    __slots__ = ("key", "page", "children", "parent", "clock", "tails")
+    __slots__ = ("key", "page", "children", "parent", "clock", "tails",
+                 "bucket")
 
     def __init__(self, key: Tuple[int, ...], page: int,
                  parent: Optional["_Node"]):
@@ -76,6 +85,7 @@ class _Node:
         self.parent = parent
         self.clock = 0
         self.tails: Dict[Tuple[int, ...], Tuple[int, ...]] = {}
+        self.bucket: Optional[int] = None   # set on namespace roots only
 
 
 @dataclasses.dataclass
@@ -100,15 +110,49 @@ class RadixPrefixCache:
         self._roots: Dict[int, _Node] = {}
         self._clock = 0
         self._nodes = 0
+        self._listeners: List[PageListener] = []
         self.stats.gauge_pages(pool.pages_in_use, pool.n_pages - 1)
 
     def __len__(self) -> int:
         return self._nodes
 
+    def add_listener(self, fn: PageListener) -> None:
+        """Subscribe to page insert/evict events (module-level
+        ``PageListener`` contract). Fired on the tree's owning dispatch
+        thread — listeners do cheap index bookkeeping only (the
+        router's ClusterPrefixIndex takes its own lock)."""
+        self._listeners.append(fn)
+
+    def _notify(self, event: str, bucket: int,
+                ids: Tuple[int, ...]) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(event, int(bucket), ids)
+            except Exception:  # noqa: BLE001 — an index listener must
+                # never take the serving tree down with it.
+                log.exception("prefix-tree listener failed (%s)", event)
+
+    def _node_ids(self, node: _Node) -> Tuple[int, ...]:
+        """Full token path of ``node`` (root-exclusive), for evict
+        events."""
+        keys: List[Tuple[int, ...]] = []
+        n: Optional[_Node] = node
+        while n is not None and n.key != ():
+            keys.append(n.key)
+            n = n.parent
+        return tuple(t for k in reversed(keys) for t in k)
+
+    def _node_bucket(self, node: _Node) -> int:
+        n = node
+        while n.parent is not None:
+            n = n.parent
+        return int(n.bucket if n.bucket is not None else 0)
+
     def _root(self, bucket: int) -> _Node:
         root = self._roots.get(int(bucket))
         if root is None:
             root = self._roots[int(bucket)] = _Node((), 0, None)
+            root.bucket = int(bucket)
         return root
 
     # -- walking -------------------------------------------------------------
@@ -276,9 +320,36 @@ class RadixPrefixCache:
             node = child
         if new_pages:
             self.stats.count("inserted_pages", len(new_pages))
+            covered = (start + len(new_pages)) * self.page_size
+            self._notify("insert", bucket,
+                         tuple(int(t) for t in ids[:covered]))
         self.stats.gauge_pages(self.pool.pages_in_use,
                                self.pool.n_pages - 1)
         return start * self.page_size, new_pages
+
+    def forget_tail(self, bucket: int, ids: Sequence[int],
+                    n_pages: int) -> int:
+        """Remove the deepest ``n_pages`` nodes along ``ids``' cached
+        path and drop the tree's page references — the ROLLBACK of a
+        cancelled/corrupt page import (serve/migrate.py): the nodes a
+        failed transfer created must leave the tree before any dispatch
+        can gather their never-filled pages. Only tail nodes with no
+        children are removable (exactly what a fresh plan_insert
+        created); returns how many were removed."""
+        path = self._walk(bucket, ids, touch=False)
+        removed = 0
+        for node in reversed(path[-n_pages:] if n_pages else []):
+            if node.children:
+                break           # someone extended past us: keep the path
+            self._notify("evict", int(bucket), self._node_ids(node))
+            del node.parent.children[node.key]
+            self._nodes -= 1
+            self.pool.decref((node.page,))
+            removed += 1
+        if removed:
+            self.stats.gauge_pages(self.pool.pages_in_use,
+                                   self.pool.n_pages - 1)
+        return removed
 
     def _alloc_with_evict(self) -> Optional[int]:
         page = self.pool.alloc()
@@ -313,6 +384,8 @@ class RadixPrefixCache:
         while freed < n_pages and candidates:
             node = candidates.pop(0)
             parent = node.parent
+            self._notify("evict", self._node_bucket(node),
+                         self._node_ids(node))
             del parent.children[node.key]
             self._nodes -= 1
             self.pool.decref((node.page,))
@@ -330,3 +403,104 @@ class RadixPrefixCache:
             self.stats.gauge_pages(self.pool.pages_in_use,
                                    self.pool.n_pages - 1)
         return freed
+
+
+# ---------------------------------------------------------------------------
+# Cluster-wide prefix index (router-side; ROADMAP item 2)
+# ---------------------------------------------------------------------------
+
+
+class ClusterPrefixIndex:
+    """The radix prefix tree made CLUSTER-WIDE: a router-side index of
+    which REPLICA holds which prefix pages, fed by every replica tree's
+    :meth:`RadixPrefixCache.add_listener` insert/evict events — the
+    same event-driven discipline the PR-12 weight-residency map rides.
+    A prefix prefilled anywhere is then warm everywhere: placement
+    reads :meth:`match_pages` (page residency beside weight residency
+    and ``hbm_pressure`` in ``ReplicaRouter._pick``), and a migration
+    (serve/migrate.py) pulls matching pages from the best holder
+    instead of re-prefilling.
+
+    The index stores token CHUNKS only (one dict node per page, no pool
+    references, no HBM) and is ADVISORY by construction: the exporting
+    replica re-looks its pages up with a pin, so a stale entry costs a
+    shorter match or a fallback re-prefill, never a wrong answer.
+    Thread-safe: listener events arrive on each replica's supervisor
+    thread while the router thread matches.
+    """
+
+    def __init__(self, page_size: int = 16):
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        # (replica_id, bucket) -> nested {chunk-tuple: child dict}
+        self._tries: Dict[Tuple[str, int], Dict] = {}  # guarded-by: _lock
+
+    def _chunks(self, ids: Sequence[int]) -> List[Tuple[int, ...]]:
+        ps = self.page_size
+        return [tuple(int(t) for t in ids[k * ps:(k + 1) * ps])
+                for k in range(len(ids) // ps)]
+
+    def on_event(self, replica_id: str, event: str, bucket: int,
+                 ids: Sequence[int]) -> None:
+        """One replica tree's page event (wire with
+        ``tree.add_listener(functools.partial(index.on_event, rid))``)."""
+        chunks = self._chunks(ids)
+        if not chunks:
+            return
+        with self._lock:
+            trie = self._tries.setdefault((str(replica_id), int(bucket)),
+                                          {})
+            if event == "insert":
+                node = trie
+                for ck in chunks:
+                    node = node.setdefault(ck, {})
+            elif event == "evict":
+                node, hops = trie, []
+                for ck in chunks:
+                    child = node.get(ck)
+                    if child is None:
+                        return          # already pruned (advisory index)
+                    hops.append((node, ck))
+                    node = child
+                parent, key = hops[-1]
+                del parent[key]         # the page and its whole subtree
+
+    def drop_replica(self, replica_id: str) -> None:
+        """Forget a replica's pages wholesale (its pool died with it)."""
+        with self._lock:
+            for key in [k for k in self._tries if k[0] == replica_id]:
+                del self._tries[key]
+
+    def match_pages(self, bucket: int, ids: Sequence[int]
+                    ) -> Dict[str, int]:
+        """Pages of ``ids``' leading prefix each replica holds in the
+        ``bucket`` namespace right now — the placement/migration probe
+        (tokens covered = pages * page_size)."""
+        chunks = self._chunks(ids)
+        out: Dict[str, int] = {}
+        with self._lock:
+            for (rid, b), trie in self._tries.items():
+                if b != int(bucket):
+                    continue
+                node, n = trie, 0
+                for ck in chunks:
+                    node = node.get(ck)
+                    if node is None:
+                        break
+                    n += 1
+                if n:
+                    out[rid] = max(out.get(rid, 0), n)
+        return out
+
+    def best_holder(self, bucket: int, ids: Sequence[int],
+                    exclude: Optional[Sequence[str]] = None
+                    ) -> Tuple[Optional[str], int]:
+        """(replica with the deepest match, pages) — the migration
+        source probe; (None, 0) when nothing matches."""
+        matches = self.match_pages(bucket, ids)
+        for rid in (exclude or ()):
+            matches.pop(rid, None)
+        if not matches:
+            return None, 0
+        rid = max(matches, key=lambda r: matches[r])
+        return rid, matches[rid]

@@ -539,6 +539,56 @@ class ScoringEngine:
         for m in plan.matches:
             self.prefix_cache.release(m)
 
+    def prefill_insert(self, bucket: int,
+                       prefix_ids: List[Sequence[int]]) -> int:
+        """PREFILL-ONLY dispatch (disaggregated serving — serve/migrate
+        .py): compute the rows' prefix KV at the ``bucket`` extent and
+        insert every full page into the pool + radix tree, decoding
+        NOTHING. The prefill-role replica's unit of work: the pages it
+        produces are bitwise the pages a full scoring dispatch of the
+        same bucket would have inserted (generate.prefill_cache +
+        the same canonical right-padded layout), so a decode replica
+        that imports them resumes identically to a colocated run.
+
+        Rows already fully page-covered are skipped (a repeat prefix
+        costs nothing); callers pad the row list to a stable batch
+        (serve/batcher.ContinuousBatcher.prefill) the same way score
+        dispatches pad, so prefill and scoring prefills share XLA
+        programs per (bucket, batch) shape. Runs on the owning
+        dispatch thread (the tree's single-threaded contract). Returns
+        the page-aligned tokens covered for the FIRST row (the
+        migration chain's request row)."""
+        tree = self.prefix_cache
+        assert tree is not None, \
+            "prefill_insert needs the prefix cache enabled"
+        ps = tree.page_size
+        rows = [list(ids)[:bucket] for ids in prefix_ids]
+        aligned0 = (len(rows[0]) // ps) * ps
+        todo = [ids for ids in rows
+                if tree.match_len(bucket, ids) < (len(ids) // ps) * ps]
+        if todo:
+            pad_id = tok.pad_token_id(self.tokenizer)
+            toks_arr, mask = tok.right_pad_ids(todo, bucket, pad_id)
+            cache = generate.prefill_cache(
+                self.params, self.cfg, jnp.asarray(toks_arr),
+                jnp.asarray(mask), prefill_fn=self._prefill_fn)
+            writes: List[Tuple[int, int, int]] = []
+            fresh: List[int] = []
+            for r, ids in enumerate(todo):
+                start, new_pages = tree.plan_insert(bucket, ids)
+                if not new_pages:
+                    continue
+                # Pin fresh pages until the scatter lands (the same
+                # evict-and-reallocate guard _finish_prefix_resume
+                # takes on a tight pool).
+                tree.pool.incref(new_pages)
+                fresh.extend(new_pages)
+                for j, pg in enumerate(new_pages):
+                    writes.append((pg, r, start + j * ps))
+            tree.pool.scatter(cache, writes)
+            tree.pool.decref(fresh)
+        return min(tree.match_len(bucket, rows[0]), aligned0)
+
     def _prefix_plan_or_none(self, bucket: int,
                              prefix_ids: List[Sequence[int]],
                              n_real: Optional[int], total: int,

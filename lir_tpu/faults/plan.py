@@ -54,10 +54,11 @@ from typing import Callable, Dict, Optional, Tuple
 from ..utils.profiling import FaultStats
 
 SITES = ("dispatch", "compile", "tokenize", "manifest_write",
-         "checkpoint_write", "preempt", "replica", "hbm")
+         "checkpoint_write", "preempt", "replica", "hbm", "migrate")
 
 KINDS = ("fault", "preempt", "hang", "nan", "replica_kill",
-         "replica_lag", "hbm_squeeze")
+         "replica_lag", "hbm_squeeze", "migration_stall",
+         "migration_corrupt")
 
 
 class InjectedFault(RuntimeError):
@@ -171,6 +172,30 @@ class SiteSchedule:
                    squeeze_frac=frac, squeeze_calls=calls)
 
     @classmethod
+    def migration_stall_at(cls, call: int,
+                           seconds: float = 30.0) -> "SiteSchedule":
+        """Stall one page-migration transfer (site "migrate" by
+        convention; wire through :func:`wrap_migrator`): the wire hop
+        sleeps ``seconds`` — pick it past MigrationConfig.timeout_s —
+        then raises on release, exactly a wedged DCN transfer. The
+        router must abandon the chain within its deadline and the
+        decode replica re-prefill LOCALLY (MigrationStats.
+        refetch_fallbacks), with the request's payload bitwise a
+        colocated run's — never a wrong answer."""
+        return cls(fail_calls=(call,), kind="migration_stall",
+                   hang_s=seconds)
+
+    @classmethod
+    def migration_corrupt_at(cls, call: int) -> "SiteSchedule":
+        """Corrupt one page-migration transfer in flight (site
+        "migrate"; :func:`wrap_migrator`): chunk bytes are flipped
+        UNDER the export's checksums, so the import must detect the
+        mismatch, refuse to land any page (rollback: destination
+        refcounts/tree untouched), and fall back to local
+        re-prefill."""
+        return cls(fail_calls=(call,), kind="migration_corrupt")
+
+    @classmethod
     def replica_kill_at(cls, call: int,
                         replica_id: str = "") -> "SiteSchedule":
         """Simulated replica death at one call index (the elastic
@@ -276,7 +301,9 @@ class FaultPlan:
         :meth:`wrap` when the lagged call's RESULT matters."""
         sched = self._decide(site)
         if sched is None or sched.kind in ("nan", "draft_corrupt",
-                                           "hbm_squeeze"):
+                                           "hbm_squeeze",
+                                           "migration_stall",
+                                           "migration_corrupt"):
             return
         if sched.kind == "replica_lag":
             self.stats.inject(site)
@@ -406,6 +433,73 @@ def wrap_replica(router, replica_id: str, plan: FaultPlan,
     wrapped.__wrapped__ = inner  # type: ignore[attr-defined]
     handle.server.batcher.score = wrapped
     return router
+
+
+def wrap_migrator(migrator, plan: FaultPlan, site: str = "migrate"):
+    """Inject the plan's ``site`` schedule at a router migrator's wire
+    hop (serve/migrate.PageMigrator.transfer — the seam between page
+    export and page import):
+
+    - ``migration_stall``: the transfer sleeps ``hang_s`` (pick it past
+      MigrationConfig.timeout_s so the router's chain deadline fires
+      first) then raises on release — a wedged DCN hop. Either way the
+      request must fall back to LOCAL re-prefill on the decode replica
+      and resolve bitwise-identical to a colocated run.
+    - ``migration_corrupt``: the export's chunk bytes are flipped IN
+      PLACE under its recorded checksums (seeded, counter-indexed) —
+      silent wire corruption. The import's verify must refuse the
+      chunk, roll the destination tree/refcounts back untouched, and
+      fall back the same way.
+
+    Other kinds behave as in :meth:`FaultPlan.wrap` (a "fault" here is
+    a transport error), so outage schedules compose onto migrations."""
+    inner = migrator.transfer
+
+    def wrapped(export):
+        sched = plan._decide(site)
+        if sched is not None:
+            if sched.kind == "migration_stall":
+                plan.stats.inject(site)
+                idx = plan.calls(site) - 1
+                time.sleep(sched.hang_s)
+                raise InjectedFault(
+                    f"injected migration stall at {site} call {idx} "
+                    f"released after {sched.hang_s:.2f}s")
+            if sched.kind == "migration_corrupt":
+                plan.stats.inject(site)
+                idx = plan.calls(site) - 1
+                corrupt_export_chunks(
+                    export, seed=f"{plan.seed}:{site}:{idx}")
+                return inner(export)
+            plan._fire(sched, site)
+        return inner(export)
+
+    wrapped.__wrapped__ = inner  # type: ignore[attr-defined]
+    migrator.transfer = wrapped
+    return migrator
+
+
+def corrupt_export_chunks(export, seed: str = "0") -> int:
+    """Flip bytes in a PageExport's host chunks WITHOUT touching its
+    recorded checksums — the in-flight corruption the import-side
+    verify exists to catch. Mutates the (owned, writable) numpy leaves
+    in place; returns bytes flipped."""
+    import jax as _jax
+    import numpy as _np
+
+    rng = random.Random(seed)
+    flipped = 0
+    for host, _n in export.chunks:
+        for leaf in _jax.tree.leaves(host):
+            flat = _np.asarray(leaf).view(_np.uint8).reshape(-1)
+            if flat.size == 0:
+                continue
+            for _ in range(min(8, flat.size)):
+                j = rng.randrange(flat.size)
+                flat[j] ^= 0xFF
+                flipped += 1
+        break            # one chunk is enough: any mismatch aborts
+    return flipped
 
 
 def wrap_governor(governor, plan: FaultPlan, site: str = "hbm"):

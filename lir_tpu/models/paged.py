@@ -142,6 +142,32 @@ def scatter_pages(pool: Any, cache: Any, page_ids, rows, slot_idx) -> Any:
     return jax.tree.map(leaf, pool, cache)
 
 
+@jax.jit
+def extract_pages(pool: Any, page_ids) -> Any:
+    """Gather whole pages out of the pool for migration (serve/migrate):
+    returns (L, K, N, ps[, hd]) blocks per leaf, ``page_ids`` (N,) int32.
+    Padding entries (callers pad to a stable chunk shape) target the
+    reserved trash page 0 — their blocks are dead bytes the import side
+    drops. Read-only on the pool: a migration export can never disturb
+    the donation discipline of the scatter path."""
+    return jax.tree.map(lambda p: p[:, :, page_ids], pool)
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def insert_pages(pool: Any, blocks: Any, page_ids) -> Any:
+    """Write migrated page blocks into the pool: page ``page_ids[j]``
+    receives ``blocks[..., j, ...]`` for every leaf — the import-side
+    sibling of :func:`scatter_pages`, taking blocks that arrived over
+    the wire instead of slots of a local dispatch cache. The pool is
+    DONATED (in-place update of the one resident buffer); padding
+    entries target the trash page 0, whose contents are masked out of
+    every gather anyway."""
+    def leaf(p, b):
+        return p.at[:, :, page_ids].set(b)
+
+    return jax.tree.map(leaf, pool, blocks)
+
+
 def _pow2(n: int) -> int:
     b = 1
     while b < n:
@@ -209,6 +235,40 @@ class KVPagePool:
 
         self.leaves = scatter_pages(self.leaves, cache, jnp.asarray(pages),
                                     jnp.asarray(rows), jnp.asarray(slot_idx))
+
+    def extract(self, page_ids: Sequence[int], pad_to: int = 0) -> Any:
+        """Device blocks for ``page_ids`` (migration export leg). The id
+        list is padded to ``pad_to`` (or the next power of two) with
+        trash-page entries so chunked exports keep one executable per
+        chunk shape. Returns the (L, K, N, ps[, hd]) block tree; the
+        call is async — the caller overlaps the device->host fetch."""
+        assert self.leaves is not None, "extract before ensure()"
+        import jax.numpy as jnp
+
+        n = max(pad_to, _pow2(len(page_ids)))
+        ids = np.zeros((n,), np.int32)
+        ids[:len(page_ids)] = np.asarray(page_ids, np.int32)
+        return extract_pages(self.leaves, jnp.asarray(ids))
+
+    def insert(self, blocks: Any, page_ids: Sequence[int]) -> None:
+        """Land migrated blocks at ``page_ids`` (import leg). ``blocks``
+        may be padded wider than the id list (the export side's stable
+        chunk shape); extra entries are steered to the trash page."""
+        assert self.leaves is not None, "insert before ensure()"
+        import jax.numpy as jnp
+
+        n = jax.tree.leaves(blocks)[0].shape[2]
+        assert n >= len(page_ids), "blocks narrower than the id list"
+        ids = np.zeros((n,), np.int32)
+        ids[:len(page_ids)] = np.asarray(page_ids, np.int32)
+        self.leaves = insert_pages(self.leaves, blocks, jnp.asarray(ids))
+
+    def page_nbytes(self) -> int:
+        """HBM bytes of ONE page across every leaf (0 before ensure) —
+        the per-page unit MigrationStats.bytes_streamed counts."""
+        if self.leaves is None:
+            return 0
+        return self.nbytes // self.n_pages
 
     # -- host-side allocator -------------------------------------------------
 

@@ -86,6 +86,12 @@ STATS_SCHEMA: Dict[str, Tuple[str, ...]] = {
         "replica_sheds", "no_replica_sheds", "kills", "revives",
         "per_replica",
     ),
+    "MigrationStats": (
+        "migrations", "prefill_ops", "pages_migrated", "bytes_streamed",
+        "chunks_streamed", "migration_s_exposed", "migration_s_hidden",
+        "refetch_fallbacks", "stalls", "corrupt_chunks",
+        "cluster_tree_hits",
+    ),
     "LeaseStats": (
         "claims", "renews", "releases", "steals", "refused", "lost",
         "expired_seen", "shards_done", "refreshes",

@@ -30,6 +30,13 @@ Components:
   weight-residency placement, exactly-once failover of a dead
   replica's in-flight requests, and deadline-whisker hedging with
   first-payload-wins resolution (RouterConfig knobs; DEPLOY.md §1m).
+- migrate (+ router roles) — disaggregated prefill/decode serving:
+  prefill-role replicas absorb long-prompt prefills, their KV pages
+  stream to decode-role replicas as chunked double-buffered checksummed
+  transfers, and the cluster-wide prefix index (engine/prefix_tree.
+  ClusterPrefixIndex) makes a prefix prefilled anywhere warm
+  everywhere; a stalled/corrupt transfer falls back to local
+  re-prefill (MigrationConfig knobs; DEPLOY.md §1p).
 - batcher.FleetBatcher + server.FleetScoringServer — the multi-model
   fleet layer (engine/fleet.py underneath): per-model dispatch queues
   with resident-first selection and background weight prefetch, and the
@@ -44,6 +51,8 @@ load driver ("serve" headline key).
 
 from .batcher import ContinuousBatcher, FleetBatcher
 from .cache import ResultCache, content_key
+from .migrate import (MigrationError, PageExport, PageMigrator,
+                      export_prefix, import_prefix)
 from .queue import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK, STATUS_SHED,
                     RequestQueue, ServeFuture, ServeRequest, ServeResult)
 from .router import ReplicaRouter
@@ -55,6 +64,8 @@ __all__ = [
     "RequestQueue", "ServeFuture", "ServeRequest", "ServeResult",
     "ScoringServer", "FleetScoringServer", "FleetScoreFuture",
     "ReplicaRouter",
+    "MigrationError", "PageExport", "PageMigrator",
+    "export_prefix", "import_prefix",
     "aggregate_fleet", "fleet_decision",
     "STATUS_OK", "STATUS_EXPIRED", "STATUS_SHED", "STATUS_ERROR",
 ]

@@ -216,6 +216,25 @@ class ContinuousBatcher:
                 return edge, rows
             # every candidate row expired — re-scan the other buckets
 
+    def prefill(self, bucket: int,
+                prefix_rows: List[Tuple[int, ...]]) -> int:
+        """PREFILL-ONLY dispatch (disaggregated serving — serve/migrate
+        .py): compute the rows' prefix KV at ``bucket`` and insert full
+        pages into this engine's pool + radix tree, decoding nothing.
+        Rows are padded exactly the way :meth:`score` pads its batch
+        (pad_full / power-of-two tail, repeating the last row) so a
+        prefill-role replica's prefill programs share the score path's
+        shape discipline — and its page VALUES are bitwise the pages a
+        full scoring dispatch would have inserted
+        (engine.prefill_insert). Returns the page-aligned tokens
+        covered for the first row."""
+        n = len(prefix_rows)
+        bsz = max(self._dispatch_rows(n), _tail_batch(n, self.batch))
+        full = [list(r) for r in prefix_rows]
+        full += [list(prefix_rows[-1])] * (bsz - n)
+        with tracing.span("serve/prefill", bucket=int(bucket), rows=n):
+            return self.engine.prefill_insert(bucket, full)
+
     def flush_all(self, status: str, note: str) -> int:
         """Resolve every bucketed request with ``status`` (health-flag
         drain); returns how many were flushed."""

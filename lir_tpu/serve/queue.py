@@ -237,6 +237,14 @@ class RequestQueue:
                 return True
             return self._nonempty.wait(timeout)
 
+    def kick(self) -> None:
+        """Wake a supervisor parked in :meth:`wait_nonempty` without
+        enqueueing anything — out-of-band work arrived (a migration
+        page op, serve/migrate.py) that the loop should notice now,
+        not a poll interval from now."""
+        with self._nonempty:
+            self._nonempty.notify()
+
     def flush(self, status: str, note: str) -> int:
         """Resolve every queued request with ``status`` (the drain path
         of the health-flag trip); returns how many were flushed."""

@@ -56,6 +56,7 @@ from ..utils.logging import get_logger
 from ..utils.manifest import atomic_write_json
 from ..utils.profiling import FaultStats, ServeStats
 from ..utils.retry import retry_with_exponential_backoff
+from . import migrate as migrate_mod
 from .batcher import ContinuousBatcher, FleetBatcher
 from .cache import ResultCache, content_key
 from .queue import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK, STATUS_SHED,
@@ -142,6 +143,13 @@ class ScoringServer:
         self._stop = threading.Event()
         self._abort = False          # stop WITHOUT draining (checkpoint)
         self._inflight: List[Pending] = []
+        # Page ops (serve/migrate.py): tree/pool work queued by the
+        # disaggregation router — prefill-only dispatches, page
+        # exports, page imports — drained on the supervisor thread
+        # ahead of dispatch formation, so every radix-tree touch stays
+        # on the one thread the tree's contract allows.
+        self._page_lock = threading.Lock()
+        self._page_ops: List[migrate_mod.PageOp] = []  # guarded-by: _page_lock
         engine.fresh_handoff()     # fresh donation chain per session
         if precompile and engine.rt.aot_precompile:
             # pad_full pins every dispatch to the full batch shape, so
@@ -290,6 +298,7 @@ class ScoringServer:
             stopping = self._stop.is_set()
             if stopping and self._abort:
                 return           # checkpoint path: leave the backlog be
+            self._drain_page_ops()
             for p in self.queue.drain():
                 self.batcher.admit(p)
             d = self.batcher.next_dispatch(self.clock(), flush=stopping)
@@ -311,6 +320,40 @@ class ScoringServer:
         if self.stream is None:
             return {}
         return self.stream.summary()
+
+    # -- page ops (disaggregated serving — serve/migrate.py) -----------------
+
+    def submit_page_op(self, fn) -> migrate_mod.OpFuture:
+        """Queue ``fn(engine)`` for the supervisor thread (drained
+        ahead of dispatch formation each loop turn) — the seam the
+        disaggregation router's handoff chain runs page exports/imports
+        through, so every tree/pool mutation happens on this server's
+        one dispatch thread. Returns the op's completion future
+        (callbacks fire on the supervisor thread)."""
+        op = migrate_mod.PageOp(fn)
+        with self._page_lock:
+            self._page_ops.append(op)
+        self.queue.kick()            # wake an idle supervisor now
+        return op.future
+
+    def submit_prefill(self, bucket: int,
+                       prefix_ids) -> migrate_mod.OpFuture:
+        """Queue a PREFILL-ONLY dispatch over one token prefix (the
+        prefill-role replica's unit of work): compute the prefix KV at
+        ``bucket`` and insert full pages into this replica's pool +
+        radix tree, decoding nothing (serve/batcher.prefill). The
+        future resolves with the page-aligned tokens covered."""
+        ids = tuple(int(t) for t in prefix_ids)
+        return self.submit_page_op(
+            lambda eng: self.batcher.prefill(int(bucket), [ids]))
+
+    def _drain_page_ops(self) -> None:
+        while True:
+            with self._page_lock:
+                if not self._page_ops:
+                    return
+                op = self._page_ops.pop(0)
+            op.run(self.engine)
 
     def _resolve_ok(self, p: Pending, payload: Dict, now: float) -> None:
         self.cache.put(p.cache_key, payload)

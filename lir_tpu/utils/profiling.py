@@ -962,6 +962,85 @@ class RouterStats:
 
 
 @dataclasses.dataclass
+class MigrationStats:
+    """Disaggregated-serving counters (serve/migrate.py + the router's
+    prefill/decode role machinery, serve/router.py). Thread-safe —
+    replica supervisor threads (page ops + chain callbacks), the router
+    tick (timeout fallbacks), and submit threads all mutate it.
+
+    Definitions (reported by ``summary()``, bench.py's "disagg" key,
+    and ``make disagg-smoke``; DEPLOY.md §1p):
+
+    - ``migrations``: completed page-migration chains (pages exported
+      from one replica's pool and imported, checksum-verified, into
+      another's); ``prefill_ops``: prefill-only dispatches run on
+      prefill-role replicas.
+    - ``pages_migrated`` / ``bytes_streamed`` / ``chunks_streamed``:
+      transfer volume (bytes are device-leaf bytes, both directions
+      counted once).
+    - ``migration_s_exposed``: transfer wall seconds on the critical
+      path before the decode dispatch could be admitted;
+      ``migration_s_hidden``: per-chunk in-flight seconds overlapped
+      away by the double-buffered window (serial sum minus wall).
+    - ``refetch_fallbacks``: chains abandoned (stall past
+      ``MigrationConfig.timeout_s``, corrupt chunk, source replica
+      died) whose request re-prefilled LOCALLY on the decode replica —
+      the never-a-wrong-answer path; ``stalls`` / ``corrupt_chunks``
+      classify why.
+    - ``cluster_tree_hits``: requests whose prefix the cluster index
+      found already page-resident on the chosen decode replica — routed
+      straight there, no migration and no prefill needed.
+    """
+
+    migrations: int = 0
+    prefill_ops: int = 0
+    pages_migrated: int = 0
+    bytes_streamed: int = 0
+    chunks_streamed: int = 0
+    migration_s_exposed: float = 0.0
+    migration_s_hidden: float = 0.0
+    refetch_fallbacks: int = 0
+    stalls: int = 0
+    corrupt_chunks: int = 0
+    cluster_tree_hits: int = 0
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def add_transfer(self, pages: int, nbytes: int, chunks: int,
+                     exposed_s: float, hidden_s: float) -> None:
+        with self._lock:
+            self.migrations += 1
+            self.pages_migrated += pages
+            self.bytes_streamed += nbytes
+            self.chunks_streamed += chunks
+            self.migration_s_exposed += exposed_s
+            self.migration_s_hidden += hidden_s
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "migrations": self.migrations,
+                "prefill_ops": self.prefill_ops,
+                "pages_migrated": self.pages_migrated,
+                "bytes_streamed": self.bytes_streamed,
+                "chunks_streamed": self.chunks_streamed,
+                "migration_s_exposed": round(self.migration_s_exposed, 4),
+                "migration_s_hidden": round(self.migration_s_hidden, 4),
+                "refetch_fallbacks": self.refetch_fallbacks,
+                "stalls": self.stalls,
+                "corrupt_chunks": self.corrupt_chunks,
+                "cluster_tree_hits": self.cluster_tree_hits,
+            }
+
+
+@dataclasses.dataclass
 class LeaseStats:
     """Shard-lease counters (engine/lease.py): how leased offline-sweep
     shards moved between holders. Thread-safe for symmetry with the

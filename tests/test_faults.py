@@ -126,6 +126,52 @@ def test_replica_kill_and_lag_schedule_kinds():
     assert lag.stats.injected == {"replica": 1}
 
 
+def test_migration_stall_and_corrupt_schedule_kinds():
+    """The disaggregation chaos kinds (serve/migrate.py seam):
+    migration_stall sleeps then raises at the migrator's wire hop;
+    migration_corrupt flips the export's chunk bytes UNDER its
+    checksums and lets the transfer proceed — detection is the
+    import-side verify's job. Both are counter-indexed at the
+    'migrate' site like every other kind."""
+
+    class _Migrator:
+        def transfer(self, export):
+            return export
+
+    class _Export:
+        def __init__(self):
+            import numpy as np
+
+            self.chunks = [(np.zeros((2, 2, 4), np.float32), 2)]
+            self.checksums = [0]
+
+    stall = faults.FaultPlan(schedules={
+        "migrate": faults.SiteSchedule.migration_stall_at(
+            1, seconds=0.02)})
+    m = faults.wrap_migrator(_Migrator(), stall)
+    e = _Export()
+    assert m.transfer(e) is e            # call 0: clean
+    t0 = time.monotonic()
+    with pytest.raises(faults.InjectedFault, match="migration stall"):
+        m.transfer(e)                    # call 1: sleeps then raises
+    assert time.monotonic() - t0 >= 0.02
+    assert stall.stats.injected == {"migrate": 1}
+
+    corrupt = faults.FaultPlan(seed=9, schedules={
+        "migrate": faults.SiteSchedule.migration_corrupt_at(0)})
+    m2 = faults.wrap_migrator(_Migrator(), corrupt)
+    e2 = _Export()
+    before = e2.chunks[0][0].copy()
+    assert m2.transfer(e2) is e2         # completes, mutated in place
+    assert not (e2.chunks[0][0] == before).all()
+    assert e2.checksums == [0]           # checksums left stale
+    assert corrupt.stats.injected == {"migrate": 1}
+    # the new kinds/site are registered
+    assert "migration_stall" in faults.KINDS
+    assert "migration_corrupt" in faults.KINDS
+    assert "migrate" in faults.SITES
+
+
 # ---------------------------------------------------------------------------
 # CircuitBreaker lifecycle
 # ---------------------------------------------------------------------------

@@ -124,6 +124,70 @@ def test_residency_is_a_routing_signal():
     assert len(a.submitted) == 1
 
 
+def test_page_residency_and_pressure_fold_into_pick():
+    """The _pick satellite fix: the residency signal is not weights
+    alone — cluster prefix-tree match length (pages a replica already
+    holds for the prompt) and hbm_pressure fold in, so a decode replica
+    already holding the prompt's pages wins placement over an
+    equally-loaded cold one, and a squeezed page-holder loses it
+    again."""
+    from lir_tpu.config import MigrationConfig
+
+    a, b = FakeReplica(depth=3), FakeReplica(depth=3)
+    router = ReplicaRouter(
+        [("a", a), ("b", b)], config=RouterConfig(),
+        migrate=MigrationConfig(page_bonus=1.0))
+    # b holds 4 of the prompt's pages (cluster-index match): b wins
+    # despite equal depth.
+    picked = router._pick("", set(), page_match={"b": 4})
+    assert picked.replica_id == "b"
+    # pressure pushes the page-holder back out: 4 pages of bonus lose
+    # to a full-ledger squeeze at pressure_weight 6.
+    b.hbm_pressure = 1.0
+    picked = router._pick("", set(), page_match={"b": 4})
+    assert picked.replica_id == "a"
+
+
+def test_page_residency_routes_real_traffic_to_the_holder():
+    """End-to-end placement: after one request warms a replica's radix
+    tree, a second request sharing the trunk routes to THAT replica
+    (listener events -> cluster index -> _pick bonus), not round-robin."""
+    import numpy as np
+
+    servers = [_tiny_server(seed=2) for _ in range(2)]
+    for s in servers:
+        s.start()
+    from lir_tpu.config import MigrationConfig
+
+    router = ReplicaRouter(
+        [("a", servers[0]), ("b", servers[1])],
+        config=RouterConfig(cache_entries=0),
+        migrate=MigrationConfig(page_bonus=2.0))
+    try:
+        rng = np.random.default_rng(3)
+        words = "coverage policy flood water damage claim".split()
+        trunk = " ".join(rng.choice(words) for _ in range(50))
+
+        def req(i):
+            body = f"{trunk} case {i}"
+            return ServeRequest(
+                binary_prompt=f"{body} Answer Yes or No .",
+                confidence_prompt=f"{body} Give a number from 0 "
+                                  f"to 100 .",
+                klass="t", request_id=str(i))
+
+        assert router.submit(req(0)).result(120).status == STATUS_OK
+        holder = next(iter(router.stats.per_replica))
+        for i in range(1, 4):
+            assert router.submit(req(i)).result(120).status == STATUS_OK
+        assert router.stats.per_replica == {holder: 4}, \
+            router.stats.per_replica
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
 def test_slo_term_avoids_stale_backlogs_for_tight_deadlines():
     # Equal depths, but a's oldest queued row has waited 30s: a
     # deadline-tight request must land on b.

@@ -53,6 +53,16 @@ The guard layer (lir_tpu/guard) adds the SILENT failure modes:
    gauges in the metrics snapshot, and an injected device OOM
    reclaim-and-retried without feeding the circuit breaker.
 
+11. MIGRATION STALL/CORRUPT — disaggregated serving's page-transfer
+   chaos (lir_tpu/serve/migrate.py): a seeded ``migration_corrupt``
+   flips transferred chunk bytes under the export checksums (the
+   import must refuse to land any page, destination tree/refcounts
+   rolled back untouched) and a ``migration_stall`` wedges the wire
+   hop past the chain deadline — BOTH fall back to local re-prefill
+   on the decode replica: every request resolves ok with payloads
+   bitwise a colocated server's, fallbacks == injections, never a
+   wrong answer.
+
 Runs hermetically on CPU (FakeTokenizer + tiny random decoder); prints
 the FaultStats/GuardStats summaries as JSON on success.
 """
@@ -1156,6 +1166,130 @@ def hbm_chaos(failures):
     return out
 
 
+def disagg_chaos(failures):
+    """Scenario 11 (migration stall/corrupt — serve/migrate.py): a
+    1-prefill + 2-decode disaggregated router under seeded transfer
+    chaos. ``migration_corrupt`` flips chunk bytes under the export's
+    checksums — the import must detect the mismatch and land ZERO
+    pages (destination refcounts/tree rolled back); ``migration_stall``
+    wedges the wire hop past the chain deadline — the tick must
+    abandon it. Both requests fall back to LOCAL re-prefill on the
+    decode replica and resolve ok with payloads bitwise-identical to a
+    colocated server's: fallbacks == injections, never a wrong
+    answer."""
+    import jax
+
+    from lir_tpu import faults
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import (MigrationConfig, RouterConfig,
+                                RuntimeConfig, ServeConfig)
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import ReplicaRouter, ScoringServer, ServeRequest
+
+    mcfg = ModelConfig(name="chaos-smoke", vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=32, n_layers=1, n_heads=2,
+                       intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(mcfg, jax.random.PRNGKey(11))
+    scfg = ServeConfig(classes=(("chaos", 600.0),),
+                       default_class="chaos", linger_s=0.002)
+
+    def server():
+        return ScoringServer(
+            ScoringEngine(params, mcfg, FakeTokenizer(),
+                          RuntimeConfig(batch_size=BATCH,
+                                        max_seq_len=256)),
+            "chaos-smoke", scfg)
+
+    words = ("coverage policy flood water damage claim insurer "
+             "premium").split()
+
+    def req(seed, rid):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        body = " ".join(rng.choice(words) for _ in range(55)) + f" q{rid}"
+        return ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="chaos", request_id=str(rid))
+
+    reqs = [req(101, "corrupt"), req(202, "stall")]
+    colo = server().start()
+    base = [colo.submit(r).result(300) for r in reqs]
+    colo.stop()
+
+    servers = [server().start() for _ in range(3)]
+    router = ReplicaRouter(
+        [("pre", servers[0]), ("d0", servers[1]), ("d1", servers[2])],
+        config=RouterConfig(cache_entries=0, tick_s=0.01),
+        roles={"pre": "prefill", "d0": "decode", "d1": "decode"},
+        # Generous chain deadline: the stall kind RAISES on release, so
+        # the fallback is exercised deterministically even on a loaded
+        # CI box (the deadline-abandonment variant is pinned by
+        # tests/test_migrate.py with a tight timeout).
+        migrate=MigrationConfig(min_prefix_tokens=16, chunk_pages=2,
+                                timeout_s=30.0)).start()
+    fields = ("model_response", "model_confidence_response",
+              "token_1_prob", "token_2_prob", "log_probabilities",
+              "confidence_value", "weighted_confidence")
+    try:
+        plan_c = faults.FaultPlan(seed=3, schedules={
+            "migrate": faults.SiteSchedule.migration_corrupt_at(0)})
+        faults.wrap_migrator(router.migrator, plan_c)
+        got = router.submit(reqs[0]).result(300)
+        if got.status != "ok":
+            failures.append(f"disagg: corrupt-transfer request "
+                            f"resolved {got.status}")
+        for f in fields:
+            if getattr(got, f) != getattr(base[0], f):
+                failures.append(f"disagg: corrupt-fallback payload "
+                                f"field {f} differs from colocated")
+        if router.migrate_stats.corrupt_chunks != 1:
+            failures.append("disagg: corrupt chunk not detected")
+        # every decode replica's refcounts stayed sane (rollback)
+        for s in servers[1:]:
+            rc = s.engine.prefix_cache.pool.refcount
+            if not (rc >= 0).all():
+                failures.append("disagg: negative refcount after "
+                                "corrupt-import rollback")
+
+        # Unwrap the corrupt schedule before arming the stall one so
+        # each phase fires exactly its own kind.
+        router.migrator.transfer = getattr(
+            router.migrator.transfer, "__wrapped__",
+            router.migrator.transfer)
+        plan_s = faults.FaultPlan(seed=4, schedules={
+            "migrate": faults.SiteSchedule.migration_stall_at(
+                0, seconds=0.8)})
+        faults.wrap_migrator(router.migrator, plan_s)
+        got2 = router.submit(reqs[1]).result(300)
+        if got2.status != "ok":
+            failures.append(f"disagg: stalled-transfer request "
+                            f"resolved {got2.status}")
+        for f in fields:
+            if getattr(got2, f) != getattr(base[1], f):
+                failures.append(f"disagg: stall-fallback payload "
+                                f"field {f} differs from colocated")
+        ms = router.migrate_stats
+        injected = (plan_c.injected("migrate")
+                    + plan_s.injected("migrate"))
+        if injected != 2:
+            failures.append(f"disagg: expected 2 injections, "
+                            f"got {injected}")
+        if ms.refetch_fallbacks != injected:
+            failures.append(f"disagg: fallbacks {ms.refetch_fallbacks} "
+                            f"!= injections {injected}")
+        if ms.stalls < 1:
+            failures.append("disagg: stall never counted")
+        return ms.summary()
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
 def main() -> int:
     failures = []
     sweep_summary = sweep_chaos(failures)
@@ -1167,6 +1301,7 @@ def main() -> int:
     elastic_summary = elastic_chaos(failures)
     spec_summary = spec_chaos(failures)
     hbm_summary = hbm_chaos(failures)
+    disagg_summary = disagg_chaos(failures)
     if failures:
         for f in failures:
             print(f"CHAOS-SMOKE FAIL: {f}")
@@ -1178,7 +1313,8 @@ def main() -> int:
                       "stream": stream_summary,
                       "elastic": elastic_summary,
                       "spec": spec_summary,
-                      "hbm": hbm_summary}))
+                      "hbm": hbm_summary,
+                      "disagg": disagg_summary}))
     print("chaos smoke: OK (sweep resumed bitwise-identical after "
           "injected kill + torn manifest; breaker tripped and recovered "
           "via half-open probe; poison row isolated; checkpoint resume "
@@ -1194,7 +1330,11 @@ def main() -> int:
           "hbm_squeeze walked the degradation ladder down and back up "
           "mid-sweep and mid-serve with zero crashed dispatches, rows "
           "and payloads bitwise vs unpressured runs, and a device OOM "
-          "reclaim-and-retried without feeding the breaker)")
+          "reclaim-and-retried without feeding the breaker; a "
+          "corrupted page migration was refused at import and a "
+          "stalled one abandoned at the chain deadline, both falling "
+          "back to local re-prefill with payloads bitwise a colocated "
+          "server's)")
     return 0
 
 

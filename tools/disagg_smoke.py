@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Disaggregated-serving smoke: the prefill/decode split + KV-page
+migration invariants the `make disagg-smoke` CI target guards
+(DEPLOY.md §1p):
+
+- 1 prefill-role + 2 decode-role replica servers (config-identical
+  tiny engines) behind a ReplicaRouter serve a prefill-heavy request
+  stream on the fake backend: every request resolves ok, scoring
+  dispatches land ONLY on decode replicas, and a NONZERO number of
+  pages migrates (prefill → export → transfer → import);
+- every payload is BITWISE-identical to the same request scored on a
+  colocated single server — migrated-page decode cannot differ from
+  local-prefill decode;
+- a replica KILLED mid-migration recovers: the chain falls back to
+  local re-prefill on a survivor, the request still resolves ok and
+  bitwise, and nothing is dropped or double-resolved.
+
+Runs hermetically on CPU; prints the migrate/router summaries as JSON
+on success.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BATCH = 4
+
+PAYLOAD_FIELDS = ("model_response", "model_confidence_response",
+                  "token_1_prob", "token_2_prob", "log_probabilities",
+                  "confidence_value", "weighted_confidence")
+
+
+def _tiny_server(cfg_serve, seed=2):
+    import jax
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import ScoringServer
+
+    cfg = ModelConfig(name="disagg-smoke",
+                      vocab_size=FakeTokenizer.VOCAB, hidden_size=32,
+                      n_layers=1, n_heads=2, intermediate_size=64,
+                      max_seq_len=256)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(seed))
+    engine = ScoringEngine(params, cfg, FakeTokenizer(),
+                           RuntimeConfig(batch_size=BATCH,
+                                         max_seq_len=256))
+    return ScoringServer(engine, "disagg-smoke", cfg_serve)
+
+
+def _requests(n, seed=7, tag=""):
+    import numpy as np
+
+    from lir_tpu.serve import ServeRequest
+
+    rng = np.random.default_rng(seed)
+    words = ("coverage policy flood water damage claim insurer "
+             "premium exclusion endorsement").split()
+    trunks = [" ".join(rng.choice(words) for _ in range(60))
+              for _ in range(2)]
+    reqs = []
+    for i in range(n):
+        body = f"{trunks[i % 2]} case {i}"
+        reqs.append(ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="smoke", request_id=f"{tag}{i}"))
+    return reqs
+
+
+def main() -> int:
+    from lir_tpu import faults
+    from lir_tpu.config import (MigrationConfig, RouterConfig,
+                                ServeConfig)
+    from lir_tpu.serve import ReplicaRouter
+
+    serve_cfg = ServeConfig(classes=(("smoke", 600.0),),
+                            default_class="smoke", linger_s=0.002)
+    reqs = _requests(10)
+
+    # Colocated baseline: one ordinary server scores everything.
+    colo = _tiny_server(serve_cfg).start()
+    base = [colo.submit(r).result(300) for r in reqs]
+    colo.stop()
+    assert all(r.status == "ok" for r in base)
+
+    servers = [_tiny_server(serve_cfg).start() for _ in range(3)]
+    router = ReplicaRouter(
+        [("pre", servers[0]), ("d0", servers[1]), ("d1", servers[2])],
+        config=RouterConfig(cache_entries=0, tick_s=0.01),
+        roles={"pre": "prefill", "d0": "decode", "d1": "decode"},
+        migrate=MigrationConfig(min_prefix_tokens=16, chunk_pages=2,
+                                timeout_s=5.0)).start()
+    try:
+        futs = [router.submit(r) for r in reqs]
+        res = [f.result(300) for f in futs]
+        assert all(r.status == "ok" for r in res), \
+            [r.status for r in res]
+        ids = [r.request_id for r in res]
+        assert len(set(ids)) == len(reqs), "dropped/double-resolved"
+        for got, ref in zip(res, base):
+            for f in PAYLOAD_FIELDS:
+                assert getattr(got, f) == getattr(ref, f), (
+                    f"payload field {f} differs from the colocated "
+                    f"baseline on request {got.request_id}")
+        ms = router.migrate_stats
+        assert ms.pages_migrated > 0, "no pages migrated"
+        assert ms.prefill_ops > 0, "no prefill-role dispatches"
+        # Scoring traffic never landed on the prefill replica.
+        assert router.stats.per_replica.get("pre", 0) == 0, \
+            router.stats.per_replica
+
+        # Kill-mid-migration: stall the wire hop so the chain is alive
+        # when the SOURCE replica dies — the request must fall back to
+        # local re-prefill on a survivor, still ok and bitwise.
+        plan = faults.FaultPlan(seed=11, schedules={
+            "migrate": faults.SiteSchedule.migration_stall_at(
+                0, seconds=1.0)})
+        faults.wrap_migrator(router.migrator, plan)
+        # A brand-new trunk (different seed): COLD everywhere, so the
+        # submit must start a real migration chain for the kill to hit.
+        kill_req = _requests(1, seed=23, tag="k")[0]
+        colo2 = _tiny_server(serve_cfg).start()
+        ref2 = colo2.submit(kill_req).result(300)
+        colo2.stop()
+        fut = router.submit(kill_req)
+        router.kill_replica("pre")            # dies mid-chain
+        got2 = fut.result(300)
+        assert got2.status == "ok", got2.status
+        for f in PAYLOAD_FIELDS:
+            assert getattr(got2, f) == getattr(ref2, f), f
+        assert ms.refetch_fallbacks >= 1, ms.summary()
+        print(json.dumps({
+            "disagg_smoke": "ok",
+            "requests": len(reqs) + 1,
+            "migrate": ms.summary(),
+            "router": router.stats.summary(),
+        }, indent=2))
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
