@@ -4,9 +4,9 @@
 
 .PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
 	kernel-smoke stats-smoke fleet-smoke observe-smoke elastic-smoke \
-	spec-smoke mem-smoke disagg-smoke install-hooks
+	spec-smoke mem-smoke disagg-smoke cascade-smoke install-hooks
 
-verify: lint
+verify: lint cascade-smoke
 	python tools/check_tier1.py
 
 # graft-lint: AST static analysis proving the engine's JAX/XLA
@@ -122,6 +122,17 @@ mem-smoke:
 # identical (tools/elastic_smoke.py).
 elastic-smoke:
 	JAX_PLATFORMS=cpu python tools/elastic_smoke.py
+
+# Cascade-prefill smoke: shared-trunk grid (3 long bases x 8 tail
+# rephrasings) served on the fake backend with cascade prefill ON vs
+# OFF — the trunk's attention must be computed once per dispatch
+# (nonzero cascade dispatches / trunk rows deduped / analytic prefix
+# FLOPs saved in CascadeStats), every argmax-derived payload field
+# identical between the two servers and float probabilities within
+# tolerance (the PR-7 parity bar), and the dense server must never
+# cascade (tools/cascade_smoke.py; DEPLOY.md §1q).
+cascade-smoke:
+	JAX_PLATFORMS=cpu python tools/cascade_smoke.py
 
 # Disaggregated-serving smoke: 1 prefill-role + 2 decode-role replicas
 # behind the router on the fake backend — scoring lands only on decode

@@ -171,6 +171,7 @@ def _add_perturb(sub) -> None:
     _add_governor_flags(p)
     _add_kernel_flags(p)
     _add_spec_flags(p)
+    _add_cascade_flags(p)
     _add_trace_flags(p)
     p.add_argument("--barrier-timeout", type=float, default=None,
                    help="multihost liveness bound in seconds: a shard-"
@@ -400,6 +401,58 @@ def _spec_config_from_args(args):
     if getattr(args, "spec_tree_tails", None) is not None:
         kw["tree_tails_per_node"] = args.spec_tree_tails
     return SpecConfig(**kw)
+
+
+def _add_cascade_flags(p) -> None:
+    """Shared-prefix cascade-prefill knobs (ops/cascade_prefill +
+    RuntimeConfig.cascade_prefill, Config.cascade CascadeConfig),
+    shared by perturb and serve (DEPLOY.md §1q)."""
+    p.add_argument("--no-cascade-prefill", action="store_true",
+                   help="disable shared-prefix cascade prefill and "
+                        "restore the dense shared-dispatch path exactly "
+                        "(cascade results are argmax-identical; dense is "
+                        "the measurement baseline)")
+    p.add_argument("--cascade-min-trunk", type=_positive_int,
+                   default=None,
+                   help="shortest shared trunk (tokens, post-snap) worth "
+                        "the cascade split; shorter trunks dispatch "
+                        "densely (default 32 — below it the extra "
+                        "launch + merge beats the deduped prefill)")
+    p.add_argument("--cascade-trunk-quantum", type=_positive_int,
+                   default=None,
+                   help="trunk lengths snap DOWN to this multiple so "
+                        "near-identical prefixes share one compiled "
+                        "cascade shape (default 16)")
+    p.add_argument("--cascade-min-rows", type=_positive_int,
+                   default=None,
+                   help="fewest real rows sharing the trunk before "
+                        "cascade engages (default 2; one row has "
+                        "nothing to dedupe)")
+    p.add_argument("--cascade-int8-qk", action="store_true",
+                   help="quantize the cascade prefix leg's QK^T to int8 "
+                        "inside the kernel (models/quant.py scales; "
+                        "softmax + PV stay fp32 — tolerance-bound, "
+                        "argmax-identical in tests)")
+
+
+def _cascade_rt_kw(args, rt_kw: dict) -> None:
+    if getattr(args, "no_cascade_prefill", False):
+        rt_kw["cascade_prefill"] = False
+
+
+def _cascade_config_from_args(args):
+    from .config import CascadeConfig
+
+    kw = {}
+    if getattr(args, "cascade_min_trunk", None) is not None:
+        kw["min_trunk"] = args.cascade_min_trunk
+    if getattr(args, "cascade_trunk_quantum", None) is not None:
+        kw["trunk_quantum"] = args.cascade_trunk_quantum
+    if getattr(args, "cascade_min_rows", None) is not None:
+        kw["min_rows"] = args.cascade_min_rows
+    if getattr(args, "cascade_int8_qk", False):
+        kw["int8_qk"] = True
+    return CascadeConfig(**kw)
 
 
 def _add_trace_flags(p) -> None:
@@ -823,6 +876,7 @@ def _add_serve(sub) -> None:
     _add_governor_flags(p)
     _add_kernel_flags(p)
     _add_spec_flags(p)
+    _add_cascade_flags(p)
     _add_trace_flags(p)
     _add_observatory_flags(p)
     _add_router_flags(p)
@@ -981,6 +1035,7 @@ def cmd_perturb(args) -> None:
     _guard_rt_kw(args, rt_kw)
     _kernel_rt_kw(args, rt_kw)
     _spec_rt_kw(args, rt_kw)
+    _cascade_rt_kw(args, rt_kw)
     _prefix_rt_kw(args, rt_kw)
     if args.no_row_artifact:
         rt_kw["row_artifact"] = False
@@ -1000,6 +1055,7 @@ def cmd_perturb(args) -> None:
         kv_cache_int8=args.kv_cache_int8,
         spec_config=_spec_config_from_args(args),
         governor_config=_governor_cfg(args),
+        cascade_config=_cascade_config_from_args(args),
     )
     entries = load_or_generate_perturbations(
         args.perturbations, LEGAL_PROMPTS, None
@@ -1034,6 +1090,7 @@ def cmd_serve(args) -> None:
     _guard_rt_kw(args, rt_kw)
     _kernel_rt_kw(args, rt_kw)
     _spec_rt_kw(args, rt_kw)
+    _cascade_rt_kw(args, rt_kw)
     _prefix_rt_kw(args, rt_kw)
     classes = dict(ServeConfig().classes)
     for spec in args.deadline or ():
@@ -1089,7 +1146,8 @@ def cmd_serve(args) -> None:
         cache_root=args.param_cache, quantize_int8=args.int8,
         int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8,
         spec_config=_spec_config_from_args(args),
-        governor_config=_governor_cfg(args))
+        governor_config=_governor_cfg(args),
+        cascade_config=_cascade_config_from_args(args))
     if args.fleet_models:
         try:
             _run_fleet_serve(args, serve_cfg, factory)
@@ -1440,7 +1498,8 @@ def cmd_precompile(args) -> None:
         args.checkpoints, RuntimeConfig(**rt_kw), _parse_mesh(args.mesh),
         cache_root=args.param_cache, quantize_int8=args.int8,
         int8_dynamic=args.int8_dynamic, kv_cache_int8=args.kv_cache_int8,
-        spec_config=_spec_config_from_args(args))
+        spec_config=_spec_config_from_args(args),
+        cascade_config=_cascade_config_from_args(args))
     engine = factory(args.model)
     specs = compile_plan.sweep_specs_for_ladder(engine, sfx_buckets=sfx)
     t0 = time.perf_counter()

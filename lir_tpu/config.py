@@ -245,6 +245,24 @@ class RuntimeConfig:
     # PR-10 WeightCache so drafting never evicts the verifier
     # mid-dispatch). Empty = self-drafting (tree + n-gram lookup).
     spec_draft_model: str = ""
+    # Shared-prefix cascade prefill (ops/cascade_prefill + generate.
+    # greedy_decode_fused_shared_cascade; DEPLOY.md §1q). ON: a shared
+    # dispatch whose rows all begin with the same trunk (LCP across the
+    # dispatch, snapped to CascadeConfig.trunk_quantum) prefills that
+    # trunk ONCE at batch 1 — or gathers it warm from the radix page
+    # pool at zero recompute — and extends the per-row remainders over
+    # it via cascade attention: prefix leg = one dense GEMM per kv head
+    # against the shared trunk KV (optionally int8 QK^T fused in-kernel),
+    # suffix leg = causal window, exact log-sum-exp merge. Results are
+    # argmax-identical to the dense shared path (tolerance-bound interior
+    # floats — the PR-7 bar, pinned by tests/test_cascade.py);
+    # --no-cascade-prefill restores the dense path exactly. Cascade
+    # takes precedence over speculation and piggybacking for eligible
+    # dispatches (it removes the prefill those paths would chain/draft
+    # around); ineligible dispatches fall back dense and count
+    # CascadeStats.dense_fallbacks. Eligibility knobs live on
+    # Config.cascade (CascadeConfig).
+    cascade_prefill: bool = True      # cli: --no-cascade-prefill
     # Lease time-to-live in WALL-CLOCK seconds (leases compare across
     # hosts, so the shared clock is time.time, not monotonic). A holder
     # renews on every flush; a lease older than this is stealable.
@@ -279,6 +297,39 @@ class SpecConfig:
     # beyond this): each completed dispatch records its prompt's
     # observed continuation so a repeat visit drafts the whole reply.
     tree_tails_per_node: int = 32     # cli: --spec-tree-tails
+
+
+@dataclasses.dataclass(frozen=True)
+class CascadeConfig:
+    """Cascade-prefill ELIGIBILITY policy (ops/cascade_prefill +
+    engine/runner cascade routing; DEPLOY.md §1q). These knobs steer
+    WHICH shared dispatches take the cascade split — they can change
+    speed, never results (the cascade is argmax-identical to the dense
+    path it replaces, and an ineligible dispatch runs the dense path
+    verbatim). The on/off switch lives on RuntimeConfig
+    (``cascade_prefill``) because it changes compiled shapes."""
+
+    # Minimum shared-trunk length (tokens, post-snap) worth the split:
+    # below this the prefix-leg GEMM is too thin to beat the dense
+    # prefill's one fused pass, so short-LCP dispatches fall back dense
+    # (counted in CascadeStats.dense_fallbacks).
+    min_trunk: int = 32               # cli: --cascade-min-trunk
+    # Trunk lengths snap DOWN to this grid before compilation: the trunk
+    # extent is a STATIC shape (compile_plan keys executables on it), so
+    # a coarse quantum keeps the executable population bounded while a
+    # few unshared tail tokens just ride the per-row remainder.
+    trunk_quantum: int = 16           # cli: --cascade-trunk-quantum
+    # Minimum REAL rows in the dispatch: the cascade dedups trunk work
+    # across rows, so a 1-row dispatch has nothing to dedup and the
+    # dense path wins on dispatch overhead alone.
+    min_rows: int = 2                 # cli: --cascade-min-rows
+    # Fuse int8 QK^T inside the prefix-leg kernel (models/quant.py's
+    # dynamic rule applied to q/trunk-k blocks in VMEM; softmax and PV
+    # stay fp32). Halves the kernel's VMEM read traffic on the score
+    # matmul; scores are tolerance-bound, argmax parity is pinned by
+    # tests/test_cascade.py. OFF by default: exact-fp32 scores unless
+    # opted in.
+    int8_qk: bool = False             # cli: --cascade-int8-qk
 
 
 @dataclasses.dataclass(frozen=True)
@@ -668,6 +719,7 @@ class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     runtime: RuntimeConfig = dataclasses.field(default_factory=RuntimeConfig)
     spec: SpecConfig = dataclasses.field(default_factory=SpecConfig)
+    cascade: CascadeConfig = dataclasses.field(default_factory=CascadeConfig)
     perturbation: PerturbationConfig = dataclasses.field(default_factory=PerturbationConfig)
     stats: StatsConfig = dataclasses.field(default_factory=StatsConfig)
     retry: RetryConfig = dataclasses.field(default_factory=RetryConfig)

@@ -69,7 +69,16 @@ class ShapeSpec:
     verify-window size (generate.greedy_decode_fused_shared_spec /
     _paged_spec — the verify executables are planned per (bucket,
     batch, k)); ``spec_draft`` its fleet-draft-model variant (the
-    draft model's params ride the traced pytree)."""
+    draft model's params ride the traced pytree). ``trunk`` > 0 selects
+    a CASCADE-prefill executable (kinds "shared_cascade"/
+    "shared_cascade_paged" — generate.greedy_decode_fused_shared_cascade
+    and its paged-trunk sibling) at that static shared-trunk extent, and
+    ``cascade_int8`` its in-kernel int8-QK^T variant; both change the
+    lowered program, so keying them here is what guarantees an
+    executable can never serve the wrong mode (a dense lookup can't
+    return a cascade program or vice versa). For the paged cascade kind,
+    ``window`` is the TRUNK's recompute-window edge (the (1, W) chunk
+    the radix resume teacher-forces), not a per-row window."""
 
     kind: str
     bucket: int
@@ -84,6 +93,8 @@ class ShapeSpec:
     window: int = 0
     spec_k: int = 0
     spec_draft: bool = False
+    trunk: int = 0
+    cascade_int8: bool = False
 
     @property
     def label(self) -> str:
@@ -96,8 +107,13 @@ class ShapeSpec:
         if self.spec_k:
             spec = f"/spec{self.spec_k}" + ("+draft" if self.spec_draft
                                             else "")
+        casc = ""
+        if self.trunk:
+            casc = f"/trunk{self.trunk}" + ("+i8" if self.cascade_int8
+                                            else "")
         return (f"{self.kind}/b{self.bucket}x{self.batch}/sfx{sfx}"
-                f"/new{self.new_tokens}-{self.conf_tokens}{win}{spec}/{var}")
+                f"/new{self.new_tokens}-{self.conf_tokens}{win}{spec}"
+                f"{casc}/{var}")
 
 
 def shared_spec(bucket: int, batch: int, sfx_a: int, sfx_b: int,
@@ -126,6 +142,34 @@ def shared_paged_spec(bucket: int, batch: int, window: int, sfx_a: int,
                      int(sfx_a), int(sfx_b), int(new_tokens),
                      int(conf_tokens), bool(stops_armed), bool(scratch),
                      int(window), spec_k=int(spec_k))
+
+
+def shared_cascade_spec(bucket: int, batch: int, trunk: int, sfx_a: int,
+                        sfx_b: int, new_tokens: int, conf_tokens: int,
+                        stops_armed: bool, scratch: bool,
+                        int8_qk: bool = False) -> ShapeSpec:
+    """Cold cascade-prefill executable (generate.greedy_decode_fused_
+    shared_cascade): batch-1 trunk prefill at the static ``trunk``
+    extent + per-row cascade remainder extension."""
+    return ShapeSpec("shared_cascade", int(bucket), int(batch), 0,
+                     int(sfx_a), int(sfx_b), int(new_tokens),
+                     int(conf_tokens), bool(stops_armed), bool(scratch),
+                     trunk=int(trunk), cascade_int8=bool(int8_qk))
+
+
+def shared_cascade_paged_spec(bucket: int, batch: int, trunk: int,
+                              window: int, sfx_a: int, sfx_b: int,
+                              new_tokens: int, conf_tokens: int,
+                              stops_armed: bool, scratch: bool,
+                              int8_qk: bool = False) -> ShapeSpec:
+    """Warm cascade executable (generate.greedy_decode_fused_shared_
+    cascade_paged): the trunk resumes from the radix page pool through a
+    (1, ``window``) recompute chunk instead of prefilling."""
+    return ShapeSpec("shared_cascade_paged", int(bucket), int(batch), 0,
+                     int(sfx_a), int(sfx_b), int(new_tokens),
+                     int(conf_tokens), bool(stops_armed), bool(scratch),
+                     int(window), trunk=int(trunk),
+                     cascade_int8=bool(int8_qk))
 
 
 def grouped_paged_spec(bucket: int, groups: int, batch: int, window: int,
@@ -186,6 +230,7 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
                piggyback: bool = False,
                stream_shape: Optional[Tuple[int, int, bool]] = None,
                spec_k: int = 0, spec_draft: bool = False,
+               cascade_trunk=None, cascade_int8: bool = False,
                ) -> List[ShapeSpec]:
     """Distinct executables a dispatch plan will call, in first-use order
     (the precompile pool works the list front-to-back, so the first
@@ -213,7 +258,17 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
     distinct fold width the plan's dispatches will use (shared: the
     padded member-row count; grouped: one branch's row count), so the
     sink's per-dispatch fold never pays trace-on-first-call inside the
-    timed loop either. Planned FIRST — the very first dispatch folds."""
+    timed loop either. Planned FIRST — the very first dispatch folds.
+
+    ``cascade_trunk`` (a cascade-prefill engine) maps a shared dispatch
+    to its snapped shared-trunk extent (0 = ineligible — the runner's
+    own eligibility rule, so the plan covers exactly the cascade
+    executables the loop will call); eligible dispatches plan the
+    cascade executable (plus its paged-trunk variants when the prefix
+    cache is on — the trunk's recompute window depends on what the
+    radix tree holds at dispatch time, so every trunk window edge is
+    covered). The plain shared spec stays planned regardless: a dense
+    fallback re-dispatches through it."""
     from ..models import paged as paged_mod
 
     specs: List[ShapeSpec] = []
@@ -249,7 +304,22 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
                                 d.sfx_bucket_b, new_tokens, conf_tokens,
                                 stops_armed, scratch=scratch,
                                 spec_k=spec_k, spec_draft=spec_draft))
-            if piggyback and scratch:
+            trunk = int(cascade_trunk(d)) if cascade_trunk else 0
+            if trunk:
+                add(shared_cascade_spec(d.bucket, m_pad, trunk,
+                                        d.sfx_bucket_a, d.sfx_bucket_b,
+                                        new_tokens, conf_tokens,
+                                        stops_armed, scratch=scratch,
+                                        int8_qk=cascade_int8))
+                if prefix_page_size:
+                    for w in paged_mod.window_edges(trunk,
+                                                    prefix_page_size):
+                        add(shared_cascade_paged_spec(
+                            d.bucket, m_pad, trunk, w, d.sfx_bucket_a,
+                            d.sfx_bucket_b, new_tokens, conf_tokens,
+                            stops_armed, scratch=scratch,
+                            int8_qk=cascade_int8))
+            if piggyback and scratch and not trunk:
                 # A repeat of the previous shared shape — the sweep will
                 # chain these dispatches: plan all three chain stages.
                 add(piggy_prefill_spec(d.bucket, m_pad, d.sfx_bucket_a,
@@ -430,6 +500,64 @@ def _avals_shared_paged(engine, spec: ShapeSpec):
     return args, kwargs, statics
 
 
+def _avals_shared_cascade(engine, spec: ShapeSpec):
+    """Avals for runner.decode_fused_shared's cascade call into
+    generate.greedy_decode_fused_shared_cascade: the dense shared
+    layout with the trunk extent baked static (``spec.trunk``)."""
+    import jax
+    import jax.numpy as jnp
+
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+    B = spec.batch
+    digit_ids, digit_vals = engine.digit_table
+    args = (engine.params, i32(B, spec.bucket), i32(B, spec.bucket),
+            i32(B, spec.sfx_a), i32(B, spec.sfx_a),
+            i32(B, spec.sfx_b), i32(B, spec.sfx_b),
+            i32(B), i32(B), i32(len(digit_ids)), f32(len(digit_vals)))
+    V = engine.cfg.vocab_size
+    kwargs = dict(
+        stop_mask_a=(i32(V) if spec.stops_armed else None),
+        stop_mask_b=(i32(V) if spec.stops_armed else None),
+        eos_id=(i32() if spec.stops_armed else None),
+    )
+    statics = dict(max_new_a=spec.new_tokens, max_new_b=spec.conf_tokens,
+                   trunk_len=spec.trunk, topk=TOPK,
+                   int8_qk=spec.cascade_int8, return_cache=True)
+    return args, kwargs, statics
+
+
+def _avals_shared_cascade_paged(engine, spec: ShapeSpec):
+    """Avals for the warm-trunk cascade call into
+    generate.greedy_decode_fused_shared_cascade_paged: a batch-1 paged
+    front (slot table + recompute window over the TRUNK extent) ahead
+    of the dense shared layout."""
+    import jax
+    import jax.numpy as jnp
+
+    i32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)  # noqa: E731
+    f32 = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+    B, W, Tt = spec.batch, spec.window, spec.trunk
+    digit_ids, digit_vals = engine.digit_table
+    args = (engine.params, _pool_avals(engine),
+            i32(1, Tt), i32(), i32(1, Tt),
+            i32(1, W), i32(1, W),
+            i32(B, spec.bucket), i32(B, spec.bucket),
+            i32(B, spec.sfx_a), i32(B, spec.sfx_a),
+            i32(B, spec.sfx_b), i32(B, spec.sfx_b),
+            i32(B), i32(B), i32(len(digit_ids)), f32(len(digit_vals)))
+    V = engine.cfg.vocab_size
+    kwargs = dict(
+        stop_mask_a=(i32(V) if spec.stops_armed else None),
+        stop_mask_b=(i32(V) if spec.stops_armed else None),
+        eos_id=(i32() if spec.stops_armed else None),
+    )
+    statics = dict(max_new_a=spec.new_tokens, max_new_b=spec.conf_tokens,
+                   trunk_len=spec.trunk, topk=TOPK,
+                   int8_qk=spec.cascade_int8, return_cache=True)
+    return args, kwargs, statics
+
+
 def _avals_grouped_paged(engine, spec: ShapeSpec):
     import jax
     import jax.numpy as jnp
@@ -521,6 +649,12 @@ def _lower_compile(engine, spec: ShapeSpec):
         fn = (generate.greedy_decode_fused_shared_spec if spec.spec_k
               else generate.greedy_decode_fused_shared)
         args, kwargs, statics = _avals_shared(engine, spec)
+    elif spec.kind == "shared_cascade":
+        fn = generate.greedy_decode_fused_shared_cascade
+        args, kwargs, statics = _avals_shared_cascade(engine, spec)
+    elif spec.kind == "shared_cascade_paged":
+        fn = generate.greedy_decode_fused_shared_cascade_paged
+        args, kwargs, statics = _avals_shared_cascade_paged(engine, spec)
     elif spec.kind == "shared_paged":
         fn = (generate.greedy_decode_fused_shared_paged_spec
               if spec.spec_k else generate.greedy_decode_fused_shared_paged)

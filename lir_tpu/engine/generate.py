@@ -422,6 +422,150 @@ def greedy_decode_fused_shared_paged(params, cfg: ModelConfig, pool,
     return out_a, out_b
 
 
+def _cascade_branches(params, cfg: ModelConfig, tcache, trunk_len: int,
+                      prefix, prefix_mask, sfx_a, sfx_a_mask, sfx_b,
+                      sfx_b_mask, yes_ids, no_ids, digit_ids, digit_vals,
+                      max_new_a: int, max_new_b: int, topk: int,
+                      int8_qk: bool, stop_mask_b, stop_mask_a, eos_id,
+                      return_cache: bool):
+    """Shared tail of the cold/paged cascade variants: cascade-extend the
+    per-row remainders over the (L, K, trunk_len, 1, hd) trunk cache,
+    then run the two format branches as the dense shared path's OWN code
+    at its own shapes — which is what makes the cascade argmax-identical
+    to :func:`greedy_decode_fused_shared` (the PR-7 parity bar, pinned
+    by tests/test_cascade.py) and lets cold/warm cascade dispatches share
+    the dense path's donated cache buffer (same cache aval)."""
+    B, S = prefix.shape
+    S2a, S2b = sfx_a.shape[1], sfx_b.shape[1]
+    T0 = S + max(S2a + max_new_a, S2b + max_new_b)
+    # Static trunk split: slots [0, trunk_len) are the shared trunk
+    # (right-padded canonical layout — slot == position), the remainder
+    # is everything after, per row.
+    rem = prefix[:, trunk_len:]
+    rem_mask = prefix_mask[:, trunk_len:]
+    cache = decoder.cascade_extend(params, cfg, tcache, rem, rem_mask,
+                                   trunk_len, T0, int8_qk=int8_qk)
+
+    empty_ids = jnp.zeros((0,), jnp.int32)
+    empty_vals = jnp.zeros((0,), jnp.float32)
+
+    def branch(cache_in, sfx, sfx_mask, new_tokens, d_ids, d_vals,
+               stop_mask=None):
+        S2 = sfx.shape[1]
+        cm = jnp.concatenate(
+            [prefix_mask, sfx_mask,
+             jnp.zeros((B, T0 - S - S2), prefix_mask.dtype)], axis=1)
+        logits_l, cache2, pos = decoder.extend(
+            params, cfg, cache_in, sfx, sfx_mask, cm, S)
+        return _fused_tail(params, cfg, logits_l, cache2, cm, pos, S + S2,
+                           yes_ids, no_ids, d_ids, d_vals, new_tokens, topk,
+                           stop_mask=stop_mask, eos_id=eos_id)
+
+    out_a, cache_a = branch(cache, sfx_a, sfx_a_mask, max_new_a,
+                            empty_ids, empty_vals, stop_mask=stop_mask_a)
+    out_b, cache_b = branch(cache_a, sfx_b, sfx_b_mask, max_new_b,
+                            digit_ids, digit_vals, stop_mask=stop_mask_b)
+    if return_cache:
+        return out_a, out_b, cache_b
+    return out_a, out_b
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "trunk_len", "max_new_a",
+                                    "max_new_b", "topk", "int8_qk",
+                                    "return_cache"),
+                   donate_argnames=("scratch_cache",))
+def greedy_decode_fused_shared_cascade(params, cfg: ModelConfig,
+                                       prefix: jax.Array,
+                                       prefix_mask: jax.Array,
+                                       sfx_a: jax.Array, sfx_a_mask: jax.Array,
+                                       sfx_b: jax.Array, sfx_b_mask: jax.Array,
+                                       yes_ids: jax.Array, no_ids: jax.Array,
+                                       digit_ids: jax.Array,
+                                       digit_vals: jax.Array,
+                                       max_new_a: int, max_new_b: int,
+                                       trunk_len: int, topk: int = 20,
+                                       int8_qk: bool = False,
+                                       stop_mask_b: jax.Array = None,
+                                       stop_mask_a: jax.Array = None,
+                                       eos_id: jax.Array = None,
+                                       return_cache: bool = False,
+                                       scratch_cache=None):
+    """:func:`greedy_decode_fused_shared` with the SHARED-TRUNK prefill
+    decomposed (ROADMAP item 1 / ops/cascade_prefill): every row of the
+    dispatch shares its first ``trunk_len`` tokens verbatim (the engine's
+    LCP gate, runner.decode_fused_shared), so the quadratic trunk prefill
+    runs ONCE at batch 1 instead of once per row, the per-row remainders
+    extend over it via cascade attention (prefix leg = one dense GEMM per
+    kv head against the shared trunk KV, suffix leg = causal window,
+    log-sum-exp merge), and the two format branches are the dense path's
+    own code. The dense path recomputes B x trunk_len^2 trunk attention;
+    this pays 1 x — the whole point of the cascade."""
+    del scratch_cache  # donated scratch: memory reuse only, never read
+    # Trunk prefill at batch 1, EXACT trunk extent: row 0's first
+    # trunk_len tokens are byte-identical to every other row's (LCP), all
+    # real (trunk <= every row's real length), so mask is all-ones and
+    # slot t is position t — the layout cascade_extend assumes and the
+    # same layout the radix page pool stores, which is what makes the
+    # paged-warm trunk bitwise-identical to this cold one.
+    ones = jnp.ones((1, trunk_len), prefix_mask.dtype)
+    _, tcache, _ = decoder.prefill(params, cfg, prefix[:1, :trunk_len],
+                                   ones, trunk_len)
+    return _cascade_branches(params, cfg, tcache, trunk_len, prefix,
+                             prefix_mask, sfx_a, sfx_a_mask, sfx_b,
+                             sfx_b_mask, yes_ids, no_ids, digit_ids,
+                             digit_vals, max_new_a, max_new_b, topk, int8_qk,
+                             stop_mask_b, stop_mask_a, eos_id, return_cache)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "trunk_len", "max_new_a",
+                                    "max_new_b", "topk", "int8_qk",
+                                    "return_cache"),
+                   donate_argnames=("scratch_cache",))
+def greedy_decode_fused_shared_cascade_paged(params, cfg: ModelConfig, pool,
+                                             slot_src: jax.Array,
+                                             win_start: jax.Array,
+                                             trunk_mask: jax.Array,
+                                             trunk_rem: jax.Array,
+                                             trunk_rem_mask: jax.Array,
+                                             prefix: jax.Array,
+                                             prefix_mask: jax.Array,
+                                             sfx_a: jax.Array,
+                                             sfx_a_mask: jax.Array,
+                                             sfx_b: jax.Array,
+                                             sfx_b_mask: jax.Array,
+                                             yes_ids: jax.Array,
+                                             no_ids: jax.Array,
+                                             digit_ids: jax.Array,
+                                             digit_vals: jax.Array,
+                                             max_new_a: int, max_new_b: int,
+                                             trunk_len: int, topk: int = 20,
+                                             int8_qk: bool = False,
+                                             stop_mask_b: jax.Array = None,
+                                             stop_mask_a: jax.Array = None,
+                                             eos_id: jax.Array = None,
+                                             return_cache: bool = False,
+                                             scratch_cache=None):
+    """:func:`greedy_decode_fused_shared_cascade` with the TRUNK resumed
+    from the cross-request radix prefix cache: the batch-1 trunk prefill
+    becomes a page-pool slot gather plus one recompute-window extension
+    (:func:`_paged_prefix` at one row, ``total_len == trunk_len`` so no
+    tail pad) — a warm trunk costs ZERO quadratic recompute, the cascade's
+    headline win. The paged trunk cache is BITWISE the cold trunk prefill
+    (same exact-layout discipline tests/test_prefix_cache.py pins for the
+    shared path), so everything from cascade_extend on — and therefore
+    every output — is bitwise the cold cascade's."""
+    del scratch_cache  # donated scratch: memory reuse only, never read
+    tcache = _paged_prefix(params, cfg, pool, slot_src, win_start,
+                           trunk_mask, trunk_rem, trunk_rem_mask, trunk_len)
+    return _cascade_branches(params, cfg, tcache, trunk_len, prefix,
+                             prefix_mask, sfx_a, sfx_a_mask, sfx_b,
+                             sfx_b_mask, yes_ids, no_ids, digit_ids,
+                             digit_vals, max_new_a, max_new_b, topk, int8_qk,
+                             stop_mask_b, stop_mask_a, eos_id, return_cache)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_new", "topk", "return_cache"),
                    donate_argnames=("scratch_cache",))

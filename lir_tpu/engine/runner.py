@@ -22,11 +22,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import GovernorConfig, RuntimeConfig, SpecConfig
+from ..config import CascadeConfig, GovernorConfig, RuntimeConfig, SpecConfig
 from ..guard.watchdog import DispatchWatchdog
 from ..models import decoder, paged, quant
-from ..utils.profiling import (CompileStats, FaultStats, GuardStats,
-                               KernelStats, PrefixCacheStats, SpecStats)
+from ..utils.profiling import (CascadeStats, CompileStats, FaultStats,
+                               GuardStats, KernelStats, PrefixCacheStats,
+                               SpecStats, cascade_prefill_flops_saved)
 from . import (compile_plan, generate, hbm, prefix_tree,
                scheduler as scheduler_mod, score, spec as spec_mod,
                tokens as tok)
@@ -122,7 +123,8 @@ class ScoringEngine:
                  seq_mesh: Any = None, seq_impl: str = "ring",
                  spec_config: Optional[SpecConfig] = None,
                  governor: Optional["hbm.HbmGovernor"] = None,
-                 governor_config: Optional[GovernorConfig] = None):
+                 governor_config: Optional[GovernorConfig] = None,
+                 cascade_config: Optional[CascadeConfig] = None):
         self.params = params
         self.cfg = cfg
         self.tokenizer = tokenizer
@@ -134,6 +136,10 @@ class ScoringEngine:
         # (faults/plan.wrap_engine).
         self.spec_cfg = spec_config or SpecConfig()
         self.spec_stats = SpecStats()
+        # Shared-prefix cascade prefill (ops/cascade_prefill): eligibility
+        # policy + the dedup counters bench.py's "cascade" key reads.
+        self.cascade_cfg = cascade_config or CascadeConfig()
+        self.cascade_stats = CascadeStats()
         self._spec_draft = None
         self._spec_pending: List[Any] = []
         self.spec_fault_plan = None
@@ -276,11 +282,16 @@ class ScoringEngine:
         # (a zero-accept dispatch degenerating to sequential cost must
         # never trip a spec-calibrated deadline — scheduler.
         # watchdog_seed_headroom).
+        # A cascade engine additionally multiplies in the cascade/dense
+        # prefill spread: deadlines calibrate on trunk-discounted
+        # dispatches, and an ineligible dispatch legitimately falls back
+        # to the full dense prefill.
         self.watchdog = DispatchWatchdog(
             multiple=self.rt.watchdog_multiple,
             floor_s=self.rt.watchdog_floor_s, stats=self.guard_stats,
             seed_headroom=scheduler_mod.watchdog_seed_headroom(
-                self.rt.spec_decode and self.rt.spec_k >= 2))
+                self.rt.spec_decode and self.rt.spec_k >= 2,
+                cascade=self.cascade_supported()))
         self._seq_mesh_note = (
             None if seq_mesh is None
             else (repr(getattr(seq_mesh, "shape", seq_mesh)), seq_impl))
@@ -427,6 +438,56 @@ class ScoringEngine:
         """Fold pending device-side SpecOut counters into spec_stats
         (deferred off the dispatch path — spec.flush_pending)."""
         spec_mod.flush_pending(self)
+
+    # -- shared-prefix cascade prefill (ops/cascade_prefill) ----------------
+
+    def cascade_supported(self) -> bool:
+        """Engine-level gate for cascade prefill: on by config, plain
+        decoder engines only (T5 and seq-parallel prefills keep their
+        own paths), float KV cache only (the cascade extension writes
+        float k/v into the broadcast trunk cache — int8 KV engines keep
+        the dense path), and only where the prefix-leg Pallas kernel
+        runs: the TPU backend, or CPU under the interpreter when
+        decoder.CASCADE_INTERPRET_ON_CPU is armed (tier-1 and the
+        cascade smoke; production CPU stays dense). Per-dispatch
+        eligibility (trunk length, row count) is
+        :meth:`cascade_trunk_for`'s."""
+        if not (self.rt.cascade_prefill and not self.encoder_decoder
+                and self._prefill_fn is None
+                and not getattr(self.cfg, "kv_cache_int8", False)):
+            return False
+        return (jax.default_backend() == "tpu"
+                or decoder.CASCADE_INTERPRET_ON_CPU)
+
+    def cascade_trunk_for(self, prefix_ids: Sequence[Sequence[int]],
+                          n_real: Optional[int] = None,
+                          bucket: Optional[int] = None) -> int:
+        """The dispatch's shared-trunk extent, or 0 when the dispatch
+        should run dense: the longest common token prefix across EVERY
+        row's shared prefix (pad rows repeat a real row, so the
+        all-rows LCP equals the real-rows LCP — and the broadcast-trunk
+        cache layout requires the trunk to lead every batch row),
+        snapped DOWN to the CascadeConfig.trunk_quantum grid (the trunk
+        extent is a static compiled shape — compile_plan keys
+        executables on it — so a few unshared tail tokens ride the
+        per-row remainder instead of minting a new executable), floored
+        at min_trunk, and kept strictly inside the bucket (a
+        trunk == bucket dispatch would leave a zero-width remainder).
+        ``n_real`` gates the min_rows dedup check — padding repeats
+        dedup for free but buy nothing."""
+        if not self.cascade_supported() or not prefix_ids:
+            return 0
+        cc = self.cascade_cfg
+        rows_real = len(prefix_ids) if n_real is None else n_real
+        if rows_real < max(cc.min_rows, 2):
+            return 0
+        q = max(int(cc.trunk_quantum), 1)
+        trunk = (tok.common_prefix_len(prefix_ids) // q) * q
+        if bucket is not None and trunk >= bucket:
+            trunk = ((bucket - 1) // q) * q
+        if trunk < max(int(cc.min_trunk), q):
+            return 0
+        return trunk
 
     def _cache_aval(self):
         """ShapeDtypeStruct tree of this engine's decode cache (leaf
@@ -886,8 +947,27 @@ class ScoringEngine:
             eos_id=(None if stop_mask is None
                     else jnp.int32(self.eos_id)))
         if reuse_cache:
+            prefix_rows = [a[:n] for a, n in zip(bin_ids, lcp)]
+            # Shared-prefix cascade prefill: an eligible dispatch takes
+            # precedence over speculation AND piggybacking (both
+            # optimize around the very prefill the cascade removes —
+            # the sweep excludes cascade-eligible dispatches from piggy
+            # chains for the same reason). Ineligible-while-enabled
+            # counts a dense fallback; the dense path runs verbatim.
+            trunk = self.cascade_trunk_for(prefix_rows, n_real, bucket)
+            if trunk:
+                return self._dispatch_shared_cascade(
+                    trunk, bucket, prefix_rows[0][:trunk], prefix,
+                    prefix_mask, sfx_a, sfx_a_mask, sfx_b, sfx_b_mask,
+                    yes_ids, no_ids, digit_ids, digit_vals, new_tokens,
+                    conf_tokens, ba, bb, early_stop,
+                    {k: kwargs[k] for k in
+                     ("stop_mask_a", "stop_mask_b", "eos_id")},
+                    use_prefix_cache, n_real)
+            if self.cascade_supported():
+                self.cascade_stats.count("dense_fallbacks")
             plan = self._prefix_plan_or_none(
-                bucket, [a[:n] for a, n in zip(bin_ids, lcp)], n_real,
+                bucket, prefix_rows, n_real,
                 len(bin_ids), use_prefix_cache)
             # Speculative decode (engine/spec.py): draft each branch's
             # continuation and verify the window in one multi-query
@@ -1087,6 +1167,116 @@ class ScoringEngine:
                     return_cache=True, scratch_cache=scratch,
                     **stop_kwargs)
         return out
+
+    def _dispatch_shared_cascade(self, trunk: int, bucket: int,
+                                 trunk_ids: Sequence[int], prefix,
+                                 prefix_mask, sfx_a, sfx_a_mask, sfx_b,
+                                 sfx_b_mask, yes_ids, no_ids, digit_ids,
+                                 digit_vals, new_tokens: int,
+                                 conf_tokens: int, ba: int, bb: int,
+                                 early_stop: bool, stop_kwargs: dict,
+                                 use_prefix_cache, n_real: Optional[int]):
+        """One CASCADE shared dispatch (registry executable when planned,
+        lazy jit otherwise): the batch-1 trunk prefill — cold, or resumed
+        warm from the radix page pool — then the per-row cascade
+        remainder extension and both branches' fused tails
+        (generate.greedy_decode_fused_shared_cascade[_paged]).
+
+        The warm trunk lives in the TRUNK-extent radix namespace (pages
+        are bitwise-reproducible only within one attention extent —
+        prefix_tree's per-bucket rule — and the cascade trunk prefills
+        at extent ``trunk``, not ``bucket``): a one-row plan over the
+        trunk ids, whose pages the cold dispatch inserts from cache
+        row 0's broadcast trunk slots, so the SECOND dispatch sharing a
+        trunk gathers it at zero recompute. The cascade cache aval
+        equals the dense shared path's, so both share one donation-chain
+        key — the handoff runs unbroken across cascade and dense
+        dispatches of a bucket queue."""
+        B = len(prefix_mask)
+        plan = self._prefix_plan_or_none(trunk, [list(trunk_ids)], 1, 1,
+                                         use_prefix_cache)
+        paged_warm = plan is not None and plan.window is not None
+        key = ("shared", bucket, B, ba, bb, new_tokens, conf_tokens,
+               early_stop, None)
+        scratch = self._handoff.take(key)
+        armed = stop_kwargs.get("eos_id") is not None
+        int8 = bool(self.cascade_cfg.int8_qk)
+        statics = dict(max_new_a=new_tokens, max_new_b=conf_tokens,
+                       trunk_len=trunk, int8_qk=int8, return_cache=True)
+        try:
+            if paged_warm:
+                trunk_mask = np.ones((1, trunk), np.int32)
+                dyn_args = (self.params, self.prefix_cache.pool.leaves,
+                            jnp.asarray(plan.slot_src), jnp.int32(plan.w0),
+                            jnp.asarray(trunk_mask),
+                            jnp.asarray(plan.rem),
+                            jnp.asarray(plan.rem_mask),
+                            jnp.asarray(prefix), jnp.asarray(prefix_mask),
+                            jnp.asarray(sfx_a), jnp.asarray(sfx_a_mask),
+                            jnp.asarray(sfx_b), jnp.asarray(sfx_b_mask),
+                            jnp.asarray(yes_ids, jnp.int32),
+                            jnp.asarray(no_ids, jnp.int32),
+                            jnp.asarray(digit_ids),
+                            jnp.asarray(digit_vals))
+                exe = None
+                if self.exec_registry is not None:
+                    exe = self.exec_registry.get(
+                        compile_plan.shared_cascade_paged_spec(
+                            bucket, B, trunk, plan.window, ba, bb,
+                            new_tokens, conf_tokens, stops_armed=armed,
+                            scratch=scratch is not None, int8_qk=int8))
+                if exe is not None:
+                    fused, cfused, cache = compile_plan.registry_call(
+                        exe, dyn_args, stop_kwargs, scratch)
+                else:
+                    fused, cfused, cache = (
+                        generate.greedy_decode_fused_shared_cascade_paged(
+                            dyn_args[0], self.cfg, *dyn_args[1:],
+                            scratch_cache=scratch, **stop_kwargs,
+                            **statics))
+            else:
+                dyn_args = (self.params, jnp.asarray(prefix),
+                            jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
+                            jnp.asarray(sfx_a_mask), jnp.asarray(sfx_b),
+                            jnp.asarray(sfx_b_mask),
+                            jnp.asarray(yes_ids, jnp.int32),
+                            jnp.asarray(no_ids, jnp.int32),
+                            jnp.asarray(digit_ids),
+                            jnp.asarray(digit_vals))
+                exe = None
+                if self.exec_registry is not None:
+                    exe = self.exec_registry.get(
+                        compile_plan.shared_cascade_spec(
+                            bucket, B, trunk, ba, bb, new_tokens,
+                            conf_tokens, stops_armed=armed,
+                            scratch=scratch is not None, int8_qk=int8))
+                if exe is not None:
+                    fused, cfused, cache = compile_plan.registry_call(
+                        exe, dyn_args, stop_kwargs, scratch)
+                else:
+                    fused, cfused, cache = (
+                        generate.greedy_decode_fused_shared_cascade(
+                            dyn_args[0], self.cfg, *dyn_args[1:],
+                            scratch_cache=scratch, **stop_kwargs,
+                            **statics))
+        except BaseException:
+            if plan is not None:
+                self._abort_prefix_resume(plan)
+            raise
+        self._handoff.put(key, cache)
+        self._note_handoff(cache)
+        if plan is not None:
+            # Cache row 0's trunk slots hold the broadcast trunk KV —
+            # exactly the batch-1 trunk prefill's values — so the
+            # standard insert path pages them into the trunk namespace.
+            self._finish_prefix_resume(plan, cache)
+        rows = B if n_real is None else n_real
+        self.cascade_stats.count("cascade_dispatches")
+        self.cascade_stats.count("trunk_rows_deduped", max(rows - 1, 0))
+        self.cascade_stats.count(
+            "prefix_flops_saved",
+            int(cascade_prefill_flops_saved(self.cfg, rows, trunk)))
+        return fused, cfused
 
     # -- chunked prefill/decode piggybacking --------------------------------
 

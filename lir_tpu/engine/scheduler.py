@@ -82,7 +82,18 @@ def decode_token_cost(fused_decode: bool = True,
             else DECODE_TOKEN_COST_UNFUSED)
 
 
-def watchdog_seed_headroom(spec_decode: bool = False) -> float:
+# Cascade-prefill watchdog spread (watchdog_seed_headroom): a cascade
+# engine's deadlines calibrate on cascade-discounted dispatches, but an
+# ineligible dispatch (short LCP, too few rows) legitimately runs the
+# FULL dense prefill — up to the whole trunk re-paid per row. 2.0 covers
+# the worst eligible-vs-fallback prefill ratio the eligibility gates
+# admit (trunk < bucket, so the dense prefill is at most ~2x the
+# cascade-discounted price the deadline was calibrated on).
+CASCADE_PREFILL_SPREAD = 2.0
+
+
+def watchdog_seed_headroom(spec_decode: bool = False,
+                           cascade: bool = False) -> float:
     """EWMA seed headroom for the dispatch watchdog (guard/watchdog.py):
     the spread between the decode pricing a deadline is calibrated on
     and the most expensive mode a dispatch may legitimately fall back
@@ -95,10 +106,19 @@ def watchdog_seed_headroom(spec_decode: bool = False) -> float:
     degenerates to the sequential scan — possibly on the dense
     fallback path — must never trip a spec-calibrated deadline.
     Non-spec engines keep the original fused/unfused spread (their
-    deadlines owe speculation nothing)."""
-    if spec_decode:
-        return DECODE_TOKEN_COST_UNFUSED / DECODE_TOKEN_COST_SPEC
-    return DECODE_TOKEN_COST_UNFUSED / DECODE_TOKEN_COST_FUSED
+    deadlines owe speculation nothing). A CASCADE engine
+    (``cascade``) additionally multiplies in the cascade/dense
+    PREFILL spread (CASCADE_PREFILL_SPREAD): its deadlines calibrate
+    on trunk-discounted dispatches, and an ineligible dispatch that
+    falls back to the full dense prefill must never trip a
+    cascade-calibrated deadline. The spreads compose — a spec+cascade
+    engine can hit both fallbacks on one dispatch."""
+    seed = (DECODE_TOKEN_COST_UNFUSED / DECODE_TOKEN_COST_SPEC
+            if spec_decode
+            else DECODE_TOKEN_COST_UNFUSED / DECODE_TOKEN_COST_FUSED)
+    if cascade:
+        seed *= CASCADE_PREFILL_SPREAD
+    return seed
 
 
 def _tail_batch(n: int, cap: int) -> int:
@@ -125,7 +145,9 @@ def decode_floor(n_rows: int, batch_size: int, decode_cost: int,
 def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
                 decode_cost: int, cached_tokens: int = 0,
                 fused_decode: bool = True,
-                spec_decode: bool = False) -> float:
+                spec_decode: bool = False,
+                cascade: bool = False,
+                trunk_tokens: int = 0) -> float:
     """Row-token cost of dispatching ``n_rows`` cells at ``bucket_edge``:
     a padded power-of-two batch prefilled at the edge, plus the fixed
     decode floor (:func:`decode_floor` — the steps run whether the slots
@@ -144,9 +166,20 @@ def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
     FREE prefill: a paged dispatch gathers them from the page pool
     instead of recomputing, so they come off the prefill term. The
     decode scan is the floor: cached prefill can never make a dispatch
-    cheaper than its decode steps."""
+    cheaper than its decode steps.
+
+    ``cascade``/``trunk_tokens`` price the shared-trunk cascade
+    discount (ops/cascade_prefill): a cascade dispatch prefills its
+    ``trunk_tokens``-token trunk ONCE instead of once per slot, so
+    ``(slots - 1) * trunk_tokens`` comes off the prefill term — on top
+    of any radix-cached tokens (a warm trunk discounts through
+    ``cached_tokens`` too; the max(0) clamp keeps double-counting from
+    going negative). Defaults price the dense path byte-identically."""
     slots = _tail_batch(n_rows, batch_size)
-    prefill = max(slots * bucket_edge - int(cached_tokens), 0)
+    prefill = slots * bucket_edge - int(cached_tokens)
+    if cascade and trunk_tokens > 0:
+        prefill -= (slots - 1) * int(trunk_tokens)
+    prefill = max(prefill, 0)
     return prefill + decode_floor(n_rows, batch_size, decode_cost,
                                   fused_decode, spec_decode)
 

@@ -660,7 +660,16 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 spec_k=(engine.rt.spec_k
                         if engine.spec_supported() else 0),
                 spec_draft=getattr(engine, "_spec_draft", None)
-                is not None)
+                is not None,
+                cascade_trunk=(
+                    (lambda d: engine.cascade_trunk_for(
+                        [it.bin_ids[:it.lcp] for it in d.items],
+                        len(d.items), d.bucket))
+                    if getattr(engine, "cascade_supported",
+                               lambda: False)() else None),
+                cascade_int8=bool(
+                    getattr(engine, "cascade_cfg", None) is not None
+                    and engine.cascade_cfg.int8_qk))
             engine.exec_registry = compile_plan.precompile_async(
                 engine, specs, max_workers=engine.rt.precompile_workers)
             log.info("compile plan: precompiling %d executable shapes "
@@ -883,15 +892,29 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
     # widened seed headroom covers a zero-accept dispatch degenerating
     # to sequential cost.
     spec_on = getattr(engine, "spec_supported", lambda: False)()
+    # Cascade-eligible dispatches take the shared-prefix path inside
+    # decode_fused_shared (runner._dispatch_shared_cascade) — they never
+    # ride the piggyback chain (the cascade prefill has no parked-decode
+    # carry slot), mirroring compile_plan's `piggyback and not trunk`
+    # spec planning. Their trunk length also discounts the watchdog
+    # prefill price below.
+    cascade_on = getattr(engine, "cascade_supported", lambda: False)()
+    cascade_trunks = []
     piggy_keys = []
     if ragged:
         for d in dispatches:
             if d.kind == "shared":
                 n = len(d.items)
+                trunk = (engine.cascade_trunk_for(
+                    [it.bin_ids[:it.lcp] for it in d.items], n, d.bucket)
+                    if cascade_on else 0)
+                cascade_trunks.append(trunk)
                 piggy_keys.append(
+                    None if trunk else
                     (d.bucket, B if n == B else _tail_batch(n, B),
                      d.sfx_bucket_a, d.sfx_bucket_b))
             else:
+                cascade_trunks.append(0)
                 piggy_keys.append(None)
     pending: List[Optional[dict]] = [None]   # the parked dispatch's meta
 
@@ -933,7 +956,9 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                 cost=sched_mod.bucket_cost(
                     meta["n"], meta["bucket"], B,
                     new_tokens + conf_tokens, fused_decode=fused_dec,
-                    spec_decode=spec_on))
+                    spec_decode=spec_on,
+                    cascade=meta.get("trunk", 0) > 0,
+                    trunk_tokens=meta.get("trunk", 0)))
         _emit(meta, fused, cfused)
 
     def _redispatch_pending():
@@ -981,10 +1006,13 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                      for it in full_items], np.int32)
                 meta = dict(batch=batch, full_items=full_items, t1=t1,
                             t2=t2, bucket=d.bucket, n=n, key=piggy_keys[i],
-                            sfx_ab=(d.sfx_bucket_a, d.sfx_bucket_b))
+                            sfx_ab=(d.sfx_bucket_a, d.sfx_bucket_b),
+                            trunk=cascade_trunks[i])
                 # Chain iff the parked dispatch shares this shape, or this
                 # dispatch opens a run the NEXT dispatch will ride.
-                chainable = use_piggy and (
+                # Cascade-eligible dispatches carry a None key — two of
+                # them must not chain through the None == None trap.
+                chainable = use_piggy and piggy_keys[i] is not None and (
                     (pending[0] is not None
                      and pending[0]["key"] == piggy_keys[i])
                     or (pending[0] is None and i + 1 < len(dispatches)

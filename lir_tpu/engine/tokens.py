@@ -273,6 +273,25 @@ def shared_prefix_len(a: Sequence[int], b: Sequence[int]) -> int:
     return max(n, 0)
 
 
+def common_prefix_len(rows: Sequence[Sequence[int]]) -> int:
+    """Longest common token prefix across ALL rows — the shared-trunk
+    extent of a dispatch (runner.cascade_trunk_for snaps it to the
+    trunk-quantum grid). Unlike :func:`shared_prefix_len` there is no
+    keep-a-suffix cap: a row whose whole prefix IS the trunk simply
+    contributes zero remainder tokens to the cascade extension (its
+    remainder slots are masked, the standard pad-slot discipline)."""
+    if not rows:
+        return 0
+    n = min(len(r) for r in rows)
+    first = rows[0]
+    for i in range(n):
+        t = first[i]
+        for r in rows[1:]:
+            if r[i] != t:
+                return i
+    return n
+
+
 def pick_bucket(lengths: Sequence[int], buckets: Sequence[int]) -> int:
     """Smallest bucket that fits the longest prompt (static-shape discipline:
     one compile per bucket instead of one per length)."""

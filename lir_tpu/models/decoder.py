@@ -47,6 +47,13 @@ FLASH_INTERPRET_ON_CPU = False
 # (cfg.fused_decode, default on; RuntimeConfig.fused_decode opts out).
 FUSED_DECODE_INTERPRET_ON_CPU = False
 
+# Same hook for the shared-prefix cascade-prefill kernel
+# (ops/cascade_prefill): tier-1 and the cascade smoke run the prefix-leg
+# Pallas kernel under the interpreter on CPU; production CPU dispatches
+# stay on the dense shared path (the engine's cascade routing checks this
+# hook, runner.ScoringEngine.cascade_supported).
+CASCADE_INTERPRET_ON_CPU = False
+
 
 # ---------------------------------------------------------------------------
 # Param init (random weights for tests; real weights come from models/loader.py)
@@ -324,6 +331,33 @@ def _attention_cached_flash_mq(q: jax.Array, k: jax.Array, v: jax.Array,
                           key_positions=key_positions, alibi_slopes=slopes,
                           interpret=interpret)
     return out.reshape(B, S, H * hd)
+
+
+def _attention_cascade(q: jax.Array, k: jax.Array, v: jax.Array,
+                       trunk_kv: Tuple[jax.Array, jax.Array],
+                       suffix_mask: jax.Array, q_positions: jax.Array,
+                       cfg: ModelConfig, int8_qk: bool) -> jax.Array:
+    """Cascade-aware sibling of :func:`_attention_cached` for the
+    shared-trunk PREFILL window (ops/cascade_prefill): the dispatch's
+    remainder queries split into a prefix leg over the single-row shared
+    trunk KV (one inter-query-batched dense matmul per kv head, int8
+    QK^T optional) and a per-row causal suffix leg over the window's own
+    k/v, merged by the flash split-K log-sum-exp rule (ops/lse). Same
+    grouped GQA contraction against un-repeated k/v, same ALiBi
+    key-position convention as every other attention route here. q:
+    (B, R, H, hd); k/v: (B, R, K, hd) post-RoPE window k/v; trunk_kv:
+    (K, Tt, hd) pair."""
+    from ..ops.cascade_prefill import cascade_attention
+
+    B, R, H, hd = q.shape
+    interpret = jax.default_backend() != "tpu"
+    slopes = (alibi_slopes(cfg.n_heads) if cfg.pos_embedding == "alibi"
+              else None)
+    tk, tv = trunk_kv
+    out = cascade_attention(q, k, v, tk, tv, suffix_mask, q_positions,
+                            alibi_slopes=slopes, int8_qk=int8_qk,
+                            interpret=interpret)
+    return out.reshape(B, R, H * hd)
 
 
 def _attention_cached(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -664,6 +698,68 @@ def extend(params: Params, cfg: ModelConfig, cache, suffix_tokens: jax.Array,
     logits = _unembed(params, cfg, x_last)[:, 0, :]
     next_positions = jnp.take_along_axis(qpos, last[:, None], axis=1)[:, 0] + 1
     return logits, new_cache, next_positions
+
+
+def cascade_extend(params: Params, cfg: ModelConfig, trunk_cache,
+                   rem_tokens: jax.Array, rem_mask: jax.Array,
+                   trunk_len: int, total_len: int, int8_qk: bool = False):
+    """Shared-trunk cascade prefill: build a B-row cache from ONE trunk.
+
+    The dense shared path (:func:`prefill` in generate.greedy_decode_
+    fused_shared) recomputes the trunk's quadratic attention once per
+    row even when every row shares it. Here the trunk KV is computed (or
+    page-pool-gathered) ONCE at batch 1 — ``trunk_cache`` is a
+    (L, K, Tt, 1, hd) pair, every slot real, slot == position — and only
+    each row's remainder ``rem_tokens``/``rem_mask`` (B, R),
+    RIGHT-padded (slot trunk_len + r == position, the canonical layout),
+    runs through the layers, attending via the cascade split
+    (:func:`_attention_cascade`): prefix leg against this layer's trunk
+    KV + causal suffix leg over the window, merged exactly. The returned
+    cache broadcasts the trunk KV across rows at slots [0, trunk_len),
+    writes the remainder k/v at [trunk_len, trunk_len + R), and
+    zero-pads to ``total_len`` — the drop-in analogue of ``prefill``'s
+    cache output for a shared-trunk dispatch (no logits: the shared
+    paths discard the prefill logits anyway and read branch logits from
+    the suffix extensions). Requires a non-int8 KV cache (the engine
+    gates routing, runner.cascade_supported).
+    """
+    assert not cfg.kv_cache_int8, "cascade prefill needs a float KV cache"
+    B, R = rem_tokens.shape
+    L, K, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    qpos = trunk_len + mask_positions(rem_mask)                  # (B, R)
+    x = _embed(params, cfg, rem_tokens, qpos)
+    sin = cos = None
+    if cfg.pos_embedding == "rotary":
+        sin, cos = _rope_sincos(qpos, cfg.rotary_dim, cfg.rope_theta)
+    tck, tcv = trunk_cache                                # (L, K, Tt, 1, hd)
+
+    def body(h, xs):
+        lp, (tk, tv) = xs
+
+        def impl(q, k, v, key_mask):
+            return _attention_cascade(q, k, v,
+                                      (tk[:, :, 0, :], tv[:, :, 0, :]),
+                                      rem_mask, qpos, cfg, int8_qk)
+
+        h, (k, v) = _block(h, lp, cfg, sin, cos, None, None, None,
+                           key_mask=rem_mask, attn_impl=impl)
+        return h, (k, v)
+
+    _, (rk, rv) = lax.scan(body, x, (params["layers"], (tck, tcv)))
+
+    # Assemble the B-row cache in the (L, K, T, B, hd) layout: the trunk
+    # side broadcasts across rows (identical KV by construction — the
+    # dedup the cascade exists for), the remainder transposes in, the
+    # tail zero-pads exactly as prefill pads.
+    pad = total_len - trunk_len - R
+
+    def side(trunk, win):
+        t = jnp.broadcast_to(trunk, (L, K, trunk_len, B, hd))
+        w = win.transpose(0, 3, 2, 1, 4).astype(trunk.dtype)  # (L,K,R,B,hd)
+        z = jnp.zeros((L, K, pad, B, hd), trunk.dtype)
+        return jnp.concatenate([t, w, z], axis=2)
+
+    return side(tck, rk), side(tcv, rv)
 
 
 def verify_extend(params: Params, cfg: ModelConfig, cache,

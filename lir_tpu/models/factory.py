@@ -105,6 +105,7 @@ def load_engine(
     kv_cache_int8: bool = False,
     spec_config=None,
     governor_config=None,
+    cascade_config=None,
 ) -> ScoringEngine:
     """Build a ready ScoringEngine from a local HF checkpoint directory.
 
@@ -198,6 +199,7 @@ def load_engine(
         params, cfg, tokenizer, runtime or RuntimeConfig(),
         encoder_decoder=encdec, seq_mesh=seq_mesh,
         spec_config=spec_config, governor_config=governor_config,
+        cascade_config=cascade_config,
     )
 
 
@@ -211,6 +213,7 @@ def engine_factory(
     kv_cache_int8: bool = False,
     spec_config=None,
     governor_config=None,
+    cascade_config=None,
 ):
     """EngineFactory for engine.multi: maps an HF repo id to
     ``checkpoint_root/<org>__<name>`` or ``checkpoint_root/<name>``."""
@@ -230,7 +233,8 @@ def engine_factory(
                                    int8_dynamic=int8_dynamic,
                                    kv_cache_int8=kv_cache_int8,
                                    spec_config=spec_config,
-                                   governor_config=governor_config)
+                                   governor_config=governor_config,
+                                   cascade_config=cascade_config)
         raise FileNotFoundError(
             f"no local checkpoint for {model_name} under {checkpoint_root} "
             f"(tried {[str(c) for c in candidates]})"

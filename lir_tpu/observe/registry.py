@@ -103,6 +103,10 @@ STATS_SCHEMA: Dict[str, Tuple[str, ...]] = {
         "seq_forwards", "dispatches_saved", "spec_dispatches",
         "spec_rows", "fallbacks",
     ),
+    "CascadeStats": (
+        "cascade_dispatches", "dense_fallbacks", "trunk_rows_deduped",
+        "prefix_flops_saved",
+    ),
     "MemStats": (
         "ledger_bytes", "budget_bytes", "pressure", "rung",
         "rung_downs", "rung_ups", "admits", "denials", "oom_events",
@@ -261,6 +265,8 @@ def engine_registry(engine, sink=None,
         reg.register("occupancy", engine.occupancy)
     if getattr(engine, "spec_stats", None) is not None:
         reg.register("spec", engine.spec_stats)
+    if getattr(engine, "cascade_stats", None) is not None:
+        reg.register("cascade", engine.cascade_stats)
     if getattr(engine, "governor", None) is not None:
         # HBM-governor gauges (engine/hbm.py): ledger/pressure/rung
         # land in the snapshot next to device_memory_stats(), so budget

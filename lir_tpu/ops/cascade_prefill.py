@@ -1,0 +1,230 @@
+"""Shared-prefix cascade attention for the prefill phase (ROADMAP item 1).
+
+The 36% MFU plateau of the isolated scoring step (BENCH_r02-r05) is a
+PREFILL problem as much as a decode one: the paper's axis-1 workload asks
+thousands of rephrasings of ~5 long legal-prompt trunks, so every
+shared-trunk dispatch recomputes trunk attention once PER ROW even though
+each row's queries see byte-identical trunk KV. This module is the
+Hydragen-style decomposition (Juravsky et al.): attention over a
+dispatch's cache splits into
+
+- a PREFIX leg — every (row, position, head) query attends the ONE
+  shared trunk KV block. Because the trunk KV carries no batch axis, the
+  whole dispatch's queries flatten into a single (N, hd) x (hd, Tt)
+  dense matmul per kv head (inter-query batching): one MXU-saturating
+  GEMM instead of B batched thin ones, and a warm trunk gathered from
+  the radix page pool costs zero recompute;
+- a per-row SUFFIX leg — each rephrasing's tail attends its own
+  remainder KV with ordinary causal masking;
+
+merged by the same log-sum-exp combination the Flash-Decoding split-K
+kernel uses (ops/lse.merge_partials — lifted out of flash_decode's
+inline combines so all three fused paths share one reduction). The split
+is exact: trunk keys all precede every suffix query, so the prefix leg
+needs neither mask nor causality, and the merge reproduces softmax over
+the full key axis bitwise-stably (parity vs the dense path is pinned at
+every ladder extent by tests/test_cascade.py).
+
+The prefix leg optionally fuses int8 QK^T INSIDE the kernel
+(models/quant.py's dynamic rule — the same per-vector machinery
+``shared_quant``/``QuantActivation`` apply around matmuls, here applied
+to q and trunk-k blocks in VMEM): scores run s8 x s8 -> s32 on the MXU
+at half the VMEM read traffic, scales fold on the s32 scores, softmax
+and the PV contraction stay fp32. ``interpret=True`` runs the kernel in
+the Pallas interpreter so tier-1 exercises it on CPU; production CPU
+keeps the dense path (models/decoder.CASCADE_INTERPRET_ON_CPU is the
+test hook, mirroring FUSED_DECODE_INTERPRET_ON_CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.quant import dynamic_quant
+from .lse import merge_partials
+
+# Flattened-query block edge: one MXU-shaped tile of inter-query-batched
+# rows per grid program (the lane width; same edge family as
+# flash_attention's DEFAULT_BLOCK_Q/K).
+DEFAULT_BLOCK_N = 128
+
+
+def pick_block_n(n: int, want: int = DEFAULT_BLOCK_N) -> int:
+    """Query-block edge for N flattened rows: ``want`` when N reaches it
+    (the padded tail block is masked by construction — pad rows are
+    sliced off after the kernel), else N rounded up to a sublane
+    multiple of 8 so tiny dispatches lower without relayout."""
+    if n >= want:
+        return int(want)
+    return max(8 * ((int(n) + 7) // 8), 8)
+
+
+def _prefix_kernel(slope_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
+                   sm_scale: float, alibi: bool, int8_qk: bool):
+    """One (kv head, query block) program of the prefix leg.
+
+    q block: (bn, hd) flattened (row, position, group) queries; k/v: the
+    WHOLE (Tt, hd) trunk for this kv head in VMEM — the trunk is one
+    block on purpose (a bucket-ladder trunk at hd <= 128 is <= 512 KiB
+    per side, and one block keeps the online-softmax state scalar per
+    query row). Every trunk key precedes every query and every trunk
+    slot is real, so there is no mask and no causal term; the partial
+    (o, m, l) triple is always finite.
+    """
+    k = k_ref[0]                                          # (Tt, hd)
+    if int8_qk:
+        # models/quant.dynamic_quant INSIDE the kernel: per-query-row /
+        # per-key-row int8 with fp32 scales, s8 x s8 -> s32 on the MXU,
+        # scales (and the softmax 1/sqrt(hd)) folded on the s32 scores.
+        qq, qs = dynamic_quant(q_ref[0])
+        kq, ks = dynamic_quant(k)
+        s32 = jnp.dot(qq, kq.T, preferred_element_type=jnp.int32)
+        s = s32.astype(jnp.float32) * (qs.astype(jnp.float32)
+                                       * sm_scale)[:, None] * ks[None, :]
+    else:
+        q = q_ref[0].astype(jnp.float32) * sm_scale       # (bn, hd)
+        s = jnp.dot(q, k.astype(jnp.float32).T,
+                    preferred_element_type=jnp.float32)   # (bn, Tt)
+    if alibi:
+        # ALiBi bias depends on the KEY position only (decoder.
+        # _causal_bias) and trunk slot t IS position t, so the bias is
+        # slope_row * iota — no position array needs to ride along.
+        kp = jax.lax.broadcasted_iota(jnp.float32, s.shape, 1)
+        s = s + slope_ref[0][:, None] * kp
+
+    m = s.max(axis=-1)                                    # (bn,)
+    p = jnp.exp(s - m[:, None])
+    o_ref[0] = jnp.dot(p, v_ref[0].astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+    m_ref[0] = m
+    l_ref[0] = p.sum(axis=-1)
+
+
+def _prefix_partials(q, trunk_k, trunk_v, slopes, int8_qk: bool,
+                     block_n: int, interpret: bool):
+    """Prefix-leg partials: (o, m, l) shaped (B, K, R, G, hd) / (B, K, R, G).
+
+    Inter-query batching: q (B, R, H, hd) flattens to (K, N, hd) with
+    N = B*R*G — the whole dispatch is one dense GEMM per kv head against
+    the single-row trunk — padded to a block multiple host-side (pad
+    rows compute garbage partials that are sliced off before the merge).
+    """
+    B, R, H, hd = q.shape
+    K, Tt = trunk_k.shape[0], trunk_k.shape[1]
+    G = H // K
+    N = B * R * G
+    sm_scale = 1.0 / math.sqrt(hd)
+    bn = pick_block_n(N, block_n)
+    n_pad = -N % bn
+    qf = (q.reshape(B, R, K, G, hd).transpose(2, 0, 1, 3, 4)
+          .reshape(K, N, hd))
+    qf = jnp.pad(qf, ((0, 0), (0, n_pad), (0, 0)))
+    alibi = slopes is not None
+    if alibi:
+        # Per-flattened-row slope: row n = (b*R + r)*G + g belongs to
+        # query head h = kh*G + g.
+        sl = jnp.broadcast_to(
+            jnp.asarray(slopes, jnp.float32).reshape(K, 1, G),
+            (K, B * R, G)).reshape(K, N)
+    else:
+        sl = jnp.zeros((K, N), jnp.float32)
+    sl = jnp.pad(sl, ((0, 0), (0, n_pad)))
+    npad = N + n_pad
+
+    kernel = functools.partial(_prefix_kernel, sm_scale=sm_scale,
+                               alibi=alibi, int8_qk=int8_qk)
+    f32 = jnp.float32
+    o_p, m_p, l_p = pl.pallas_call(
+        kernel,
+        grid=(K, npad // bn),
+        in_specs=[
+            pl.BlockSpec((1, bn), lambda h, i: (h, i)),
+            pl.BlockSpec((1, bn, hd), lambda h, i: (h, i, 0)),
+            # The whole trunk per program (see _prefix_kernel).
+            pl.BlockSpec((1, Tt, hd), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((1, Tt, hd), lambda h, i: (h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bn, hd), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((1, bn), lambda h, i: (h, i)),
+            pl.BlockSpec((1, bn), lambda h, i: (h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K, npad, hd), f32),
+            jax.ShapeDtypeStruct((K, npad), f32),
+            jax.ShapeDtypeStruct((K, npad), f32),
+        ],
+        interpret=interpret,
+    )(sl, qf, trunk_k, trunk_v)
+
+    def unflat(x):
+        x = x[:, :N]
+        x = x.reshape((K, B, R, G) + x.shape[2:])
+        return jnp.moveaxis(x, 0, 1)                      # (B, K, R, G, ...)
+
+    return unflat(o_p), unflat(m_p), unflat(l_p)
+
+
+def _suffix_partials(q, sfx_k, sfx_v, suffix_mask, q_positions, slopes):
+    """Suffix-leg partials over each row's OWN remainder KV: causal
+    within the window (key position <= query position, mask-aware — the
+    exact ``decoder._causal_bias`` rule, so ragged right-padded and
+    left-padded windows both behave like unpadded rows), ALiBi on key
+    positions, grouped GQA contraction against un-repeated k/v. Plain
+    XLA on purpose: the per-row window is short (R x R) and batched thin
+    — there is no (S, T) tile to save, exactly why decode steps stay
+    dense too. A fully-masked (pad) query row yields m = -inf / l = 0
+    and defers entirely to the prefix leg in the merge."""
+    B, R, H, hd = q.shape
+    K = sfx_k.shape[2]
+    G = H // K
+    sm_scale = 1.0 / math.sqrt(hd)
+    qg = q.reshape(B, R, K, G, hd).astype(jnp.float32) * sm_scale
+    s = jnp.einsum("brkgd,btkd->bkrgt", qg, sfx_k.astype(jnp.float32))
+    kp = q_positions.astype(jnp.float32)                  # keys = queries
+    if slopes is not None:
+        sl = jnp.asarray(slopes, jnp.float32).reshape(K, G)
+        s = s + sl[None, :, None, :, None] * kp[:, None, None, None, :]
+    valid = ((suffix_mask[:, None, :] > 0)
+             & (q_positions[:, None, :] <= q_positions[:, :, None]))
+    s = jnp.where(valid[:, None, :, None, :], s, -jnp.inf)
+    m = s.max(axis=-1)                                    # (B, K, R, G)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    o = jnp.einsum("bkrgt,btkd->bkrgd", p, sfx_v.astype(jnp.float32))
+    return o, m, p.sum(axis=-1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("int8_qk", "block_n", "interpret"))
+def cascade_attention(q, sfx_k, sfx_v, trunk_k, trunk_v, suffix_mask,
+                      q_positions, alibi_slopes=None, int8_qk: bool = False,
+                      block_n: int = DEFAULT_BLOCK_N,
+                      interpret: bool = False) -> jnp.ndarray:
+    """Shared-trunk cascade attention for one layer's remainder window.
+
+    ``q``: (B, R, H, hd) post-RoPE queries at the dispatch's remainder
+    positions. ``sfx_k``/``sfx_v``: (B, R, K, hd) the window's own
+    post-RoPE k/v (un-repeated GQA). ``trunk_k``/``trunk_v``:
+    (K, Tt, hd) the SHARED trunk KV — one row, no batch axis; slot t is
+    position t and every slot is real. ``suffix_mask``: (B, R) validity
+    of the remainder positions; ``q_positions``: (B, R) mask-aware
+    ABSOLUTE positions (trunk_len + window-local). Returns (B, R, H, hd)
+    in q's dtype — softmax over trunk + window keys, exact.
+    """
+    B, R, H, hd = q.shape
+    o_t, m_t, l_t = _prefix_partials(q, trunk_k, trunk_v, alibi_slopes,
+                                     int8_qk, block_n, interpret)
+    o_s, m_s, l_s = _suffix_partials(q, sfx_k, sfx_v, suffix_mask,
+                                     q_positions, alibi_slopes)
+    out = merge_partials(jnp.stack([o_t, o_s], axis=2),
+                         jnp.stack([m_t, m_s], axis=2),
+                         jnp.stack([l_t, l_s], axis=2),
+                         axis=2)                          # (B, K, R, G, hd)
+    return out.transpose(0, 2, 1, 3, 4).reshape(B, R, H, hd).astype(q.dtype)

@@ -44,6 +44,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import DEFAULT_BLOCK_K
+from .lse import merge_partials
 
 
 def pick_split(total: int, want: int = DEFAULT_BLOCK_K) -> int:
@@ -167,15 +168,11 @@ def flash_decode(
     )(q_positions[:, None].astype(jnp.int32), slopes,
       key_mask[:, None, :], key_positions[:, None, :], qg, k, v)
 
-    # Log-sum-exp combine across splits: renormalize each partial by the
+    # Log-sum-exp combine across splits (ops/lse.merge_partials, shared
+    # with the cascade-prefill merge): renormalize each partial by the
     # global row max, then sum the weighted accumulators and weights. A
     # fully-masked split carries m = -inf and weight exactly 0.
-    m = m_p.max(axis=2)                                   # (B, K, G)
-    w = jnp.where(jnp.isfinite(m_p),
-                  jnp.exp(m_p - m[:, :, None, :]), 0.0)   # (B, K, S, G)
-    l = (w * l_p).sum(axis=2)                             # (B, K, G)
-    o = (w[..., None] * o_p).sum(axis=2)                  # (B, K, G, hd)
-    out = o / jnp.maximum(l, 1e-30)[..., None]
+    out = merge_partials(o_p, m_p, l_p, axis=2)           # (B, K, G, hd)
     return out.reshape(B, H, hd).astype(q.dtype)
 
 
@@ -291,10 +288,5 @@ def flash_decode_mq(
       key_mask[:, None, :], key_positions[:, None, :], qg, k, v)
 
     # Same log-sum-exp combine as flash_decode, with the query axis along.
-    m = m_p.max(axis=2)                                   # (B, K, S, G)
-    w = jnp.where(jnp.isfinite(m_p),
-                  jnp.exp(m_p - m[:, :, None, :, :]), 0.0)
-    l = (w * l_p).sum(axis=2)                             # (B, K, S, G)
-    o = (w[..., None] * o_p).sum(axis=2)                  # (B, K, S, G, hd)
-    out = o / jnp.maximum(l, 1e-30)[..., None]
+    out = merge_partials(o_p, m_p, l_p, axis=2)           # (B, K, S, G, hd)
     return out.transpose(0, 2, 1, 3, 4).reshape(B, S, H, hd).astype(q.dtype)

@@ -1,0 +1,42 @@
+"""Log-sum-exp combination of partial attention reductions.
+
+Flash-style attention kernels split the key axis — into cache splits
+(ops/flash_decode's split-K grid) or into legs (ops/cascade_prefill's
+shared-trunk prefix leg + per-row suffix leg) — and each partition
+reduces independently into a partial ``(o, m, l)`` triple: the
+probability-weighted value accumulator, the running score max, and the
+softmax normalizer, all computed against the partition's LOCAL max.
+Combining partials is the one numerically delicate step, and before this
+module it lived inline in two places of flash_decode.py (the single- and
+multi-query kernels) with the cascade merge about to make three; the
+arithmetic must stay IDENTICAL everywhere or a resumed/split path drifts
+from the dense reference. This helper is now that single source
+(ISSUE-16 satellite: the refactor is pinned bitwise against the
+pre-refactor combine by tests/test_cascade.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def merge_partials(o_p: jnp.ndarray, m_p: jnp.ndarray, l_p: jnp.ndarray,
+                   axis: int) -> jnp.ndarray:
+    """Combine partial flash reductions along ``axis`` — exact attention.
+
+    ``o_p``: partial weighted-value accumulators, shaped like the final
+    output with an extra partition axis at ``axis`` and the head-dim
+    last. ``m_p``/``l_p``: the matching per-partition score maxima and
+    normalizers (``o_p`` without the head-dim axis). Each partial is
+    renormalized by the GLOBAL max across partitions, then the weighted
+    accumulators and weights sum; a fully-masked partition carries
+    ``m = -inf`` and weight exactly 0, so empty splits are no-ops. The
+    ``1e-30`` floor only engages when EVERY partition is empty (an
+    all-masked row), where the convention is an all-zero output row.
+    """
+    m = m_p.max(axis=axis)
+    w = jnp.where(jnp.isfinite(m_p),
+                  jnp.exp(m_p - jnp.expand_dims(m, axis)), 0.0)
+    l = (w * l_p).sum(axis=axis)
+    o = (w[..., None] * o_p).sum(axis=axis)
+    return o / jnp.maximum(l, 1e-30)[..., None]

@@ -148,6 +148,18 @@ class ContinuousBatcher:
         cap = self._batch_cap()
         return cap if self.pad_full else _tail_batch(n, cap)
 
+    def _cascade_trunk(self, rows: List["Pending"], bucket: int) -> int:
+        """Shared-trunk tokens the engine's cascade-prefill path would
+        dedupe for these queued rows (0 when cascade is off or the rows
+        are ineligible). Advisory pricing input only — the dispatch
+        itself re-derives eligibility from the same rows, so the price
+        model and the routing can never disagree on the discount."""
+        fn = getattr(self.engine, "cascade_trunk_for", None)
+        if fn is None or len(rows) < 2:
+            return 0
+        return fn([list(p.bin_ids[:p.lcp]) for p in rows],
+                  len(rows), bucket)
+
     def next_dispatch(self, now: float, flush: bool = False
                       ) -> Optional[Tuple[int, List[Pending]]]:
         """Form the next dispatch, or None when no bucket is ripe. A
@@ -175,11 +187,14 @@ class ContinuousBatcher:
                 # (advisory submit-time hints; scheduler.bucket_cost).
                 cached = (sum(q[i].cached_hint for i in range(n))
                           if self.prefix_cache else 0)
+                trunk = self._cascade_trunk([q[i] for i in range(n)],
+                                            edge)
                 per_row = sched_mod.bucket_cost(
                     self._dispatch_rows(n), edge, self.batch,
                     self.decode_cost, cached_tokens=cached,
                     fused_decode=self.fused_decode,
-                    spec_decode=self.spec_decode) / n
+                    spec_decode=self.spec_decode,
+                    cascade=trunk > 0, trunk_tokens=trunk) / n
                 return per_row, q[0].t_submit
 
             edge = min(ripe, key=price)

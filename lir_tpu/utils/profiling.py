@@ -703,6 +703,54 @@ class PrefixCacheStats:
 
 
 @dataclasses.dataclass
+class CascadeStats:
+    """Shared-prefix cascade-prefill counters (ops/cascade_prefill +
+    engine/runner routing; DEPLOY.md §1q). Thread-safe — the sweep loop
+    and serve batcher threads mutate it concurrently.
+
+    - ``cascade_dispatches`` / ``dense_fallbacks``: shared dispatches
+      that took the cascade split vs ones that ran the dense path while
+      cascade was ENABLED (trunk below min_trunk, too few rows, int8 KV
+      cache, ...). A high fallback fraction on a shared-trunk workload
+      means the eligibility knobs (CascadeConfig) are mistuned.
+    - ``trunk_rows_deduped``: rows whose quadratic trunk prefill was NOT
+      recomputed (rows - 1 per cascade dispatch; the dense path pays all
+      of them) — the dedup the cascade exists for.
+    - ``prefix_flops_saved``: analytic matmul FLOPs those deduped trunk
+      rows would have cost (the dense prefill's attention + projection
+      terms over trunk tokens) — THE perf number; bench.py's ``cascade``
+      key divides it into the dense prefill total for the implied
+      prefill-MFU uplift.
+    """
+
+    cascade_dispatches: int = 0
+    dense_fallbacks: int = 0
+    trunk_rows_deduped: int = 0
+    prefix_flops_saved: int = 0
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            total = self.cascade_dispatches + self.dense_fallbacks
+            return {
+                "cascade_dispatches": self.cascade_dispatches,
+                "dense_fallbacks": self.dense_fallbacks,
+                "cascade_frac": (round(self.cascade_dispatches / total, 4)
+                                 if total else 0.0),
+                "trunk_rows_deduped": self.trunk_rows_deduped,
+                "prefix_flops_saved": self.prefix_flops_saved,
+            }
+
+
+@dataclasses.dataclass
 class FleetStats:
     """Multi-model fleet counters (engine/fleet.py over
     models/weights.py): how much model-swap latency the async weight
@@ -1348,6 +1396,24 @@ def scoring_step_flops(cfg, batch: int, seq: int, new_tokens: int) -> float:
     See :func:`scoring_step_flops_split` for the per-phase breakdown."""
     return float(sum(scoring_step_flops_split(
         cfg, batch, seq, new_tokens).values()))
+
+
+def cascade_prefill_flops_saved(cfg, rows: int, trunk_len: int) -> float:
+    """Analytic matmul FLOPs a cascade dispatch dedups away: the dense
+    shared path prefills the ``trunk_len``-token trunk once per row —
+    layer-stack linears plus the quadratic attention term, the exact
+    per-row prefill arithmetic of :func:`scoring_step_flops_split` —
+    while the cascade pays it ONCE, so ``rows - 1`` trunk prefills are
+    saved (CascadeStats.prefix_flops_saved; the suffix-leg and merge
+    work is common to both paths and cancels)."""
+    if rows <= 1 or trunk_len <= 0:
+        return 0.0
+    D, hd = cfg.hidden_size, cfg.head_dim
+    H, L, V = cfg.n_heads, cfg.n_layers, cfg.vocab_size
+    p_layers = decoder_matmul_params(cfg) - D * V
+    per_row = 2 * p_layers * trunk_len
+    per_row += 4 * H * trunk_len * trunk_len * hd * L
+    return float((rows - 1) * per_row)
 
 
 def device_memory_stats() -> Dict[str, Dict[str, float]]:
