@@ -4,9 +4,10 @@
 
 .PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
 	kernel-smoke stats-smoke fleet-smoke observe-smoke elastic-smoke \
-	spec-smoke mem-smoke disagg-smoke cascade-smoke install-hooks
+	spec-smoke mem-smoke disagg-smoke cascade-smoke \
+	cascade-decode-smoke install-hooks
 
-verify: lint cascade-smoke
+verify: lint cascade-smoke cascade-decode-smoke
 	python tools/check_tier1.py
 
 # graft-lint: AST static analysis proving the engine's JAX/XLA
@@ -133,6 +134,16 @@ elastic-smoke:
 # cascade (tools/cascade_smoke.py; DEPLOY.md §1q).
 cascade-smoke:
 	JAX_PLATFORMS=cpu python tools/cascade_smoke.py
+
+# Cascade-decode smoke: the same shared-trunk grid served with cascade
+# DECODE on vs off (prefill dense on both) — nonzero trunk-aware decode
+# dispatches AND analytic trunk bytes deduped in CascadeStats, every
+# payload field BITWISE-identical between the two servers (the trunk
+# kernels compute the flat kernels' exact partials), and the flat
+# server never counting a cascade-decode dispatch
+# (tools/cascade_decode_smoke.py; DEPLOY.md §1r).
+cascade-decode-smoke:
+	JAX_PLATFORMS=cpu python tools/cascade_decode_smoke.py
 
 # Disaggregated-serving smoke: 1 prefill-role + 2 decode-role replicas
 # behind the router on the fake backend — scoring lands only on decode

@@ -265,6 +265,13 @@ def main() -> None:
                          "shared-trunk grid swept cascade-ON vs OFF with "
                          "per-cell parity and the prefill-phase MFU / p-s "
                          "plateau gates asserted in-bench)")
+    ap.add_argument("--no-cascade-decode", action="store_true",
+                    help="skip the cascade-decode bench mode (shared-"
+                         "trunk warm grid dispatched with the trunk-"
+                         "aware decode splits ON vs OFF: decode-phase "
+                         "attention HBM-bytes/row reduction >= 1.3x, "
+                         "payloads argmax-identical cold and paged-"
+                         "warm — headline key \"cascade_decode\")")
     ap.add_argument("--no-elastic", action="store_true",
                     help="skip the elastic-serving mode (3 replica "
                          "servers behind the failover router, 1 killed "
@@ -735,6 +742,19 @@ def main() -> None:
         except (Exception, SystemExit) as err:  # noqa: BLE001
             print(f"# cascade bench mode failed ({err!r}); headline "
                   "is unaffected", file=sys.stderr)
+    # Cascade-DECODE mode (PR 17): the shared-trunk warm grid's decode
+    # phase with the trunk-aware flash-decode splits ON vs OFF —
+    # attention HBM-bytes/row reduction >= 1.3x (analytic, mirroring
+    # the kernel's own split ladder), payloads argmax-identical cold
+    # and paged-warm. Failures never discard the headline.
+    if not args.no_cascade_decode:
+        try:
+            cascade_decode = _cascade_decode_bench(on_accel)
+            if cascade_decode is not None:
+                headline["cascade_decode"] = cascade_decode
+        except (Exception, SystemExit) as err:  # noqa: BLE001
+            print(f"# cascade-decode bench mode failed ({err!r}); "
+                  "headline is unaffected", file=sys.stderr)
     # Memory-governance mode: the identical grid swept unpressured vs
     # under a seeded mid-run hbm_squeeze (engine/hbm.py degradation
     # ladder) — the memory-robustness cost tracked like perf. Failures
@@ -2383,6 +2403,128 @@ def _cascade_bench(on_accel: bool):
         "implied_step_ps": round(implied_ps, 2),
         "plateau_mfu_pct": PLATEAU_MFU,
         "plateau_ps": PLATEAU_PS,
+        "parity_ok": bool(parity_ok),
+    }
+
+
+def _cascade_decode_bench(on_accel: bool):
+    """Cascade-decode mode (PR 17): the shared-trunk warm grid's DECODE
+    phase — the same dispatch batch run cold and paged-warm with the
+    trunk-aware flash-decode splits ON vs OFF. Gates asserted before
+    reporting:
+
+    - PARITY: per-row payloads argmax-identical between ON and OFF on
+      BOTH passes (ints exact, floats within FLOAT_TOL — on the chip
+      the trunk kernels are bitwise; under the CPU interpreter XLA's
+      shape-dependent SIMD tails allow ulp drift);
+    - the dedup engaged: nonzero cascade-decode dispatches and analytic
+      trunk bytes deduped on the ON engine, zero on the OFF engine;
+    - the HEADLINE gate: decode-phase attention HBM bytes per row,
+      with the flat kernels streaming every row's full cache each step
+      vs the trunk splits loaded once per dispatch-step, reduced by
+      >= 1.3x. The byte model mirrors the kernel's own static split
+      ladder (profiling.cascade_decode_bytes_saved), so the ratio is
+      the traffic the lowered kernel really removes — on TPU the same
+      ratio rides the measured step.
+    """
+    import jax
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder as decoder_mod
+    from lir_tpu.models.registry import ModelConfig
+
+    FLOAT_TOL = 1e-4
+    MIN_RATIO = 1.3
+    ROWS, BUCKET, TRUNK, SFX = 8, 128, 96, 8
+    NEW, CONF = 3, 4
+
+    cfg = ModelConfig(name="cascdec-bench", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                      intermediate_size=64, max_seq_len=512)
+    params = decoder_mod.init_params(cfg, jax.random.PRNGKey(53))
+    rng = np.random.default_rng(59)
+    trunk_ids = [int(x) for x in rng.integers(3, 200, TRUNK)]
+    rows = [trunk_ids + [int(x) for x in rng.integers(3, 200, 6 - (r % 3))]
+            for r in range(ROWS)]
+    bins = [r + [5, 6] for r in rows]
+    conf = [r + [7, 8] for r in rows]
+    t1 = np.asarray([5] * ROWS, np.int32)
+    t2 = np.asarray([9] * ROWS, np.int32)
+
+    def engine(decode_on):
+        # prefix_cache=True so the second dispatch resumes the trunk
+        # paged-warm — the workload regime where decode dominates.
+        return ScoringEngine(params, cfg, FakeTokenizer(), RuntimeConfig(
+            batch_size=ROWS, max_seq_len=512, prefix_cache=True,
+            prefix_cache_pages=256, cascade_decode=decode_on))
+
+    def dispatch(eng):
+        return eng.decode_fused_shared(
+            [""] * ROWS, [""] * ROWS, t1, t2, new_tokens=NEW,
+            conf_tokens=CONF, pretokenized_a=bins, pretokenized_b=conf,
+            bucket=BUCKET, sfx_buckets_ab=(SFX, SFX), reuse_cache=True,
+            n_real=ROWS)
+
+    prev_hook = decoder_mod.FUSED_DECODE_INTERPRET_ON_CPU
+    if not on_accel:
+        # Off-chip the decode gate requires the fused kernel route to
+        # exist: arm the tier-1 interpreter hook for the comparison
+        # (the OFF engine ignores it — cascade_decode=False wins first).
+        decoder_mod.FUSED_DECODE_INTERPRET_ON_CPU = True
+    try:
+        eng_on = engine(True)
+        on_cold, on_warm = dispatch(eng_on), dispatch(eng_on)
+        eng_off = engine(False)
+        off_cold, off_warm = dispatch(eng_off), dispatch(eng_off)
+    finally:
+        decoder_mod.FUSED_DECODE_INTERPRET_ON_CPU = prev_hook
+
+    parity_ok = True
+    for got, want in ((on_cold, off_cold), (on_warm, off_warm)):
+        for a, b in zip(got, want):
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                x, y = np.asarray(x), np.asarray(y)
+                if np.issubdtype(x.dtype, np.floating):
+                    parity_ok &= bool(np.allclose(x, y, atol=FLOAT_TOL))
+                else:
+                    parity_ok &= bool((x == y).all())
+    assert parity_ok, ("cascade-decode ON vs OFF payloads diverged past "
+                       "the argmax parity bar")
+
+    s = eng_on.cascade_stats
+    n_disp = int(s.cascade_decode_dispatches)
+    saved = float(s.trunk_bytes_deduped)
+    assert n_disp >= 2, "cold + warm dispatches did not both cascade"
+    assert saved > 0, "zero trunk bytes deduped"
+    assert eng_off.cascade_stats.cascade_decode_dispatches == 0, \
+        "the cascade-decode-OFF engine still deduped"
+
+    # Decode-phase attention HBM bytes: the flat kernels stream every
+    # row's full cache extent (K + V) each decode step.
+    t0 = BUCKET + max(SFX + NEW, SFX + CONF)
+    steps = NEW + CONF
+    per_row_step = 2 * cfg.n_kv_heads * t0 * cfg.head_dim * 4 * cfg.n_layers
+    flat_bytes = float(per_row_step * ROWS * steps * n_disp)
+    dedup_bytes = flat_bytes - saved
+    assert dedup_bytes > 0, "deduped more bytes than the flat kernel reads"
+    ratio = flat_bytes / dedup_bytes
+    assert ratio >= MIN_RATIO, (
+        f"decode-phase HBM-bytes/row reduction {ratio:.3f}x below the "
+        f"{MIN_RATIO}x bar")
+
+    return {
+        "cascade_decode_dispatches": n_disp,
+        "trunk_bytes_deduped": saved,
+        "decode_attn_bytes_flat": flat_bytes,
+        "decode_attn_bytes_dedup": dedup_bytes,
+        "hbm_bytes_per_row_reduction": round(ratio, 3),
+        "min_ratio": MIN_RATIO,
+        "rows": ROWS,
+        "trunk_tokens": TRUNK,
+        "cache_extent": t0,
         "parity_ok": bool(parity_ok),
     }
 

@@ -433,11 +433,27 @@ def _add_cascade_flags(p) -> None:
                         "inside the kernel (models/quant.py scales; "
                         "softmax + PV stay fp32 — tolerance-bound, "
                         "argmax-identical in tests)")
+    p.add_argument("--no-cascade-decode", action="store_true",
+                   help="disable the trunk-aware flash-decode split "
+                        "dedup and restore the flat decode kernels "
+                        "exactly (cascade-decode payloads are BITWISE "
+                        "the flat kernels'; flat is the measurement "
+                        "baseline — DEPLOY.md §1r)")
+    p.add_argument("--no-cascade-fused-suffix", action="store_true",
+                   help="run the cascade prefill as two kernel launches "
+                        "plus an HBM merge round-trip instead of the "
+                        "fused single-kernel path (bitwise-identical "
+                        "results; the two-leg path is the fused "
+                        "kernel's verification baseline)")
 
 
 def _cascade_rt_kw(args, rt_kw: dict) -> None:
     if getattr(args, "no_cascade_prefill", False):
         rt_kw["cascade_prefill"] = False
+    if getattr(args, "no_cascade_decode", False):
+        rt_kw["cascade_decode"] = False
+    if getattr(args, "no_cascade_fused_suffix", False):
+        rt_kw["cascade_fused_suffix"] = False
 
 
 def _cascade_config_from_args(args):

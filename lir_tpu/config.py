@@ -263,6 +263,27 @@ class RuntimeConfig:
     # CascadeStats.dense_fallbacks. Eligibility knobs live on
     # Config.cascade (CascadeConfig).
     cascade_prefill: bool = True      # cli: --no-cascade-prefill
+    # Cascade DECODE (ops/flash_decode trunk variants; DEPLOY.md §1r):
+    # on a shared-trunk dispatch, every decode step's trunk-key splits
+    # compute as ONE batched GEMM per kv head against cache row 0's
+    # trunk K/V — the trunk tiles stream from HBM once per step instead
+    # of once per row — and only the per-row suffix splits run the
+    # split-K path; the log-sum-exp merge makes the result BITWISE the
+    # flat kernel's (tests/test_cascade.py pins it, speculative verify
+    # windows ride flash_decode_mq_trunk the same way). Independent of
+    # cascade_prefill: a dense-prefill or paged-warm dispatch dedups its
+    # decode too. --no-cascade-decode restores the flat kernels exactly
+    # (the flag mirrors into the static ModelConfig, re-keying every
+    # decode executable). Trunk eligibility shares CascadeConfig.
+    cascade_decode: bool = True       # cli: --no-cascade-decode
+    # Fused single-kernel cascade prefill (ops/cascade_prefill): prefix
+    # leg + suffix leg + log-sum-exp merge in ONE Pallas launch — no HBM
+    # round-trip for the per-leg partials. BITWISE the two-leg path at
+    # every trunk extent (tests/test_cascade.py); --no-cascade-fused-
+    # suffix restores the two-leg lowering exactly (mirrored into the
+    # static ModelConfig like cascade_decode). float QK^T only — the
+    # int8_qk cascade keeps the two-leg path.
+    cascade_fused_suffix: bool = True  # cli: --no-cascade-fused-suffix
     # Lease time-to-live in WALL-CLOCK seconds (leases compare across
     # hosts, so the shared clock is time.time, not monotonic). A holder
     # renews on every flush; a lease older than this is stealable.

@@ -78,7 +78,14 @@ class ShapeSpec:
     executable can never serve the wrong mode (a dense lookup can't
     return a cascade program or vice versa). For the paged cascade kind,
     ``window`` is the TRUNK's recompute-window edge (the (1, W) chunk
-    the radix resume teacher-forces), not a per-row window."""
+    the radix resume teacher-forces), not a per-row window.
+    ``decode_trunk`` > 0 selects the CASCADE-DECODE variant of the
+    plain "shared"/"shared_paged" kinds (and their spec siblings): the
+    decode scans' trunk splits run trunk-aware
+    (ops/flash_decode.flash_decode_trunk — bitwise the flat kernels)
+    at that static trunk extent. The cascade kinds don't carry it:
+    their decode trunk IS ``trunk`` (generate._cascade_branches), so
+    ``trunk`` already keys the lowering."""
 
     kind: str
     bucket: int
@@ -95,6 +102,7 @@ class ShapeSpec:
     spec_draft: bool = False
     trunk: int = 0
     cascade_int8: bool = False
+    decode_trunk: int = 0
 
     @property
     def label(self) -> str:
@@ -111,6 +119,8 @@ class ShapeSpec:
         if self.trunk:
             casc = f"/trunk{self.trunk}" + ("+i8" if self.cascade_int8
                                             else "")
+        if self.decode_trunk:
+            casc += f"/dtrunk{self.decode_trunk}"
         return (f"{self.kind}/b{self.bucket}x{self.batch}/sfx{sfx}"
                 f"/new{self.new_tokens}-{self.conf_tokens}{win}{spec}"
                 f"{casc}/{var}")
@@ -119,11 +129,13 @@ class ShapeSpec:
 def shared_spec(bucket: int, batch: int, sfx_a: int, sfx_b: int,
                 new_tokens: int, conf_tokens: int, stops_armed: bool,
                 scratch: bool, spec_k: int = 0,
-                spec_draft: bool = False) -> ShapeSpec:
+                spec_draft: bool = False,
+                decode_trunk: int = 0) -> ShapeSpec:
     return ShapeSpec("shared", int(bucket), int(batch), 0, int(sfx_a),
                      int(sfx_b), int(new_tokens), int(conf_tokens),
                      bool(stops_armed), bool(scratch),
-                     spec_k=int(spec_k), spec_draft=bool(spec_draft))
+                     spec_k=int(spec_k), spec_draft=bool(spec_draft),
+                     decode_trunk=int(decode_trunk))
 
 
 def grouped_spec(bucket: int, groups: int, batch: int, sfx: int,
@@ -137,11 +149,13 @@ def grouped_spec(bucket: int, groups: int, batch: int, sfx: int,
 def shared_paged_spec(bucket: int, batch: int, window: int, sfx_a: int,
                       sfx_b: int, new_tokens: int, conf_tokens: int,
                       stops_armed: bool, scratch: bool,
-                      spec_k: int = 0) -> ShapeSpec:
+                      spec_k: int = 0,
+                      decode_trunk: int = 0) -> ShapeSpec:
     return ShapeSpec("shared_paged", int(bucket), int(batch), 0,
                      int(sfx_a), int(sfx_b), int(new_tokens),
                      int(conf_tokens), bool(stops_armed), bool(scratch),
-                     int(window), spec_k=int(spec_k))
+                     int(window), spec_k=int(spec_k),
+                     decode_trunk=int(decode_trunk))
 
 
 def shared_cascade_spec(bucket: int, batch: int, trunk: int, sfx_a: int,
@@ -231,6 +245,7 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
                stream_shape: Optional[Tuple[int, int, bool]] = None,
                spec_k: int = 0, spec_draft: bool = False,
                cascade_trunk=None, cascade_int8: bool = False,
+               decode_trunk=None,
                ) -> List[ShapeSpec]:
     """Distinct executables a dispatch plan will call, in first-use order
     (the precompile pool works the list front-to-back, so the first
@@ -268,7 +283,15 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
     cache is on — the trunk's recompute window depends on what the
     radix tree holds at dispatch time, so every trunk window edge is
     covered). The plain shared spec stays planned regardless: a dense
-    fallback re-dispatches through it."""
+    fallback re-dispatches through it.
+
+    ``decode_trunk`` (a cascade-DECODE engine) maps a shared dispatch to
+    the static trunk extent its decode scans dedup at (0 = flat
+    kernels); eligible dispatches plan the trunk-aware variant of every
+    plain shared/paged/spec executable ALONGSIDE the flat one — which
+    variant the runner calls depends on the same per-dispatch rule, and
+    the flat specs cover the --no-cascade-decode engine and the dense
+    fallback."""
     from ..models import paged as paged_mod
 
     specs: List[ShapeSpec] = []
@@ -292,9 +315,17 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
             key = ("shared", d.bucket, m_pad, d.sfx_bucket_a,
                    d.sfx_bucket_b, new_tokens, conf_tokens)
             scratch = key == prev_key
+            trunk = int(cascade_trunk(d)) if cascade_trunk else 0
+            # Cascade-decode extent for the PLAIN kinds: a cascade-
+            # prefill-eligible dispatch never reaches them (the cascade
+            # path takes precedence), so its dtrunk variants would be
+            # dead compiles.
+            dt = (int(decode_trunk(d))
+                  if (decode_trunk is not None and not trunk) else 0)
             add(shared_spec(d.bucket, m_pad, d.sfx_bucket_a,
                             d.sfx_bucket_b, new_tokens, conf_tokens,
-                            stops_armed, scratch=scratch))
+                            stops_armed, scratch=scratch,
+                            decode_trunk=dt))
             if spec_k:
                 # Speculative verify executables, planned per
                 # (bucket, batch, k) alongside the sequential shape
@@ -303,8 +334,8 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
                 add(shared_spec(d.bucket, m_pad, d.sfx_bucket_a,
                                 d.sfx_bucket_b, new_tokens, conf_tokens,
                                 stops_armed, scratch=scratch,
-                                spec_k=spec_k, spec_draft=spec_draft))
-            trunk = int(cascade_trunk(d)) if cascade_trunk else 0
+                                spec_k=spec_k, spec_draft=spec_draft,
+                                decode_trunk=dt))
             if trunk:
                 add(shared_cascade_spec(d.bucket, m_pad, trunk,
                                         d.sfx_bucket_a, d.sfx_bucket_b,
@@ -336,7 +367,7 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
                     add(shared_paged_spec(
                         d.bucket, m_pad, w, d.sfx_bucket_a, d.sfx_bucket_b,
                         new_tokens, conf_tokens, stops_armed,
-                        scratch=scratch))
+                        scratch=scratch, decode_trunk=dt))
                     if spec_k and not spec_draft:
                         # Paged + speculative composes for self-drafting
                         # only (the paged front binds slot tables, not
@@ -345,7 +376,8 @@ def plan_specs(dispatches: Sequence[Any], batch_size: int, new_tokens: int,
                         add(shared_paged_spec(
                             d.bucket, m_pad, w, d.sfx_bucket_a,
                             d.sfx_bucket_b, new_tokens, conf_tokens,
-                            stops_armed, scratch=scratch, spec_k=spec_k))
+                            stops_armed, scratch=scratch, spec_k=spec_k,
+                            decode_trunk=dt))
         else:
             sfx = max(d.sfx_bucket_a, d.sfx_bucket_b)
             max_new = max(new_tokens, conf_tokens)
@@ -424,7 +456,7 @@ def _avals_shared(engine, spec: ShapeSpec):
     )
     statics = dict(max_new_a=spec.new_tokens, max_new_b=spec.conf_tokens,
                    topk=TOPK, prefill_fn=engine._prefill_fn,
-                   return_cache=True)
+                   return_cache=True, decode_trunk=spec.decode_trunk)
     if spec.spec_k:
         args = args + _spec_avals(engine, spec)
         dk, ds = _spec_draft_kwargs(engine, spec)
@@ -493,7 +525,8 @@ def _avals_shared_paged(engine, spec: ShapeSpec):
         eos_id=(i32() if spec.stops_armed else None),
     )
     statics = dict(max_new_a=spec.new_tokens, max_new_b=spec.conf_tokens,
-                   topk=TOPK, return_cache=True)
+                   topk=TOPK, return_cache=True,
+                   decode_trunk=spec.decode_trunk)
     if spec.spec_k:
         args = args + _spec_avals(engine, spec)
         statics.update(_spec_statics(engine, spec))

@@ -77,11 +77,18 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
                 digit_vals: jax.Array, max_new_tokens: int, topk: int,
                 stop_mask: jax.Array = None, eos_id: jax.Array = None,
                 stop_mask2: jax.Array = None, stop_sel: jax.Array = None,
+                decode_trunk: int = 0,
                 ) -> Tuple[FusedDecodeOut, Tuple]:
     """The fused greedy scan shared by the full-prompt and shared-prefix
     paths: start from ``logits0`` (the first generated position), write
     generated k/v at cache slots ``slot0 + t``, capture the C13/D6 readouts
     in-scan. Returns (FusedDecodeOut, final cache).
+
+    ``decode_trunk`` (static) marks the cache's leading shared-trunk
+    slots on a shared-prefix dispatch: every decode step's trunk splits
+    then run trunk-aware (cascade decode — decoder.decode_step), the
+    trunk K/V streaming from HBM once per step instead of once per row.
+    Gated by ``cfg.cascade_decode``; 0 keeps the flat kernel exactly.
 
     ``stop_mask`` ((V,) int32 surface-class bitmask from
     tokens.digit_stop_classes) + ``eos_id`` enable the confidence early
@@ -155,7 +162,8 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
             def run(args):
                 lg, c = args
                 return decoder.decode_step(
-                    params, cfg, c, emit, pos0 + t, slot0 + t, step_mask)
+                    params, cfg, c, emit, pos0 + t, slot0 + t, step_mask,
+                    trunk_len=decode_trunk)
 
             new_logits, cache = lax.cond(
                 all_done, lambda args: args, run, (logits, cache))
@@ -164,7 +172,8 @@ def _fused_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
             emit = nxt
             cache_mask = cache_mask.at[:, slot0 + t].set(1)
             new_logits, cache = decoder.decode_step(
-                params, cfg, cache, emit, pos0 + t, slot0 + t, cache_mask)
+                params, cfg, cache, emit, pos0 + t, slot0 + t, cache_mask,
+                trunk_len=decode_trunk)
         return ((new_logits, cache, cache_mask, done, digit_run, prev_ew),
                 (emit, p_yes, p_no, top2))
 
@@ -361,7 +370,7 @@ def _paged_prefix(params, cfg: ModelConfig, pool, slot_src: jax.Array,
 
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_new_a", "max_new_b", "topk",
-                                    "return_cache"),
+                                    "return_cache", "decode_trunk"),
                    donate_argnames=("scratch_cache",))
 def greedy_decode_fused_shared_paged(params, cfg: ModelConfig, pool,
                                      slot_src: jax.Array,
@@ -378,6 +387,7 @@ def greedy_decode_fused_shared_paged(params, cfg: ModelConfig, pool,
                                      stop_mask_a: jax.Array = None,
                                      eos_id: jax.Array = None,
                                      return_cache: bool = False,
+                                     decode_trunk: int = 0,
                                      scratch_cache=None):
     """:func:`greedy_decode_fused_shared` resuming from the cross-request
     radix prefix cache: the quadratic prefill over each row's shared
@@ -411,7 +421,8 @@ def greedy_decode_fused_shared_paged(params, cfg: ModelConfig, pool,
             params, cfg, cache_in, sfx, sfx_mask, cm, S)
         return _fused_tail(params, cfg, logits_l, cache2, cm, pos, S + S2,
                            yes_ids, no_ids, d_ids, d_vals, new_tokens, topk,
-                           stop_mask=stop_mask, eos_id=eos_id)
+                           stop_mask=stop_mask, eos_id=eos_id,
+                           decode_trunk=decode_trunk)
 
     out_a, cache_a = branch(cache, sfx_a, sfx_a_mask, max_new_a,
                             empty_ids, empty_vals, stop_mask=stop_mask_a)
@@ -459,7 +470,8 @@ def _cascade_branches(params, cfg: ModelConfig, tcache, trunk_len: int,
             params, cfg, cache_in, sfx, sfx_mask, cm, S)
         return _fused_tail(params, cfg, logits_l, cache2, cm, pos, S + S2,
                            yes_ids, no_ids, d_ids, d_vals, new_tokens, topk,
-                           stop_mask=stop_mask, eos_id=eos_id)
+                           stop_mask=stop_mask, eos_id=eos_id,
+                           decode_trunk=trunk_len)
 
     out_a, cache_a = branch(cache, sfx_a, sfx_a_mask, max_new_a,
                             empty_ids, empty_vals, stop_mask=stop_mask_a)
@@ -669,7 +681,7 @@ def _spec_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
                draft_tokens: jax.Array, draft_len: jax.Array,
                stop_mask: jax.Array = None, eos_id: jax.Array = None,
                ngram: int = 2, draft_params=None, draft_cfg=None,
-               dcache=None):
+               dcache=None, decode_trunk: int = 0):
     """The speculative counterpart of :func:`_fused_tail`: instead of T
     sequential decode steps, scan up to T verify WINDOWS of ``spec_k``
     teacher-forced positions each — [pending emission, draft, draft, ...]
@@ -848,7 +860,8 @@ def _spec_tail(params, cfg: ModelConfig, logits0: jax.Array, cache,
                 cache_mask, jnp.ones((B, spec_k), cache_mask.dtype),
                 (0, base))
             V, new_cache = decoder.verify_extend(
-                params, cfg, carry["cache"], X, cm_run, base)
+                params, cfg, carry["cache"], X, cm_run, base,
+                trunk_len=decode_trunk)
 
             # -- greedy acceptance + per-position emissions ---------------
             acc = live0
@@ -959,7 +972,8 @@ def _shared_spec_branches(params, cfg: ModelConfig, cache, dcache,
                           T0: int, max_new_a: int, max_new_b: int,
                           spec_k: int, ngram: int, topk: int,
                           stop_mask_a, stop_mask_b, eos_id,
-                          draft_params, draft_cfg, return_cache: bool):
+                          draft_params, draft_cfg, return_cache: bool,
+                          decode_trunk: int = 0):
     """Both format branches of a shared-prefix dispatch through the
     speculative tail — branch B consumes branch A's cache buffer exactly
     as the sequential path does (masks keep the branches disjoint).
@@ -1012,7 +1026,8 @@ def _shared_spec_branches(params, cfg: ModelConfig, cache, dcache,
             params, cfg, logits_l, cache2, cm, pos, S + S2, yes_ids,
             no_ids, d_ids, d_vals, new_tokens, topk, spec_k, ctx, ctx_len,
             dr, dr_len, stop_mask=stop_mask, eos_id=eos_id, ngram=ngram,
-            draft_params=draft_params, draft_cfg=draft_cfg, dcache=dcache2)
+            draft_params=draft_params, draft_cfg=draft_cfg, dcache=dcache2,
+            decode_trunk=decode_trunk)
 
     out_a, cache_a, dcache_a, spec_a = branch(
         cache, dcache, sfx_a, sfx_a_mask, max_new_a, empty_ids, empty_vals,
@@ -1037,7 +1052,8 @@ def spec_total_len(bucket: int, sfx_a: int, sfx_b: int, max_new_a: int,
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_new_a", "max_new_b", "topk",
                                     "spec_k", "ngram", "draft_cfg",
-                                    "prefill_fn", "return_cache"),
+                                    "prefill_fn", "return_cache",
+                                    "decode_trunk"),
                    donate_argnames=("scratch_cache",))
 def greedy_decode_fused_shared_spec(
         params, cfg: ModelConfig, prefix: jax.Array, prefix_mask: jax.Array,
@@ -1051,7 +1067,8 @@ def greedy_decode_fused_shared_spec(
         topk: int = 20, prefill_fn=None, stop_mask_b: jax.Array = None,
         stop_mask_a: jax.Array = None, eos_id: jax.Array = None,
         draft_params=None, draft_cfg: ModelConfig = None,
-        return_cache: bool = False, scratch_cache=None):
+        return_cache: bool = False, decode_trunk: int = 0,
+        scratch_cache=None):
     """:func:`greedy_decode_fused_shared` with SPECULATIVE decode tails:
     one shared-prefix prefill, two suffix extensions, then each branch's
     sequential greedy scan is replaced by the draft-and-verify window
@@ -1080,12 +1097,13 @@ def greedy_decode_fused_shared_spec(
         ctx_a, ctx_a_len, draft_a, draft_a_len, ctx_b, ctx_b_len, draft_b,
         draft_b_len, T0, max_new_a, max_new_b, spec_k, ngram, topk,
         stop_mask_a, stop_mask_b, eos_id, draft_params, draft_cfg,
-        return_cache)
+        return_cache, decode_trunk=decode_trunk)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_new_a", "max_new_b", "topk",
-                                    "spec_k", "ngram", "return_cache"),
+                                    "spec_k", "ngram", "return_cache",
+                                    "decode_trunk"),
                    donate_argnames=("scratch_cache",))
 def greedy_decode_fused_shared_paged_spec(
         params, cfg: ModelConfig, pool, slot_src: jax.Array,
@@ -1099,7 +1117,8 @@ def greedy_decode_fused_shared_paged_spec(
         max_new_a: int, max_new_b: int, spec_k: int, ngram: int = 2,
         topk: int = 20, stop_mask_b: jax.Array = None,
         stop_mask_a: jax.Array = None, eos_id: jax.Array = None,
-        return_cache: bool = False, scratch_cache=None):
+        return_cache: bool = False, decode_trunk: int = 0,
+        scratch_cache=None):
     """Speculative decode over the radix-paged prefill front: cached
     prefix pages gather from the pool and only the remainder window
     recomputes (:func:`_paged_prefix`), then both branches run the
@@ -1118,7 +1137,8 @@ def greedy_decode_fused_shared_paged_spec(
         sfx_b_mask, yes_ids, no_ids, digit_ids, digit_vals,
         ctx_a, ctx_a_len, draft_a, draft_a_len, ctx_b, ctx_b_len, draft_b,
         draft_b_len, T0, max_new_a, max_new_b, spec_k, ngram, topk,
-        stop_mask_a, stop_mask_b, eos_id, None, None, return_cache)
+        stop_mask_a, stop_mask_b, eos_id, None, None, return_cache,
+        decode_trunk=decode_trunk)
 
 
 # ---------------------------------------------------------------------------
@@ -1266,7 +1286,8 @@ def shared_piggyback_drain(params, cfg: ModelConfig, carry: PiggybackCarry,
 
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "max_new_a", "max_new_b", "topk",
-                                    "prefill_fn", "return_cache"),
+                                    "prefill_fn", "return_cache",
+                                    "decode_trunk"),
                    donate_argnames=("scratch_cache",))
 def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
                                prefix_mask: jax.Array, sfx_a: jax.Array,
@@ -1279,6 +1300,7 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
                                stop_mask_a: jax.Array = None,
                                eos_id: jax.Array = None,
                                return_cache: bool = False,
+                               decode_trunk: int = 0,
                                scratch_cache=None):
     """TWO fused greedy decodes sharing ONE prefill over a common prefix.
 
@@ -1329,7 +1351,8 @@ def greedy_decode_fused_shared(params, cfg: ModelConfig, prefix: jax.Array,
             params, cfg, cache_in, sfx, sfx_mask, cm, S)
         return _fused_tail(params, cfg, logits_l, cache2, cm, pos, S + S2,
                            yes_ids, no_ids, d_ids, d_vals, new_tokens, topk,
-                           stop_mask=stop_mask, eos_id=eos_id)
+                           stop_mask=stop_mask, eos_id=eos_id,
+                           decode_trunk=decode_trunk)
 
     # The binary branch (A) takes, when provided, the EOS-only stop
     # (tokens.eos_only_stop_classes: all-transparent classes reduce the

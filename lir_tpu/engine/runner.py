@@ -27,7 +27,8 @@ from ..guard.watchdog import DispatchWatchdog
 from ..models import decoder, paged, quant
 from ..utils.profiling import (CascadeStats, CompileStats, FaultStats,
                                GuardStats, KernelStats, PrefixCacheStats,
-                               SpecStats, cascade_prefill_flops_saved)
+                               SpecStats, cascade_decode_bytes_saved,
+                               cascade_prefill_flops_saved)
 from . import (compile_plan, generate, hbm, prefix_tree,
                scheduler as scheduler_mod, score, spec as spec_mod,
                tokens as tok)
@@ -155,6 +156,21 @@ class ScoringEngine:
                 and cfg.fused_decode != self.rt.fused_decode):
             self.cfg = cfg = dataclasses.replace(
                 cfg, fused_decode=self.rt.fused_decode)
+        # Cascade decode + fused-suffix cascade prefill follow the same
+        # discipline: runtime choices mirrored into the static model
+        # config, so --no-cascade-decode / --no-cascade-fused-suffix
+        # re-key every affected executable and the manifest can never
+        # serve the other mode's lowering.
+        if (not encoder_decoder
+                and getattr(cfg, "cascade_decode", None) is not None
+                and cfg.cascade_decode != self.rt.cascade_decode):
+            self.cfg = cfg = dataclasses.replace(
+                cfg, cascade_decode=self.rt.cascade_decode)
+        if (not encoder_decoder
+                and getattr(cfg, "cascade_fused_suffix", None) is not None
+                and cfg.cascade_fused_suffix != self.rt.cascade_fused_suffix):
+            self.cfg = cfg = dataclasses.replace(
+                cfg, cascade_fused_suffix=self.rt.cascade_fused_suffix)
         # Sequence-parallel prefill (long-context path): with a mesh whose
         # `seq` axis > 1, the quadratic prompt phase runs seq-sharded
         # through ring/Ulysses attention (parallel/seq_forward) and hands
@@ -477,6 +493,14 @@ class ScoringEngine:
         dedup for free but buy nothing."""
         if not self.cascade_supported() or not prefix_ids:
             return 0
+        return self._lcp_trunk(prefix_ids, n_real, bucket)
+
+    def _lcp_trunk(self, prefix_ids: Sequence[Sequence[int]],
+                   n_real: Optional[int], bucket: Optional[int]) -> int:
+        """The quantized shared-trunk extent both cascade phases key on:
+        all-rows LCP, snapped DOWN to the trunk_quantum grid, clamped
+        strictly inside the bucket, floored at min_trunk; 0 when the
+        dispatch is too small (min_rows) or the trunk too short."""
         cc = self.cascade_cfg
         rows_real = len(prefix_ids) if n_real is None else n_real
         if rows_real < max(cc.min_rows, 2):
@@ -488,6 +512,58 @@ class ScoringEngine:
         if trunk < max(int(cc.min_trunk), q):
             return 0
         return trunk
+
+    # -- cascade decode (ops/flash_decode trunk-aware splits) ---------------
+
+    def cascade_decode_supported(self) -> bool:
+        """Engine-level gate for cascade DECODE: on by config, plain
+        decoder engines only, float KV only, and only where the fused
+        decode kernels run at all (cfg.fused_decode on the TPU backend,
+        or CPU under the interpreter when
+        decoder.FUSED_DECODE_INTERPRET_ON_CPU is armed) — the
+        trunk-aware split dedup lives inside flash_decode/flash_decode_mq,
+        so without the fused kernels there is nothing to dedup. The
+        decoder gates once more on cfg.cascade_decode (belt and braces:
+        --no-cascade-decode zeroes the trunk here AND flips the static
+        cfg, so stale executables can never serve the other mode)."""
+        if not (self.rt.cascade_decode and not self.encoder_decoder
+                and getattr(self.cfg, "fused_decode", True)
+                and not getattr(self.cfg, "kv_cache_int8", False)):
+            return False
+        return (jax.default_backend() == "tpu"
+                or decoder.FUSED_DECODE_INTERPRET_ON_CPU)
+
+    def decode_trunk_for(self, prefix_ids: Sequence[Sequence[int]],
+                         n_real: Optional[int] = None,
+                         bucket: Optional[int] = None) -> int:
+        """The dispatch's shared-trunk extent for DECODE-phase dedup, or
+        0 for the flat kernels: same LCP/quantum/bucket discipline as
+        :meth:`cascade_trunk_for` (the trunk slots lead every row of the
+        right-padded cache either way), but gated on the decode-side
+        support check — a dispatch can cascade its decode steps even
+        when the prefill ran dense (e.g. paged-warm prefixes), and vice
+        versa. The extent is a static compiled shape: compile_plan keys
+        decode executables on it."""
+        if not self.cascade_decode_supported() or not prefix_ids:
+            return 0
+        return self._lcp_trunk(prefix_ids, n_real, bucket)
+
+    def _note_cascade_decode(self, dtrunk: int, rows: int, bucket: int,
+                             ba: int, bb: int, new_tokens: int,
+                             conf_tokens: int) -> None:
+        """Fold one trunk-aware decode dispatch into the cascade
+        counters: the analytic HBM bytes the trunk dedup did NOT stream
+        (trunk K/V tiles load once per decode step instead of once per
+        row — profiling.cascade_decode_bytes_saved), over both format
+        branches' full decode budgets."""
+        if not dtrunk or rows <= 1:
+            return
+        t0 = bucket + max(ba + new_tokens, bb + conf_tokens)
+        self.cascade_stats.count("cascade_decode_dispatches")
+        self.cascade_stats.count(
+            "trunk_bytes_deduped",
+            int(cascade_decode_bytes_saved(
+                self.cfg, rows, dtrunk, t0, new_tokens + conf_tokens)))
 
     def _cache_aval(self):
         """ShapeDtypeStruct tree of this engine's decode cache (leaf
@@ -966,6 +1042,15 @@ class ScoringEngine:
                     use_prefix_cache, n_real)
             if self.cascade_supported():
                 self.cascade_stats.count("dense_fallbacks")
+            # Cascade DECODE without cascade prefill: a dispatch that
+            # runs its prefill dense (cascade prefill off, ineligible,
+            # or superseded by a paged-warm front) still shares its
+            # trunk slots row-for-row, so every decode step's trunk
+            # splits can read the trunk KV once per kv head instead of
+            # once per row (ops/flash_decode trunk variants — bitwise
+            # the flat kernels). The extent is a static compiled shape;
+            # compile_plan keys the shared executables on it.
+            dtrunk = self.decode_trunk_for(prefix_rows, n_real, bucket)
             plan = self._prefix_plan_or_none(
                 bucket, prefix_rows, n_real,
                 len(bin_ids), use_prefix_cache)
@@ -1007,7 +1092,7 @@ class ScoringEngine:
                         prefix_mask, sfx_a, sfx_a_mask, sfx_b, sfx_b_mask,
                         yes_ids, no_ids, digit_ids, digit_vals,
                         new_tokens, conf_tokens, stop_kwargs, scratch,
-                        ba, bb)
+                        ba, bb, dtrunk)
                 except BaseException:
                     if plan is not None:
                         self._abort_prefix_resume(plan)
@@ -1021,6 +1106,9 @@ class ScoringEngine:
                 self._note_handoff(cache)
                 if plan is not None:
                     self._finish_prefix_resume(plan, cache)
+                self._note_cascade_decode(
+                    dtrunk, len(bin_ids) if n_real is None else n_real,
+                    bucket, ba, bb, new_tokens, conf_tokens)
                 return fused, cfused
             try:
                 if plan is not None and plan.window is not None:
@@ -1043,7 +1131,8 @@ class ScoringEngine:
                                 bucket, len(bin_ids), plan.window, ba, bb,
                                 new_tokens, conf_tokens,
                                 stops_armed=stop_mask is not None,
-                                scratch=scratch is not None))
+                                scratch=scratch is not None,
+                                decode_trunk=dtrunk))
                     if exe is not None:
                         fused, cfused, cache = compile_plan.registry_call(
                             exe, dyn_args, stop_kwargs, scratch)
@@ -1053,7 +1142,7 @@ class ScoringEngine:
                                 dyn_args[0], self.cfg, *dyn_args[1:],
                                 max_new_a=new_tokens, max_new_b=conf_tokens,
                                 return_cache=True, scratch_cache=scratch,
-                                **stop_kwargs))
+                                decode_trunk=dtrunk, **stop_kwargs))
                 else:
                     dyn_args = (self.params, jnp.asarray(prefix),
                                 jnp.asarray(prefix_mask), jnp.asarray(sfx_a),
@@ -1068,7 +1157,8 @@ class ScoringEngine:
                         exe = self.exec_registry.get(compile_plan.shared_spec(
                             bucket, len(bin_ids), ba, bb, new_tokens,
                             conf_tokens, stops_armed=stop_mask is not None,
-                            scratch=scratch is not None))
+                            scratch=scratch is not None,
+                            decode_trunk=dtrunk))
                     if exe is not None:
                         fused, cfused, cache = compile_plan.registry_call(
                             exe, dyn_args, stop_kwargs, scratch)
@@ -1077,7 +1167,7 @@ class ScoringEngine:
                             generate.greedy_decode_fused_shared(
                                 dyn_args[0], self.cfg, *dyn_args[1:],
                                 return_cache=True, scratch_cache=scratch,
-                                **kwargs))
+                                decode_trunk=dtrunk, **kwargs))
             except BaseException:
                 if plan is not None:
                     self._abort_prefix_resume(plan)
@@ -1086,6 +1176,9 @@ class ScoringEngine:
             self._note_handoff(cache)
             if plan is not None:
                 self._finish_prefix_resume(plan, cache)
+            self._note_cascade_decode(
+                dtrunk, len(bin_ids) if n_real is None else n_real,
+                bucket, ba, bb, new_tokens, conf_tokens)
             return fused, cfused
         return generate.greedy_decode_fused_shared(
             self.params, self.cfg, jnp.asarray(prefix),
@@ -1101,11 +1194,15 @@ class ScoringEngine:
                               no_ids, digit_ids, digit_vals,
                               new_tokens: int, conf_tokens: int,
                               stop_kwargs: dict, scratch, ba: int,
-                              bb: int):
+                              bb: int, dtrunk: int = 0):
         """One SPECULATIVE shared dispatch (registry executable when
         planned, lazy jit otherwise): the unpaged prefill front or the
         radix-paged resume front, then both branches' draft-and-verify
-        tails. Returns (fused, cfused, SpecOut_a, SpecOut_b, cache)."""
+        tails. ``dtrunk`` > 0 runs every verify window's trunk splits
+        trunk-aware (cascade decode — the verifier's multi-query
+        flash_decode_mq_trunk; the fleet draft model stays flat, its
+        drafts are quality-only). Returns (fused, cfused, SpecOut_a,
+        SpecOut_b, cache)."""
         armed = stop_kwargs.get("eos_id") is not None
         spec_args = tuple(jnp.asarray(x) for x in splan.dyn_args())
         if paged_warm:
@@ -1124,7 +1221,8 @@ class ScoringEngine:
                 exe = self.exec_registry.get(compile_plan.shared_paged_spec(
                     bucket, len(prefix_mask), plan.window, ba, bb,
                     new_tokens, conf_tokens, stops_armed=armed,
-                    scratch=scratch is not None, spec_k=splan.k))
+                    scratch=scratch is not None, spec_k=splan.k,
+                    decode_trunk=dtrunk))
             if exe is not None:
                 out = compile_plan.registry_call(exe, dyn_args,
                                                  stop_kwargs, scratch)
@@ -1133,7 +1231,8 @@ class ScoringEngine:
                     dyn_args[0], self.cfg, *dyn_args[1:],
                     max_new_a=new_tokens, max_new_b=conf_tokens,
                     spec_k=splan.k, ngram=splan.ngram, return_cache=True,
-                    scratch_cache=scratch, **stop_kwargs)
+                    scratch_cache=scratch, decode_trunk=dtrunk,
+                    **stop_kwargs)
         else:
             draft_params, draft_cfg = None, None
             if splan.fleet:
@@ -1152,7 +1251,8 @@ class ScoringEngine:
                     bucket, len(prefix_mask), ba, bb, new_tokens,
                     conf_tokens, stops_armed=armed,
                     scratch=scratch is not None,
-                    spec_k=splan.k, spec_draft=splan.fleet))
+                    spec_k=splan.k, spec_draft=splan.fleet,
+                    decode_trunk=dtrunk))
             if exe is not None:
                 out = compile_plan.registry_call(
                     exe, dyn_args,
@@ -1165,7 +1265,7 @@ class ScoringEngine:
                     prefill_fn=self._prefill_fn,
                     draft_params=draft_params, draft_cfg=draft_cfg,
                     return_cache=True, scratch_cache=scratch,
-                    **stop_kwargs)
+                    decode_trunk=dtrunk, **stop_kwargs)
         return out
 
     def _dispatch_shared_cascade(self, trunk: int, bucket: int,
@@ -1276,6 +1376,13 @@ class ScoringEngine:
         self.cascade_stats.count(
             "prefix_flops_saved",
             int(cascade_prefill_flops_saved(self.cfg, rows, trunk)))
+        # The cascade dispatch's decode scans ride the trunk-aware flash
+        # kernels too (generate._cascade_branches passes the trunk
+        # through) — count that side's dedup where the kernels actually
+        # run (the decode gate, not the prefill one).
+        if self.cascade_decode_supported():
+            self._note_cascade_decode(trunk, rows, bucket, ba, bb,
+                                      new_tokens, conf_tokens)
         return fused, cfused
 
     # -- chunked prefill/decode piggybacking --------------------------------

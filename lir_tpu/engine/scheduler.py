@@ -82,6 +82,14 @@ def decode_token_cost(fused_decode: bool = True,
             else DECODE_TOKEN_COST_UNFUSED)
 
 
+# Cascade DECODE discount (ops/flash_decode trunk variants): the share
+# of a fused decode step's cost that is KV-cache HBM streaming — the
+# only term the trunk dedup removes (weights/activations stream either
+# way). The discount scales by the trunk's fraction of the cache extent
+# and by the deduped-row fraction (slots - 1) / slots, so a batch-1 or
+# trunkless dispatch prices byte-identically to the flat kernel.
+CASCADE_DECODE_KV_SHARE = 0.3
+
 # Cascade-prefill watchdog spread (watchdog_seed_headroom): a cascade
 # engine's deadlines calibrate on cascade-discounted dispatches, but an
 # ineligible dispatch (short LCP, too few rows) legitimately runs the
@@ -131,15 +139,28 @@ def _tail_batch(n: int, cap: int) -> int:
 
 def decode_floor(n_rows: int, batch_size: int, decode_cost: int,
                  fused_decode: bool = True,
-                 spec_decode: bool = False) -> float:
+                 spec_decode: bool = False,
+                 decode_trunk_frac: float = 0.0) -> float:
     """The decode-scan floor of a dispatch's price: every padded slot runs
     the full decode budget whether it carries work or padding, priced at
     the kernel mode's decode-floor constant. Cached prefill can never
     push a dispatch below this (bucket_cost); the piggyback path prices
     a parked dispatch's pending scans with exactly this term.
-    ``spec_decode`` prices a speculating dispatch's verify forwards."""
-    return (_tail_batch(n_rows, batch_size) * decode_cost
-            * decode_token_cost(fused_decode, spec_decode))
+    ``spec_decode`` prices a speculating dispatch's verify forwards.
+
+    ``decode_trunk_frac`` (trunk tokens / cache extent, 0..1) prices the
+    cascade-DECODE dedup: a trunk-aware dispatch streams its trunk K/V
+    tiles once per step instead of once per row, shaving
+    CASCADE_DECODE_KV_SHARE x trunk-fraction x deduped-row-fraction off
+    the floor. The default keeps every pre-existing plan
+    byte-identical."""
+    slots = _tail_batch(n_rows, batch_size)
+    floor = (slots * decode_cost
+             * decode_token_cost(fused_decode, spec_decode))
+    if decode_trunk_frac > 0.0 and slots > 1:
+        frac = min(max(float(decode_trunk_frac), 0.0), 1.0)
+        floor *= 1.0 - CASCADE_DECODE_KV_SHARE * frac * (slots - 1) / slots
+    return floor
 
 
 def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
@@ -147,7 +168,8 @@ def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
                 fused_decode: bool = True,
                 spec_decode: bool = False,
                 cascade: bool = False,
-                trunk_tokens: int = 0) -> float:
+                trunk_tokens: int = 0,
+                decode_trunk_frac: float = 0.0) -> float:
     """Row-token cost of dispatching ``n_rows`` cells at ``bucket_edge``:
     a padded power-of-two batch prefilled at the edge, plus the fixed
     decode floor (:func:`decode_floor` — the steps run whether the slots
@@ -174,14 +196,17 @@ def bucket_cost(n_rows: int, bucket_edge: int, batch_size: int,
     ``(slots - 1) * trunk_tokens`` comes off the prefill term — on top
     of any radix-cached tokens (a warm trunk discounts through
     ``cached_tokens`` too; the max(0) clamp keeps double-counting from
-    going negative). Defaults price the dense path byte-identically."""
+    going negative). ``decode_trunk_frac`` prices the cascade-DECODE
+    dedup through :func:`decode_floor`. Defaults price the dense path
+    byte-identically."""
     slots = _tail_batch(n_rows, batch_size)
     prefill = slots * bucket_edge - int(cached_tokens)
     if cascade and trunk_tokens > 0:
         prefill -= (slots - 1) * int(trunk_tokens)
     prefill = max(prefill, 0)
     return prefill + decode_floor(n_rows, batch_size, decode_cost,
-                                  fused_decode, spec_decode)
+                                  fused_decode, spec_decode,
+                                  decode_trunk_frac=decode_trunk_frac)
 
 
 @dataclasses.dataclass(frozen=True)

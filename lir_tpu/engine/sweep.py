@@ -669,7 +669,13 @@ def _run_pipelined(engine, model_name, todo, target_ids, results_path,
                                lambda: False)() else None),
                 cascade_int8=bool(
                     getattr(engine, "cascade_cfg", None) is not None
-                    and engine.cascade_cfg.int8_qk))
+                    and engine.cascade_cfg.int8_qk),
+                decode_trunk=(
+                    (lambda d: engine.decode_trunk_for(
+                        [it.bin_ids[:it.lcp] for it in d.items],
+                        len(d.items), d.bucket))
+                    if getattr(engine, "cascade_decode_supported",
+                               lambda: False)() else None))
             engine.exec_registry = compile_plan.precompile_async(
                 engine, specs, max_workers=engine.rt.precompile_workers)
             log.info("compile plan: precompiling %d executable shapes "

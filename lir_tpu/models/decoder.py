@@ -275,7 +275,8 @@ def _fused_decode_ok(cfg: ModelConfig, S: int, fused_ctx) -> bool:
 
 
 def _attention_cached_flash(q: jax.Array, k: jax.Array, v: jax.Array,
-                            cfg: ModelConfig, fused_ctx) -> jax.Array:
+                            cfg: ModelConfig, fused_ctx,
+                            trunk_len: int = 0) -> jax.Array:
     """Decode-step attention through the fused Pallas flash-decode kernel
     (ops/flash_decode): the (B, H, 1, T) score row, fp32 softmax, and
     probability row stay in VMEM instead of round-tripping HBM between
@@ -283,8 +284,15 @@ def _attention_cached_flash(q: jax.Array, k: jax.Array, v: jax.Array,
     grouped contraction against the un-repeated cache, same masking
     semantics as :func:`_attention_cached` (pinned by tests/
     test_kernels.py); ALiBi rides per-head slopes + mask-aware key
-    positions exactly like the prefill flash kernel."""
-    from ..ops.flash_decode import flash_decode
+    positions exactly like the prefill flash kernel.
+
+    ``trunk_len`` > 0 (a shared-trunk dispatch with cascade decode on)
+    routes through the trunk-aware variant: the cache's leading
+    ``trunk_len`` slots are identical across rows, so the trunk splits
+    read K/V from cache row 0 ONCE per kv head for all rows' queries —
+    bitwise the flat kernel (the split ladder, per-split arithmetic and
+    merge are unchanged; only the trunk tiles' HBM reads dedup)."""
+    from ..ops.flash_decode import flash_decode, flash_decode_trunk
 
     B, S, H, hd = q.shape
     q_pos, key_mask, key_positions = fused_ctx
@@ -292,9 +300,15 @@ def _attention_cached_flash(q: jax.Array, k: jax.Array, v: jax.Array,
                  and jax.default_backend() != "tpu")
     slopes = (alibi_slopes(cfg.n_heads) if cfg.pos_embedding == "alibi"
               else None)
-    out = flash_decode(q[:, 0], k, v, q_pos, key_mask,
-                       key_positions=key_positions, alibi_slopes=slopes,
-                       interpret=interpret)
+    if trunk_len > 0:
+        out = flash_decode_trunk(q[:, 0], k, v, q_pos, key_mask,
+                                 key_positions=key_positions,
+                                 alibi_slopes=slopes, trunk_len=trunk_len,
+                                 interpret=interpret)
+    else:
+        out = flash_decode(q[:, 0], k, v, q_pos, key_mask,
+                           key_positions=key_positions, alibi_slopes=slopes,
+                           interpret=interpret)
     return out.reshape(B, S, H * hd)
 
 
@@ -313,13 +327,17 @@ def _fused_decode_mq_ok(cfg: ModelConfig, S: int, fused_ctx) -> bool:
 
 
 def _attention_cached_flash_mq(q: jax.Array, k: jax.Array, v: jax.Array,
-                               cfg: ModelConfig, fused_ctx) -> jax.Array:
+                               cfg: ModelConfig, fused_ctx,
+                               trunk_len: int = 0) -> jax.Array:
     """Verify-window attention through the multi-query fused kernel
     (ops/flash_decode.flash_decode_mq): S teacher-forced queries per row
     attend over the cache (the window's own k/v already written) in one
     launch, each query's reduction bitwise the single-query kernel's —
-    the speculative verify path's decode-step parity contract."""
-    from ..ops.flash_decode import flash_decode_mq
+    the speculative verify path's decode-step parity contract.
+    ``trunk_len`` > 0 routes the trunk-aware sibling so PR-13
+    speculative verify windows ride the trunk-split dedup too (see
+    :func:`_attention_cached_flash`)."""
+    from ..ops.flash_decode import flash_decode_mq, flash_decode_mq_trunk
 
     B, S, H, hd = q.shape
     q_pos, key_mask, key_positions = fused_ctx
@@ -327,9 +345,16 @@ def _attention_cached_flash_mq(q: jax.Array, k: jax.Array, v: jax.Array,
                  and jax.default_backend() != "tpu")
     slopes = (alibi_slopes(cfg.n_heads) if cfg.pos_embedding == "alibi"
               else None)
-    out = flash_decode_mq(q, k, v, q_pos, key_mask,
-                          key_positions=key_positions, alibi_slopes=slopes,
-                          interpret=interpret)
+    if trunk_len > 0:
+        out = flash_decode_mq_trunk(q, k, v, q_pos, key_mask,
+                                    key_positions=key_positions,
+                                    alibi_slopes=slopes,
+                                    trunk_len=trunk_len,
+                                    interpret=interpret)
+    else:
+        out = flash_decode_mq(q, k, v, q_pos, key_mask,
+                              key_positions=key_positions,
+                              alibi_slopes=slopes, interpret=interpret)
     return out.reshape(B, S, H * hd)
 
 
@@ -356,7 +381,8 @@ def _attention_cascade(q: jax.Array, k: jax.Array, v: jax.Array,
     tk, tv = trunk_kv
     out = cascade_attention(q, k, v, tk, tv, suffix_mask, q_positions,
                             alibi_slopes=slopes, int8_qk=int8_qk,
-                            interpret=interpret)
+                            interpret=interpret,
+                            fused_suffix=cfg.cascade_fused_suffix)
     return out.reshape(B, R, H * hd)
 
 
@@ -388,7 +414,7 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
            bias: jax.Array, cache_kv: Optional[Tuple[jax.Array, jax.Array]],
            cache_index: Optional[jax.Array],
            key_mask: Optional[jax.Array] = None,
-           attn_impl=None, fused_ctx=None):
+           attn_impl=None, fused_ctx=None, trunk_len: int = 0):
     """One transformer block. Returns (new_x, (k_full, v_full)).
 
     ``attn_impl(q, k, v, key_mask) -> (B, S, H*hd)`` replaces dense
@@ -398,6 +424,9 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
     key positions (B, T)) triple — arms the fused flash-decode route for
     single-query cache steps (:func:`_fused_decode_ok`); the dense path
     and its ``bias`` remain the fallback on every other shape/backend.
+    ``trunk_len`` (static) marks the cache's leading shared-trunk slots
+    for the trunk-aware fused decode kernels (cascade decode) — 0 on
+    every non-shared dispatch and whenever the fused route is off.
     """
     B, S, _ = x.shape
     H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -442,9 +471,11 @@ def _block(x: jax.Array, lp: Params, cfg: ModelConfig, sin, cos,
             cv = lax.dynamic_update_slice(cv, v_t.astype(cv.dtype),
                                           (0, cache_index, 0, 0))
             if _fused_decode_ok(cfg, S, fused_ctx):
-                attn = _attention_cached_flash(q, ck, cv, cfg, fused_ctx)
+                attn = _attention_cached_flash(q, ck, cv, cfg, fused_ctx,
+                                               trunk_len=trunk_len)
             elif _fused_decode_mq_ok(cfg, S, fused_ctx):
-                attn = _attention_cached_flash_mq(q, ck, cv, cfg, fused_ctx)
+                attn = _attention_cached_flash_mq(q, ck, cv, cfg, fused_ctx,
+                                                  trunk_len=trunk_len)
             else:
                 attn = _attention_cached(q, ck, cv, bias, cfg)
     elif attn_impl is not None:
@@ -548,7 +579,7 @@ def mask_positions(attn_mask: jax.Array) -> jax.Array:
 
 def _scan_blocks(params: Params, cfg: ModelConfig, x, sin, cos, bias,
                  cache=None, cache_index=None, key_mask=None, attn_impl=None,
-                 fused_ctx=None):
+                 fused_ctx=None, trunk_len: int = 0):
     """lax.scan over the stacked layer params."""
     def body(carry, xs):
         h = carry
@@ -559,7 +590,8 @@ def _scan_blocks(params: Params, cfg: ModelConfig, x, sin, cos, bias,
             return h, None
         lp, (ck, cv) = xs
         h, (nk, nv) = _block(h, lp, cfg, sin, cos, bias, (ck, cv),
-                             cache_index, fused_ctx=fused_ctx)
+                             cache_index, fused_ctx=fused_ctx,
+                             trunk_len=trunk_len)
         return h, (nk, nv)
 
     xs = params["layers"] if cache is None else (params["layers"], cache)
@@ -764,7 +796,7 @@ def cascade_extend(params: Params, cfg: ModelConfig, trunk_cache,
 
 def verify_extend(params: Params, cfg: ModelConfig, cache,
                   chunk_tokens: jax.Array, cache_mask: jax.Array,
-                  start_index: jax.Array):
+                  start_index: jax.Array, trunk_len: int = 0):
     """Teacher-forced VERIFY window (speculative decode): run the S-token
     draft window [current emission, drafts...] through the layers in one
     forward, writing its k/v at cache slots [start_index, start_index+S)
@@ -791,6 +823,11 @@ def verify_extend(params: Params, cfg: ModelConfig, cache,
     speculative tail needs: every CONSUMED readout (position-0 floats,
     the emitted token stream) stays bitwise.
 
+    ``trunk_len`` (static) routes the window through the trunk-aware
+    multi-query kernel on shared-trunk dispatches (cascade decode, gated
+    by ``cfg.cascade_decode``): the verify window's trunk splits compute
+    once per kv head for every row's queries, bitwise the flat kernel.
+
     Returns (logits (B, S, V) fp32, new_cache)."""
     B, S2 = chunk_tokens.shape
     key_positions = mask_positions(cache_mask)
@@ -805,20 +842,26 @@ def verify_extend(params: Params, cfg: ModelConfig, cache,
     x, new_cache = _scan_blocks(params, cfg, x, sin, cos, bias,
                                 cache=cache, cache_index=start_index,
                                 fused_ctx=(qpos, cache_mask,
-                                           key_positions))
+                                           key_positions),
+                                trunk_len=(int(trunk_len)
+                                           if cfg.cascade_decode else 0))
     logits = _unembed(params, cfg, x)
     return logits, new_cache
 
 
 def decode_step(params: Params, cfg: ModelConfig, cache, token: jax.Array,
                 position: jax.Array, step_index: jax.Array,
-                prompt_mask: jax.Array):
+                prompt_mask: jax.Array, trunk_len: int = 0):
     """One greedy-decode step.
 
     token: (B,) int32 current input; position: (B,) its mask-aware position;
     step_index: scalar slot in the cache where this token's k/v land (= S + t);
     prompt_mask: (B, T) validity mask over the FULL cache length T (prompt pads
     0, prompt tokens and generated slots 1 once written).
+    ``trunk_len`` (static): on a shared-trunk dispatch with cascade
+    decode on (``cfg.cascade_decode``), the cache's leading trunk slots
+    are row-identical and the fused kernel's trunk splits read them once
+    per kv head for all rows — bitwise the flat kernel.
     Returns (logits (B, V) fp32, new_cache).
     """
     B = token.shape[0]
@@ -837,6 +880,8 @@ def decode_step(params: Params, cfg: ModelConfig, cache, token: jax.Array,
     x, new_cache = _scan_blocks(params, cfg, x, sin, cos, bias,
                                 cache=cache, cache_index=step_index,
                                 fused_ctx=(position, prompt_mask,
-                                           key_positions))
+                                           key_positions),
+                                trunk_len=(int(trunk_len)
+                                           if cfg.cascade_decode else 0))
     logits = _unembed(params, cfg, x)[:, 0, :]
     return logits, new_cache

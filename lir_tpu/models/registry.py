@@ -79,6 +79,24 @@ class ModelConfig:
     # decode path exactly.
     fused_decode: bool = True
 
+    # Cascade decode (ops/flash_decode.flash_decode_trunk): shared-trunk
+    # dispatches compute the trunk's split-K decode partials ONCE per kv
+    # head for ALL rows' queries (the trunk K/V tiles stream from HBM
+    # once per step instead of once per row), per-row suffix splits run
+    # the flat kernel's path over only the tail, merged by ops/lse —
+    # bitwise the flat kernel by construction. Static so the decode
+    # executables specialize on it; mirrored from RuntimeConfig.
+    # cascade_decode / --no-cascade-decode, which restores the flat
+    # kernel exactly (the trunk extent is then pinned to 0).
+    cascade_decode: bool = True
+
+    # Fused cascade-prefill suffix leg (ops/cascade_prefill): prefix +
+    # suffix + log-sum-exp merge in ONE Pallas launch, no HBM round-trip
+    # for the partial (o, m, l) triples. Bitwise the two-leg path on the
+    # cascade matrix; RuntimeConfig.cascade_fused_suffix /
+    # --no-cascade-fused-suffix restores the two-leg lowering exactly.
+    cascade_fused_suffix: bool = True
+
     # KV-cache storage: int8 with per-(head, position, row) scales halves
     # cache HBM (the single-chip long-context limiter — a 7B's bf16 cache
     # plus XLA's while-loop copy OOMs v5e at seq 1024, SCALE.md) and

@@ -105,7 +105,8 @@ STATS_SCHEMA: Dict[str, Tuple[str, ...]] = {
     ),
     "CascadeStats": (
         "cascade_dispatches", "dense_fallbacks", "trunk_rows_deduped",
-        "prefix_flops_saved",
+        "prefix_flops_saved", "cascade_decode_dispatches",
+        "trunk_bytes_deduped",
     ),
     "MemStats": (
         "ledger_bytes", "budget_bytes", "pressure", "rung",

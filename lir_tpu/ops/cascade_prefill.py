@@ -201,12 +201,122 @@ def _suffix_partials(q, sfx_k, sfx_v, suffix_mask, q_positions, slopes):
     return o, m, p.sum(axis=-1)
 
 
+def _fused_cascade_kernel(slope_ref, qpos_ref, smask_ref, q_ref, sk_ref,
+                          sv_ref, tk_ref, tv_ref, o_ref, *, sm_scale: float,
+                          alibi: bool, n_groups: int):
+    """One (kv head, batch row) program of the FULLY-FUSED cascade:
+    prefix leg + suffix leg + log-sum-exp merge in a single kernel, so
+    the partial (o, m, l) triples never round-trip through HBM. Every
+    per-element op mirrors the two-leg path exactly — the prefix block
+    is :func:`_prefix_kernel`'s arithmetic, the suffix block is
+    :func:`_suffix_partials`' (per (row, kv head) slice), and the merge
+    is :func:`~lir_tpu.ops.lse.merge_partials`' stacked-sum order — so
+    the fused output is BITWISE the two-leg path's (pinned across the
+    cascade matrix by tests/test_cascade.py)."""
+    G = n_groups
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale        # (R*G, hd)
+    RG, hd = q.shape
+    R = RG // G
+    # Per-flattened-row slopes arrive HOST-built (like _prefix_partials'
+    # flattened slope array): building them in-kernel from a (G,) block
+    # lets XLA contract the bias mul+add into an FMA, a 1-ulp drift off
+    # the two-leg lowering.
+    slope_rg = slope_ref[0]                               # (RG,)
+
+    # Prefix leg (== _prefix_kernel, non-int8): no mask, no causality.
+    tk = tk_ref[0]                                        # (Tt, hd)
+    s = jnp.dot(q, tk.astype(jnp.float32).T,
+                preferred_element_type=jnp.float32)       # (RG, Tt)
+    if alibi:
+        kp_t = jax.lax.broadcasted_iota(jnp.float32, s.shape, 1)
+        s = s + slope_rg[:, None] * kp_t
+    m_t = s.max(axis=-1)                                  # (RG,)
+    p = jnp.exp(s - m_t[:, None])
+    o_t = jnp.dot(p, tv_ref[0].astype(jnp.float32),
+                  preferred_element_type=jnp.float32)
+    l_t = p.sum(axis=-1)
+
+    # Suffix leg (== _suffix_partials for this (b, kh) slice): causal
+    # within the window, mask-aware, ALiBi on absolute key positions.
+    sk = sk_ref[0, 0].astype(jnp.float32)                 # (R, hd)
+    s2 = jnp.dot(q, sk.T, preferred_element_type=jnp.float32)  # (RG, R)
+    qp = qpos_ref[0]                                      # (R,)
+    if alibi:
+        s2 = s2 + slope_rg[:, None] * qp.astype(jnp.float32)[None, :]
+    valid = (smask_ref[0] > 0)[None, :] & (qp[None, :] <= qp[:, None])
+    valid = jnp.broadcast_to(valid[:, None, :], (R, G, R)).reshape(RG, R)
+    s2 = jnp.where(valid, s2, -jnp.inf)
+    m_s = s2.max(axis=-1)
+    p2 = jnp.exp(s2 - m_s[:, None])
+    p2 = jnp.where(jnp.isfinite(s2), p2, 0.0)             # all-masked row
+    o_s = jnp.dot(p2, sv_ref[0, 0].astype(jnp.float32),
+                  preferred_element_type=jnp.float32)
+    l_s = p2.sum(axis=-1)
+
+    # In-VMEM merge: merge_partials' exact stacked-reduction order over
+    # the two partials, trunk first.
+    m_p = jnp.stack([m_t, m_s])
+    m = m_p.max(axis=0)
+    w = jnp.where(jnp.isfinite(m_p), jnp.exp(m_p - m[None]), 0.0)
+    l = (w * jnp.stack([l_t, l_s])).sum(axis=0)
+    o = (w[..., None] * jnp.stack([o_t, o_s])).sum(axis=0)
+    o_ref[0, 0] = o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def _cascade_fused(q, sfx_k, sfx_v, trunk_k, trunk_v, suffix_mask,
+                   q_positions, slopes, interpret: bool):
+    """Single-launch cascade attention: grid (K, B), each program owns
+    one row's R*G flattened queries against the whole trunk plus the
+    row's own suffix window, merged in VMEM — one kernel, zero HBM
+    round-trips for the partials."""
+    B, R, H, hd = q.shape
+    K, Tt = trunk_k.shape[0], trunk_k.shape[1]
+    G = H // K
+    RG = R * G
+    sm_scale = 1.0 / math.sqrt(hd)
+    alibi = slopes is not None
+    if alibi:
+        sl = jnp.broadcast_to(
+            jnp.asarray(slopes, jnp.float32).reshape(K, 1, G),
+            (K, R, G)).reshape(K, RG)
+    else:
+        sl = jnp.zeros((K, RG), jnp.float32)
+    qf = (q.reshape(B, R, K, G, hd).transpose(0, 2, 1, 3, 4)
+          .reshape(B, K, RG, hd))
+    skt = sfx_k.transpose(0, 2, 1, 3)                     # (B, K, R, hd)
+    svt = sfx_v.transpose(0, 2, 1, 3)
+    kernel = functools.partial(_fused_cascade_kernel, sm_scale=sm_scale,
+                               alibi=alibi, n_groups=G)
+    out = pl.pallas_call(
+        kernel,
+        grid=(K, B),
+        in_specs=[
+            pl.BlockSpec((1, RG), lambda h, b: (h, 0)),
+            pl.BlockSpec((1, R), lambda h, b: (b, 0)),
+            pl.BlockSpec((1, R), lambda h, b: (b, 0)),
+            pl.BlockSpec((1, 1, RG, hd), lambda h, b: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, R, hd), lambda h, b: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, R, hd), lambda h, b: (b, h, 0, 0)),
+            pl.BlockSpec((1, Tt, hd), lambda h, b: (h, 0, 0)),
+            pl.BlockSpec((1, Tt, hd), lambda h, b: (h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, RG, hd), lambda h, b: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, K, RG, hd), jnp.float32),
+        interpret=interpret,
+    )(sl, jnp.asarray(q_positions, jnp.int32),
+      jnp.asarray(suffix_mask, jnp.int32), qf, skt, svt, trunk_k, trunk_v)
+    out = out.reshape(B, K, R, G, hd).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, R, H, hd).astype(q.dtype)
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("int8_qk", "block_n", "interpret"))
+                   static_argnames=("int8_qk", "block_n", "interpret",
+                                    "fused_suffix"))
 def cascade_attention(q, sfx_k, sfx_v, trunk_k, trunk_v, suffix_mask,
                       q_positions, alibi_slopes=None, int8_qk: bool = False,
                       block_n: int = DEFAULT_BLOCK_N,
-                      interpret: bool = False) -> jnp.ndarray:
+                      interpret: bool = False,
+                      fused_suffix: bool = True) -> jnp.ndarray:
     """Shared-trunk cascade attention for one layer's remainder window.
 
     ``q``: (B, R, H, hd) post-RoPE queries at the dispatch's remainder
@@ -217,7 +327,18 @@ def cascade_attention(q, sfx_k, sfx_v, trunk_k, trunk_v, suffix_mask,
     of the remainder positions; ``q_positions``: (B, R) mask-aware
     ABSOLUTE positions (trunk_len + window-local). Returns (B, R, H, hd)
     in q's dtype — softmax over trunk + window keys, exact.
+
+    ``fused_suffix`` (default ON, RuntimeConfig.cascade_fused_suffix)
+    runs prefix + suffix + merge as ONE Pallas launch with the partials
+    merged in VMEM — bitwise the two-leg path below. The int8-QK^T
+    variant keeps the two-leg split (its prefix leg quantizes in-kernel
+    over flattened query blocks; --no-cascade-fused-suffix restores the
+    two-leg path for float too).
     """
+    if fused_suffix and not int8_qk:
+        return _cascade_fused(q, sfx_k, sfx_v, trunk_k, trunk_v,
+                              suffix_mask, q_positions, alibi_slopes,
+                              interpret)
     B, R, H, hd = q.shape
     o_t, m_t, l_t = _prefix_partials(q, trunk_k, trunk_v, alibi_slopes,
                                      int8_qk, block_n, interpret)

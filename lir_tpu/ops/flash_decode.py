@@ -176,6 +176,175 @@ def flash_decode(
     return out.reshape(B, H, hd).astype(q.dtype)
 
 
+def _trunk_decode_kernel(qpos_ref, slope_ref, mask_ref, kpos_ref, q_ref,
+                         k_ref, v_ref, o_ref, m_ref, l_ref, *,
+                         sm_scale: float, alibi: bool, n_groups: int):
+    """Trunk-split sibling of :func:`_decode_kernel` for shared-prefix
+    cascade decode: every row of a shared dispatch attends the SAME
+    trunk KV (the cascade cache broadcasts the trunk into every batch
+    row), so a split that lies fully inside the trunk reads its K/V
+    block from cache row 0 ONLY — once per (kv head, split) instead of
+    once per row — and batches ALL rows' queries into one MXU GEMM.
+    Per-(row, group) arithmetic is exactly the single-row kernel's (the
+    batched dot never mixes rows, masks/positions stay per-row), which
+    is what keeps the merged output bitwise the flat kernel's."""
+    kh = pl.program_id(0)
+    q = q_ref[0].astype(jnp.float32) * sm_scale           # (B, G, hd)
+    B, G, hd = q.shape
+    k = k_ref[0, :, 0, :].astype(jnp.float32)             # (bs, hd) row 0
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    s = jnp.dot(q.reshape(B * G, hd), k.T,
+                preferred_element_type=jnp.float32)       # (B*G, bs)
+    s = s.reshape(B, G, -1)
+    kmask = mask_ref[0] > 0                               # (B, bs)
+    kp = kpos_ref[0]                                      # (B, bs)
+    qp = qpos_ref[:, 0]                                   # (B,)
+    if alibi:
+        slope = slope_ref[pl.ds(kh * n_groups, n_groups), 0]  # (G,)
+        s = s + slope[None, :, None] * kp.astype(jnp.float32)[:, None, :]
+    valid = (kmask & (kp <= qp[:, None]))[:, None, :]     # (B, 1, bs)
+    s = jnp.where(valid, s, -jnp.inf)
+
+    m = s.max(axis=-1)                                    # (B, G)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)                # all-masked split
+    o = jnp.dot(p.reshape(B * G, -1), v,
+                preferred_element_type=jnp.float32)
+    o_ref[0, 0] = o.reshape(B, G, hd)
+    m_ref[0, 0] = m
+    l_ref[0, 0] = p.sum(axis=-1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("trunk_len", "block_k", "interpret"))
+def flash_decode_trunk(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    key_mask: jnp.ndarray,
+    key_positions: jnp.ndarray | None = None,
+    alibi_slopes: jnp.ndarray | None = None,
+    trunk_len: int = 0,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Trunk-aware decode step for shared-prefix (cascade) dispatches.
+
+    Arguments as :func:`flash_decode` plus static ``trunk_len``: the
+    leading cache extent whose KV is bitwise-identical across the batch
+    (the shared trunk a cascade/shared dispatch broadcast or prefilled
+    into every row). The split ladder is the flat kernel's exactly —
+    ``pick_split(T)`` over the WHOLE cache extent — but the splits that
+    lie fully inside the trunk run as one batched GEMM per kv head
+    against row 0's K/V (HBM loads the trunk tiles once per step, not
+    once per row), while the tail splits run the unmodified per-row
+    kernel. The two partial sets concatenate in original split order
+    and merge through the same :func:`~lir_tpu.ops.lse.merge_partials`
+    reduction, so the result is BITWISE the flat kernel's (pinned by
+    tests/test_cascade_decode) — trunk dedup is a pure HBM-traffic
+    lever. Per step and layer it saves ``2 * K * nt*split * hd *
+    itemsize * (B - 1)`` trunk bytes, nt the trunk split count.
+    """
+    B, H, hd = q.shape
+    K, T = k.shape[0], k.shape[1]
+    G = H // K
+    split = pick_split(T, block_k)
+    nt = max(0, min(int(trunk_len), T - 1)) // split
+    if nt == 0:
+        # No full split fits inside the trunk: the flat kernel verbatim.
+        return flash_decode(q, k, v, q_positions, key_mask, key_positions,
+                            alibi_slopes, block_k, interpret)
+    sm_scale = 1.0 / np.sqrt(hd)
+    alibi = alibi_slopes is not None
+    if key_positions is None:
+        key_positions = jnp.maximum(jnp.cumsum(key_mask, axis=-1) - 1, 0)
+    key_mask = jnp.asarray(key_mask, jnp.int32)
+    key_positions = jnp.asarray(key_positions, jnp.int32)
+    if alibi_slopes is None:
+        slopes = jnp.zeros((H, 1), jnp.float32)
+    else:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(H, 1)
+
+    n_splits = T // split
+    qg = q.reshape(B, K, G, hd)
+    f32 = jnp.float32
+    qpos2 = q_positions[:, None].astype(jnp.int32)
+
+    # Trunk leg: grid (K, nt); K/V blocks index row 0 only — the dedup.
+    kernel_t = functools.partial(_trunk_decode_kernel, sm_scale=sm_scale,
+                                 alibi=alibi, n_groups=G)
+    o_t, m_t, l_t = pl.pallas_call(
+        kernel_t,
+        grid=(K, nt),
+        in_specs=[
+            pl.BlockSpec(index_map=lambda h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(index_map=lambda h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, B, split), lambda h, j: (0, 0, j)),
+            pl.BlockSpec((1, B, split), lambda h, j: (0, 0, j)),
+            pl.BlockSpec((1, B, G, hd), lambda h, j: (h, 0, 0, 0)),
+            pl.BlockSpec((1, split, 1, hd), lambda h, j: (h, j, 0, 0)),
+            pl.BlockSpec((1, split, 1, hd), lambda h, j: (h, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, B, G, hd), lambda h, j: (h, j, 0, 0, 0)),
+            pl.BlockSpec((1, 1, B, G), lambda h, j: (h, j, 0, 0)),
+            pl.BlockSpec((1, 1, B, G), lambda h, j: (h, j, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K, nt, B, G, hd), f32),
+            jax.ShapeDtypeStruct((K, nt, B, G), f32),
+            jax.ShapeDtypeStruct((K, nt, B, G), f32),
+        ],
+        interpret=interpret,
+    )(qpos2, slopes, key_mask[None], key_positions[None],
+      qg.transpose(1, 0, 2, 3), k, v)
+
+    # Suffix leg: the unmodified per-row kernel over only the tail
+    # splits (index maps offset by nt — no cache slicing or copies).
+    ns = n_splits - nt
+    kernel_s = functools.partial(_decode_kernel, sm_scale=sm_scale,
+                                 alibi=alibi, n_groups=G)
+    o_s, m_s, l_s = pl.pallas_call(
+        kernel_s,
+        grid=(B, K, ns),
+        in_specs=[
+            pl.BlockSpec(index_map=lambda b, h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(index_map=lambda b, h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, split), lambda b, h, j: (b, 0, j + nt)),
+            pl.BlockSpec((1, 1, split), lambda b, h, j: (b, 0, j + nt)),
+            pl.BlockSpec((1, 1, G, hd), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, split, 1, hd),
+                         lambda b, h, j: (h, j + nt, b, 0)),
+            pl.BlockSpec((1, split, 1, hd),
+                         lambda b, h, j: (h, j + nt, b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, G, hd), lambda b, h, j: (b, h, j, 0, 0)),
+            pl.BlockSpec((1, 1, 1, G), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, 1, G), lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, K, ns, G, hd), f32),
+            jax.ShapeDtypeStruct((B, K, ns, G), f32),
+            jax.ShapeDtypeStruct((B, K, ns, G), f32),
+        ],
+        interpret=interpret,
+    )(qpos2, slopes, key_mask[:, None, :], key_positions[:, None, :],
+      qg, k, v)
+
+    # Concatenate in original split order, then the flat kernel's merge:
+    # every partial equals the flat kernel's for its split, so the
+    # reduction — and the output — are bitwise-identical.
+    o_p = jnp.concatenate([o_t.transpose(2, 0, 1, 3, 4), o_s], axis=2)
+    m_p = jnp.concatenate([m_t.transpose(2, 0, 1, 3), m_s], axis=2)
+    l_p = jnp.concatenate([l_t.transpose(2, 0, 1, 3), l_s], axis=2)
+    out = merge_partials(o_p, m_p, l_p, axis=2)           # (B, K, G, hd)
+    return out.reshape(B, H, hd).astype(q.dtype)
+
+
 def _decode_kernel_mq(qpos_ref, slope_ref, mask_ref, kpos_ref, q_ref, k_ref,
                       v_ref, o_ref, m_ref, l_ref, *, sm_scale: float,
                       alibi: bool, n_groups: int):
@@ -288,5 +457,157 @@ def flash_decode_mq(
       key_mask[:, None, :], key_positions[:, None, :], qg, k, v)
 
     # Same log-sum-exp combine as flash_decode, with the query axis along.
+    out = merge_partials(o_p, m_p, l_p, axis=2)           # (B, K, S, G, hd)
+    return out.transpose(0, 2, 1, 3, 4).reshape(B, S, H, hd).astype(q.dtype)
+
+
+def _trunk_decode_kernel_mq(qpos_ref, slope_ref, mask_ref, kpos_ref, q_ref,
+                            k_ref, v_ref, o_ref, m_ref, l_ref, *,
+                            sm_scale: float, alibi: bool, n_groups: int):
+    """Trunk-split sibling of :func:`_decode_kernel_mq`: all rows' verify
+    windows (B*S queries) batch into one GEMM per (kv head, trunk
+    split), K/V read from cache row 0 only — speculative verify rides
+    the same trunk dedup as the single-query step, with identical
+    per-(row, query, group) arithmetic."""
+    kh = pl.program_id(0)
+    q = q_ref[0].astype(jnp.float32) * sm_scale           # (B, S, G, hd)
+    B, S, G, hd = q.shape
+    k = k_ref[0, :, 0, :].astype(jnp.float32)             # (bs, hd) row 0
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    s = jnp.dot(q.reshape(B * S * G, hd), k.T,
+                preferred_element_type=jnp.float32)
+    s = s.reshape(B, S, G, -1)
+    kmask = mask_ref[0] > 0                               # (B, bs)
+    kp = kpos_ref[0]                                      # (B, bs)
+    qp = qpos_ref[:]                                      # (B, S)
+    if alibi:
+        slope = slope_ref[pl.ds(kh * n_groups, n_groups), 0]  # (G,)
+        s = s + (slope[None, None, :, None]
+                 * kp.astype(jnp.float32)[:, None, None, :])
+    valid = (kmask[:, None, :]
+             & (kp[:, None, :] <= qp[:, :, None]))[:, :, None, :]
+    s = jnp.where(valid, s, -jnp.inf)                     # (B, S, G, bs)
+
+    m = s.max(axis=-1)                                    # (B, S, G)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)                # all-masked split
+    o = jnp.dot(p.reshape(B * S * G, -1), v,
+                preferred_element_type=jnp.float32)
+    o_ref[0, 0] = o.reshape(B, S, G, hd)
+    m_ref[0, 0] = m
+    l_ref[0, 0] = p.sum(axis=-1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("trunk_len", "block_k", "interpret"))
+def flash_decode_mq_trunk(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    key_mask: jnp.ndarray,
+    key_positions: jnp.ndarray | None = None,
+    alibi_slopes: jnp.ndarray | None = None,
+    trunk_len: int = 0,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Trunk-aware multi-query decode: :func:`flash_decode_mq` with the
+    :func:`flash_decode_trunk` split dedup, so speculative verify
+    windows in a shared-trunk dispatch load the trunk KV once per
+    (kv head, split) per verify pass instead of once per row. Bitwise
+    the flat mq kernel's output (same split ladder, same per-element
+    arithmetic, same merge)."""
+    B, S, H, hd = q.shape
+    K, T = k.shape[0], k.shape[1]
+    G = H // K
+    split = pick_split(T, block_k)
+    nt = max(0, min(int(trunk_len), T - 1)) // split
+    if nt == 0:
+        return flash_decode_mq(q, k, v, q_positions, key_mask,
+                               key_positions, alibi_slopes, block_k,
+                               interpret)
+    sm_scale = 1.0 / np.sqrt(hd)
+    alibi = alibi_slopes is not None
+    if key_positions is None:
+        key_positions = jnp.maximum(jnp.cumsum(key_mask, axis=-1) - 1, 0)
+    key_mask = jnp.asarray(key_mask, jnp.int32)
+    key_positions = jnp.asarray(key_positions, jnp.int32)
+    if alibi_slopes is None:
+        slopes = jnp.zeros((H, 1), jnp.float32)
+    else:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(H, 1)
+
+    n_splits = T // split
+    qg = q.reshape(B, S, K, G, hd).transpose(0, 2, 1, 3, 4)  # (B, K, S, G, hd)
+    f32 = jnp.float32
+    qpos = q_positions.astype(jnp.int32)
+
+    kernel_t = functools.partial(_trunk_decode_kernel_mq, sm_scale=sm_scale,
+                                 alibi=alibi, n_groups=G)
+    o_t, m_t, l_t = pl.pallas_call(
+        kernel_t,
+        grid=(K, nt),
+        in_specs=[
+            pl.BlockSpec(index_map=lambda h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(index_map=lambda h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, B, split), lambda h, j: (0, 0, j)),
+            pl.BlockSpec((1, B, split), lambda h, j: (0, 0, j)),
+            pl.BlockSpec((1, B, S, G, hd), lambda h, j: (h, 0, 0, 0, 0)),
+            pl.BlockSpec((1, split, 1, hd), lambda h, j: (h, j, 0, 0)),
+            pl.BlockSpec((1, split, 1, hd), lambda h, j: (h, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, B, S, G, hd),
+                         lambda h, j: (h, j, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 1, B, S, G), lambda h, j: (h, j, 0, 0, 0)),
+            pl.BlockSpec((1, 1, B, S, G), lambda h, j: (h, j, 0, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K, nt, B, S, G, hd), f32),
+            jax.ShapeDtypeStruct((K, nt, B, S, G), f32),
+            jax.ShapeDtypeStruct((K, nt, B, S, G), f32),
+        ],
+        interpret=interpret,
+    )(qpos, slopes, key_mask[None], key_positions[None],
+      qg.transpose(1, 0, 2, 3, 4), k, v)
+
+    ns = n_splits - nt
+    kernel_s = functools.partial(_decode_kernel_mq, sm_scale=sm_scale,
+                                 alibi=alibi, n_groups=G)
+    o_s, m_s, l_s = pl.pallas_call(
+        kernel_s,
+        grid=(B, K, ns),
+        in_specs=[
+            pl.BlockSpec(index_map=lambda b, h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(index_map=lambda b, h, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, split), lambda b, h, j: (b, 0, j + nt)),
+            pl.BlockSpec((1, 1, split), lambda b, h, j: (b, 0, j + nt)),
+            pl.BlockSpec((1, 1, S, G, hd), lambda b, h, j: (b, h, 0, 0, 0)),
+            pl.BlockSpec((1, split, 1, hd),
+                         lambda b, h, j: (h, j + nt, b, 0)),
+            pl.BlockSpec((1, split, 1, hd),
+                         lambda b, h, j: (h, j + nt, b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, S, G, hd),
+                         lambda b, h, j: (b, h, j, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 1, S, G), lambda b, h, j: (b, h, j, 0, 0)),
+            pl.BlockSpec((1, 1, 1, S, G), lambda b, h, j: (b, h, j, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, K, ns, S, G, hd), f32),
+            jax.ShapeDtypeStruct((B, K, ns, S, G), f32),
+            jax.ShapeDtypeStruct((B, K, ns, S, G), f32),
+        ],
+        interpret=interpret,
+    )(qpos, slopes, key_mask[:, None, :], key_positions[:, None, :],
+      qg, k, v)
+
+    o_p = jnp.concatenate([o_t.transpose(2, 0, 1, 3, 4, 5), o_s], axis=2)
+    m_p = jnp.concatenate([m_t.transpose(2, 0, 1, 3, 4), m_s], axis=2)
+    l_p = jnp.concatenate([l_t.transpose(2, 0, 1, 3, 4), l_s], axis=2)
     out = merge_partials(o_p, m_p, l_p, axis=2)           # (B, K, S, G, hd)
     return out.transpose(0, 2, 1, 3, 4).reshape(B, S, H, hd).astype(q.dtype)

@@ -160,6 +160,18 @@ class ContinuousBatcher:
         return fn([list(p.bin_ids[:p.lcp]) for p in rows],
                   len(rows), bucket)
 
+    def _decode_trunk(self, rows: List["Pending"], bucket: int) -> int:
+        """Shared-trunk tokens the engine's cascade-DECODE path would
+        dedupe per decode step for these queued rows (0 when cascade
+        decode is off or the rows are ineligible). Advisory pricing
+        input, like :meth:`_cascade_trunk` — the dispatch re-derives
+        the extent from the same rows."""
+        fn = getattr(self.engine, "decode_trunk_for", None)
+        if fn is None or len(rows) < 2:
+            return 0
+        return fn([list(p.bin_ids[:p.lcp]) for p in rows],
+                  len(rows), bucket)
+
     def next_dispatch(self, now: float, flush: bool = False
                       ) -> Optional[Tuple[int, List[Pending]]]:
         """Form the next dispatch, or None when no bucket is ripe. A
@@ -187,14 +199,17 @@ class ContinuousBatcher:
                 # (advisory submit-time hints; scheduler.bucket_cost).
                 cached = (sum(q[i].cached_hint for i in range(n))
                           if self.prefix_cache else 0)
-                trunk = self._cascade_trunk([q[i] for i in range(n)],
-                                            edge)
+                picked = [q[i] for i in range(n)]
+                trunk = self._cascade_trunk(picked, edge)
+                dtrunk = self._decode_trunk(picked, edge)
                 per_row = sched_mod.bucket_cost(
                     self._dispatch_rows(n), edge, self.batch,
                     self.decode_cost, cached_tokens=cached,
                     fused_decode=self.fused_decode,
                     spec_decode=self.spec_decode,
-                    cascade=trunk > 0, trunk_tokens=trunk) / n
+                    cascade=trunk > 0, trunk_tokens=trunk,
+                    decode_trunk_frac=(dtrunk / edge if edge else 0.0)
+                    ) / n
                 return per_row, q[0].t_submit
 
             edge = min(ripe, key=price)

@@ -721,12 +721,24 @@ class CascadeStats:
       terms over trunk tokens) — THE perf number; bench.py's ``cascade``
       key divides it into the dense prefill total for the implied
       prefill-MFU uplift.
+    - ``cascade_decode_dispatches``: shared dispatches whose DECODE
+      scans ran the trunk-aware flash-decode split dedup
+      (ops/flash_decode trunk variants; DEPLOY.md §1r) — cascade-prefill
+      AND dense-prefill dispatches alike, whenever the trunk extent and
+      the decode-side gates line up.
+    - ``trunk_bytes_deduped``: analytic HBM bytes those dispatches' trunk
+      K/V tiles did NOT stream (once per decode step instead of once per
+      row — profiling.cascade_decode_bytes_saved); bench.py's
+      ``cascade_decode`` key divides the flat kernel's decode bytes by
+      the deduped total for the headline bytes/row reduction.
     """
 
     cascade_dispatches: int = 0
     dense_fallbacks: int = 0
     trunk_rows_deduped: int = 0
     prefix_flops_saved: int = 0
+    cascade_decode_dispatches: int = 0
+    trunk_bytes_deduped: int = 0
 
     def __post_init__(self) -> None:
         import threading
@@ -747,6 +759,8 @@ class CascadeStats:
                                  if total else 0.0),
                 "trunk_rows_deduped": self.trunk_rows_deduped,
                 "prefix_flops_saved": self.prefix_flops_saved,
+                "cascade_decode_dispatches": self.cascade_decode_dispatches,
+                "trunk_bytes_deduped": self.trunk_bytes_deduped,
             }
 
 
@@ -1414,6 +1428,38 @@ def cascade_prefill_flops_saved(cfg, rows: int, trunk_len: int) -> float:
     per_row = 2 * p_layers * trunk_len
     per_row += 4 * H * trunk_len * trunk_len * hd * L
     return float((rows - 1) * per_row)
+
+
+def cascade_decode_bytes_saved(cfg, rows: int, trunk_len: int,
+                               cache_len: int, steps: int,
+                               itemsize: int = 4) -> float:
+    """Analytic HBM bytes the trunk-aware flash-decode split dedup does
+    NOT stream: the flat kernel's split-K grid reads every row's trunk
+    K/V tiles from HBM each decode step, the trunk variant reads cache
+    row 0's ONCE per step and batches every row's query against it
+    (ops/flash_decode.flash_decode_trunk) — so each step saves
+    ``rows - 1`` copies of the trunk splits' K+V bytes per layer.
+
+    The trunk split count mirrors the kernel's own static ladder
+    exactly (``pick_split``'s divisor-of-``cache_len`` pick, then
+    ``min(trunk_len, cache_len - 1) // split`` whole splits — partial
+    trailing splits stay per-row), so the counter reports the bytes the
+    lowered kernel really dedups, not an idealized ``trunk_len`` bound.
+    ``itemsize`` is the cache dtype's (float32 = 4; the engine's float
+    KV caches — the int8 cache never reaches these kernels)."""
+    if rows <= 1 or trunk_len <= 0 or steps <= 0 or cache_len <= 1:
+        return 0.0
+    from ..ops.flash_attention import DEFAULT_BLOCK_K
+    from ..ops.flash_decode import pick_split
+
+    split = pick_split(int(cache_len), DEFAULT_BLOCK_K)
+    nt = max(0, min(int(trunk_len), int(cache_len) - 1)) // split
+    if nt == 0:
+        return 0.0
+    n_kv = getattr(cfg, "n_kv_heads", None) or cfg.n_heads
+    hd = cfg.head_dim
+    per_row_step = 2 * n_kv * (nt * split) * hd * itemsize * cfg.n_layers
+    return float(per_row_step * (rows - 1) * steps)
 
 
 def device_memory_stats() -> Dict[str, Dict[str, float]]:

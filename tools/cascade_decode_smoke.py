@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Cascade-decode smoke: the trunk-aware flash-decode split dedup
+(ops/flash_decode trunk kernels + engine/runner decode routing) on the
+fake backend — the `make cascade-decode-smoke` CI target.
+
+Serves a shared-trunk grid (waves of requests that rephrase the SAME
+long legal-prompt trunk, varying only a short tail) on two servers
+sharing nothing but the request trace: cascade decode ON (the default)
+and OFF (--no-cascade-decode, the flat split-K baseline). Prefill runs
+dense on BOTH servers (the cascade-prefill interpret hook stays
+unarmed), so the only difference under test is the decode-phase trunk
+dedup. Asserts the PR's load-bearing claims:
+
+- the dedup actually engaged: nonzero cascade-decode dispatches AND
+  nonzero analytic trunk bytes deduped (the trunk covered at least one
+  whole key split — a zero here means the ladder never dedup'd);
+- payload parity is BITWISE: every field of every request's payload —
+  argmax-derived strings AND float probabilities — is identical
+  between the two servers (the trunk kernels compute the flat kernels'
+  exact partials; the merge is the same reduction);
+- the flat server never counted a cascade-decode dispatch.
+
+Runs hermetically on CPU with the FakeTokenizer + a tiny random decoder
+(the trunk kernels under the Pallas interpreter via the tier-1
+fused-decode hook); prints the CascadeStats summary JSON on success.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_BASES = 3
+WAVE = 8           # requests per shared-trunk wave (one batch's worth)
+# Long trunks: the prefix must land in a bucket whose decode cache
+# extent splits into more than one key tile (pick_split), with the
+# quantized trunk covering at least one whole tile — ~120 words puts
+# the prefix in the 128 bucket (cache extent 144 -> split 72, trunk
+# 112 -> one whole tile deduped). 90 words lands in the 96 bucket,
+# whose 112-slot cache is a SINGLE split: zero dedup by design.
+BASE_WORDS = 120
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig, ServeConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import ScoringServer, ServeRequest
+
+    # Tier-1 hook: the fused decode kernels (and their trunk-aware
+    # siblings) run under the Pallas interpreter on CPU. The cascade
+    # PREFILL hook stays unarmed — prefill runs dense on both servers,
+    # isolating the decode-phase dedup as the only variable.
+    decoder.FUSED_DECODE_INTERPRET_ON_CPU = True
+
+    cfg = ModelConfig(name="cascdec-smoke", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=1, n_heads=2,
+                      intermediate_size=64, max_seq_len=512)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(13))
+
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible adjuster settle "
+             "liability clause binding interpret statute meaning").split()
+    rng = np.random.default_rng(31)
+    bases = [" ".join(rng.choice(words) for _ in range(BASE_WORDS))
+             for _ in range(N_BASES)]
+
+    def request(b: int, i: int) -> ServeRequest:
+        main_text = f"{bases[b]} case {i} maybe ?"
+        return ServeRequest(
+            binary_prompt=f"{main_text} Answer Yes or No .",
+            confidence_prompt=f"{main_text} Give a number from 0 to 100 .",
+            klass="smoke", request_id=f"{b}-{i}")
+
+    def serve(decode_on: bool):
+        rt = RuntimeConfig(batch_size=WAVE, max_seq_len=512,
+                           cascade_decode=decode_on)
+        engine = ScoringEngine(params, cfg, FakeTokenizer(), rt)
+        sc = ServeConfig(queue_depth=2 * WAVE, classes=(("smoke", 600.0),),
+                         default_class="smoke", linger_s=0.01)
+        server = ScoringServer(engine, "cascdec-smoke", sc).start()
+        payloads = []
+        for b in range(N_BASES):
+            futs = [server.submit(request(b, i)) for i in range(WAVE)]
+            payloads.extend(f.result(timeout=600) for f in futs)
+        server.stop()
+        return engine, payloads
+
+    eng_on, res_on = serve(True)
+    eng_off, res_off = serve(False)
+
+    failures = []
+    bad = [r.request_id for r in res_on + res_off if r.status != "ok"]
+    if bad:
+        failures.append(f"non-ok results: {bad}")
+    stats = eng_on.cascade_stats
+    if stats.cascade_decode_dispatches <= 0:
+        failures.append("the shared-trunk grid never took the trunk-aware "
+                        "decode path (zero cascade-decode dispatches)")
+    if stats.trunk_bytes_deduped <= 0:
+        failures.append("zero trunk bytes deduped — the trunk never "
+                        "covered a whole key split (check the bucket "
+                        "ladder vs the trunk extent)")
+    if eng_off.cascade_stats.cascade_decode_dispatches != 0:
+        failures.append("--no-cascade-decode engine still counted "
+                        "cascade-decode dispatches")
+    fields = ("status", "model_response", "model_confidence_response",
+              "confidence_value", "token_1_prob", "token_2_prob",
+              "weighted_confidence")
+    for a, b in zip(res_on, res_off):
+        diff = [f for f in fields
+                if getattr(a, f, None) != getattr(b, f, None)]
+        if diff:
+            failures.append(f"payload fields {diff} differ for request "
+                            f"{a.request_id} — trunk decode must be "
+                            f"BITWISE the flat kernel")
+            break
+    if failures:
+        for f in failures:
+            print(f"CASCADE-DECODE-SMOKE FAIL: {f}")
+        return 1
+    print(json.dumps(stats.summary()))
+    print(f"cascade decode smoke: OK ({N_BASES * WAVE} requests over "
+          f"{N_BASES} shared trunks, "
+          f"{stats.cascade_decode_dispatches} trunk-aware decode "
+          f"dispatches, {stats.trunk_bytes_deduped:.2e} trunk bytes "
+          f"deduped, payloads bitwise ON vs OFF)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
