@@ -5,9 +5,9 @@
 .PHONY: verify test bench lint serve-smoke prefix-smoke chaos-smoke \
 	kernel-smoke stats-smoke fleet-smoke observe-smoke elastic-smoke \
 	spec-smoke mem-smoke disagg-smoke cascade-smoke \
-	cascade-decode-smoke install-hooks
+	cascade-decode-smoke tiered-smoke install-hooks
 
-verify: lint cascade-smoke cascade-decode-smoke
+verify: lint cascade-smoke cascade-decode-smoke tiered-smoke
 	python tools/check_tier1.py
 
 # graft-lint: AST static analysis proving the engine's JAX/XLA
@@ -154,6 +154,15 @@ cascade-decode-smoke:
 # DEPLOY.md §1p).
 disagg-smoke:
 	JAX_PLATFORMS=cpu python tools/disagg_smoke.py
+
+# Tiered-memory smoke: a shared-prefix working set larger than the HBM
+# page budget on the HBM -> host DRAM -> disk KV ladder — nonzero
+# demotions AND promotions, every payload bitwise-identical to the
+# untiered server's, and a restarted server re-seeds its radix tree
+# from the disk index with nonzero prefill tokens avoided
+# (tools/tiered_smoke.py; DEPLOY.md §1s).
+tiered-smoke:
+	JAX_PLATFORMS=cpu python tools/tiered_smoke.py
 
 # Run graft-lint (seconds) then the tier-1 guard before every
 # `git push` — lint first so an invariant break fails in two seconds,

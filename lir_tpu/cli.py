@@ -621,6 +621,83 @@ def _migrate_cfg(args):
     return MigrationConfig(**kw)
 
 
+def _add_tier_flags(p) -> None:
+    """Tiered-memory knobs (config.TierConfig — serve/tiers.py;
+    DEPLOY.md §1s)."""
+    p.add_argument("--tiered", action="store_true",
+                   help="enable the tiered memory ladder "
+                        "(TierConfig.enabled): the HBM governor's "
+                        "reclaim rungs demote KV radix pages and idle "
+                        "fleet weights to pinned host DRAM and local "
+                        "disk instead of deleting them; promotes ride "
+                        "the checksummed paged-warm import (bitwise)")
+    p.add_argument("--tier-host-mb", type=float, default=None,
+                   help="host-DRAM tier budget in MiB "
+                        "(TierConfig.host_budget_mb, default 256); "
+                        "overflow spills to the disk tier, LRU first")
+    p.add_argument("--tier-disk-dir", type=str, default=None,
+                   help="local directory for the disk tier "
+                        "(TierConfig.disk_dir; empty = host tier only, "
+                        "no spill and no restart-warm)")
+    p.add_argument("--tier-disk-mb", type=float, default=None,
+                   help="disk tier budget in MiB "
+                        "(TierConfig.disk_budget_mb, default 1024); "
+                        "oldest entries drop at the budget")
+    p.add_argument("--tier-demote-pages", type=int, default=None,
+                   help="max KV pages one evict_pages rung engagement "
+                        "demotes (TierConfig.demote_pages_per_step, "
+                        "default 32)")
+    p.add_argument("--no-tier-verify", action="store_true",
+                   help="skip promote-side chunk checksums "
+                        "(TierConfig.verify) — tier corruption then "
+                        "lands undetected; only for measurement")
+    p.add_argument("--tier-disk-timeout", type=float, default=None,
+                   help="seconds a disk-tier promote may take before "
+                        "the store abandons it and the request "
+                        "re-prefills (TierConfig.disk_timeout_s, "
+                        "default 10)")
+    p.add_argument("--no-restart-warm", action="store_true",
+                   help="do NOT reseed the radix tree / weight cache "
+                        "from the disk tier at server construction "
+                        "(TierConfig.restart_warm)")
+    p.add_argument("--tier-host-bonus", type=float, default=None,
+                   help="placement price of one host-tier page in "
+                        "HBM-page equivalents (TierConfig.host_bonus, "
+                        "default 0.5)")
+    p.add_argument("--tier-disk-bonus", type=float, default=None,
+                   help="placement price of one disk-tier page in "
+                        "HBM-page equivalents (TierConfig.disk_bonus, "
+                        "default 0.25)")
+
+
+def _tier_cfg(args):
+    """TierConfig from the flags (None = dataclass default)."""
+    from .config import TierConfig
+
+    kw = {}
+    if getattr(args, "tiered", False):
+        kw["enabled"] = True
+    if getattr(args, "tier_host_mb", None) is not None:
+        kw["host_budget_mb"] = args.tier_host_mb
+    if getattr(args, "tier_disk_dir", None) is not None:
+        kw["disk_dir"] = args.tier_disk_dir
+    if getattr(args, "tier_disk_mb", None) is not None:
+        kw["disk_budget_mb"] = args.tier_disk_mb
+    if getattr(args, "tier_demote_pages", None) is not None:
+        kw["demote_pages_per_step"] = args.tier_demote_pages
+    if getattr(args, "no_tier_verify", False):
+        kw["verify"] = False
+    if getattr(args, "tier_disk_timeout", None) is not None:
+        kw["disk_timeout_s"] = args.tier_disk_timeout
+    if getattr(args, "no_restart_warm", False):
+        kw["restart_warm"] = False
+    if getattr(args, "tier_host_bonus", None) is not None:
+        kw["host_bonus"] = args.tier_host_bonus
+    if getattr(args, "tier_disk_bonus", None) is not None:
+        kw["disk_bonus"] = args.tier_disk_bonus
+    return TierConfig(**kw)
+
+
 def _add_observatory_flags(p) -> None:
     """Reliability-observatory knobs (lir_tpu/observe; fleet serving
     only — the sentinel grid fans across every fleet model)."""
@@ -897,6 +974,7 @@ def _add_serve(sub) -> None:
     _add_observatory_flags(p)
     _add_router_flags(p)
     _add_migrate_flags(p)
+    _add_tier_flags(p)
     _add_fleet_flags(p, with_models=True)
 
 
@@ -1178,7 +1256,8 @@ def cmd_serve(args) -> None:
         return
     engine = factory(args.model)
     server = ScoringServer(engine, args.model, serve_cfg,
-                           precompile=not args.no_precompile).start()
+                           precompile=not args.no_precompile,
+                           tiers=_tier_cfg(args)).start()
 
     futures = []
     if args.state_checkpoint is not None:
@@ -1279,11 +1358,19 @@ def _run_router_serve(args, serve_cfg, factory, n_replicas: int) -> None:
     from .serve import ReplicaRouter, ScoringServer, ServeRequest
 
     servers = []
+    tcfg = _tier_cfg(args)
     for i in range(n_replicas):
         engine = factory(args.model)
+        # Each in-process replica owns its own disk-tier directory —
+        # the on-disk index is per-store, never shared.
+        rep_tiers = tcfg
+        if tcfg.enabled and tcfg.disk_dir:
+            import dataclasses as _dc
+            rep_tiers = _dc.replace(
+                tcfg, disk_dir=str(Path(tcfg.disk_dir) / f"r{i}"))
         servers.append(ScoringServer(
             engine, args.model, serve_cfg,
-            precompile=not args.no_precompile).start())
+            precompile=not args.no_precompile, tiers=rep_tiers).start())
     # Disaggregated roles (serve/migrate.py; DEPLOY.md §1p): the first
     # --migrate-prefill-replicas servers take the prefill role, the
     # rest decode; 0 keeps every replica colocated ("both").
@@ -1379,6 +1466,7 @@ def _run_fleet_serve(args, serve_cfg, factory) -> None:
         fleet, serve_cfg,
         fleet_deadline_s=(args.fleet_deadline
                           if args.fleet_deadline is not None else 60.0),
+        tiers=_tier_cfg(args),
     ).start()
     default_rf = LEGAL_PROMPTS[0].response_format
     default_cf = LEGAL_PROMPTS[0].confidence_format

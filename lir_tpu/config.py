@@ -693,6 +693,72 @@ class MigrationConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TierConfig:
+    """Tiered KV + weight store knobs (serve/tiers.py; DEPLOY.md §1s).
+
+    Mooncake's observation applied to this engine: HBM pressure should
+    DEMOTE cached state down a tier ladder (HBM -> pinned host DRAM ->
+    local disk), not delete it. The governor's reclaim rungs become
+    reversible — ``evict_weights`` records the victim's staged host
+    tree to the disk tier before eviction, ``evict_pages`` exports the
+    coldest radix leaves (serve/migrate.py's chunked checksummed
+    transfer discipline) into a byte-budgeted host pool whose own LRU
+    overflow spills to an on-disk page store with an append-only JSONL
+    index (the manifest kill-mid-append discipline). Promotion back to
+    HBM runs through the ordinary paged-warm import path, so payloads
+    stay bitwise; a corrupt or stalled tier read falls back to local
+    re-prefill — never a wrong answer. The disk tier survives process
+    death: a restarted server re-seeds its radix tree and weight cache
+    from it (restart-warm).
+    """
+
+    # Master switch. OFF restores the PR-14 delete-on-pressure rungs
+    # exactly (and serve restarts start cold).
+    enabled: bool = False               # cli: --tiered
+    # Pinned-host-DRAM pool budget for demoted KV pages, MiB. LRU
+    # overflow spills to the disk tier (or is dropped when no disk_dir
+    # is configured). Size against models/paged.kv_page_bytes.
+    host_budget_mb: float = 256.0       # cli: --tier-host-mb
+    # Disk tier root directory ("" disables the disk leg: demotions
+    # stop at host DRAM and restart-warm is off). One page store +
+    # one weight store per serving process live under it.
+    disk_dir: str = ""                  # cli: --tier-disk-dir
+    # Disk tier budget, MiB; oldest spilled entries are dropped past it
+    # (tombstoned in the index, file unlinked).
+    disk_budget_mb: float = 1024.0      # cli: --tier-disk-mb
+    # Radix pages demoted per evict_pages rung engagement — replaces
+    # GovernorConfig.evict_pages_per_step deletions when tiering is ON.
+    demote_pages_per_step: int = 32     # cli: --tier-demote-pages
+    # Verify per-chunk checksums at promote: a corrupted host/disk
+    # chunk is refused BEFORE its pages enter the radix tree and the
+    # request re-prefills (chaos kind ``tier_corrupt``).
+    verify: bool = True                 # cli: --no-tier-verify
+    # Wall-clock budget for one disk-tier read; past it the promote is
+    # abandoned and the request re-prefills locally (chaos kind
+    # ``disk_stall``). The entry stays — a transient stall is not
+    # corruption.
+    disk_timeout_s: float = 10.0        # cli: --tier-disk-timeout
+    # Re-seed the radix tree + weight cache from the disk tier at
+    # server construction (restart-warm serving). Needs disk_dir.
+    restart_warm: bool = True           # cli: --no-restart-warm
+    # Placement bonus per HOST-tier-matched page as a fraction of
+    # MigrationConfig.page_bonus ("warm on host at replica 2" prices
+    # between HBM-warm and cold in ReplicaRouter._pick).
+    host_bonus: float = 0.5             # cli: --tier-host-bonus
+    # Same for DISK-tier-matched pages (cheaper than host, dearer
+    # than a cold re-prefill).
+    disk_bonus: float = 0.25            # cli: --tier-disk-bonus
+
+    @property
+    def host_budget_bytes(self) -> int:
+        return int(self.host_budget_mb * 2**20)
+
+    @property
+    def disk_budget_bytes(self) -> int:
+        return int(self.disk_budget_mb * 2**20)
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Multi-model fleet knobs (engine/fleet.py over models/weights.py).
 
@@ -754,6 +820,7 @@ class Config:
         default_factory=MigrationConfig)
     governor: GovernorConfig = dataclasses.field(
         default_factory=GovernorConfig)
+    tiers: TierConfig = dataclasses.field(default_factory=TierConfig)
 
     # Paths: everything under one results root; no personal gdrive paths.
     results_dir: Path = Path("results")

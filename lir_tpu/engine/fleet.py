@@ -36,8 +36,11 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..models import weights
+from ..utils.logging import get_logger
 from ..utils.profiling import FleetStats
 from . import hbm
+
+log = get_logger(__name__)
 
 # An engine factory maps model id -> ready ScoringEngine (models/
 # factory.engine_factory is the checkpoint-backed one; tests inject
@@ -202,8 +205,75 @@ class ModelFleet:
     def evict_idle(self) -> bool:
         """Governor evict_weights rung: drop ONE idle LRU model (its
         staged host copy survives, so a re-acquire streams it back
-        bitwise). True when a model was actually evicted."""
-        return self.cache.evict_idle() is not None
+        bitwise). With a weight tier store attached
+        (:meth:`attach_tiers`) the rung DEMOTES: the victim's staged
+        tree is recorded to the disk tier first, so the weights
+        survive even process death (restart-warm re-stages them).
+        True when a model was actually evicted."""
+        evicted = self.cache.evict_idle()
+        if evicted is not None:
+            # The staged tree never changes after staging, so recording
+            # AFTER eviction is the same bytes recording before would
+            # have been (and a no-op when attach_tiers already
+            # mirrored it).
+            slot = self._slots.get(evicted)
+            if slot is not None:
+                self._record_staged(slot)
+        return evicted is not None
+
+    def attach_tiers(self, store) -> None:
+        """Adopt a serve/tiers.TieredWeightStore: every staged host
+        tree is MIRRORED to the disk tier (staged trees are immutable,
+        so one record per model covers every later eviction — the
+        cache's own insert-time LRU evictions included, not just the
+        governor's evict_idle rung), and :meth:`reseed_weights`
+        re-stages recorded models on a restart-warm boot. Models
+        already staged when the store attaches record here; models
+        staged later record at staging time (:meth:`_load`)."""
+        self._tier_store = store
+        with self._lock:
+            slots = [s for s in self._slots.values()
+                     if s.staged is not None]
+        for slot in slots:
+            self._record_staged(slot)
+
+    def _record_staged(self, slot: _Slot) -> None:
+        """Best-effort disk-tier record of one staged tree (no-op
+        without a store or when already recorded; a full or broken
+        disk degrades to pre-tier behavior, never fails the caller)."""
+        store = getattr(self, "_tier_store", None)
+        if store is None or slot.staged is None:
+            return
+        try:
+            store.put(slot.model_id, slot.staged)
+        except Exception:  # noqa: BLE001 — see docstring.
+            log.exception("weight tier record failed for %s — "
+                          "continuing untiered", slot.model_id)
+
+    def reseed_weights(self, store=None) -> int:
+        """Restart-warm the fleet's HOST tier from the disk tier: any
+        slot without a staged tree whose model the store has recorded
+        gets it back (CRC-verified — a corrupt record is refused and
+        the model cold-loads). The DEVICE copy still streams on first
+        acquire through the ordinary bitwise ``stream_params`` path.
+        Returns models re-staged."""
+        store = store if store is not None else getattr(
+            self, "_tier_store", None)
+        if store is None:
+            return 0
+        n = 0
+        with self._lock:
+            for slot in self._slots.values():
+                if slot.staged is not None or not store.has(slot.model_id):
+                    continue
+                staged = store.get(slot.model_id)
+                if staged is None:
+                    continue        # refused (checksum) or vanished
+                slot.staged = staged
+                n += 1
+        if n:
+            store.stats.count("restart_weights_reseeded", n)
+        return n
 
     def _on_evict(self, model_id: str) -> None:
         slot = self._slots.get(model_id)
@@ -241,6 +311,7 @@ class ModelFleet:
             release()    # the cache owns the bytes from here
         if self.stage_reloads:
             slot.staged = weights.host_stage(params)
+            self._record_staged(slot)
         return params
 
     def prefetch(self, model_id: str) -> None:

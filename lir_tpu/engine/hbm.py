@@ -77,7 +77,13 @@ log = get_logger(__name__)
 
 # Reclaim order — the ladder walks DOWN this list under pressure and
 # back UP it (reverse order) when pressure clears. Indexes are the
-# MemStats.rung gauge.
+# MemStats.rung gauge. With a tier store attached (serve/tiers.py),
+# the first two rungs become reversible DEMOTIONS: evict_weights
+# records the victim's staged tree to the disk tier before eviction
+# (engine/fleet.py) and evict_pages exports the coldest radix leaves
+# to the host/disk ladder before their pages leave HBM
+# (engine/runner._evict_cold_pages) — same bytes freed, nothing
+# deleted.
 RUNGS: Tuple[str, ...] = ("evict_weights", "evict_pages", "no_piggyback",
                           "no_spec", "batch_down", "shed")
 # Rungs that free bytes NOW — the set handle_oom force-engages.

@@ -351,6 +351,32 @@ class RadixPrefixCache:
                                    self.pool.n_pages - 1)
         return removed
 
+    def evict_tail(self, bucket: int, ids: Sequence[int],
+                   n_pages: int) -> int:
+        """Like :meth:`forget_tail`, but for tier DEMOTION
+        (serve/tiers.py): additionally refuses any page whose refcount
+        is not exactly the tree's own 1 — a dispatch-pinned page must
+        never leave HBM, demoted or otherwise. Removal walks from the
+        deepest node up and stops at the first shared (has-children) or
+        pinned node, so a partial demotion still leaves a valid radix
+        path; returns how many pages actually left the tree (the
+        caller counts the shortfall as pin refusals)."""
+        path = self._walk(bucket, ids, touch=False)
+        removed = 0
+        for node in reversed(path[-n_pages:] if n_pages else []):
+            if node.children or self.pool.refcount[node.page] != 1:
+                break           # shared or pinned: the tail stops here
+            self._notify("evict", int(bucket), self._node_ids(node))
+            del node.parent.children[node.key]
+            self._nodes -= 1
+            self.pool.decref((node.page,))
+            removed += 1
+        if removed:
+            self.stats.count("evicted_pages", removed)
+            self.stats.gauge_pages(self.pool.pages_in_use,
+                                   self.pool.n_pages - 1)
+        return removed
+
     def _alloc_with_evict(self) -> Optional[int]:
         page = self.pool.alloc()
         if page is None and self.evict(1):
@@ -373,6 +399,17 @@ class RadixPrefixCache:
             elif self.pool.refcount[n.page] == 1:
                 out.append(n)
         return out
+
+    def coldest_leaves(self, limit: int = 8
+                       ) -> List[Tuple[int, Tuple[int, ...]]]:
+        """The stalest evictable leaves as (bucket, full token path)
+        pairs, LRU-first — the tier-demotion candidate probe
+        (serve/tiers.py): each pair names a whole cached prefix whose
+        tail :meth:`evict_tail` can demote without touching pinned or
+        shared pages. Read-only; takes no references."""
+        leaves = sorted(self._evictable_leaves(), key=lambda n: n.clock)
+        return [(self._node_bucket(n), self._node_ids(n))
+                for n in leaves[:max(0, int(limit))]]
 
     def evict(self, n_pages: int) -> int:
         """Free >= ``n_pages`` pool pages by removing the least-recently
@@ -432,8 +469,12 @@ class ClusterPrefixIndex:
     def __init__(self, page_size: int = 16):
         self.page_size = int(page_size)
         self._lock = threading.Lock()
-        # (replica_id, bucket) -> nested {chunk-tuple: child dict}
-        self._tries: Dict[Tuple[str, int], Dict] = {}  # guarded-by: _lock
+        # (replica_id, bucket, tier) -> nested {chunk-tuple: child dict}
+        # — tier is a residency DIMENSION ("hbm" from the replica trees,
+        # "host"/"disk" from each replica's TieredPageStore), so
+        # placement can price "warm on host at replica 2" against "cold
+        # everywhere" (serve/tiers.py; DEPLOY.md §1s).
+        self._tries: Dict[Tuple[str, int, str], Dict] = {}  # guarded-by: _lock
 
     def _chunks(self, ids: Sequence[int]) -> List[Tuple[int, ...]]:
         ps = self.page_size
@@ -441,15 +482,17 @@ class ClusterPrefixIndex:
                 for k in range(len(ids) // ps)]
 
     def on_event(self, replica_id: str, event: str, bucket: int,
-                 ids: Sequence[int]) -> None:
+                 ids: Sequence[int], tier: str = "hbm") -> None:
         """One replica tree's page event (wire with
-        ``tree.add_listener(functools.partial(index.on_event, rid))``)."""
+        ``tree.add_listener(functools.partial(index.on_event, rid))``);
+        tier stores fire the same events with ``tier="host"``/``"disk"``
+        via :meth:`on_tier_event`."""
         chunks = self._chunks(ids)
         if not chunks:
             return
         with self._lock:
-            trie = self._tries.setdefault((str(replica_id), int(bucket)),
-                                          {})
+            trie = self._tries.setdefault(
+                (str(replica_id), int(bucket), str(tier)), {})
             if event == "insert":
                 node = trie
                 for ck in chunks:
@@ -465,22 +508,35 @@ class ClusterPrefixIndex:
                 parent, key = hops[-1]
                 del parent[key]         # the page and its whole subtree
 
+    def on_tier_event(self, replica_id: str, event: str, tier: str,
+                      bucket: int, ids: Sequence[int]) -> None:
+        """A TieredPageStore's movement event (serve/tiers.py
+        ``TierListener`` contract — wire with ``store.add_listener(
+        functools.partial(index.on_tier_event, rid))``). A tier entry
+        ALWAYS covers a whole prefix, so ``event="evict"`` prunes the
+        full path."""
+        self.on_event(replica_id, event, bucket, ids, tier=tier)
+
     def drop_replica(self, replica_id: str) -> None:
-        """Forget a replica's pages wholesale (its pool died with it)."""
+        """Forget a replica's HBM pages wholesale (its pool died with
+        it). Host/disk tier entries survive — they live outside the
+        process's device memory and are exactly what a restart-warm
+        rejoin re-serves."""
         with self._lock:
-            for key in [k for k in self._tries if k[0] == replica_id]:
+            for key in [k for k in self._tries
+                        if k[0] == replica_id and k[2] == "hbm"]:
                 del self._tries[key]
 
-    def match_pages(self, bucket: int, ids: Sequence[int]
-                    ) -> Dict[str, int]:
+    def match_pages(self, bucket: int, ids: Sequence[int],
+                    tier: str = "hbm") -> Dict[str, int]:
         """Pages of ``ids``' leading prefix each replica holds in the
-        ``bucket`` namespace right now — the placement/migration probe
-        (tokens covered = pages * page_size)."""
+        ``bucket`` namespace at ``tier`` right now — the placement/
+        migration probe (tokens covered = pages * page_size)."""
         chunks = self._chunks(ids)
         out: Dict[str, int] = {}
         with self._lock:
-            for (rid, b), trie in self._tries.items():
-                if b != int(bucket):
+            for (rid, b, t), trie in self._tries.items():
+                if b != int(bucket) or t != str(tier):
                     continue
                 node, n = trie, 0
                 for ck in chunks:
@@ -490,6 +546,20 @@ class ClusterPrefixIndex:
                     n += 1
                 if n:
                     out[rid] = max(out.get(rid, 0), n)
+        return out
+
+    def match_tiers(self, bucket: int, ids: Sequence[int]
+                    ) -> Dict[str, Dict[str, int]]:
+        """Every tier's match depth per replica: {replica_id: {tier:
+        pages}} — ``ReplicaRouter._pick`` prices each tier's pages with
+        its own bonus (HBM full, host/disk discounted)."""
+        with self._lock:
+            tiers = sorted({k[2] for k in self._tries})
+        out: Dict[str, Dict[str, int]] = {}
+        for t in tiers:
+            for rid, pages in self.match_pages(bucket, ids,
+                                               tier=t).items():
+                out.setdefault(rid, {})[t] = pages
         return out
 
     def best_holder(self, bucket: int, ids: Sequence[int],

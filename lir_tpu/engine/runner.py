@@ -348,13 +348,30 @@ class ScoringEngine:
     def _evict_cold_pages(self) -> bool:
         """Governor evict_pages rung: drop the coldest radix pages
         (tree-driven LRU — models/paged refcounts keep in-flight pages
-        unevictable). Returns True when any page was actually freed."""
+        unevictable). Returns True when any page was actually freed.
+        With a tier store attached (:meth:`attach_tiers`) the rung
+        DEMOTES instead: the coldest leaves export to the host tier
+        before their pages leave HBM, and plain eviction remains the
+        fallback when nothing was demotable."""
         if self.prefix_cache is None:
             return False
+        store = getattr(self, "_tier_store", None)
+        if store is not None and store.demote(self):
+            return True
         n = self.prefix_cache.evict(
             self.governor.cfg.evict_pages_per_step
             if self.governor is not None else paged.DEFAULT_PAGE_SIZE)
         return n > 0
+
+    def attach_tiers(self, store) -> None:
+        """Point the ``evict_pages`` reclaim rung at a
+        serve/tiers.TieredPageStore: HBM pressure then demotes the
+        coldest radix leaves down the host/disk ladder (reversible —
+        a later promote re-enters through the paged-warm import path
+        bitwise) instead of deleting them. The rung's engage callback
+        is unchanged — demotion frees the same HBM pages eviction
+        would, so the governor's reclaim accounting holds."""
+        self._tier_store = store
 
     def _note_handoff(self, cache: Any) -> None:
         """Ledger the donation-chain scratch cache the engine keeps

@@ -33,13 +33,13 @@ from .plan import (KINDS, SITES, FaultPlan, InjectedFault,
                    InjectedPreemption, InjectedReplicaKill, SiteSchedule,
                    corrupt_export_chunks, corrupt_result_nan,
                    tear_jsonl_tail, wrap_engine, wrap_governor,
-                   wrap_migrator, wrap_replica, wrap_server)
+                   wrap_migrator, wrap_replica, wrap_server, wrap_tiers)
 
 __all__ = [
     "FaultPlan", "SiteSchedule", "InjectedFault", "InjectedPreemption",
     "InjectedReplicaKill",
     "SITES", "KINDS", "wrap_engine", "wrap_server", "wrap_replica",
-    "wrap_governor", "wrap_migrator", "tear_jsonl_tail",
+    "wrap_governor", "wrap_migrator", "wrap_tiers", "tear_jsonl_tail",
     "corrupt_result_nan", "corrupt_export_chunks",
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "degrade_dispatch",

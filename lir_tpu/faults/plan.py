@@ -54,11 +54,12 @@ from typing import Callable, Dict, Optional, Tuple
 from ..utils.profiling import FaultStats
 
 SITES = ("dispatch", "compile", "tokenize", "manifest_write",
-         "checkpoint_write", "preempt", "replica", "hbm", "migrate")
+         "checkpoint_write", "preempt", "replica", "hbm", "migrate",
+         "tiers")
 
 KINDS = ("fault", "preempt", "hang", "nan", "replica_kill",
          "replica_lag", "hbm_squeeze", "migration_stall",
-         "migration_corrupt")
+         "migration_corrupt", "tier_corrupt", "disk_stall")
 
 
 class InjectedFault(RuntimeError):
@@ -196,6 +197,28 @@ class SiteSchedule:
         return cls(fail_calls=(call,), kind="migration_corrupt")
 
     @classmethod
+    def tier_corrupt_at(cls, call: int) -> "SiteSchedule":
+        """Corrupt one tiered-store promote in flight (site "tiers";
+        :func:`wrap_tiers`): the promoted export's chunk bytes are
+        flipped UNDER its recorded checksums — a bad host buffer or
+        disk sector. The promote's verify must refuse the chunks
+        (TierStats.checksum_refusals), drop the poisoned entry, and
+        the request re-prefill locally — never a wrong answer."""
+        return cls(fail_calls=(call,), kind="tier_corrupt")
+
+    @classmethod
+    def disk_stall_at(cls, call: int,
+                      seconds: float = 30.0) -> "SiteSchedule":
+        """Stall one tiered-store disk read (site "tiers";
+        :func:`wrap_tiers`): the promote's transfer hop sleeps
+        ``seconds`` — pick it past TierConfig.disk_timeout_s — then
+        PROCEEDS, exactly a wedged disk. The store's deadline check
+        must abandon the promote (TierStats.disk_stalls), keep the
+        entry (a stall is not corruption), and let the request
+        re-prefill locally."""
+        return cls(fail_calls=(call,), kind="disk_stall", hang_s=seconds)
+
+    @classmethod
     def replica_kill_at(cls, call: int,
                         replica_id: str = "") -> "SiteSchedule":
         """Simulated replica death at one call index (the elastic
@@ -303,7 +326,9 @@ class FaultPlan:
         if sched is None or sched.kind in ("nan", "draft_corrupt",
                                            "hbm_squeeze",
                                            "migration_stall",
-                                           "migration_corrupt"):
+                                           "migration_corrupt",
+                                           "tier_corrupt",
+                                           "disk_stall"):
             return
         if sched.kind == "replica_lag":
             self.stats.inject(site)
@@ -477,6 +502,47 @@ def wrap_migrator(migrator, plan: FaultPlan, site: str = "migrate"):
     wrapped.__wrapped__ = inner  # type: ignore[attr-defined]
     migrator.transfer = wrapped
     return migrator
+
+
+def wrap_tiers(store, plan: FaultPlan, site: str = "tiers"):
+    """Inject the plan's ``site`` schedule at a tiered store's promote
+    hop (serve/tiers.TieredPageStore.transfer — the seam every promote
+    passes on its way back toward HBM):
+
+    - ``tier_corrupt``: the promoted export's chunk bytes are flipped
+      IN PLACE under its recorded checksums (seeded, counter-indexed)
+      — a rotted host buffer or bad disk sector. The promote's verify
+      must refuse the chunks, drop the poisoned entry, and the request
+      re-prefill locally with a bitwise-identical payload.
+    - ``disk_stall``: the hop sleeps ``hang_s`` (pick it past
+      TierConfig.disk_timeout_s) then PROCEEDS — a wedged disk read,
+      not a death. The store's own deadline check must observe the
+      elapsed time, abandon the promote (TierStats.disk_stalls), and
+      keep the entry for later.
+
+    Other kinds behave as in :meth:`FaultPlan.wrap` (a "fault" here is
+    an I/O error on the tier hop)."""
+    inner = store.transfer
+
+    def wrapped(export):
+        sched = plan._decide(site)
+        if sched is not None:
+            if sched.kind == "disk_stall":
+                plan.stats.inject(site)
+                time.sleep(sched.hang_s)
+                return inner(export)
+            if sched.kind == "tier_corrupt":
+                plan.stats.inject(site)
+                idx = plan.calls(site) - 1
+                corrupt_export_chunks(
+                    export, seed=f"{plan.seed}:{site}:{idx}")
+                return inner(export)
+            plan._fire(sched, site)
+        return inner(export)
+
+    wrapped.__wrapped__ = inner  # type: ignore[attr-defined]
+    store.transfer = wrapped
+    return store
 
 
 def corrupt_export_chunks(export, seed: str = "0") -> int:

@@ -45,7 +45,7 @@ RUNNER_REL = "lir_tpu/engine/runner.py"
 DEPLOY_REL = "DEPLOY.md"
 CLASSES = ("RuntimeConfig", "ServeConfig", "ObserveConfig", "SpecConfig",
            "RouterConfig", "GovernorConfig", "MigrationConfig",
-           "CascadeConfig")
+           "CascadeConfig", "TierConfig")
 
 CLI_COMMENT_RE = re.compile(r"#\s*cli:\s*(--[A-Za-z0-9-]+)")
 HOST_ONLY_RE = re.compile(r"#\s*host-only\b")

@@ -92,6 +92,12 @@ STATS_SCHEMA: Dict[str, Tuple[str, ...]] = {
         "refetch_fallbacks", "stalls", "corrupt_chunks",
         "cluster_tree_hits",
     ),
+    "TierStats": (
+        "demotions", "promotions", "pages_demoted", "pages_promoted",
+        "bytes_spilled", "bytes_promoted", "restart_pages_reseeded",
+        "restart_weights_reseeded", "checksum_refusals", "disk_stalls",
+        "pin_refusals", "host_bytes", "disk_bytes",
+    ),
     "LeaseStats": (
         "claims", "renews", "releases", "steals", "refused", "lost",
         "expired_seen", "shards_done", "refreshes",

@@ -37,6 +37,13 @@ Components:
   ClusterPrefixIndex) makes a prefix prefilled anywhere warm
   everywhere; a stalled/corrupt transfer falls back to local
   re-prefill (MigrationConfig knobs; DEPLOY.md §1p).
+- tiers.TieredPageStore / tiers.TieredWeightStore — tiered memory: the
+  HBM governor's reclaim rungs DEMOTE radix KV pages and fleet weight
+  trees down an HBM -> pinned-host-DRAM -> local-disk ladder instead of
+  deleting them (same bytes freed, nothing lost), promotes ride the
+  checksummed paged-warm import path (bitwise), and a restarted replica
+  reseeds its radix tree and weight cache from the disk tier before
+  taking traffic (TierConfig knobs; DEPLOY.md §1s).
 - batcher.FleetBatcher + server.FleetScoringServer — the multi-model
   fleet layer (engine/fleet.py underneath): per-model dispatch queues
   with resident-first selection and background weight prefetch, and the
@@ -56,6 +63,8 @@ from .migrate import (MigrationError, PageExport, PageMigrator,
 from .queue import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK, STATUS_SHED,
                     RequestQueue, ServeFuture, ServeRequest, ServeResult)
 from .router import ReplicaRouter
+from .tiers import (TIER_DISK, TIER_HBM, TIER_HOST, DiskPageStore,
+                    TieredPageStore, TieredWeightStore)
 from .server import (FleetScoreFuture, FleetScoringServer, ScoringServer,
                      aggregate_fleet, fleet_decision)
 
@@ -66,6 +75,8 @@ __all__ = [
     "ReplicaRouter",
     "MigrationError", "PageExport", "PageMigrator",
     "export_prefix", "import_prefix",
+    "TieredPageStore", "TieredWeightStore", "DiskPageStore",
+    "TIER_HBM", "TIER_HOST", "TIER_DISK",
     "aggregate_fleet", "fleet_decision",
     "STATUS_OK", "STATUS_EXPIRED", "STATUS_SHED", "STATUS_ERROR",
 ]

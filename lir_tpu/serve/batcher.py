@@ -99,6 +99,15 @@ class ContinuousBatcher:
     # -- queue side ---------------------------------------------------------
 
     def admit(self, pending: Pending) -> None:
+        # Admission runs on the supervisor thread AFTER the loop drains
+        # page ops, so a tier promote queued at submit time has already
+        # landed in the radix tree — refresh the submit-side advisory
+        # hint against the live tree so bucket pricing sees promoted
+        # pages as the free prefill they now are (serve/tiers.py).
+        if (self.prefix_cache
+                and getattr(self.engine, "_tier_store", None) is not None):
+            pending.cached_hint = self.engine.prefix_cache.match_len(
+                pending.bucket, pending.bin_ids[:pending.lcp])
         self._queues[pending.bucket].append(pending)
 
     @property

@@ -43,6 +43,13 @@ up with a pin, the import re-plans against the destination tree's
 actual state, and a migration that cannot complete costs a local
 re-prefill (``MigrationStats.refetch_fallbacks``), never a wrong or
 dropped request.
+
+The export/import legs are also the MOVEMENT ENGINE of the tiered
+memory ladder (serve/tiers.py): a demotion is an ``export_prefix`` kept
+in host DRAM or spilled to disk instead of shipped to a peer, and a
+promotion is the same ``import_prefix`` — checksum verify, plan_insert,
+skip-what's-resident, rollback — pointed back at the exporting
+replica's own tree. One transfer discipline, three directions.
 """
 
 from __future__ import annotations

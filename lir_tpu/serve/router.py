@@ -334,6 +334,22 @@ class ReplicaRouter:
                 self._have_page_index = True
                 tree.add_listener(
                     functools.partial(self.cluster_tree.on_event, rid))
+        # Tier-residency feed (serve/tiers.py): each replica's tiered
+        # page store reports host/disk movement into the SAME cluster
+        # index under its tier dimension, and a restart-warm replica
+        # joining the router announces what its surviving host/disk
+        # entries can re-serve (emit_residency) — so placement sees
+        # "demoted but promotable here" as warmer than cold.
+        self._tier_bonus: Dict[str, float] = {"host": 0.5, "disk": 0.25}
+        for rid, handle in self._handles.items():
+            store = getattr(handle.server, "tiers", None)
+            if store is None:
+                continue
+            self._tier_bonus = {"host": store.cfg.host_bonus,
+                                "disk": store.cfg.disk_bonus}
+            store.add_listener(
+                functools.partial(self.cluster_tree.on_tier_event, rid))
+            store.emit_residency()
         # Router-level content-addressed dedup: the exactly-once
         # backstop. The cache's own ServeStats is private; RouterStats
         # carries the router-visible dedup counter.
@@ -417,6 +433,9 @@ class ReplicaRouter:
                     "oldest_wait_s": round(h.oldest_wait(now), 4),
                     "hbm_pressure": round(h.pressure, 4),
                     "resident": sorted(h.resident_view()),
+                    "tiers": (h.server.tiers.summary()
+                              if getattr(h.server, "tiers", None)
+                              is not None else None),
                 }
                 for rid, h in self._handles.items()
             },
@@ -424,9 +443,26 @@ class ReplicaRouter:
 
     # -- placement -----------------------------------------------------------
 
+    def _tier_priced(self, bucket: int, prefix: Tuple[int, ...],
+                     hbm_match: Dict[str, int]) -> Dict[str, float]:
+        """Effective page-equivalents per replica: HBM pages at full
+        price plus host/disk tier pages discounted by the tier bonuses
+        (TierConfig.host_bonus / disk_bonus) — a demoted prefix is
+        warmer than a cold replica, but a promote is not free. Feeds
+        :meth:`_pick` only; pull/prefill decisions keep the exact HBM
+        match."""
+        priced: Dict[str, float] = dict(hbm_match)
+        for rid, by_tier in self.cluster_tree.match_tiers(
+                bucket, prefix).items():
+            for tier, pages in by_tier.items():
+                bonus = self._tier_bonus.get(tier, 0.0)
+                if bonus:
+                    priced[rid] = priced.get(rid, 0) + bonus * pages
+        return priced
+
     def _pick(self, model_id: str, exclude: Set[str],
               remaining_s: Optional[float] = None,
-              page_match: Optional[Dict[str, int]] = None
+              page_match: Optional[Dict[str, float]] = None
               ) -> Optional[_Replica]:
         """The placement decision: among live replicas whose breaker
         admits traffic (and not in ``exclude``), the lowest-scoring one
@@ -574,15 +610,22 @@ class ReplicaRouter:
             prefix: Optional[Tuple[int, ...]] = None
             bucket = 0
             page_match: Dict[str, int] = {}
+            pick_match: Dict[str, float] = {}
             if self._have_page_index and not model_id:
                 info = self._tokenize_prefix(request)
                 if info is not None:
                     prefix, bucket = info
                     page_match = self.cluster_tree.match_pages(bucket,
                                                                prefix)
+                    # Placement prices host/disk-tier pages at a
+                    # discount (promotable, not free); migration
+                    # decisions below keep the exact HBM-only match —
+                    # only HBM pages are exportable.
+                    pick_match = self._tier_priced(bucket, prefix,
+                                                   page_match)
             handle = self._pick(model_id, exclude=set(),
                                 remaining_s=deadline_s,
-                                page_match=page_match)
+                                page_match=pick_match or page_match)
             if handle is None:
                 self.stats.count("no_replica_sheds")
                 pending.claim_resolution()

@@ -42,7 +42,7 @@ import time
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..config import ServeConfig
+from ..config import ServeConfig, TierConfig
 from ..engine import compile_plan
 from ..engine import hbm
 from ..engine import scheduler as sched_mod
@@ -57,6 +57,7 @@ from ..utils.manifest import atomic_write_json
 from ..utils.profiling import FaultStats, ServeStats
 from ..utils.retry import retry_with_exponential_backoff
 from . import migrate as migrate_mod
+from . import tiers as tiers_mod
 from .batcher import ContinuousBatcher, FleetBatcher
 from .cache import ResultCache, content_key
 from .queue import (STATUS_ERROR, STATUS_EXPIRED, STATUS_OK, STATUS_SHED,
@@ -81,7 +82,8 @@ class ScoringServer:
                  config: Optional[ServeConfig] = None,
                  stats: Optional[ServeStats] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 precompile: bool = False):
+                 precompile: bool = False,
+                 tiers: Optional[TierConfig] = None):
         self.engine = engine
         self.model_name = model_name
         self.config = config or ServeConfig()
@@ -127,6 +129,26 @@ class ScoringServer:
         self.metrics.register("serve_faults", self.faults)
         metrics_mod.engine_registry(engine, sink=self.stream,
                                     registry=self.metrics)
+        # Tiered KV residency (serve/tiers.py; config.TierConfig): the
+        # governor's reclaim rungs DEMOTE radix pages down the
+        # HBM -> pinned-host -> disk ladder instead of deleting them,
+        # and a fresh process reseeds its radix tree from the disk tier
+        # before taking traffic (restart-warm). Requires the prefix
+        # cache — the tiers store PageExports of its radix paths.
+        self.tiers: Optional[tiers_mod.TieredPageStore] = None
+        if (tiers is not None and tiers.enabled
+                and self.config.prefix_cache):
+            self.tiers = tiers_mod.TieredPageStore(tiers, clock=clock)
+            engine.attach_tiers(self.tiers)
+            self.metrics.register("tiers", self.tiers.stats)
+            if tiers.restart_warm and self.tiers.disk is not None:
+                # Constructor runs before start(): the supervisor
+                # thread does not exist yet, so importing into the
+                # radix tree here honors its single-thread contract.
+                n = self.tiers.reseed(engine)
+                if n:
+                    log.info("serve: restart-warm — reseeded %d KV "
+                             "pages from the disk tier", n)
         rec = tracing.get_recorder()
         if rec is not None:
             self.metrics.register("trace", rec)
@@ -267,6 +289,20 @@ class ScoringServer:
         if self.batcher.prefix_cache:
             cached_hint = self.engine.prefix_cache.match_len(
                 bucket, bin_ids[:lcp])
+            # Tier promote probe: when the host/disk ladder holds a
+            # DEEPER prefix than HBM, queue a promote op ahead of this
+            # request's dispatch — the ordinary paged-warm import fills
+            # exactly the missing tail (bitwise), and the dispatch's
+            # pinned re-lookup sees the promoted pages. Advisory like
+            # cached_hint: a promote that loses the race (entry
+            # dropped, checksum refusal, disk stall) just means plain
+            # prefill.
+            if self.tiers is not None:
+                prefix = bin_ids[:lcp]
+                if self.tiers.match_len(bucket, prefix) > cached_hint:
+                    store = self.tiers
+                    self.submit_page_op(
+                        lambda eng: store.promote(eng, bucket, prefix))
         pending = Pending(
             request=request, future=fut, t_submit=now,
             t_deadline=now + deadline, bin_ids=bin_ids, conf_ids=conf_ids,
@@ -804,7 +840,8 @@ class FleetScoringServer:
     def __init__(self, fleet, config: Optional[ServeConfig] = None,
                  fleet_deadline_s: float = 60.0,
                  stats: Optional[ServeStats] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 tiers: Optional[TierConfig] = None):
         self.fleet = fleet
         self.config = config or ServeConfig()
         self.fleet_deadline_s = float(fleet_deadline_s)
@@ -850,6 +887,22 @@ class FleetScoringServer:
                                       eng.guard_stats)
                 self.metrics.register(f"model:{mid}:compile",
                                       eng.compile_stats)
+        # Tiered weight residency (serve/tiers.TieredWeightStore): the
+        # governor's evict_weights rung records each evicted staged
+        # tree to disk first (ModelFleet.evict_idle), and a fresh
+        # process re-stages every recorded model from disk before
+        # taking traffic — restart-warm weights, CRC-checked per leaf.
+        self.weight_tiers: Optional[tiers_mod.TieredWeightStore] = None
+        if tiers is not None and tiers.enabled and tiers.disk_dir:
+            self.weight_tiers = tiers_mod.TieredWeightStore(
+                Path(tiers.disk_dir) / "weights")
+            fleet.attach_tiers(self.weight_tiers)
+            self.metrics.register("tiers", self.weight_tiers.stats)
+            if tiers.restart_warm:
+                n = fleet.reseed_weights(self.weight_tiers)
+                if n:
+                    log.info("serve: restart-warm — re-staged %d fleet "
+                             "weight trees from the disk tier", n)
         rec = tracing.get_recorder()
         if rec is not None:
             self.metrics.register("trace", rec)

@@ -1,0 +1,787 @@
+"""Tiered KV + weight store: HBM -> pinned host DRAM -> local disk.
+
+ROADMAP item 3 (Mooncake's KVCache-centric store, PAPERS.md): every
+HBM-pressure response in this engine used to be a *deletion* — a
+governor rung or LRU eviction threw radix pages or model weights away,
+and the production workload (millions of users re-asking variations of
+~5 legal trunks) paid the full prefill or weight-stream bill again.
+This module makes those responses reversible *demotions* down a tier
+ladder, and makes the bottom tier survive process death:
+
+- **Demotion** (:meth:`TieredPageStore.demote`): the radix tree's
+  coldest evictable leaves (``RadixPrefixCache.coldest_leaves``) are
+  exported to host chunks through ``serve/migrate.export_prefix`` —
+  the SAME chunked double-buffered checksummed transfer discipline the
+  disaggregated handoff uses, pointed down-ladder — then their tail
+  pages leave HBM via ``evict_tail`` (which REFUSES dispatch-pinned
+  pages: refcount discipline survives demotion). The host pool is a
+  byte-budgeted LRU; overflow spills to :class:`DiskPageStore`.
+- **Promotion** (:meth:`TieredPageStore.promote`): the deepest tier
+  match re-enters HBM through ``serve/migrate.import_prefix`` — the
+  ordinary paged-warm insert path, per-chunk checksums verified first
+  — so promoted pages back dispatches bitwise-identically to pages
+  computed in place. A corrupt chunk is refused (``tier_corrupt``
+  chaos kind -> ``checksum_refusals``) and a disk read past
+  ``TierConfig.disk_timeout_s`` is abandoned (``disk_stall`` ->
+  ``disk_stalls``); either way the request re-prefills locally —
+  never a wrong answer, never a dropped request.
+- **Disk tier** (:class:`DiskPageStore` / :class:`TieredWeightStore`):
+  one ``.npz`` per spilled prefix or staged weight tree plus an
+  append-only JSONL index riding the manifest ``__meta__`` discipline
+  (utils/manifest.SweepManifest): a torn trailing line from a
+  kill-mid-spill is detected at load and truncated before the next
+  append, so a crash during spill can never poison restart-warm.
+- **Restart-warm** (:meth:`TieredPageStore.reseed` /
+  :meth:`TieredWeightStore.get`): a restarted server replays the disk
+  index, promotes spilled prefixes back into its radix tree, and
+  re-stages spilled weight trees — serving warm in seconds instead of
+  re-prefilling the whole working set.
+- **Fault seam** (:meth:`TieredPageStore.transfer`): the identity hop
+  every promote passes through, mirroring ``PageMigrator.transfer`` —
+  ``faults.wrap_tiers`` injects the ``tier_corrupt`` / ``disk_stall``
+  chaos kinds there.
+
+Movement runs on the owning replica's supervisor thread (demotions
+inside governor rung engagements, promotions as page ops), honoring the
+radix tree's single-threaded contract; ``match_len`` is the only probe
+submit threads touch, and it takes the store's own lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import MigrationConfig, TierConfig
+from ..utils.logging import get_logger
+from ..utils.profiling import TierStats
+from . import migrate
+
+log = get_logger(__name__)
+
+# Tier names, top to bottom. "hbm" lives in the radix tree/page pool;
+# this module owns the other two.
+TIER_HBM, TIER_HOST, TIER_DISK = "hbm", "host", "disk"
+
+# Tier residency events (the cluster index rides these beside the
+# radix tree's PageListener events): fn(event, tier, bucket, ids) with
+# event "insert"/"evict" and tier "host"/"disk".
+TierListener = Callable[[str, str, int, Tuple[int, ...]], None]
+
+_Key = Tuple[int, Tuple[int, ...]]
+
+
+def _fsync_dir(path: Path) -> None:
+    """Durable directory entry (atomic_write/SweepManifest discipline)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class _TierIndex:
+    """Append-only JSONL index with the SweepManifest kill-mid-append
+    discipline: a ``{"__meta__": ...}`` first line, one JSON record per
+    append, fsync per append, and a torn trailing line (the process
+    died mid-write) detected at load and truncated before the next
+    append — never raised past the constructor, never replayed."""
+
+    def __init__(self, path: Path, meta: Dict[str, Any]):
+        self.path = Path(path)
+        self.records: List[Dict[str, Any]] = []
+        self._truncate_to: Optional[int] = None
+        if self.path.exists():
+            self._load()
+        else:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            with open(self.path, "wb") as f:
+                f.write(json.dumps({"__meta__": meta}).encode() + b"\n")
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(self.path.parent)
+
+    def _load(self) -> None:
+        raw = self.path.read_bytes()
+        pos = 0
+        for chunk in raw.split(b"\n"):
+            start = pos
+            pos += len(chunk) + 1
+            if not chunk.strip():
+                continue
+            try:
+                rec = json.loads(chunk)
+            except (ValueError, UnicodeDecodeError):
+                # Torn tail from a kill mid-append: everything after it
+                # must be whitespace, else the file is really corrupt.
+                rest = raw[start:].split(b"\n")
+                if all(not c.strip() for c in rest[1:]):
+                    self._truncate_to = start
+                    log.warning("tier index %s: torn trailing line "
+                                "truncated at byte %d", self.path, start)
+                    break
+                raise
+            if "__meta__" in rec:
+                continue
+            self.records.append(rec)
+
+    def append(self, record: Dict[str, Any]) -> None:
+        with open(self.path, "r+b") as f:
+            if self._truncate_to is not None:
+                f.truncate(self._truncate_to)
+                self._truncate_to = None
+            f.seek(0, os.SEEK_END)
+            f.write(json.dumps(record).encode() + b"\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self.records.append(record)
+
+
+def _key_of(bucket: int, ids) -> _Key:
+    return int(bucket), tuple(int(t) for t in ids)
+
+
+def _lcp_tokens(entry_ids: Tuple[int, ...], ids, page_size: int) -> int:
+    """Page-aligned longest common prefix between a stored prefix and a
+    request's token ids — what a promote of this entry could warm."""
+    n = min(len(entry_ids), len(ids))
+    lcp = 0
+    while lcp < n and int(ids[lcp]) == entry_ids[lcp]:
+        lcp += 1
+    return (lcp // page_size) * page_size
+
+
+# ---------------------------------------------------------------------------
+# Disk tier: one .npz per prefix + the append-only index
+# ---------------------------------------------------------------------------
+
+
+class DiskPageStore:
+    """On-disk page store for spilled :class:`~.migrate.PageExport`
+    payloads. Each entry is one ``.npz`` (chunk leaves flattened in
+    ``jax.tree.leaves`` order — the promote side unflattens against the
+    destination pool's own treedef) plus one index record carrying the
+    export's metadata and checksums. Oldest entries drop past the byte
+    budget (file unlinked, tombstone appended). Single-writer by
+    contract (the owning TieredPageStore's lock)."""
+
+    def __init__(self, root: Path, budget_bytes: int, page_size: int):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.budget_bytes = int(budget_bytes)
+        self._index = _TierIndex(self.root / "index.jsonl",
+                                 meta={"version": 1, "kind": "pages",
+                                       "page_size": int(page_size)})
+        self._seq = 0
+        # Replay: last put per key wins; tombstones remove.
+        self.entries: "OrderedDict[_Key, Dict[str, Any]]" = OrderedDict()
+        for rec in self._index.records:
+            if "put" in rec:
+                meta = rec["put"]
+                key = _key_of(meta["bucket"], meta["ids"])
+                self.entries.pop(key, None)
+                if (self.root / meta["file"]).exists():
+                    self.entries[key] = meta
+                self._seq = max(self._seq, meta.get("seq", 0))
+            elif "del" in rec:
+                key = _key_of(rec["del"]["bucket"], rec["del"]["ids"])
+                self.entries.pop(key, None)
+
+    @property
+    def index_path(self) -> Path:
+        return self.root / "index.jsonl"
+
+    def total_bytes(self) -> int:
+        return sum(m["nbytes"] for m in self.entries.values())
+
+    def has(self, key: _Key) -> bool:
+        return key in self.entries
+
+    def keys(self) -> List[_Key]:
+        return list(self.entries)
+
+    def put(self, key: _Key, export: migrate.PageExport) -> int:
+        """Spill one export; returns bytes written. The data file lands
+        fsynced BEFORE its index record (a crash between the two leaves
+        an orphan file, never a record naming a missing file)."""
+        import jax
+
+        self._seq += 1
+        fname = f"pages-{self._seq:06d}.npz"
+        arrays: Dict[str, np.ndarray] = {}
+        real: List[int] = []
+        n_leaves = 0
+        for ci, (host, n) in enumerate(export.chunks):
+            leaves = jax.tree.leaves(host)
+            n_leaves = len(leaves)
+            real.append(int(n))
+            for li, leaf in enumerate(leaves):
+                arrays[f"c{ci}_l{li}"] = np.asarray(leaf)
+        tmp = self.root / (fname + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.root / fname)
+        _fsync_dir(self.root)
+        meta = {
+            "seq": self._seq, "file": fname,
+            "bucket": int(export.bucket), "ids": list(export.ids),
+            "start_tokens": int(export.start_tokens),
+            "page_size": int(export.page_size),
+            "n_pages": int(export.n_pages),
+            "chunk_pages": int(export.chunk_pages),
+            "real": real, "n_leaves": n_leaves,
+            "checksums": [int(c) for c in export.checksums],
+            "nbytes": int(export.nbytes),
+        }
+        old = self.entries.pop(key, None)
+        if old is not None:
+            self._unlink(old)
+        self._index.append({"put": meta})
+        self.entries[key] = meta
+        nbytes = (self.root / fname).stat().st_size
+        self._enforce_budget()
+        return int(nbytes)
+
+    def get(self, key: _Key, treedef) -> Optional[migrate.PageExport]:
+        """Rebuild one spilled export (chunks unflattened against the
+        promoting pool's ``treedef``); None when the entry or its file
+        is gone — the caller just re-prefills."""
+        import jax
+
+        meta = self.entries.get(key)
+        if meta is None:
+            return None
+        path = self.root / meta["file"]
+        try:
+            with np.load(path) as z:
+                chunks: List[Tuple[Any, int]] = []
+                for ci, n in enumerate(meta["real"]):
+                    leaves = [z[f"c{ci}_l{li}"]
+                              for li in range(meta["n_leaves"])]
+                    chunks.append(
+                        (jax.tree.unflatten(treedef, leaves), int(n)))
+        except Exception as err:  # noqa: BLE001 — np.load's lazy zip
+            # reads surface container-level corruption (BadZipFile,
+            # zip CRC) here, alongside vanished/truncated files; any
+            # unreadable entry drops and the caller re-prefills.
+            log.warning("disk tier: unreadable entry %s (%r) — "
+                        "dropping", meta["file"], err)
+            self.delete(key)
+            return None
+        return migrate.PageExport(
+            bucket=int(meta["bucket"]), ids=tuple(meta["ids"]),
+            start_tokens=int(meta["start_tokens"]),
+            page_size=int(meta["page_size"]),
+            n_pages=int(meta["n_pages"]),
+            chunk_pages=int(meta["chunk_pages"]), chunks=chunks,
+            checksums=list(meta["checksums"]),
+            nbytes=int(meta["nbytes"]))
+
+    def delete(self, key: _Key) -> None:
+        meta = self.entries.pop(key, None)
+        if meta is None:
+            return
+        self._unlink(meta)
+        self._index.append({"del": {"bucket": key[0],
+                                    "ids": list(key[1])}})
+
+    def _unlink(self, meta: Dict[str, Any]) -> None:
+        try:
+            (self.root / meta["file"]).unlink()
+        except OSError:
+            pass
+
+    def _enforce_budget(self) -> None:
+        while len(self.entries) > 1 and self.total_bytes() > self.budget_bytes:
+            key = next(iter(self.entries))    # oldest spill first
+            self.delete(key)
+
+
+# ---------------------------------------------------------------------------
+# The tiered page store (per replica)
+# ---------------------------------------------------------------------------
+
+
+class TieredPageStore:
+    """The HBM -> host -> disk ladder for one replica's KV radix pages
+    (module docstring). Owns the host LRU pool and the disk store;
+    attach with ``ScoringEngine.attach_tiers`` so the governor's
+    ``evict_pages`` rung demotes instead of deleting."""
+
+    def __init__(self, config: Optional[TierConfig] = None,
+                 stats: Optional[TierStats] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = config or TierConfig(enabled=True)
+        self.stats = stats if stats is not None else TierStats()
+        self.clock = clock
+        self._lock = threading.RLock()
+        self._host: "OrderedDict[_Key, migrate.PageExport]" = OrderedDict()
+        self._host_bytes = 0
+        self._listeners: List[TierListener] = []
+        # Export/import run with the migration discipline's defaults;
+        # only the verify switch is the tier store's own.
+        self._mig_cfg = MigrationConfig(verify=self.cfg.verify)
+        self.disk: Optional[DiskPageStore] = None
+        if self.cfg.disk_dir:
+            self.disk = DiskPageStore(
+                Path(self.cfg.disk_dir) / "pages",
+                self.cfg.disk_budget_bytes,
+                page_size=0)
+            self.stats.gauge("disk_bytes", self.disk.total_bytes())
+
+    # -- events --------------------------------------------------------------
+
+    def add_listener(self, fn: TierListener) -> None:
+        """Subscribe to tier insert/evict events (``TierListener``
+        contract) — the router feeds them into the cluster prefix
+        index's tier dimension."""
+        self._listeners.append(fn)
+
+    def _notify(self, event: str, tier: str, bucket: int,
+                ids: Tuple[int, ...]) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(event, tier, int(bucket), ids)
+            except Exception:  # noqa: BLE001 — an index listener must
+                # never take the tier store down with it.
+                log.exception("tier listener failed (%s/%s)", event, tier)
+
+    def emit_residency(self) -> None:
+        """Re-fire "insert" for every current entry — a restarted
+        replica rejoining a router announces its disk-tier residency."""
+        with self._lock:
+            host = list(self._host)
+            disk = self.disk.keys() if self.disk is not None else []
+        for bucket, ids in host:
+            self._notify("insert", TIER_HOST, bucket, ids)
+        for bucket, ids in disk:
+            self._notify("insert", TIER_DISK, bucket, ids)
+
+    # -- the fault seam ------------------------------------------------------
+
+    def transfer(self, export: migrate.PageExport) -> migrate.PageExport:
+        """The hop every promote passes through on its way back toward
+        HBM (PageMigrator.transfer's sibling, pointed up-ladder). In
+        process: a no-op. ``faults.wrap_tiers`` wraps it —
+        ``tier_corrupt`` flips chunk bytes under the checksums,
+        ``disk_stall`` sleeps past ``disk_timeout_s``."""
+        return export
+
+    # -- probes --------------------------------------------------------------
+
+    def _best_entry(self, bucket: int, ids
+                    ) -> Tuple[Optional[_Key], str, int]:
+        """(key, tier, lcp tokens) of the deepest stored match — host
+        beats disk at equal depth (cheaper promote)."""
+        best: Tuple[Optional[_Key], str, int] = (None, TIER_HOST, 0)
+        with self._lock:
+            for (b, eids), export in self._host.items():
+                if b != int(bucket):
+                    continue
+                lcp = _lcp_tokens(eids, ids, export.page_size)
+                if lcp > best[2]:
+                    best = ((b, eids), TIER_HOST, lcp)
+            if self.disk is not None:
+                for key, meta in self.disk.entries.items():
+                    if key[0] != int(bucket):
+                        continue
+                    lcp = _lcp_tokens(key[1], ids, meta["page_size"])
+                    if lcp > best[2]:
+                        best = (key, TIER_DISK, lcp)
+        return best
+
+    def match_len(self, bucket: int, ids) -> int:
+        """Tokens of ``ids``' leading prefix a promote could warm from
+        the host/disk tiers right now — the submit-side probe deciding
+        whether to queue a promote op. Advisory (entries can move or
+        drop between probe and promote; the promote re-checks)."""
+        return self._best_entry(bucket, ids)[2]
+
+    def host_bytes(self) -> int:
+        with self._lock:
+            return self._host_bytes
+
+    # -- demotion (supervisor thread: governor rung engagements) -------------
+
+    def demote(self, engine, n_pages: Optional[int] = None) -> bool:
+        """Demote up to ``n_pages`` of the radix tree's coldest leaves
+        to the host tier (the ``evict_pages`` rung's engage when tiers
+        are attached). Returns True when any HBM page was actually
+        freed — the governor's engage contract."""
+        tree = getattr(engine, "prefix_cache", None)
+        if tree is None:
+            return False
+        want = int(n_pages or self.cfg.demote_pages_per_step)
+        freed = 0
+        for bucket, ids in tree.coldest_leaves(limit=max(8, want)):
+            if freed >= want:
+                break
+            freed += self.demote_prefix(engine, bucket, ids,
+                                        max_pages=want - freed)
+        return freed > 0
+
+    def demote_prefix(self, engine, bucket: int, ids,
+                      max_pages: int = 0) -> int:
+        """Demote one cached prefix: export the full path to host
+        chunks, then evict its tail pages from HBM (``evict_tail``
+        refuses pinned pages — a refused demotion books
+        ``pin_refusals`` and stores nothing). Returns HBM pages
+        freed."""
+        tree = engine.prefix_cache
+        key = _key_of(bucket, ids)
+        with self._lock:
+            stored = (key in self._host
+                      or (self.disk is not None and self.disk.has(key)))
+        export = None
+        if not stored:
+            export = migrate.export_prefix(engine, bucket, ids,
+                                           config=self._mig_cfg,
+                                           clock=self.clock)
+            if export is None:
+                return 0
+        n_pages = max_pages or len(tuple(ids)) // tree.page_size
+        removed = tree.evict_tail(bucket, ids, n_pages)
+        if removed == 0:
+            if tree.match_len(bucket, ids) > 0:
+                self.stats.count("pin_refusals")
+            return 0
+        if export is not None:
+            self._put_host(key, export)
+        return removed
+
+    def _put_host(self, key: _Key, export: migrate.PageExport) -> None:
+        with self._lock:
+            old = self._host.pop(key, None)
+            if old is not None:
+                self._host_bytes -= old.nbytes
+            self._host[key] = export
+            self._host_bytes += export.nbytes
+        self.stats.site("demotions", TIER_HOST)
+        self.stats.count("pages_demoted", export.n_pages)
+        self._notify("insert", TIER_HOST, key[0], key[1])
+        self._enforce_host_budget()
+        self.stats.gauge("host_bytes", self.host_bytes())
+
+    def _enforce_host_budget(self) -> None:
+        """LRU host overflow spills to disk (or drops without one)."""
+        while True:
+            with self._lock:
+                if (self._host_bytes <= self.cfg.host_budget_bytes
+                        or not self._host):
+                    break
+                key, export = self._host.popitem(last=False)
+                self._host_bytes -= export.nbytes
+            self._notify("evict", TIER_HOST, key[0], key[1])
+            if self.disk is not None:
+                with self._lock:
+                    nbytes = self.disk.put(key, export)
+                self.stats.site("demotions", TIER_DISK)
+                self.stats.count("bytes_spilled", nbytes)
+                self._notify("insert", TIER_DISK, key[0], key[1])
+                self.stats.gauge("disk_bytes", self.disk.total_bytes())
+
+    # -- promotion (supervisor thread: page ops) -----------------------------
+
+    def promote(self, engine, bucket: int, ids) -> int:
+        """Promote the deepest stored match of ``ids`` back into HBM
+        through the ordinary paged-warm import path. Returns pages
+        landed (0: nothing stored, HBM already deeper, checksum
+        refused, or disk stalled — the request just prefills)."""
+        key, tier, lcp = self._best_entry(bucket, ids)
+        if key is None:
+            return 0
+        tree = getattr(engine, "prefix_cache", None)
+        if tree is None or lcp <= tree.match_len(bucket, ids):
+            return 0
+        return self._promote_entry(engine, key, tier)
+
+    def _promote_entry(self, engine, key: _Key, tier: str) -> int:
+        tree = engine.prefix_cache
+        t0 = self.clock()
+        if tier == TIER_HOST:
+            with self._lock:
+                export = self._host.get(key)
+                if export is not None:
+                    self._host.move_to_end(key)     # promote = touch
+        else:
+            import jax
+
+            treedef = jax.tree.structure(tree.pool.leaves)
+            with self._lock:
+                export = (self.disk.get(key, treedef)
+                          if self.disk is not None else None)
+        if export is None:
+            return 0
+        export = self.transfer(export)
+        if tier == TIER_DISK and self.clock() - t0 > self.cfg.disk_timeout_s:
+            # The watchdog semantics: a disk leg past its deadline is
+            # abandoned (the caller re-prefills); the entry stays — a
+            # transient stall is not corruption.
+            self.stats.count("disk_stalls")
+            log.warning("disk tier: read of bucket=%d exceeded %.1fs — "
+                        "abandoning promote, re-prefilling",
+                        key[0], self.cfg.disk_timeout_s)
+            return 0
+        try:
+            imp = migrate.import_prefix(engine, export,
+                                        config=self._mig_cfg,
+                                        clock=self.clock)
+        except migrate.MigrationError as err:
+            if "checksum" in str(err):
+                # Poisoned entry: drop it everywhere so it can never be
+                # offered again; the request re-prefills.
+                self.stats.count("checksum_refusals")
+                self.drop(key)
+                log.warning("tier promote refused (checksum): %s", err)
+            else:
+                log.warning("tier promote failed: %s", err)
+            return 0
+        if imp.pages:
+            self.stats.site("promotions", tier)
+            self.stats.count("pages_promoted", imp.pages)
+            self.stats.count("bytes_promoted", imp.nbytes)
+        return imp.pages
+
+    def drop(self, key: _Key) -> None:
+        """Remove one entry from every tier (poisoned or obsolete)."""
+        with self._lock:
+            export = self._host.pop(key, None)
+            if export is not None:
+                self._host_bytes -= export.nbytes
+            had_disk = self.disk is not None and self.disk.has(key)
+            if had_disk:
+                self.disk.delete(key)
+        if export is not None:
+            self._notify("evict", TIER_HOST, key[0], key[1])
+            self.stats.gauge("host_bytes", self.host_bytes())
+        if had_disk:
+            self._notify("evict", TIER_DISK, key[0], key[1])
+            self.stats.gauge("disk_bytes",
+                             self.disk.total_bytes() if self.disk else 0)
+
+    # -- restart-warm --------------------------------------------------------
+
+    def reseed(self, engine, max_pages: Optional[int] = None) -> int:
+        """Replay the disk index into the engine's radix tree (restart-
+        warm boot): every spilled prefix promotes through the ordinary
+        verified import path, newest spills first, until the pool or
+        ``max_pages`` says stop. Returns pages re-seeded."""
+        if self.disk is None or not self.cfg.restart_warm:
+            return 0
+        total = 0
+        for key in reversed(self.disk.keys()):     # newest spill first
+            if max_pages is not None and total >= max_pages:
+                break
+            pages = self._promote_entry(engine, key, TIER_DISK)
+            total += pages
+            if pages:
+                self._notify("insert", TIER_DISK, key[0], key[1])
+        if total:
+            self.stats.count("restart_pages_reseeded", total)
+            log.info("restart-warm: re-seeded %d KV pages from %s",
+                     total, self.disk.root)
+        return total
+
+    def summary(self) -> Dict[str, object]:
+        out = dict(self.stats.summary())
+        out["host_entries"] = len(self._host)
+        out["disk_entries"] = (len(self.disk.entries)
+                               if self.disk is not None else 0)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# The tiered weight store (fleet-wide)
+# ---------------------------------------------------------------------------
+
+
+class TieredWeightStore:
+    """Disk tier for staged model weight trees (models/weights.py
+    ``host_stage`` output: numpy leaves, QuantTensor payload+scale
+    preserved). The host tier for weights already exists — the fleet
+    keeps each slot's staged tree when ``stage_reloads`` is on — so
+    this store adds the legs the fleet lacked: a record that survives
+    eviction with staging off, and a restart-warm re-stage that skips
+    the original checkpoint read entirely. Entries are one ``.npz``
+    per model (path-keyed leaves) plus the same torn-tail-tolerant
+    JSONL index the page store rides; every leaf carries a CRC32
+    verified at :meth:`get` — a corrupt record is refused and dropped
+    (``checksum_refusals``), and the fleet falls back to its ordinary
+    cold load."""
+
+    def __init__(self, root: Path,
+                 stats: Optional[TierStats] = None,
+                 budget_bytes: Optional[int] = None):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.stats = stats if stats is not None else TierStats()
+        self.budget_bytes = budget_bytes
+        self._lock = threading.Lock()
+        self._index = _TierIndex(self.root / "index.jsonl",
+                                 meta={"version": 1, "kind": "weights"})
+        self._seq = 0
+        self.entries: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        for rec in self._index.records:
+            if "put" in rec:
+                meta = rec["put"]
+                self.entries.pop(meta["model"], None)
+                if (self.root / meta["file"]).exists():
+                    self.entries[meta["model"]] = meta
+                self._seq = max(self._seq, meta.get("seq", 0))
+            elif "del" in rec:
+                self.entries.pop(rec["del"]["model"], None)
+
+    @staticmethod
+    def _flatten(staged) -> List[Tuple[str, str, np.ndarray, bool]]:
+        """(path, kind, array, dynamic) per leaf — QuantTensor leaves
+        contribute a payload and a scale entry each."""
+        import jax
+
+        from ..models.quant import QuantTensor
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            staged, is_leaf=lambda x: isinstance(x, QuantTensor))
+        out: List[Tuple[str, str, np.ndarray, bool]] = []
+        for path, leaf in flat:
+            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+            if isinstance(leaf, QuantTensor):
+                out.append((name, "quant_q", np.asarray(leaf.q),
+                            bool(leaf.dynamic)))
+                out.append((name, "quant_scale", np.asarray(leaf.scale),
+                            bool(leaf.dynamic)))
+            else:
+                out.append((name, "array", np.asarray(leaf), False))
+        return out
+
+    def has(self, model_id: str) -> bool:
+        with self._lock:
+            return str(model_id) in self.entries
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return list(self.entries)
+
+    def put(self, model_id: str, staged) -> int:
+        """Record one staged tree; returns bytes written (0 when the
+        model is already recorded — staged trees never change after
+        staging, so one record is enough)."""
+        model_id = str(model_id)
+        with self._lock:
+            if model_id in self.entries:
+                return 0
+            self._seq += 1
+            fname = f"weights-{self._seq:06d}.npz"
+            leaves = self._flatten(staged)
+            arrays = {f"l{i}": arr for i, (_, _, arr, _) in
+                      enumerate(leaves)}
+            tmp = self.root / (fname + ".tmp")
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.root / fname)
+            _fsync_dir(self.root)
+            meta = {
+                "seq": self._seq, "model": model_id, "file": fname,
+                "leaves": [
+                    {"path": name, "kind": kind, "dynamic": dyn,
+                     "crc": int(zlib.crc32(
+                         np.ascontiguousarray(arr).tobytes()))}
+                    for name, kind, arr, dyn in leaves],
+            }
+            self._index.append({"put": meta})
+            self.entries[model_id] = meta
+            nbytes = (self.root / fname).stat().st_size
+        self.stats.site("demotions", "weights")
+        self.stats.count("bytes_spilled", int(nbytes))
+        return int(nbytes)
+
+    def get(self, model_id: str):
+        """Rebuild one staged tree (nested dicts, QuantTensor leaves
+        re-assembled), every leaf CRC-verified. None when absent,
+        unreadable, or corrupt (corrupt entries are dropped and booked
+        as ``checksum_refusals`` — the fleet cold-loads instead)."""
+        from ..models.quant import QuantTensor
+
+        model_id = str(model_id)
+        with self._lock:
+            meta = self.entries.get(model_id)
+        if meta is None:
+            return None
+        try:
+            with np.load(self.root / meta["file"]) as z:
+                arrays = [z[f"l{i}"] for i in range(len(meta["leaves"]))]
+        except FileNotFoundError:
+            log.warning("weight tier: entry file vanished for %s",
+                        model_id)
+            self.delete(model_id)
+            return None
+        except Exception as err:  # noqa: BLE001 — np.load's lazy zip
+            # reads surface container-level corruption (BadZipFile,
+            # zip CRC) here, before the per-leaf CRCs get a look — the
+            # same refusal: drop the entry, the model cold-loads.
+            self.stats.count("checksum_refusals")
+            log.warning("weight tier: unreadable/corrupt entry for %s "
+                        "(%r) — dropping, cold load", model_id, err)
+            self.delete(model_id)
+            return None
+        for arr, leaf_meta in zip(arrays, meta["leaves"]):
+            if zlib.crc32(np.ascontiguousarray(arr).tobytes()) \
+                    != leaf_meta["crc"] % 2**32:
+                self.stats.count("checksum_refusals")
+                log.warning("weight tier: checksum refused for %s "
+                            "(leaf %s) — dropping entry, cold load",
+                            model_id, leaf_meta["path"])
+                self.delete(model_id)
+                return None
+        tree: Dict[str, Any] = {}
+        quants: Dict[str, Dict[str, Any]] = {}
+        for arr, leaf_meta in zip(arrays, meta["leaves"]):
+            path, kind = leaf_meta["path"], leaf_meta["kind"]
+            if kind == "array":
+                self._set_path(tree, path, arr)
+            else:
+                q = quants.setdefault(path,
+                                      {"dynamic": leaf_meta["dynamic"]})
+                q["q" if kind == "quant_q" else "scale"] = arr
+        for path, parts in quants.items():
+            self._set_path(tree, path,
+                           QuantTensor(q=parts["q"],
+                                       scale=parts["scale"],
+                                       dynamic=parts["dynamic"]))
+        self.stats.site("promotions", "weights")
+        return tree
+
+    @staticmethod
+    def _set_path(tree: Dict[str, Any], path: str, value) -> None:
+        parts = path.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+
+    def delete(self, model_id: str) -> None:
+        with self._lock:
+            meta = self.entries.pop(str(model_id), None)
+            if meta is None:
+                return
+            try:
+                (self.root / meta["file"]).unlink()
+            except OSError:
+                pass
+            self._index.append({"del": {"model": str(model_id)}})

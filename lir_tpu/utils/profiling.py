@@ -1103,6 +1103,87 @@ class MigrationStats:
 
 
 @dataclasses.dataclass
+class TierStats:
+    """Tiered-store counters (serve/tiers.py): how cached state moved
+    down and back up the HBM -> host DRAM -> disk ladder. Thread-safe —
+    demotions/promotions run on each replica's supervisor thread while
+    submit threads probe ``match_len`` and the metrics endpoint reads.
+
+    Definitions (reported by ``summary()``, bench.py's "tiered" key,
+    and ``make tiered-smoke``; DEPLOY.md §1s):
+
+    - ``demotions`` / ``promotions``: per-tier movement counts (keys
+      ``host``, ``disk``, ``weights``) — a demotion books the tier the
+      state LANDED in, a promotion the tier it was READ from.
+    - ``pages_demoted`` / ``pages_promoted``: KV page volume either
+      direction; ``bytes_spilled``: bytes written to the DISK tier
+      (host-pool LRU overflow + weight records); ``bytes_promoted``:
+      bytes read back toward HBM.
+    - ``restart_pages_reseeded`` / ``restart_weights_reseeded``: state
+      recovered from the disk tier by a restart-warm boot.
+    - ``checksum_refusals``: promotes refused because a host/disk chunk
+      failed its checksum (chaos kind ``tier_corrupt``) — the entry is
+      dropped and the request re-prefills, never a wrong answer;
+      ``disk_stalls``: disk reads abandoned past
+      ``TierConfig.disk_timeout_s`` (chaos kind ``disk_stall``);
+      ``pin_refusals``: demotion requests refused because a dispatch
+      still pinned the pages (refcount discipline — a pinned page
+      never leaves HBM).
+    - ``host_bytes`` / ``disk_bytes``: current tier occupancy gauges.
+    """
+
+    demotions: Dict[str, int] = dataclasses.field(default_factory=dict)
+    promotions: Dict[str, int] = dataclasses.field(default_factory=dict)
+    pages_demoted: int = 0
+    pages_promoted: int = 0
+    bytes_spilled: int = 0
+    bytes_promoted: int = 0
+    restart_pages_reseeded: int = 0
+    restart_weights_reseeded: int = 0
+    checksum_refusals: int = 0
+    disk_stalls: int = 0
+    pin_refusals: int = 0
+    host_bytes: int = 0
+    disk_bytes: int = 0
+
+    def __post_init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def gauge(self, field: str, value) -> None:
+        with self._lock:
+            setattr(self, field, value)
+
+    def site(self, field: str, site: str, n: int = 1) -> None:
+        with self._lock:
+            d = getattr(self, field)
+            d[site] = d.get(site, 0) + n
+
+    def summary(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "demotions": dict(self.demotions),
+                "promotions": dict(self.promotions),
+                "pages_demoted": self.pages_demoted,
+                "pages_promoted": self.pages_promoted,
+                "bytes_spilled": self.bytes_spilled,
+                "bytes_promoted": self.bytes_promoted,
+                "restart_pages_reseeded": self.restart_pages_reseeded,
+                "restart_weights_reseeded": self.restart_weights_reseeded,
+                "checksum_refusals": self.checksum_refusals,
+                "disk_stalls": self.disk_stalls,
+                "pin_refusals": self.pin_refusals,
+                "host_bytes": self.host_bytes,
+                "disk_bytes": self.disk_bytes,
+            }
+
+
+@dataclasses.dataclass
 class LeaseStats:
     """Shard-lease counters (engine/lease.py): how leased offline-sweep
     shards moved between holders. Thread-safe for symmetry with the

@@ -1,0 +1,523 @@
+"""Tiered KV + weight store (serve/tiers.py): demote to host DRAM and
+disk instead of dying, restart-warm serving.
+
+Pins the PR's load-bearing claims:
+
+- demote -> promote round-trips are BITWISE for bf16 and int8
+  (payload+scale) KV pages: a demotion is an export kept on the
+  ladder, a promotion is the ordinary checksummed paged-warm import,
+  so promoted pages decode exactly like never-demoted ones;
+- the three-tier residency ladder: host-budget overflow spills LRU
+  entries to the disk tier; listener events announce every movement
+  (the router's cluster-index tier dimension rides them);
+- pinned pages REFUSE demotion (in-flight dispatch references win;
+  TierStats.pin_refusals) and refcounts stay sane;
+- the governor's evict_pages rung becomes a reversible demotion with a
+  tier store attached — a rung walk down and back up moves pages off
+  HBM and a later promote restores them bitwise;
+- restart-warm: a fresh process reseeds its radix tree and its fleet
+  weight staging from the disk tier, and re-serves bitwise;
+- kill-mid-spill: a torn tail on the disk index JSONL is truncated at
+  load (the manifest discipline), never a crash or a corrupt entry;
+- the seeded chaos kinds: ``tier_corrupt`` is refused by the promote
+  checksums (poisoned entry dropped, local re-prefill bitwise),
+  ``disk_stall`` abandons the promote past ``disk_timeout_s`` and
+  KEEPS the entry (a stall is not corruption) — zero wrong answers.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from lir_tpu import faults
+from lir_tpu.backends.fake import FakeTokenizer
+from lir_tpu.config import (GovernorConfig, RuntimeConfig, ServeConfig,
+                            TierConfig)
+from lir_tpu.engine import hbm
+from lir_tpu.engine import tokens as tok
+from lir_tpu.engine.fleet import ModelFleet
+from lir_tpu.engine.runner import ScoringEngine
+from lir_tpu.models import decoder, weights
+from lir_tpu.models.quant import QuantTensor
+from lir_tpu.models.registry import ModelConfig, tiny
+from lir_tpu.serve import ScoringServer, ServeRequest
+from lir_tpu.serve import migrate as mig
+from lir_tpu.serve import tiers as tiers_mod
+
+CFG = tiny("llama")
+PARAMS = decoder.init_params(CFG, jax.random.PRNGKey(1))
+TOKZ = FakeTokenizer(vocab=CFG.vocab_size)
+
+FUSED_FIELDS = ("generated", "p_yes", "p_no", "top2_ids", "topk_logprobs",
+                "topk_ids", "weighted_confidence")
+
+
+def _engine(pages: int = 64, params=PARAMS, cfg=CFG, **kw):
+    rt = RuntimeConfig(batch_size=4, max_seq_len=128,
+                       aot_precompile=False, prefix_cache=True,
+                       prefix_cache_pages=pages, **kw)
+    return ScoringEngine(params, cfg, TOKZ, rt)
+
+
+def _prompts(n, trunk_words=60, seed=0):
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible adjuster").split()
+    rng = np.random.default_rng(seed)
+    base = " ".join(rng.choice(words) for _ in range(trunk_words))
+    bps = [f"{base} case {i} Answer Yes or No ." for i in range(n)]
+    cps = [f"{base} case {i} Give a number 0 to 100 ." for i in range(n)]
+    return bps, cps
+
+
+def _prefixes(bps, cps):
+    bin_ids = [TOKZ(p).input_ids for p in bps]
+    conf_ids = [TOKZ(p).input_ids for p in cps]
+    lcps = [tok.shared_prefix_len(a, b)
+            for a, b in zip(bin_ids, conf_ids)]
+    return [list(a[:n]) for a, n in zip(bin_ids, lcps)]
+
+
+def _shared(engine, bps, cps, early_stop=False):
+    engine.fresh_handoff()
+    yes = np.full((len(bps),), TOKZ.YES, np.int32)
+    no = np.full((len(bps),), TOKZ.NO, np.int32)
+    return engine.decode_fused_shared(
+        bps, cps, yes, no, new_tokens=4, conf_tokens=6,
+        early_stop=early_stop, bucket=128, sfx_buckets_ab=(16, 16),
+        reuse_cache=True, use_prefix_cache=True, n_real=len(bps))
+
+
+def assert_fused_bitwise(a, b):
+    for f in FUSED_FIELDS:
+        np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)),
+                                      err_msg=f"fused field {f}")
+
+
+def _assert_pins_released(engine):
+    pool = engine.prefix_cache.pool
+    assert (pool.refcount >= 0).all()
+    assert pool.refcount[1:].sum() == pool.pages_in_use
+
+
+def _export_snapshot(engine, bucket, ids):
+    """Canonical host bytes of a cached prefix (the page-level bitwise
+    probe: chunked owned host copies + per-chunk CRCs)."""
+    e = mig.export_prefix(engine, bucket, ids)
+    assert e is not None
+    return e
+
+
+def _assert_exports_bitwise(a, b):
+    """Real pages only: chunk padding gathers the pool's trash page 0,
+    whose dead bytes legitimately differ across engines (blocks are
+    (L, K, N, ps[, hd]) — pages on axis 2)."""
+    assert a.n_pages == b.n_pages and a.start_tokens == b.start_tokens
+    for (ha, ra), (hb, rb) in zip(a.chunks, b.chunks):
+        assert ra == rb
+        for la, lb in zip(jax.tree.leaves(ha), jax.tree.leaves(hb)):
+            np.testing.assert_array_equal(np.asarray(la)[:, :, :ra],
+                                          np.asarray(lb)[:, :, :rb])
+
+
+def _store(tmp_path, **kw):
+    cfg = TierConfig(enabled=True, disk_dir=str(tmp_path / "tier"), **kw)
+    return tiers_mod.TieredPageStore(cfg)
+
+
+# ---------------------------------------------------------------------------
+# Demote -> promote round trips
+# ---------------------------------------------------------------------------
+
+def test_demote_promote_roundtrip_bitwise(tmp_path):
+    """The headline: pages demoted through host AND disk come back
+    through the paged-warm import and the next decode is bitwise the
+    pre-demotion warm decode."""
+    eng = _engine()
+    bps, cps = _prompts(3)
+    prefixes = _prefixes(bps, cps)
+    _shared(eng, bps, cps)                       # cold fill
+    warm = _shared(eng, bps, cps)                # warm reference
+    # Tiny host budget: demotion spills through the full ladder.
+    store = _store(tmp_path, host_budget_mb=0.0001)
+    eng.attach_tiers(store)
+    assert store.demote(eng, n_pages=999)
+    assert eng.prefix_cache.match_len(128, prefixes[0]) == 0
+    s = store.stats.summary()
+    assert s["pages_demoted"] > 0 and s["bytes_spilled"] > 0
+    assert store.match_len(128, prefixes[0]) > 0
+    assert store.promote(eng, 128, prefixes[0]) > 0
+    got = _shared(eng, bps, cps)
+    for k in (0, 1):
+        assert_fused_bitwise(got[k], warm[k])
+    _assert_pins_released(eng)
+    s = store.stats.summary()
+    assert s["pages_promoted"] > 0 and s["bytes_promoted"] > 0
+
+
+def test_demote_promote_roundtrip_bitwise_int8_kv(tmp_path):
+    """int8-KV flavor at the PAGE level: quantized payload+scale pages
+    that crossed host+disk re-export bitwise-identical bytes."""
+    cfg_q = dataclasses.replace(CFG, kv_cache_int8=True)
+    params_q = decoder.init_params(cfg_q, jax.random.PRNGKey(7))
+    bps, cps = _prompts(3, seed=3)
+    prefixes = _prefixes(bps, cps)
+    eng = _engine(params=params_q, cfg=cfg_q)
+    eng.prefill_insert(128, prefixes)
+    before = _export_snapshot(eng, 128, prefixes[0])
+    store = _store(tmp_path, host_budget_mb=0.0001)
+    eng.attach_tiers(store)
+    assert store.demote(eng, n_pages=999)
+    assert store.promote(eng, 128, prefixes[0]) > 0
+    after = _export_snapshot(eng, 128, prefixes[0])
+    _assert_exports_bitwise(before, after)
+    _assert_pins_released(eng)
+
+
+def test_three_tier_residency_spill_and_events(tmp_path):
+    """Host budget overflow spills LRU entries down to disk; every
+    movement fires a TierListener event (the cluster index's feed)."""
+    eng = _engine()
+    # Three DISTINCT trunks -> three disjoint radix paths -> three tier
+    # entries (a shared trunk would collapse to one).
+    for seed in (0, 1, 2):
+        bps, cps = _prompts(1, seed=seed)
+        eng.prefill_insert(128, _prefixes(bps, cps))
+    # Budget sized for roughly one export: later demotions spill the
+    # LRU entries to disk.
+    store = _store(tmp_path, host_budget_mb=0.07)
+    eng.attach_tiers(store)
+    events = []
+    store.add_listener(lambda ev, tier, b, ids: events.append((ev, tier)))
+    assert store.demote(eng, n_pages=999)
+    s = store.summary()
+    assert s["disk_entries"] > 0            # something spilled
+    assert s["demotions"].get("host", 0) > 0
+    assert ("insert", "host") in events
+    assert ("evict", "host") in events      # the spill's host departure
+    assert ("insert", "disk") in events
+    assert s["disk_bytes"] > 0
+    # emit_residency replays the current residency for a rejoin.
+    events.clear()
+    store.emit_residency()
+    assert events and all(ev == "insert" for ev, _ in events)
+
+
+def test_pinned_pages_refuse_demotion(tmp_path):
+    """In-flight dispatch pins win: a pinned path demotes nothing
+    (pin_refusals counts), the whole-tree walk finds no evictable
+    leaf, and refcounts stay sane throughout."""
+    eng = _engine()
+    bps, cps = _prompts(2)
+    prefixes = _prefixes(bps, cps)
+    eng.prefill_insert(128, prefixes)
+    store = _store(tmp_path)
+    eng.attach_tiers(store)
+    tree = eng.prefix_cache
+    pin = tree.lookup(128, prefixes[0], record=False)
+    assert pin.pages
+    before = tree.match_len(128, prefixes[0])
+    assert store.demote_prefix(eng, 128, tuple(prefixes[0])) == 0
+    assert store.stats.summary()["pin_refusals"] == 1
+    assert tree.match_len(128, prefixes[0]) == before   # path intact
+    tree.release(pin)
+    _assert_pins_released(eng)
+    # Unpinned, the same path demotes.
+    assert store.demote_prefix(eng, 128, tuple(prefixes[0])) > 0
+
+
+# ---------------------------------------------------------------------------
+# Governor integration: reclaim rungs as reversible demotions
+# ---------------------------------------------------------------------------
+
+def test_governor_rung_walk_demotes_then_promotes_back(tmp_path):
+    """Sustained pressure walks the ladder onto evict_pages, which now
+    DEMOTES (tier counters move, HBM pages free); pressure release
+    re-arms the rung; a promote restores the pages bitwise."""
+    eng = _engine()
+    bps, cps = _prompts(3)
+    prefixes = _prefixes(bps, cps)
+    eng.prefill_insert(128, prefixes)
+    before = _export_snapshot(eng, 128, prefixes[0])
+    store = _store(tmp_path)
+    eng.attach_tiers(store)
+    MB = 1 << 20
+    gov = hbm.HbmGovernor(
+        GovernorConfig(enabled=True, engage_pressure=0.9,
+                       hysteresis=0.15, sustain_ticks=1),
+        budget_bytes=100 * MB)
+    eng.governor = gov
+    gov.set_action("evict_pages", engage=eng._evict_cold_pages)
+    gov.update("pressure_src", 99 * MB)
+    for _ in range(len(hbm.RUNGS) + 1):
+        gov.tick()
+    assert "evict_pages" in gov.engaged_rungs()
+    s = store.stats.summary()
+    assert s["pages_demoted"] > 0           # the rung demoted, not deleted
+    assert eng.prefix_cache.match_len(128, prefixes[0]) == 0
+    gov.update("pressure_src", 1 * MB)      # pressure clears
+    for _ in range(len(hbm.RUNGS) + 1):
+        gov.tick()
+    assert gov.engaged_rungs() == []        # walked back up
+    assert store.promote(eng, 128, prefixes[0]) > 0
+    after = _export_snapshot(eng, 128, prefixes[0])
+    _assert_exports_bitwise(before, after)
+    _assert_pins_released(eng)
+
+
+# ---------------------------------------------------------------------------
+# Restart-warm
+# ---------------------------------------------------------------------------
+
+def test_restart_warm_reseed_bitwise(tmp_path):
+    """Process death with a disk tier: a FRESH engine + store over the
+    same directory reseed the radix tree and re-serve bitwise what the
+    first incarnation served."""
+    bps, cps = _prompts(3)
+    prefixes = _prefixes(bps, cps)
+    eng = _engine()
+    _shared(eng, bps, cps)
+    warm = _shared(eng, bps, cps)
+    store = _store(tmp_path, host_budget_mb=0.0001)   # everything to disk
+    eng.attach_tiers(store)
+    assert store.demote(eng, n_pages=999)
+    del eng, store                          # "kill" the process
+
+    eng2 = _engine()
+    store2 = _store(tmp_path)
+    eng2.attach_tiers(store2)
+    n = store2.reseed(eng2)
+    assert n > 0
+    assert store2.stats.summary()["restart_pages_reseeded"] == n
+    assert eng2.prefix_cache.match_len(128, prefixes[0]) > 0
+    got = _shared(eng2, bps, cps)
+    for k in (0, 1):
+        assert_fused_bitwise(got[k], warm[k])
+    _assert_pins_released(eng2)
+
+
+def test_server_constructor_wires_tiers(tmp_path):
+    """ScoringServer(tiers=...) builds the store, attaches it to the
+    engine, registers TierStats in the metrics registry, and reseeds
+    at construction (before the supervisor thread exists)."""
+    eng = _engine()
+    cfg = TierConfig(enabled=True, disk_dir=str(tmp_path / "t"))
+    srv = ScoringServer(eng, "m", ServeConfig(
+        classes=(("t", 600.0),), default_class="t", cache_entries=0),
+        tiers=cfg)
+    assert srv.tiers is not None
+    assert getattr(eng, "_tier_store", None) is srv.tiers
+    assert "tiers" in srv.metrics.snapshot()["sources"]
+
+
+def test_torn_disk_index_tolerated_kill_mid_spill(tmp_path):
+    """A spill killed mid-append leaves a torn JSONL tail on the disk
+    index; the next load truncates it (manifest discipline), keeps
+    every complete record, and the surviving entries promote bitwise."""
+    eng = _engine()
+    bps, cps = _prompts(3)
+    prefixes = _prefixes(bps, cps)
+    eng.prefill_insert(128, prefixes)
+    before = _export_snapshot(eng, 128, prefixes[0])
+    store = _store(tmp_path, host_budget_mb=0.0001)
+    eng.attach_tiers(store)
+    assert store.demote(eng, n_pages=999)
+    n_entries = store.summary()["disk_entries"]
+    assert n_entries > 0
+    index_path = store.disk.index_path
+    faults.tear_jsonl_tail(index_path)
+
+    store2 = _store(tmp_path)               # reload over the torn index
+    assert store2.summary()["disk_entries"] == n_entries
+    eng2 = _engine()
+    assert store2.reseed(eng2) > 0
+    after = _export_snapshot(eng2, 128, prefixes[0])
+    _assert_exports_bitwise(before, after)
+    # The truncated index accepts new appends (the spill that died
+    # mid-write simply re-runs).
+    eng3 = _engine()
+    bps3, cps3 = _prompts(2, seed=9)
+    eng3.prefill_insert(128, _prefixes(bps3, cps3))
+    eng3.attach_tiers(store2)
+    assert store2.demote(eng3, n_pages=999)
+
+
+# ---------------------------------------------------------------------------
+# Weight tier
+# ---------------------------------------------------------------------------
+
+def _tiny_cfg(name):
+    return ModelConfig(name=name, vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=32, n_layers=1, n_heads=2,
+                       intermediate_size=64, max_seq_len=256)
+
+
+def _tiny_engine(name, seed):
+    cfg = _tiny_cfg(name)
+    return ScoringEngine(
+        decoder.init_params(cfg, jax.random.PRNGKey(seed)), cfg,
+        FakeTokenizer(), RuntimeConfig(batch_size=4, max_seq_len=256))
+
+
+def _assert_trees_bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_weight_store_roundtrip_bitwise(tmp_path):
+    """A staged host tree recorded to disk comes back leaf-for-leaf
+    bitwise (CRC-verified), nested structure intact."""
+    staged = weights.host_stage(
+        decoder.init_params(_tiny_cfg("w"), jax.random.PRNGKey(3)))
+    ws = tiers_mod.TieredWeightStore(tmp_path / "w")
+    assert ws.put("m0", staged) > 0
+    assert ws.has("m0")
+    assert ws.put("m0", staged) == 0        # immutable: record once
+    got = ws.get("m0")
+    _assert_trees_bitwise(staged, got)
+    assert ws.stats.summary()["demotions"].get("weights", 0) == 1
+
+
+def test_weight_store_quant_tensor_roundtrip(tmp_path):
+    """int8 weights: QuantTensor leaves (payload + scale + dynamic
+    flag) survive the disk tier bitwise and come back AS QuantTensor."""
+    rng = np.random.default_rng(5)
+    staged = {
+        "dense": {"w": rng.standard_normal((8, 8)).astype(np.float32)},
+        "q": QuantTensor(
+            q=rng.integers(-127, 127, (8, 8), dtype=np.int8),
+            scale=rng.standard_normal((8, 1)).astype(np.float32),
+            dynamic=False),
+    }
+    ws = tiers_mod.TieredWeightStore(tmp_path / "w")
+    assert ws.put("mq", staged) > 0
+    got = ws.get("mq")
+    assert isinstance(got["q"], QuantTensor)
+    assert got["q"].dynamic is False
+    np.testing.assert_array_equal(np.asarray(got["q"].q),
+                                  np.asarray(staged["q"].q))
+    np.testing.assert_array_equal(np.asarray(got["q"].scale),
+                                  np.asarray(staged["q"].scale))
+    _assert_trees_bitwise(staged["dense"], got["dense"])
+
+
+def test_weight_store_corrupt_record_refused(tmp_path):
+    """A rotted on-disk leaf fails its CRC: get() refuses (None), the
+    record drops, checksum_refusals counts — the model cold-loads
+    instead of serving corrupt weights."""
+    staged = weights.host_stage(
+        decoder.init_params(_tiny_cfg("w"), jax.random.PRNGKey(3)))
+    ws = tiers_mod.TieredWeightStore(tmp_path / "w")
+    assert ws.put("m0", staged) > 0
+    npz = next(p for p in (tmp_path / "w").iterdir()
+               if p.suffix == ".npz")
+    raw = bytearray(npz.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    npz.write_bytes(bytes(raw))
+    assert ws.get("m0") is None
+    assert ws.stats.summary()["checksum_refusals"] >= 1
+    assert not ws.has("m0")
+
+
+def test_fleet_attach_mirrors_and_restart_warm_reseeds(tmp_path):
+    """attach_tiers mirrors every staged tree (covering the cache's
+    own insert-time LRU evictions, not just the evict_idle rung), and
+    a fresh fleet restart-warm re-stages them bitwise."""
+    e0, e1 = _tiny_engine("m0", 0), _tiny_engine("m1", 1)
+    orig0 = weights.host_stage(e0.params)
+    nb = weights.tree_bytes(e0.params)
+    fleet = ModelFleet.from_engines([("m0", e0), ("m1", e1)],
+                                    cache_budget_bytes=nb + nb // 2,
+                                    prefetch=False)
+    ws = tiers_mod.TieredWeightStore(tmp_path / "w")
+    fleet.attach_tiers(ws)
+    try:
+        assert sorted(ws.models()) == ["m0", "m1"]
+        _assert_trees_bitwise(orig0, ws.get("m0"))
+    finally:
+        fleet.shutdown()
+
+    e0b = _tiny_engine("m0", 0)
+    fleet2 = ModelFleet.from_engines([("m0", e0b)], prefetch=False)
+    try:
+        for slot in fleet2._slots.values():
+            slot.staged = None              # cold restart: staging lost
+        assert fleet2.reseed_weights(ws) == 1
+        assert ws.stats.summary()["restart_weights_reseeded"] == 1
+        _assert_trees_bitwise(orig0, fleet2._slots["m0"].staged)
+    finally:
+        fleet2.shutdown()
+
+
+def test_fleet_evict_idle_records_via_governor_rung(tmp_path):
+    """The evict_weights rung demotes: evict_idle still frees the HBM
+    copy (engage contract True) and the victim's staged tree is on
+    disk afterwards."""
+    e0, e1 = _tiny_engine("m0", 0), _tiny_engine("m1", 1)
+    fleet = ModelFleet.from_engines([("m0", e0), ("m1", e1)],
+                                    prefetch=False)
+    ws = tiers_mod.TieredWeightStore(tmp_path / "w")
+    try:
+        fleet._tier_store = ws              # skip attach-time mirror
+        assert fleet.evict_idle() is True
+        assert len(ws.models()) == 1        # exactly the victim
+    finally:
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Chaos kinds
+# ---------------------------------------------------------------------------
+
+def test_tier_corrupt_refused_and_reprefill_bitwise(tmp_path):
+    """tier_corrupt flips promoted bytes under the checksums: the
+    import refuses, the poisoned entry drops everywhere, and the local
+    re-prefill is bitwise — never a wrong answer."""
+    eng = _engine()
+    bps, cps = _prompts(3)
+    prefixes = _prefixes(bps, cps)
+    eng.prefill_insert(128, prefixes)
+    before = _export_snapshot(eng, 128, prefixes[0])
+    store = _store(tmp_path)
+    eng.attach_tiers(store)
+    assert store.demote(eng, n_pages=999)
+    plan = faults.FaultPlan(seed=7, schedules={
+        "tiers": faults.SiteSchedule.tier_corrupt_at(0)})
+    faults.wrap_tiers(store, plan)
+    assert store.promote(eng, 128, prefixes[0]) == 0
+    assert store.stats.summary()["checksum_refusals"] == 1
+    assert plan.stats.summary()["injected"].get("tiers") == 1
+    assert store.match_len(128, prefixes[0]) == 0    # entry dropped
+    eng.prefill_insert(128, prefixes)                # local re-prefill
+    after = _export_snapshot(eng, 128, prefixes[0])
+    _assert_exports_bitwise(before, after)
+    _assert_pins_released(eng)
+
+
+def test_disk_stall_abandons_then_retry_succeeds(tmp_path):
+    """disk_stall sleeps past disk_timeout_s then proceeds (a wedged
+    read, not a death): the store abandons the promote, KEEPS the
+    entry, and an unstalled retry promotes it bitwise."""
+    eng = _engine()
+    bps, cps = _prompts(3)
+    prefixes = _prefixes(bps, cps)
+    eng.prefill_insert(128, prefixes)
+    before = _export_snapshot(eng, 128, prefixes[0])
+    store = _store(tmp_path, host_budget_mb=0.0001,
+                   disk_timeout_s=0.05)
+    eng.attach_tiers(store)
+    assert store.demote(eng, n_pages=999)
+    plan = faults.FaultPlan(seed=7, schedules={
+        "tiers": faults.SiteSchedule.disk_stall_at(0, seconds=0.2)})
+    faults.wrap_tiers(store, plan)
+    assert store.promote(eng, 128, prefixes[0]) == 0
+    assert store.stats.summary()["disk_stalls"] == 1
+    assert store.match_len(128, prefixes[0]) > 0     # entry KEPT
+    assert store.promote(eng, 128, prefixes[0]) > 0  # retry clean
+    after = _export_snapshot(eng, 128, prefixes[0])
+    _assert_exports_bitwise(before, after)
+    _assert_pins_released(eng)

@@ -63,6 +63,16 @@ The guard layer (lir_tpu/guard) adds the SILENT failure modes:
    bitwise a colocated server's, fallbacks == injections, never a
    wrong answer.
 
+12. TIER CORRUPT/STALL — the tiered KV ladder's promote chaos
+   (lir_tpu/serve/tiers.py): a seeded ``tier_corrupt`` flips a demoted
+   prefix's bytes under its chunk checksums (the promote must refuse
+   BEFORE any page enters the radix tree and drop the poisoned entry)
+   and a ``disk_stall`` wedges a disk-tier read past ``disk_timeout_s``
+   (the promote is abandoned but the entry KEPT — a transient stall is
+   not corruption) — BOTH requests fall back to local re-prefill and
+   resolve ok with payloads bitwise an untiered server's: refusals and
+   stalls counted == injections, never a wrong answer.
+
 Runs hermetically on CPU (FakeTokenizer + tiny random decoder); prints
 the FaultStats/GuardStats summaries as JSON on success.
 """
@@ -1290,6 +1300,142 @@ def disagg_chaos(failures):
             s.stop()
 
 
+def tiers_chaos(failures):
+    """Scenario 12 (tier corrupt/stall — serve/tiers.py): a tiered
+    server whose whole radix tree was demoted down the ladder, under
+    seeded promote chaos. ``tier_corrupt`` flips the demoted bytes
+    under the export's checksums — the promote must refuse before any
+    page lands and DROP the entry; ``disk_stall`` wedges the disk read
+    past ``disk_timeout_s`` — the promote is abandoned but the entry
+    KEPT. Both re-asks fall back to local re-prefill and resolve ok
+    with payloads bitwise an untiered server's: never a wrong
+    answer."""
+    import tempfile
+
+    import jax
+
+    from lir_tpu import faults
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig, ServeConfig, TierConfig
+    from lir_tpu.engine import tokens as tok
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import ScoringServer, ServeRequest
+
+    mcfg = ModelConfig(name="chaos-smoke", vocab_size=FakeTokenizer.VOCAB,
+                       hidden_size=32, n_layers=1, n_heads=2,
+                       intermediate_size=64, max_seq_len=256)
+    params = decoder.init_params(mcfg, jax.random.PRNGKey(11))
+    # cache_entries=0: exact-dedup would answer the chaos re-asks from
+    # the result cache and the tier promote would never run.
+    scfg = ServeConfig(classes=(("chaos", 600.0),), default_class="chaos",
+                       prefix_cache=True, cache_entries=0, linger_s=0.002)
+
+    def engine():
+        return ScoringEngine(params, mcfg, FakeTokenizer(),
+                             RuntimeConfig(batch_size=BATCH,
+                                           max_seq_len=256,
+                                           prefix_cache=True))
+
+    words = ("coverage policy flood water damage claim insurer "
+             "premium").split()
+
+    def req(seed, rid):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        body = " ".join(rng.choice(words) for _ in range(55)) + f" q{rid}"
+        return ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="chaos", request_id=str(rid))
+
+    reqs = [req(101, "tier-corrupt"), req(202, "disk-stall")]
+    colo = ScoringServer(engine(), "chaos-smoke", scfg).start()
+    base = [colo.submit(r).result(300) for r in reqs]
+    colo.stop()
+
+    fields = ("model_response", "model_confidence_response",
+              "token_1_prob", "token_2_prob", "log_probabilities",
+              "confidence_value", "weighted_confidence")
+    with tempfile.TemporaryDirectory(prefix="tiers_chaos_") as tmp:
+        # Tiny host pool: every demotion spills through to the disk
+        # tier, so the stall leg exercises the disk deadline. Generous
+        # timeout vs a 2 s injected stall: a healthy few-KB read never
+        # takes 500 ms, the wedged one always abandons.
+        srv = ScoringServer(
+            engine(), "chaos-smoke", scfg,
+            tiers=TierConfig(enabled=True, disk_dir=tmp,
+                             host_budget_mb=0.0001,
+                             disk_timeout_s=0.5)).start()
+        store = srv.tiers
+        try:
+            cold = [srv.submit(r).result(300) for r in reqs]
+            if any(r.status != "ok" for r in cold):
+                failures.append("tiers: cold pass not all ok")
+            srv.submit_page_op(
+                lambda eng: [store.demote(eng, n_pages=999)
+                             for _ in range(8)]).result(60)
+            if not store.summary()["pages_demoted"]:
+                failures.append("tiers: nothing demoted — chaos legs "
+                                "have no ladder to attack")
+
+            plan_c = faults.FaultPlan(seed=3, schedules={
+                "tiers": faults.SiteSchedule.tier_corrupt_at(0)})
+            faults.wrap_tiers(store, plan_c)
+            got = srv.submit(reqs[0]).result(300)
+            if got.status != "ok":
+                failures.append(f"tiers: corrupt-promote request "
+                                f"resolved {got.status}")
+            for f in fields:
+                if getattr(got, f) != getattr(base[0], f):
+                    failures.append(f"tiers: corrupt-fallback payload "
+                                    f"field {f} differs from untiered")
+            if store.summary()["checksum_refusals"] != 1:
+                failures.append("tiers: corrupt promote not refused")
+
+            # Unwrap the corrupt schedule before arming the stall one
+            # so each phase fires exactly its own kind.
+            store.transfer = getattr(store.transfer, "__wrapped__",
+                                     store.transfer)
+            plan_s = faults.FaultPlan(seed=4, schedules={
+                "tiers": faults.SiteSchedule.disk_stall_at(
+                    0, seconds=2.0)})
+            faults.wrap_tiers(store, plan_s)
+            got2 = srv.submit(reqs[1]).result(300)
+            if got2.status != "ok":
+                failures.append(f"tiers: stalled-promote request "
+                                f"resolved {got2.status}")
+            for f in fields:
+                if getattr(got2, f) != getattr(base[1], f):
+                    failures.append(f"tiers: stall-fallback payload "
+                                    f"field {f} differs from untiered")
+            summary = store.summary()
+            if summary["disk_stalls"] != 1:
+                failures.append("tiers: disk stall never counted")
+            injected = (plan_c.injected("tiers")
+                        + plan_s.injected("tiers"))
+            if injected != 2:
+                failures.append(f"tiers: expected 2 injections, "
+                                f"got {injected}")
+            # The stalled entry survived (kept); the corrupt one is
+            # gone (dropped) — a wedged read is not corruption.
+            e = srv.engine
+            bi = tuple(int(i) for i in e.tokenizer(
+                reqs[1].binary_prompt).input_ids)
+            ci = tuple(int(i) for i in e.tokenizer(
+                reqs[1].confidence_prompt).input_ids)
+            lcp = tok.shared_prefix_len(bi, ci)
+            bucket = tok.assign_bucket(max(lcp, 1), e.buckets)
+            if store.match_len(bucket, bi[:lcp]) <= 0:
+                failures.append("tiers: stalled entry was dropped — "
+                                "a transient stall is not corruption")
+            return summary
+        finally:
+            srv.stop()
+
+
 def main() -> int:
     failures = []
     sweep_summary = sweep_chaos(failures)
@@ -1302,6 +1448,7 @@ def main() -> int:
     spec_summary = spec_chaos(failures)
     hbm_summary = hbm_chaos(failures)
     disagg_summary = disagg_chaos(failures)
+    tiers_summary = tiers_chaos(failures)
     if failures:
         for f in failures:
             print(f"CHAOS-SMOKE FAIL: {f}")
@@ -1314,7 +1461,8 @@ def main() -> int:
                       "elastic": elastic_summary,
                       "spec": spec_summary,
                       "hbm": hbm_summary,
-                      "disagg": disagg_summary}))
+                      "disagg": disagg_summary,
+                      "tiers": tiers_summary}))
     print("chaos smoke: OK (sweep resumed bitwise-identical after "
           "injected kill + torn manifest; breaker tripped and recovered "
           "via half-open probe; poison row isolated; checkpoint resume "
@@ -1334,6 +1482,10 @@ def main() -> int:
           "corrupted page migration was refused at import and a "
           "stalled one abandoned at the chain deadline, both falling "
           "back to local re-prefill with payloads bitwise a colocated "
+          "server's; a corrupted tier promote was refused under its "
+          "checksums with the poisoned entry dropped and a stalled "
+          "disk-tier read abandoned past its deadline with the entry "
+          "kept, both re-asks re-prefilled bitwise an untiered "
           "server's)")
     return 0
 

@@ -31,7 +31,7 @@ from pathlib import Path
 
 # The recorded floor. Update DELIBERATELY (with the PR that raises
 # coverage), never to paper over a regression.
-TIER1_FLOOR = 502
+TIER1_FLOOR = 517
 
 PYTEST_ARGS = [
     "-m", "pytest", "tests/", "-q", "-m", "not slow",

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Tiered-memory smoke: the HBM -> host DRAM -> disk KV ladder
+(serve/tiers.py, DEPLOY.md §1s) on the fake backend — the
+`make tiered-smoke` CI target.
+
+Serves a shared-prefix working set LARGER than the HBM page budget on a
+tiered server (tiny host pool, so demotions spill through to the disk
+tier), demotes the whole radix tree between passes the way the
+governor's ``evict_pages`` rung would, and asserts the PR's
+load-bearing claims:
+
+- NONZERO demotions AND promotions: the warm pass resumed prefixes
+  from the host/disk ladder through the paged-warm import path instead
+  of re-prefilling them;
+- every payload is BITWISE-identical to the same stream served with
+  tiering OFF — the ladder is a pure capacity lever, invisible in
+  results;
+- restart-warm: after the process "dies" (server + engine discarded,
+  only the disk directory survives), a fresh server on the same
+  ``disk_dir`` re-seeds its radix tree from the index, serves the same
+  stream with nonzero prefill-tokens-avoided, and stays bitwise.
+
+Runs hermetically on CPU; prints the TierStats summaries as JSON on
+success.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_BASES = 4
+N_REQUESTS = 12
+BASE_WORDS = 90    # long trunks: the working set outgrows the page pool
+POOL_PAGES = 48    # HBM page budget — smaller than the 4-base working set
+
+PAYLOAD_FIELDS = ("status", "model_response", "model_confidence_response",
+                  "token_1_prob", "token_2_prob", "log_probabilities",
+                  "confidence_value", "weighted_confidence")
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from lir_tpu.backends.fake import FakeTokenizer
+    from lir_tpu.config import RuntimeConfig, ServeConfig, TierConfig
+    from lir_tpu.engine.runner import ScoringEngine
+    from lir_tpu.models import decoder
+    from lir_tpu.models.registry import ModelConfig
+    from lir_tpu.serve import ScoringServer, ServeRequest
+
+    cfg = ModelConfig(name="tiered-smoke", vocab_size=FakeTokenizer.VOCAB,
+                      hidden_size=32, n_layers=1, n_heads=2,
+                      intermediate_size=64, max_seq_len=512)
+    params = decoder.init_params(cfg, jax.random.PRNGKey(5))
+
+    words = ("coverage policy flood water damage claim insurer premium "
+             "exclusion endorsement peril deductible adjuster settle "
+             "liability clause binding interpret statute meaning").split()
+    rng = np.random.default_rng(29)
+    bases = [" ".join(rng.choice(words) for _ in range(BASE_WORDS))
+             for _ in range(N_BASES)]
+
+    def request(i: int) -> ServeRequest:
+        body = f"{bases[i % N_BASES]} case {i} ?"
+        return ServeRequest(
+            binary_prompt=f"{body} Answer Yes or No .",
+            confidence_prompt=f"{body} Give a number from 0 to 100 .",
+            klass="smoke", request_id=str(i))
+
+    def fresh_engine() -> ScoringEngine:
+        return ScoringEngine(params, cfg, FakeTokenizer(),
+                             RuntimeConfig(batch_size=4, max_seq_len=512,
+                                           prefix_cache=True,
+                                           prefix_cache_pages=POOL_PAGES))
+
+    # cache_entries=0: exact-dedup would answer the warm re-asks from
+    # the result cache and the tier probe would never run — this smoke
+    # is about the KV ladder, not dedup.
+    serve_cfg = ServeConfig(queue_depth=N_REQUESTS + 8, prefix_cache=True,
+                            cache_entries=0, classes=(("smoke", 600.0),),
+                            default_class="smoke", linger_s=0.01)
+
+    def serve_stream(server) -> list:
+        futs = [server.submit(request(i)) for i in range(N_REQUESTS)]
+        return [f.result(timeout=600) for f in futs]
+
+    failures = []
+
+    # Baseline: tiering OFF, same stream, same params.
+    base_srv = ScoringServer(fresh_engine(), "tiered-smoke",
+                             serve_cfg).start()
+    base = serve_stream(base_srv)
+    base_srv.stop()
+
+    with tempfile.TemporaryDirectory(prefix="tiered_smoke_") as tmp:
+        tiers = TierConfig(enabled=True, disk_dir=tmp,
+                           host_budget_mb=0.05,   # tiny: spill to disk
+                           disk_timeout_s=30.0, restart_warm=True)
+        srv = ScoringServer(fresh_engine(), "tiered-smoke", serve_cfg,
+                            tiers=tiers).start()
+        cold = serve_stream(srv)
+        store = srv.tiers
+
+        # Demote the whole tree (the evict_pages rung under sustained
+        # pressure) on the supervisor thread, then re-ask everything:
+        # the promote probe must warm the trunks back from the ladder.
+        def demote_all(eng):
+            while store.demote(eng, n_pages=POOL_PAGES):
+                pass
+        srv.submit_page_op(demote_all).result(timeout=60)
+        warm = serve_stream(srv)
+        summary_live = store.summary()
+        srv.stop()
+
+        if not summary_live.get("pages_demoted"):
+            failures.append("zero demotions — nothing left HBM for the "
+                            "ladder")
+        if not summary_live.get("pages_promoted"):
+            failures.append("zero promotions — the warm pass never "
+                            "resumed from the host/disk tiers")
+        if summary_live.get("checksum_refusals"):
+            failures.append("checksum refusals on a healthy ladder: "
+                            f"{summary_live}")
+
+        # Restart-warm: the process dies; only the disk dir survives.
+        del srv, store
+        srv2 = ScoringServer(fresh_engine(), "tiered-smoke", serve_cfg,
+                             tiers=tiers).start()
+        reseeded = srv2.tiers.summary().get("restart_pages_reseeded", 0)
+        rewarm = serve_stream(srv2)
+        hit_tokens = srv2.engine.prefix_stats.hit_tokens
+        summary_restart = srv2.tiers.summary()
+        srv2.stop()
+
+        if not reseeded:
+            failures.append("restart-warm re-seeded zero pages from the "
+                            "disk tier")
+        if hit_tokens <= 0:
+            failures.append("zero prefill tokens avoided after restart — "
+                            "the re-seeded tree never served a hit")
+        for name, got in (("tiered-cold", cold), ("tiered-warm", warm),
+                          ("restart-warm", rewarm)):
+            bad = [r.request_id for r, ref in zip(got, base)
+                   if any(getattr(r, f, None) != getattr(ref, f, None)
+                          for f in PAYLOAD_FIELDS)]
+            if bad:
+                failures.append(f"{name} payloads differ from the "
+                                f"untiered baseline: requests {bad}")
+
+        if failures:
+            for f in failures:
+                print(f"TIERED-SMOKE FAIL: {f}")
+            return 1
+        print(json.dumps({"tiered_smoke": "ok",
+                          "live": summary_live,
+                          "restart": summary_restart}, indent=2))
+        print(f"tiered smoke: OK ({3 * N_REQUESTS} tiered requests over "
+              f"{N_BASES} shared bases, "
+              f"{summary_live['pages_demoted']} pages demoted, "
+              f"{summary_live['pages_promoted']} promoted, "
+              f"{reseeded} re-seeded after restart, "
+              f"{hit_tokens} prefill tokens avoided restart-warm, "
+              f"tiered == untiered bitwise)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
